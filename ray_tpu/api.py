@@ -1,0 +1,201 @@
+"""Top-level public API: init/shutdown/get/put/wait/remote/kill.
+
+Equivalent of ray ``python/ray/_private/worker.py`` public functions
+(``ray.init:1406``, ``ray.get:2819``, ``ray.put:3002``, ``ray.wait:3073``,
+``ray.kill:3253``, ``ray.get_actor:3218``).
+"""
+
+from __future__ import annotations
+
+import atexit
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from .core import node as node_mod
+from .core.api_frontend import ActorClass, ActorHandle, RemoteFunction, remote  # noqa: F401
+from .core.config import GlobalConfig
+from .core.core_worker import CoreWorker, global_worker, set_global_worker, try_global_worker
+from .core.exceptions import *  # noqa: F401,F403
+from .core.ids import JobID, NodeID
+from .core.placement import (  # noqa: F401
+    PlacementGroup,
+    SlicePlacementGroup,
+    placement_group,
+    placement_group_strategy,
+    remove_placement_group,
+)
+from .core.task_spec import ObjectRef  # noqa: F401
+
+_local_node: Optional[node_mod.Node] = None
+
+
+def is_initialized() -> bool:
+    return try_global_worker() is not None
+
+
+def init(
+    address: Optional[str] = None,
+    *,
+    num_cpus: Optional[float] = None,
+    resources: Optional[Dict[str, float]] = None,
+    labels: Optional[Dict[str, str]] = None,
+    _system_config: Optional[Dict[str, Any]] = None,
+) -> "ClientContext":
+    """Start a local cluster (head) or connect to an existing one.
+
+    ``address``: None → start head locally; "auto" → discover local head;
+    "host:port" → connect to that control plane (starts a local node agent
+    for this machine if none is known).
+    """
+    global _local_node
+    if is_initialized():
+        return ClientContext(global_worker())
+    if _system_config:
+        GlobalConfig.override(**_system_config)
+
+    if address in (None, "local"):
+        node = node_mod.Node(
+            head=True, resources=resources, labels=labels, num_cpus=num_cpus
+        )
+        node.start()
+        _local_node = node
+        cp_address = node.cp_address
+        agent_address = node.agent_address
+        session_id = node.session_id
+    else:
+        if address == "auto":
+            info = node_mod.read_head_info()
+            if info is None:
+                raise ConnectionError("no local head found (address='auto')")
+            cp_address = info["cp_address"]
+            session_id = info["session_id"]
+        else:
+            cp_address = address
+            info = node_mod.read_head_info()
+            session_id = info["session_id"] if info else "remote"
+        node = node_mod.Node(
+            head=False,
+            cp_address=cp_address,
+            resources=resources,
+            labels=labels,
+            session_id=session_id,
+            num_cpus=num_cpus,
+        )
+        node.start()
+        _local_node = node
+        agent_address = node.agent_address
+
+    worker = CoreWorker(
+        CoreWorker.DRIVER,
+        cp_address,
+        agent_address,
+        session_id,
+        NodeID.from_random(),
+        job_id=JobID.from_random(),
+    )
+    worker.start_threaded()
+    set_global_worker(worker)
+    atexit.register(shutdown)
+    return ClientContext(worker)
+
+
+def shutdown():
+    global _local_node
+    worker = try_global_worker()
+    if worker is not None:
+        worker.shutdown()
+        set_global_worker(None)
+    if _local_node is not None:
+        _local_node.stop()
+        _local_node = None
+
+
+class ClientContext:
+    def __init__(self, worker: CoreWorker):
+        self.worker = worker
+
+    @property
+    def address_info(self) -> dict:
+        return {
+            "cp_address": self.worker.cp_address,
+            "agent_address": self.worker.agent_address,
+            "session_id": self.worker.session_id,
+        }
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        shutdown()
+
+
+def get(
+    refs: Union[ObjectRef, Sequence[ObjectRef]],
+    *,
+    timeout: Optional[float] = None,
+):
+    return global_worker().get(refs, timeout=timeout)
+
+
+def put(value: Any) -> ObjectRef:
+    return global_worker().put(value)
+
+
+def wait(
+    refs: List[ObjectRef],
+    *,
+    num_returns: int = 1,
+    timeout: Optional[float] = None,
+):
+    if isinstance(refs, ObjectRef):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    return global_worker().wait(refs, num_returns=num_returns, timeout=timeout)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True):
+    global_worker().kill_actor(actor._actor_id, no_restart=no_restart)
+
+
+def get_actor(name: str, namespace: str = "") -> ActorHandle:
+    info = global_worker().get_actor_by_name(name, namespace)
+    if info is None or info["state"] == "DEAD":
+        raise ValueError(f"actor {name!r} not found in namespace {namespace!r}")
+    return ActorHandle(info["actor_id"])
+
+
+def cluster_resources() -> Dict[str, float]:
+    worker = global_worker()
+    view = worker._run_sync(worker.cp.call("get_cluster_view"))
+    total: Dict[str, float] = {}
+    for info in view["nodes"].values():
+        for k, v in info["snapshot"]["total"].items():
+            total[k] = total.get(k, 0) + v
+    return total
+
+
+def available_resources() -> Dict[str, float]:
+    worker = global_worker()
+    view = worker._run_sync(worker.cp.call("get_cluster_view"))
+    total: Dict[str, float] = {}
+    for info in view["nodes"].values():
+        for k, v in info["snapshot"]["available"].items():
+            total[k] = total.get(k, 0) + v
+    return total
+
+
+def nodes() -> List[dict]:
+    worker = global_worker()
+    view = worker._run_sync(worker.cp.call("get_cluster_view"))
+    return [
+        {"node_id": nid.hex(), **info} for nid, info in view["nodes"].items()
+    ]
+
+
+def state_summary() -> dict:
+    """Cluster state snapshot (ray.util.state analog)."""
+    worker = global_worker()
+    return worker._run_sync(worker.cp.call("get_state"))
+
+
+def timeline_stats() -> dict:
+    worker = global_worker()
+    return worker._run_sync(worker.agent.call("debug_state"))

@@ -1,0 +1,45 @@
+"""Collective types (reference: ray ``python/ray/util/collective/types.py``).
+
+Backends: the reference exposes {NCCL, GLOO}; here the native backend is XLA —
+collectives lower to ``jax.lax.psum``/``all_gather``/``psum_scatter``/
+``all_to_all``/``ppermute`` over ICI within a slice (DCN across slices), and
+a LOCAL backend runs the same ops over this process's local devices (used for
+single-host groups and CPU-mesh tests).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from enum import Enum
+
+
+class Backend(str, Enum):
+    XLA = "xla"  # multi-host jax.distributed group
+    LOCAL = "local"  # this process's devices only (single-controller)
+
+    @classmethod
+    def normalize(cls, value) -> "Backend":
+        if isinstance(value, cls):
+            return value
+        v = str(value).lower()
+        if v in ("xla", "tpu", "ici"):
+            return cls.XLA
+        if v in ("local", "cpu", "host"):
+            return cls.LOCAL
+        raise ValueError(f"unknown collective backend {value!r}")
+
+
+class ReduceOp(str, Enum):
+    SUM = "sum"
+    PRODUCT = "product"
+    MAX = "max"
+    MIN = "min"
+    MEAN = "mean"
+
+
+@dataclass
+class GroupInfo:
+    group_name: str
+    world_size: int
+    rank: int
+    backend: Backend

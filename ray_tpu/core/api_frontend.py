@@ -1,0 +1,201 @@
+"""Public API frontends: @remote functions, actor classes, handles.
+
+Equivalent of the reference's ``remote_function.py`` + ``actor.py`` (ray
+``python/ray/remote_function.py:41``, ``python/ray/actor.py:1190``): thin
+declarative wrappers that translate ``.remote()`` / ``.options()`` calls into
+core-worker submissions.  Resource options are TPU-first: ``num_tpus=`` is a
+first-class option next to ``num_cpus=``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+from .core_worker import global_worker
+from .ids import ActorID
+from .scheduler import (
+    NodeAffinityStrategy,
+    NodeLabelStrategy,
+    PlacementGroupStrategy,
+    SpreadStrategy,
+)
+from .task_spec import ObjectRef
+
+
+def _normalize_options(opts: Dict[str, Any]) -> Dict[str, Any]:
+    resources = dict(opts.get("resources") or {})
+    if "num_cpus" in opts and opts["num_cpus"] is not None:
+        resources["CPU"] = float(opts["num_cpus"])
+    if "num_tpus" in opts and opts["num_tpus"] is not None:
+        resources["TPU"] = float(opts["num_tpus"])
+    if "num_gpus" in opts and opts["num_gpus"] is not None:
+        resources["GPU"] = float(opts["num_gpus"])
+    # Tasks and actors both default to one CPU slot (actors hold it for
+    # their lifetime; declare num_cpus=0 for pure-TPU actors).
+    resources.setdefault("CPU", 1.0)
+    strategy = opts.get("scheduling_strategy")
+    pg_id = None
+    bundle_index = -1
+    if isinstance(strategy, PlacementGroupStrategy):
+        from .ids import PlacementGroupID
+
+        pg_id = PlacementGroupID.from_hex(strategy.pg_id_hex)
+        bundle_index = strategy.bundle_index
+        strategy = None
+    elif strategy == "SPREAD":
+        strategy = SpreadStrategy()
+    elif strategy == "DEFAULT" or strategy is None:
+        strategy = None
+    out = {
+        "resources": resources,
+        "strategy": strategy,
+        "placement_group_id": pg_id,
+        "bundle_index": bundle_index,
+        "env_vars": (opts.get("runtime_env") or {}).get("env_vars", {}),
+    }
+    return out
+
+
+class RemoteFunction:
+    def __init__(self, fn, default_opts: Optional[dict] = None):
+        self._fn = fn
+        self._opts = default_opts or {}
+        self._function_id = None  # cached per-process export
+        functools.update_wrapper(self, fn)
+
+    def options(self, **opts) -> "RemoteFunction":
+        merged = dict(self._opts)
+        merged.update(opts)
+        rf = RemoteFunction(self._fn, merged)
+        rf._function_id = self._function_id
+        return rf
+
+    def remote(self, *args, **kwargs):
+        worker = global_worker()
+        if self._function_id is None:
+            self._function_id = worker._export_function(self._fn)
+        norm = _normalize_options(self._opts)
+        refs = worker.submit_task(
+            self._fn,
+            args,
+            kwargs,
+            name=self._opts.get("name") or self._fn.__name__,
+            num_returns=self._opts.get("num_returns", 1),
+            resources=norm["resources"],
+            strategy=norm["strategy"],
+            max_retries=self._opts.get(
+                "max_retries", 0
+            ),
+            placement_group_id=norm["placement_group_id"],
+            bundle_index=norm["bundle_index"],
+            env_vars=norm["env_vars"],
+            function_id=self._function_id,
+        )
+        if self._opts.get("num_returns", 1) == 1:
+            return refs[0]
+        return refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function {self._fn.__name__!r} cannot be called directly; "
+            f"use {self._fn.__name__}.remote()"
+        )
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", method_name: str, num_returns: int = 1):
+        self._handle = handle
+        self._method_name = method_name
+        self._num_returns = num_returns
+
+    def options(self, num_returns: int = 1) -> "ActorMethod":
+        return ActorMethod(self._handle, self._method_name, num_returns)
+
+    def remote(self, *args, **kwargs):
+        worker = global_worker()
+        refs = worker.submit_actor_task(
+            self._handle._actor_id,
+            self._method_name,
+            args,
+            kwargs,
+            num_returns=self._num_returns,
+        )
+        return refs[0] if self._num_returns == 1 else refs
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID):
+        self._actor_id = actor_id
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def __repr__(self):
+        return f"ActorHandle({self._actor_id.hex()[:12]})"
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id,))
+
+
+class ActorClass:
+    def __init__(self, cls, default_opts: Optional[dict] = None):
+        self._cls = cls
+        self._opts = default_opts or {}
+
+    def options(self, **opts) -> "ActorClass":
+        merged = dict(self._opts)
+        merged.update(opts)
+        return ActorClass(self._cls, merged)
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        worker = global_worker()
+        opts = dict(self._opts)
+        opts["_actor"] = True
+        norm = _normalize_options(opts)
+        actor_id, _spec = worker.create_actor(
+            self._cls,
+            args,
+            kwargs,
+            name=opts.get("name"),
+            namespace=opts.get("namespace", ""),
+            resources=norm["resources"],
+            max_restarts=opts.get("max_restarts", 0),
+            max_task_retries=opts.get("max_task_retries", 0),
+            max_concurrency=opts.get("max_concurrency", 1),
+            strategy=norm["strategy"],
+            placement_group_id=norm["placement_group_id"],
+            bundle_index=norm["bundle_index"],
+            env_vars=norm["env_vars"],
+            detached=opts.get("lifetime") == "detached",
+            get_if_exists=opts.get("get_if_exists", False),
+        )
+        return ActorHandle(actor_id)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class {self._cls.__name__!r} cannot be instantiated directly; "
+            f"use {self._cls.__name__}.remote()"
+        )
+
+
+def remote(*args, **kwargs):
+    """``@remote`` decorator for functions and classes, with options:
+    ``@remote(num_cpus=2, num_tpus=4, max_retries=3, ...)``."""
+
+    def decorate(obj, opts):
+        if isinstance(obj, type):
+            return ActorClass(obj, opts)
+        return RemoteFunction(obj, opts)
+
+    if len(args) == 1 and not kwargs and (callable(args[0]) or isinstance(args[0], type)):
+        return decorate(args[0], {})
+    if args:
+        raise TypeError("@remote takes keyword options only, e.g. @remote(num_tpus=1)")
+
+    def wrapper(obj):
+        return decorate(obj, dict(kwargs))
+
+    return wrapper

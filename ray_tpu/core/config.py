@@ -1,0 +1,106 @@
+"""Typed, env-var-overridable configuration knobs.
+
+Equivalent of the reference's ``RAY_CONFIG(type, name, default)`` macro table
+(Ray ``src/ray/common/ray_config_def.h``, overridden via ``RAY_<name>`` env
+vars).  Here each knob is declared once in ``_KNOBS`` and can be overridden by
+``RAY_TPU_<name>`` in the environment or programmatically via
+``Config.override`` (the analog of the driver-shipped ``_system_config``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict
+
+_ENV_PREFIX = "RAY_TPU_"
+
+
+def _parse(typ, raw: str):
+    if typ is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    if typ in (dict, list):
+        return json.loads(raw)
+    return typ(raw)
+
+
+# name -> (type, default, doc)
+_KNOBS: Dict[str, tuple] = {
+    # -- RPC layer --
+    "rpc_connect_timeout_s": (float, 10.0, "TCP connect timeout"),
+    "rpc_call_timeout_s": (float, 60.0, "Default RPC deadline"),
+    "rpc_retry_base_delay_s": (float, 0.05, "Exponential backoff base"),
+    "rpc_retry_max_delay_s": (float, 2.0, "Backoff cap"),
+    "rpc_max_retries": (int, 8, "Retryable RPC attempts"),
+    "testing_rpc_failure": (str, "", "Chaos spec: 'method:prob_req:prob_resp,…'"),
+    # -- control plane --
+    "health_check_period_s": (float, 1.0, "Agent heartbeat period"),
+    "health_check_timeout_s": (float, 10.0, "Mark node dead after this long"),
+    "resource_sync_period_s": (float, 0.2, "Resource view gossip period"),
+    # -- scheduling --
+    "scheduler_spread_threshold": (float, 0.5, "Pack until this utilization, then spread"),
+    "scheduler_top_k_fraction": (float, 0.2, "Top-k random choice fraction"),
+    "lease_idle_timeout_s": (float, 0.3, "Return idle leased worker after"),
+    "worker_startup_timeout_s": (float, 60.0, "Worker process start deadline"),
+    "max_tasks_in_flight_per_worker": (int, 10, "Pipelined pushes per leased worker"),
+    # -- object store --
+    "max_inline_object_bytes": (int, 100 * 1024, "Inline small objects in RPCs"),
+    "object_store_memory_bytes": (int, 2 * 1024**30 if False else 2 * 1024**3, "Per-node shm budget"),
+    "object_chunk_bytes": (int, 5 * 1024 * 1024, "Chunk size for node-to-node transfer"),
+    "memory_store_fallback_bytes": (int, 512 * 1024 * 1024, "In-process store budget"),
+    # -- workers --
+    "num_workers_soft_limit": (int, 0, "0 = num_cpus"),
+    "worker_niceness": (int, 0, "Nice level for spawned workers"),
+    "prestart_workers": (int, 0, "Workers to pre-start per node"),
+    # -- fault tolerance --
+    "task_max_retries_default": (int, 3, "Default retries for idempotent tasks"),
+    "actor_max_restarts_default": (int, 0, "Default actor restarts"),
+    # -- TPU --
+    "tpu_visible_chips_env": (str, "TPU_VISIBLE_CHIPS", "Env var used for chip isolation"),
+    # -- logging --
+    "log_level": (str, "INFO", "Python log level for system processes"),
+    "session_dir": (str, "", "Session directory (default: /tmp/ray_tpu/session_*)"),
+    "event_stats_print_period_s": (float, 0.0, "0 disables periodic handler-latency dumps"),
+}
+
+
+class Config:
+    """Process-wide configuration singleton."""
+
+    def __init__(self):
+        self._overrides: Dict[str, Any] = {}
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        try:
+            typ, default, _doc = _KNOBS[name]
+        except KeyError:
+            raise AttributeError(f"unknown config knob {name!r}") from None
+        if name in self._overrides:
+            return self._overrides[name]
+        raw = os.environ.get(_ENV_PREFIX + name)
+        if raw is not None:
+            return _parse(typ, raw)
+        return default
+
+    def override(self, **kwargs):
+        for k, v in kwargs.items():
+            if k not in _KNOBS:
+                raise ValueError(f"unknown config knob {k!r}")
+            self._overrides[k] = v
+
+    def overrides_as_env(self) -> Dict[str, str]:
+        """Serialize programmatic overrides as env vars to ship to child
+        processes (the analog of passing _system_config through argv)."""
+        env = {}
+        for k, v in self._overrides.items():
+            typ = _KNOBS[k][0]
+            env[_ENV_PREFIX + k] = json.dumps(v) if typ in (dict, list) else str(v)
+        return env
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in _KNOBS}
+
+
+GlobalConfig = Config()

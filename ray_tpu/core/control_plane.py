@@ -1,0 +1,556 @@
+"""Cluster control plane — the GCS equivalent.
+
+One process per cluster (Ray ``src/ray/gcs/gcs_server.h``).  Owns:
+  - node table + health checking (GcsNodeManager / GcsHealthCheckManager)
+  - cluster-wide KV store (InternalKV) — function exports, named actors, user KV
+  - actor directory + scheduling + restart FT (GcsActorManager/Scheduler)
+  - placement groups with two-phase Prepare/Commit across node agents
+    (GcsPlacementGroupManager/Scheduler)
+  - job table
+  - pubsub of node/actor state changes (long-poll-free: server-push over the
+    subscriber's existing connection, Ray ``src/ray/pubsub/``)
+  - the authoritative eventually-consistent resource view (ray_syncer analog:
+    agents push snapshots on every heartbeat).
+
+Storage is in-memory (the reference's default); a Redis-backed StoreClient
+can be slotted behind ``_kv`` later for control-plane HA.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import time
+from typing import Dict, List, Optional, Set
+
+from .config import GlobalConfig
+from .ids import ActorID, JobID, NodeID, PlacementGroupID
+from .resources import ResourceSet
+from .rpc import ClientPool, RpcServer, ServerConnection
+from .scheduler import ClusterScheduler, InfeasibleError
+from .task_spec import ActorSpec
+
+logger = logging.getLogger(__name__)
+
+# Actor lifecycle states (reference: rpc::ActorTableData::ActorState).
+PENDING_CREATION = "PENDING_CREATION"
+ALIVE = "ALIVE"
+RESTARTING = "RESTARTING"
+DEAD = "DEAD"
+
+
+class NodeEntry:
+    def __init__(self, node_id: NodeID, agent_address: str, snapshot: dict):
+        self.node_id = node_id
+        self.agent_address = agent_address
+        self.snapshot = snapshot
+        self.last_heartbeat = time.monotonic()
+        self.alive = True
+
+
+class ActorEntry:
+    def __init__(self, spec: ActorSpec):
+        self.spec = spec
+        self.state = PENDING_CREATION
+        self.address: Optional[str] = None  # worker RPC address
+        self.node_id: Optional[NodeID] = None
+        self.num_restarts = 0
+        self.incarnation = 0
+        self.death_cause: Optional[str] = None
+
+    def public_info(self) -> dict:
+        return {
+            "actor_id": self.spec.actor_id,
+            "state": self.state,
+            "address": self.address,
+            "incarnation": self.incarnation,
+            "name": self.spec.name,
+            "death_cause": self.death_cause,
+            "max_task_retries": self.spec.max_task_retries,
+        }
+
+
+class PlacementGroupEntry:
+    def __init__(self, pg_id, bundles: List[dict], strategy: str, name: str):
+        self.pg_id = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+        self.name = name
+        self.state = "PENDING"  # PENDING | CREATED | REMOVED
+        self.bundle_nodes: Optional[List[NodeID]] = None
+
+    def public_info(self) -> dict:
+        return {
+            "pg_id": self.pg_id,
+            "state": self.state,
+            "bundles": self.bundles,
+            "strategy": self.strategy,
+            "bundle_nodes": [n.hex() if n else None for n in (self.bundle_nodes or [])],
+        }
+
+
+class ControlPlane:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, session_id: str = ""):
+        self.session_id = session_id
+        self.server = RpcServer(self, host, port)
+        self.scheduler = ClusterScheduler()
+        self.nodes: Dict[NodeID, NodeEntry] = {}
+        self.agent_clients = ClientPool()
+        self._kv: Dict[str, Dict[str, bytes]] = {}  # namespace -> key -> value
+        self.actors: Dict[ActorID, ActorEntry] = {}
+        self.named_actors: Dict[tuple, ActorID] = {}  # (namespace, name) -> id
+        self.placement_groups: Dict[PlacementGroupID, PlacementGroupEntry] = {}
+        self.jobs: Dict[JobID, dict] = {}
+        # pubsub: channel -> set of subscriber connections
+        self._subs: Dict[str, Set[ServerConnection]] = {}
+        self._pending_actors: List[ActorID] = []
+        self._pending_pgs: List[PlacementGroupID] = []
+        self._bg_tasks: List[asyncio.Task] = []
+
+    # ------------------------------------------------------------- lifecycle
+    async def start(self) -> str:
+        addr = await self.server.start()
+        loop = asyncio.get_running_loop()
+        self._bg_tasks.append(loop.create_task(self._health_check_loop()))
+        logger.info("control plane listening on %s", addr)
+        return addr
+
+    async def stop(self):
+        for t in self._bg_tasks:
+            t.cancel()
+        await self.server.stop()
+        await self.agent_clients.close_all()
+
+    # ---------------------------------------------------------------- pubsub
+    def _publish(self, channel: str, message: dict):
+        dead = []
+        for conn in self._subs.get(channel, ()):  # copy not needed; no await
+            task = asyncio.get_running_loop().create_task(
+                conn.push("pub", {"channel": channel, "message": message})
+            )
+            task.add_done_callback(lambda t: t.exception())  # swallow
+        _ = dead
+
+    def handle_subscribe(self, payload, conn: ServerConnection):
+        for channel in payload["channels"]:
+            self._subs.setdefault(channel, set()).add(conn)
+        conn.metadata.setdefault("channels", set()).update(payload["channels"])
+        return True
+
+    def handle_unsubscribe(self, payload, conn: ServerConnection):
+        for channel in payload["channels"]:
+            self._subs.get(channel, set()).discard(conn)
+        return True
+
+    def on_connection_closed(self, conn: ServerConnection):
+        for channel in conn.metadata.get("channels", ()):
+            self._subs.get(channel, set()).discard(conn)
+        # Driver connection death ⇒ its non-detached job is finished.
+        job_id = conn.metadata.get("job_id")
+        if job_id is not None and job_id in self.jobs:
+            self.jobs[job_id]["state"] = "FINISHED"
+            asyncio.get_running_loop().create_task(self._cleanup_job(job_id))
+
+    async def _cleanup_job(self, job_id: JobID):
+        """Kill the job's non-detached actors."""
+        for actor_id, entry in list(self.actors.items()):
+            if entry.spec.job_id == job_id and not entry.spec.detached:
+                await self._kill_actor_entry(entry, "job finished")
+
+    # ----------------------------------------------------------------- nodes
+    def handle_register_node(self, payload, conn):
+        node_id = payload["node_id"]
+        entry = NodeEntry(node_id, payload["agent_address"], payload["snapshot"])
+        self.nodes[node_id] = entry
+        self.scheduler.update_node(node_id, payload["snapshot"])
+        logger.info(
+            "node %s registered (%s) resources=%s",
+            node_id.hex()[:8],
+            payload["agent_address"],
+            payload["snapshot"]["total"],
+        )
+        self._publish("nodes", {"event": "added", "node_id": node_id})
+        self._kick_pending()
+        return {"ok": True, "session_id": self.session_id}
+
+    def handle_heartbeat(self, payload, conn):
+        node_id = payload["node_id"]
+        entry = self.nodes.get(node_id)
+        if entry is None:
+            return {"ok": False, "reregister": True}
+        entry.last_heartbeat = time.monotonic()
+        entry.snapshot = payload["snapshot"]
+        self.scheduler.update_node(node_id, payload["snapshot"])
+        self._kick_pending()
+        return {"ok": True}
+
+    def handle_get_cluster_view(self, payload, conn):
+        return {
+            "nodes": {
+                nid: {
+                    "agent_address": e.agent_address,
+                    "snapshot": e.snapshot,
+                    "alive": e.alive,
+                }
+                for nid, e in self.nodes.items()
+                if e.alive
+            }
+        }
+
+    async def _health_check_loop(self):
+        period = GlobalConfig.health_check_period_s
+        timeout = GlobalConfig.health_check_timeout_s
+        while True:
+            await asyncio.sleep(period)
+            now = time.monotonic()
+            for node_id, entry in list(self.nodes.items()):
+                if entry.alive and now - entry.last_heartbeat > timeout:
+                    await self._on_node_dead(node_id)
+
+    async def _on_node_dead(self, node_id: NodeID):
+        entry = self.nodes.get(node_id)
+        if entry is None or not entry.alive:
+            return
+        entry.alive = False
+        self.scheduler.remove_node(node_id)
+        logger.warning("node %s marked dead", node_id.hex()[:8])
+        self._publish("nodes", {"event": "removed", "node_id": node_id})
+        # Fail or restart actors that lived there.
+        for actor_id, a in list(self.actors.items()):
+            if a.node_id == node_id and a.state == ALIVE:
+                await self._on_actor_worker_died(actor_id, "node died")
+
+    # -------------------------------------------------------------------- kv
+    def handle_kv_put(self, payload, conn):
+        ns = self._kv.setdefault(payload.get("namespace", ""), {})
+        overwrite = payload.get("overwrite", True)
+        if not overwrite and payload["key"] in ns:
+            return False
+        ns[payload["key"]] = payload["value"]
+        return True
+
+    def handle_kv_get(self, payload, conn):
+        return self._kv.get(payload.get("namespace", ""), {}).get(payload["key"])
+
+    def handle_kv_del(self, payload, conn):
+        ns = self._kv.get(payload.get("namespace", ""), {})
+        return ns.pop(payload["key"], None) is not None
+
+    def handle_kv_keys(self, payload, conn):
+        ns = self._kv.get(payload.get("namespace", ""), {})
+        prefix = payload.get("prefix", "")
+        return [k for k in ns if k.startswith(prefix)]
+
+    def handle_kv_exists(self, payload, conn):
+        return payload["key"] in self._kv.get(payload.get("namespace", ""), {})
+
+    # ------------------------------------------------------------------ jobs
+    def handle_register_job(self, payload, conn):
+        job_id = payload["job_id"]
+        self.jobs[job_id] = {
+            "state": "RUNNING",
+            "driver_address": payload.get("driver_address"),
+            "start_time": time.time(),
+        }
+        conn.metadata["job_id"] = job_id
+        return {"ok": True, "session_id": self.session_id}
+
+    def handle_list_jobs(self, payload, conn):
+        return {jid: dict(info) for jid, info in self.jobs.items()}
+
+    # ---------------------------------------------------------------- actors
+    async def handle_register_actor(self, payload, conn):
+        spec: ActorSpec = payload["spec"]
+        if spec.name is not None:
+            key = (spec.namespace, spec.name)
+            if key in self.named_actors:
+                existing = self.actors.get(self.named_actors[key])
+                if existing is not None and existing.state != DEAD:
+                    if payload.get("get_if_exists"):
+                        return existing.public_info()
+                    raise ValueError(
+                        f"actor name {spec.name!r} already taken in "
+                        f"namespace {spec.namespace!r}"
+                    )
+            self.named_actors[key] = spec.actor_id
+        entry = ActorEntry(spec)
+        self.actors[spec.actor_id] = entry
+        await self._try_schedule_actor(entry)
+        return entry.public_info()
+
+    async def _try_schedule_actor(self, entry: ActorEntry):
+        spec = entry.spec
+        try:
+            node_id = self.scheduler.pick_node(
+                ResourceSet(spec.resources), spec.strategy
+            )
+        except InfeasibleError as e:
+            entry.state = DEAD
+            entry.death_cause = str(e)
+            self._publish_actor(entry)
+            return
+        if node_id is None:
+            if spec.actor_id not in self._pending_actors:
+                self._pending_actors.append(spec.actor_id)
+            return
+        node = self.nodes[node_id]
+        client = self.agent_clients.get(node.agent_address)
+        try:
+            reply = await client.call(
+                "create_actor_worker",
+                {"spec": spec, "incarnation": entry.incarnation},
+                timeout=GlobalConfig.worker_startup_timeout_s,
+            )
+        except Exception as e:  # noqa: BLE001
+            logger.warning("actor %s creation on node failed: %s", spec.actor_id, e)
+            if spec.actor_id not in self._pending_actors:
+                self._pending_actors.append(spec.actor_id)
+            return
+        entry.node_id = node_id
+        entry.address = reply["worker_address"]
+        entry.state = ALIVE
+        self._publish_actor(entry)
+
+    def _publish_actor(self, entry: ActorEntry):
+        self._publish("actor:" + entry.spec.actor_id.hex(), entry.public_info())
+
+    def handle_get_actor_info(self, payload, conn):
+        entry = self.actors.get(payload["actor_id"])
+        if entry is None:
+            return None
+        return entry.public_info()
+
+    def handle_get_named_actor(self, payload, conn):
+        key = (payload.get("namespace", ""), payload["name"])
+        actor_id = self.named_actors.get(key)
+        if actor_id is None:
+            return None
+        entry = self.actors[actor_id]
+        info = entry.public_info()
+        info["spec"] = entry.spec
+        return info
+
+    def handle_list_actors(self, payload, conn):
+        return [e.public_info() for e in self.actors.values()]
+
+    async def handle_actor_worker_died(self, payload, conn):
+        await self._on_actor_worker_died(
+            payload["actor_id"], payload.get("cause", "worker died")
+        )
+        return True
+
+    async def _on_actor_worker_died(self, actor_id: ActorID, cause: str):
+        entry = self.actors.get(actor_id)
+        if entry is None or entry.state == DEAD:
+            return
+        restarts_allowed = (
+            entry.spec.max_restarts == -1
+            or entry.num_restarts < entry.spec.max_restarts
+        )
+        if restarts_allowed:
+            entry.num_restarts += 1
+            entry.incarnation += 1
+            entry.state = RESTARTING
+            entry.address = None
+            self._publish_actor(entry)
+            await self._try_schedule_actor(entry)
+        else:
+            entry.state = DEAD
+            entry.death_cause = cause
+            entry.address = None
+            self._publish_actor(entry)
+
+    async def handle_kill_actor(self, payload, conn):
+        entry = self.actors.get(payload["actor_id"])
+        if entry is None:
+            return False
+        if payload.get("no_restart", True):
+            await self._kill_actor_entry(entry, "ray_tpu.kill")
+        else:
+            # Kill only the worker process; the death path restarts the
+            # actor if restarts remain.
+            await self._kill_actor_worker(entry)
+            await self._on_actor_worker_died(
+                entry.spec.actor_id, "ray_tpu.kill(no_restart=False)"
+            )
+        return True
+
+    async def _kill_actor_worker(self, entry: ActorEntry):
+        if entry.node_id is not None and entry.address is not None:
+            node = self.nodes.get(entry.node_id)
+            if node is not None and node.alive:
+                client = self.agent_clients.get(node.agent_address)
+                try:
+                    await client.call(
+                        "kill_worker", {"worker_address": entry.address}, retries=1
+                    )
+                except Exception:
+                    pass
+
+    async def _kill_actor_entry(self, entry: ActorEntry, cause: str):
+        await self._kill_actor_worker(entry)
+        entry.state = DEAD
+        entry.death_cause = cause
+        entry.address = None
+        self._publish_actor(entry)
+
+    # ------------------------------------------------------- placement groups
+    async def handle_create_placement_group(self, payload, conn):
+        pg_id = payload["pg_id"]
+        entry = PlacementGroupEntry(
+            pg_id, payload["bundles"], payload["strategy"], payload.get("name", "")
+        )
+        self.placement_groups[pg_id] = entry
+        await self._try_schedule_pg(entry)
+        return entry.public_info()
+
+    async def _try_schedule_pg(self, entry: PlacementGroupEntry):
+        bundles = [ResourceSet(b) for b in entry.bundles]
+        assignment = self.scheduler.pick_nodes_for_bundles(bundles, entry.strategy)
+        if assignment is None:
+            if entry.pg_id not in self._pending_pgs:
+                self._pending_pgs.append(entry.pg_id)
+            return
+        # Phase 1: prepare on each involved agent.
+        by_node: Dict[NodeID, List[int]] = {}
+        for idx, nid in enumerate(assignment):
+            by_node.setdefault(nid, []).append(idx)
+        prepared: List[NodeID] = []
+        ok = True
+        for nid, idxs in by_node.items():
+            client = self.agent_clients.get(self.nodes[nid].agent_address)
+            try:
+                res = await client.call(
+                    "prepare_bundles",
+                    {
+                        "pg_id": entry.pg_id,
+                        "bundles": {i: entry.bundles[i] for i in idxs},
+                    },
+                )
+                if not res["ok"]:
+                    ok = False
+                    break
+                prepared.append(nid)
+            except Exception:
+                ok = False
+                break
+        if not ok:
+            for nid in prepared:
+                client = self.agent_clients.get(self.nodes[nid].agent_address)
+                try:
+                    await client.call("cancel_bundles", {"pg_id": entry.pg_id})
+                except Exception:
+                    pass
+            if entry.pg_id not in self._pending_pgs:
+                self._pending_pgs.append(entry.pg_id)
+            return
+        # Phase 2: commit.
+        for nid in by_node:
+            client = self.agent_clients.get(self.nodes[nid].agent_address)
+            await client.call("commit_bundles", {"pg_id": entry.pg_id})
+        entry.bundle_nodes = list(assignment)
+        entry.state = "CREATED"
+        self._publish("pg:" + entry.pg_id.hex(), entry.public_info())
+
+    async def handle_remove_placement_group(self, payload, conn):
+        entry = self.placement_groups.get(payload["pg_id"])
+        if entry is None:
+            return False
+        if entry.bundle_nodes:
+            for nid in set(entry.bundle_nodes):
+                node = self.nodes.get(nid)
+                if node is None or not node.alive:
+                    continue
+                client = self.agent_clients.get(node.agent_address)
+                try:
+                    await client.call("return_bundles", {"pg_id": entry.pg_id})
+                except Exception:
+                    pass
+        entry.state = "REMOVED"
+        if payload["pg_id"] in self._pending_pgs:
+            self._pending_pgs.remove(payload["pg_id"])
+        self._publish("pg:" + entry.pg_id.hex(), entry.public_info())
+        return True
+
+    def handle_get_placement_group(self, payload, conn):
+        entry = self.placement_groups.get(payload["pg_id"])
+        return entry.public_info() if entry else None
+
+    def handle_list_placement_groups(self, payload, conn):
+        return [e.public_info() for e in self.placement_groups.values()]
+
+    # ------------------------------------------------------- pending retries
+    def _kick_pending(self):
+        if self._pending_actors or self._pending_pgs:
+            asyncio.get_running_loop().create_task(self._drain_pending())
+
+    async def _drain_pending(self):
+        pending_actors, self._pending_actors = self._pending_actors, []
+        for actor_id in pending_actors:
+            entry = self.actors.get(actor_id)
+            if entry is not None and entry.state in (PENDING_CREATION, RESTARTING):
+                await self._try_schedule_actor(entry)
+        pending_pgs, self._pending_pgs = self._pending_pgs, []
+        for pg_id in pending_pgs:
+            entry = self.placement_groups.get(pg_id)
+            if entry is not None and entry.state == "PENDING":
+                await self._try_schedule_pg(entry)
+
+    # -------------------------------------------------------------- lookups
+    def handle_pick_node_for_lease(self, payload, conn):
+        """Spillback target selection for agents that can't fit a lease."""
+        try:
+            node_id = self.scheduler.pick_node(
+                ResourceSet(payload["resources"]),
+                payload.get("strategy"),
+                preferred=payload.get("preferred"),
+            )
+        except InfeasibleError as e:
+            return {"infeasible": True, "error": str(e)}
+        if node_id is None:
+            return {"node_id": None}
+        return {
+            "node_id": node_id,
+            "agent_address": self.nodes[node_id].agent_address,
+        }
+
+    def handle_ping(self, payload, conn):
+        return "pong"
+
+    def handle_get_state(self, payload, conn):
+        """State-API snapshot (reference: ray.util.state / StateAggregator)."""
+        return {
+            "nodes": {
+                nid.hex(): {"alive": e.alive, "snapshot": e.snapshot}
+                for nid, e in self.nodes.items()
+            },
+            "actors": [e.public_info() for e in self.actors.values()],
+            "placement_groups": [
+                e.public_info() for e in self.placement_groups.values()
+            ],
+            "jobs": {jid.hex(): dict(j) for jid, j in self.jobs.items()},
+        }
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, required=True)
+    parser.add_argument("--session-id", required=True)
+    args = parser.parse_args()
+    logging.basicConfig(
+        level=GlobalConfig.log_level,
+        format="%(asctime)s %(levelname)s control_plane: %(message)s",
+    )
+
+    async def run():
+        cp = ControlPlane(args.host, args.port, args.session_id)
+        await cp.start()
+        await asyncio.Event().wait()  # serve forever
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,1147 @@
+"""The per-process worker runtime — core-worker equivalent.
+
+Embedded in every driver and worker process (Ray
+``src/ray/core_worker/core_worker.h``).  Owns:
+  - the in-process memory store + shm store client (object plane)
+  - the ownership table + distributed reference counting
+    (Ray ``reference_counter.h`` — simplified borrow protocol: args-holds on
+    submission, incref/decref from deserializing borrowers)
+  - normal task submission: lease pools per scheduling class with pipelining,
+    spillback handling, retries (Ray ``normal_task_submitter.h``)
+  - actor task submission: per-actor sequencing, restart-aware retries
+    (Ray ``actor_task_submitter.h``)
+  - the task execution loop: ordered actor queues, concurrency via a thread
+    pool, inline vs shm return routing (Ray ``task_execution/``)
+  - pubsub subscriptions for actor/node state.
+
+Threading model: one asyncio event loop runs all protocol work.  In a driver
+the loop runs on a background thread and the public API bridges with
+``run_coroutine_threadsafe``; in a worker the loop is the main thread and
+user code runs on a thread pool, so the loop stays responsive to serve
+owned objects while user code blocks.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from .config import GlobalConfig
+from .exceptions import (
+    ActorDiedError,
+    GetTimeoutError,
+    ObjectLostError,
+    TaskError,
+    WorkerCrashedError,
+)
+from .ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID, new_task_id
+from .object_store import MemoryStore, ShmObjectStore
+from .rpc import (
+    ClientPool,
+    RetryableRpcClient,
+    RpcConnectionError,
+    RpcRemoteError,
+    RpcServer,
+)
+from .serialization import (
+    deserialize_from_bytes,
+    dumps_function,
+    loads_function,
+    serialize_to_bytes,
+)
+from .task_spec import ActorSpec, ObjectRef, TaskSpec, _RefMarker, function_key
+
+logger = logging.getLogger(__name__)
+
+_global_worker: Optional["CoreWorker"] = None
+
+
+def global_worker() -> "CoreWorker":
+    if _global_worker is None:
+        raise RuntimeError("ray_tpu is not initialized — call ray_tpu.init() first")
+    return _global_worker
+
+
+def try_global_worker() -> Optional["CoreWorker"]:
+    return _global_worker
+
+
+def set_global_worker(w: Optional["CoreWorker"]):
+    global _global_worker
+    _global_worker = w
+
+
+PENDING, READY, ERROR = "PENDING", "READY", "ERROR"
+
+
+class OwnedObject:
+    __slots__ = (
+        "state", "inline_payload", "locations", "size", "local_refs",
+        "borrows", "args_holds", "error", "event", "lineage",
+    )
+
+    def __init__(self):
+        self.state = PENDING
+        self.inline_payload: Optional[bytes] = None
+        self.locations: Set[str] = set()  # agent addresses
+        self.size = 0
+        self.local_refs = 0
+        self.borrows = 0
+        self.args_holds = 0
+        self.error: Optional[BaseException] = None
+        self.event = asyncio.Event()
+        self.lineage: Optional[TaskSpec] = None  # for reconstruction
+
+
+class _ActorState:
+    def __init__(self, actor_id: ActorID):
+        self.actor_id = actor_id
+        self.address: Optional[str] = None
+        self.incarnation = 0
+        self.state = "PENDING_CREATION"
+        self.death_cause = ""
+        self.max_task_retries = 0
+        self.changed = asyncio.Event()
+        self.next_seq = 0
+        self.subscribed = False
+
+
+class _LeasePool:
+    """Leases + pipelined pushes for one scheduling class
+    (NormalTaskSubmitter analog)."""
+
+    def __init__(self, worker: "CoreWorker", sched_class: tuple, template: TaskSpec):
+        self.worker = worker
+        self.sched_class = sched_class
+        self.template = template
+        self.queue: asyncio.Queue = asyncio.Queue()
+        self.leases: Dict[int, dict] = {}  # lease_id -> {addr, client, inflight}
+        self.requesting = False
+        self.idle_cancel: Dict[int, asyncio.TimerHandle] = {}
+
+    def submit(self, spec: TaskSpec, attempt: int = 0):
+        self.queue.put_nowait((spec, attempt))
+        self._pump()
+
+    def _pump(self):
+        # Dispatch queued tasks onto leases with spare in-flight capacity.
+        max_inflight = GlobalConfig.max_tasks_in_flight_per_worker
+        while not self.queue.empty():
+            lease = None
+            for l in self.leases.values():
+                if l["inflight"] < max_inflight and not l["dead"]:
+                    lease = l
+                    break
+            if lease is None:
+                self._maybe_request_lease()
+                return
+            spec, attempt = self.queue.get_nowait()
+            lease["inflight"] += 1
+            timer = self.idle_cancel.pop(lease["lease_id"], None)
+            if timer:
+                timer.cancel()
+            asyncio.get_running_loop().create_task(
+                self._push(lease, spec, attempt)
+            )
+
+    def _maybe_request_lease(self):
+        if self.requesting:
+            return
+        self.requesting = True
+        asyncio.get_running_loop().create_task(self._request_lease())
+
+    async def _request_lease(self):
+        try:
+            agent = self.worker.agent
+            payload = {
+                "resources": self.template.resources,
+                "strategy": self.template.strategy,
+                "placement_group_id": self.template.placement_group_id,
+                "bundle_index": self.template.bundle_index,
+                "env_vars": self.template.env_vars,
+            }
+            while True:
+                reply = await agent.call(
+                    "request_lease", payload,
+                    timeout=GlobalConfig.worker_startup_timeout_s + 30,
+                )
+                if reply.get("granted"):
+                    lease = {
+                        "lease_id": reply["lease_id"],
+                        "addr": reply["worker_address"],
+                        "client": self.worker.worker_clients.get(
+                            reply["worker_address"]
+                        ),
+                        "inflight": 0,
+                        "dead": False,
+                        "agent": agent,
+                    }
+                    self.leases[reply["lease_id"]] = lease
+                    if self.queue.empty():
+                        # Work drained while we waited for the grant: don't
+                        # leak the lease — arm its idle-return timer.
+                        self._arm_idle(lease)
+                    break
+                if reply.get("spillback"):
+                    agent = self.worker.agent_clients.get(reply["spillback"])
+                    continue
+                await asyncio.sleep(0.2)  # cluster full; retry
+        except Exception as e:  # noqa: BLE001
+            # Fail one queued task so the error surfaces; rest retried later.
+            if not self.queue.empty():
+                spec, _ = self.queue.get_nowait()
+                self.worker._fail_task_returns(spec, e)
+        finally:
+            self.requesting = False
+            if not self.queue.empty():
+                self._pump()
+
+    async def _push(self, lease, spec: TaskSpec, attempt: int):
+        try:
+            reply = await lease["client"].call(
+                "push_task",
+                {"spec": spec},
+                timeout=86400.0,  # tasks may run arbitrarily long
+                retries=1,
+            )
+            self.worker._handle_task_reply(spec, reply)
+        except (RpcConnectionError, RpcRemoteError) as e:
+            is_crash = isinstance(e, RpcConnectionError)
+            lease["dead"] = True
+            self._drop_lease(lease, returned=False)
+            if is_crash and attempt < spec.max_retries:
+                logger.warning(
+                    "task %s attempt %d failed (%s); retrying", spec.name, attempt, e
+                )
+                self.submit(spec, attempt + 1)
+            else:
+                self.worker._fail_task_returns(
+                    spec,
+                    WorkerCrashedError(f"worker died executing {spec.name}: {e}")
+                    if is_crash
+                    else e,
+                )
+            return
+        finally:
+            if not lease["dead"]:
+                lease["inflight"] -= 1
+        self._pump()
+        if lease["inflight"] == 0 and self.queue.empty() and not lease["dead"]:
+            self._arm_idle(lease)
+
+    def _arm_idle(self, lease):
+        if lease["lease_id"] in self.idle_cancel:
+            return
+        loop = asyncio.get_running_loop()
+        self.idle_cancel[lease["lease_id"]] = loop.call_later(
+            GlobalConfig.lease_idle_timeout_s,
+            lambda: self._drop_lease(lease, returned=True),
+        )
+
+    def _drop_lease(self, lease, returned: bool):
+        self.leases.pop(lease["lease_id"], None)
+        timer = self.idle_cancel.pop(lease["lease_id"], None)
+        if timer:
+            timer.cancel()
+        if returned:
+            asyncio.get_running_loop().create_task(
+                self._return_lease_rpc(lease)
+            )
+
+    async def _return_lease_rpc(self, lease):
+        try:
+            await lease["agent"].call(
+                "return_lease", {"lease_id": lease["lease_id"]}, retries=2
+            )
+        except Exception:
+            pass
+
+
+class CoreWorker:
+    DRIVER = "driver"
+    WORKER = "worker"
+
+    def __init__(
+        self,
+        mode: str,
+        cp_address: str,
+        agent_address: str,
+        session_id: str,
+        node_id: NodeID,
+        job_id: Optional[JobID] = None,
+        worker_id: Optional[WorkerID] = None,
+    ):
+        self.mode = mode
+        self.cp_address = cp_address
+        self.agent_address = agent_address
+        self.session_id = session_id
+        self.node_id = node_id
+        self.job_id = job_id or JobID.from_random()
+        self.worker_id = worker_id or WorkerID.from_random()
+
+        self.server = RpcServer(self, "127.0.0.1", 0)
+        self.address: str = ""
+        self.cp: Optional[RetryableRpcClient] = None
+        self.agent: Optional[RetryableRpcClient] = None
+        self.agent_clients = ClientPool()
+        self.worker_clients = ClientPool()
+
+        self.memory_store = MemoryStore()
+        self.shm_store = ShmObjectStore(session_id)
+        self.owned: Dict[ObjectID, OwnedObject] = {}
+        self.lease_pools: Dict[tuple, _LeasePool] = {}
+        self.actors: Dict[ActorID, _ActorState] = {}
+
+        self.loop: Optional[asyncio.AbstractEventLoop] = None
+        self._loop_thread: Optional[threading.Thread] = None
+        self._fn_cache: Dict[str, Any] = {}
+        self._exported_fns: Set[str] = set()
+        self._task_executor = ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix="task"
+        )
+        self._task_semaphore: Optional[asyncio.Semaphore] = None  # created on loop
+        # Actor-execution state (when this worker hosts an actor)
+        self.actor_instance = None
+        self.actor_spec: Optional[ActorSpec] = None
+        self.actor_incarnation = 0
+        self._actor_exec_lock: Optional[asyncio.Semaphore] = None
+        self._actor_seq_state: Dict[tuple, dict] = {}  # (caller, inc) -> {expected, buffer}
+        self._current_task_name = ""
+        self._shutdown = False
+
+    # ------------------------------------------------------------- lifecycle
+    async def async_start(self):
+        self.loop = asyncio.get_running_loop()
+        self._task_semaphore = asyncio.Semaphore(1)
+        self.address = await self.server.start()
+        self.cp = RetryableRpcClient(self.cp_address, push_handler=self._on_push)
+        self.agent = RetryableRpcClient(self.agent_address)
+        if self.mode == self.DRIVER:
+            await self.cp.call(
+                "register_job",
+                {"job_id": self.job_id, "driver_address": self.address},
+            )
+        return self.address
+
+    def start_threaded(self):
+        """Driver mode: run the protocol loop on a background thread."""
+        ready = threading.Event()
+        err: List[BaseException] = []
+
+        def run():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self.loop = loop
+
+            async def boot():
+                try:
+                    await self.async_start()
+                finally:
+                    ready.set()
+
+            try:
+                loop.run_until_complete(boot())
+                loop.run_forever()
+            except BaseException as e:  # noqa: BLE001
+                err.append(e)
+                ready.set()
+            finally:
+                try:
+                    loop.close()
+                except Exception:
+                    pass
+
+        self._loop_thread = threading.Thread(target=run, daemon=True, name="core-worker")
+        self._loop_thread.start()
+        ready.wait(timeout=30)
+        if err:
+            raise err[0]
+        if not self.address:
+            raise RuntimeError("core worker failed to start")
+
+    def _run_sync(self, coro, timeout=None):
+        """Bridge from user threads into the protocol loop."""
+        if self.loop is None:
+            raise RuntimeError("core worker not started")
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    async def async_shutdown(self):
+        self._shutdown = True
+        await self.server.stop()
+        for pool in (self.worker_clients, self.agent_clients):
+            await pool.close_all()
+        if self.cp:
+            await self.cp.close()
+        if self.agent:
+            await self.agent.close()
+
+    def shutdown(self):
+        if self.loop and self._loop_thread:
+            try:
+                self._run_sync(self.async_shutdown(), timeout=5)
+            except Exception:
+                pass
+            self.loop.call_soon_threadsafe(self.loop.stop)
+            self._loop_thread.join(timeout=5)
+        self._task_executor.shutdown(wait=False)
+
+    # ----------------------------------------------------------------- puts
+    def _new_owned(self, object_id: ObjectID, lineage=None) -> OwnedObject:
+        obj = OwnedObject()
+        obj.lineage = lineage
+        self.owned[object_id] = obj
+        return obj
+
+    async def _put_async(self, value: Any) -> ObjectRef:
+        oid = ObjectID.from_random()
+        obj = self._new_owned(oid)
+        obj.local_refs += 1
+        payload = serialize_to_bytes(value)
+        obj.size = len(payload)
+        if len(payload) <= GlobalConfig.max_inline_object_bytes:
+            obj.inline_payload = payload
+            self.memory_store.put(oid, value)
+        else:
+            self.shm_store.create_from_bytes(oid, payload)
+            await self.agent.call("seal_object", {"object_id": oid, "size": len(payload)})
+            obj.locations.add(self.agent_address)
+            self.memory_store.put(oid, value)  # local cache for owner gets
+        obj.state = READY
+        obj.event.set()
+        ref = ObjectRef.__new__(ObjectRef)
+        ref.id = oid
+        ref.owner_address = self.address
+        ref._worker = self
+        return ref
+
+    def put(self, value: Any) -> ObjectRef:
+        return self._run_sync(self._put_async(value))
+
+    # ----------------------------------------------------------------- gets
+    async def get_async(self, ref: ObjectRef, timeout: Optional[float] = None):
+        try:
+            return await asyncio.wait_for(self._get_one(ref), timeout)
+        except asyncio.TimeoutError:
+            raise GetTimeoutError(f"get() timed out on {ref}")
+
+    async def _get_one(self, ref: ObjectRef):
+        oid = ref.id
+        if ref.owner_address == self.address:
+            obj = self.owned.get(oid)
+            if obj is None:
+                # Owned but already freed, or unknown.
+                if self.memory_store.contains(oid):
+                    return self.memory_store.peek(oid)
+                raise ObjectLostError(oid.hex(), "owner has no record")
+            await obj.event.wait()
+            if obj.state == ERROR:
+                raise obj.error
+            if self.memory_store.contains(oid):
+                return self.memory_store.peek(oid)
+            if obj.inline_payload is not None:
+                value = deserialize_from_bytes(obj.inline_payload)
+                self.memory_store.put(oid, value)
+                return value
+            return await self._fetch_from_locations(oid, sorted(obj.locations))
+        # Borrowed object: resolve via the owner.
+        if self.memory_store.contains(oid):
+            return self.memory_store.peek(oid)
+        owner = self.worker_clients.get(ref.owner_address)
+        reply = await owner.call("get_object", {"object_id": oid})
+        kind = reply["kind"]
+        if kind == "inline":
+            value = deserialize_from_bytes(reply["payload"])
+            self.memory_store.put(oid, value)
+            return value
+        if kind == "error":
+            raise deserialize_from_bytes(reply["payload"])
+        # shm: fetch via local agent (zero-copy if already node-local)
+        value = await self._fetch_from_locations(oid, reply["locations"])
+        return value
+
+    async def _fetch_from_locations(self, oid: ObjectID, locations: List[str]):
+        if not locations:
+            raise ObjectLostError(oid.hex(), "no locations")
+        if self.agent_address not in locations:
+            src = locations[0]
+            await self.agent.call(
+                "pull_object", {"object_id": oid, "from_agent": src},
+                timeout=GlobalConfig.rpc_call_timeout_s * 4,
+            )
+        loop = asyncio.get_running_loop()
+        value = await loop.run_in_executor(None, self.shm_store.get, oid)
+        self.memory_store.put(oid, value)
+        return value
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ObjectRef)
+        if single:
+            refs = [refs]
+        async def get_all():
+            # Resolve concurrently: remote-owner round-trips and shm pulls
+            # overlap instead of summing.
+            return await asyncio.gather(
+                *(self.get_async(r, timeout) for r in refs)
+            )
+
+        results = self._run_sync(get_all())
+        return results[0] if single else results
+
+    # ----------------------------------------------------------------- wait
+    async def _ready_probe(self, ref: ObjectRef) -> bool:
+        oid = ref.id
+        if ref.owner_address == self.address:
+            obj = self.owned.get(oid)
+            if obj is None:
+                return self.memory_store.contains(oid)
+            return obj.event.is_set()
+        if self.memory_store.contains(oid):
+            return True
+        owner = self.worker_clients.get(ref.owner_address)
+        try:
+            reply = await owner.call("probe_object", {"object_id": oid})
+            return reply["ready"]
+        except Exception:
+            return True  # owner gone: surface via get()
+
+    def wait(self, refs: List[ObjectRef], num_returns=1, timeout=None):
+        async def do_wait():
+            deadline = None if timeout is None else time.monotonic() + timeout
+            ready: List[ObjectRef] = []
+            pending = list(refs)
+            while len(ready) < num_returns:
+                new_pending = []
+                for r in pending:
+                    if await self._ready_probe(r):
+                        ready.append(r)
+                    else:
+                        new_pending.append(r)
+                pending = new_pending
+                if len(ready) >= num_returns or not pending:
+                    break
+                if deadline is not None and time.monotonic() >= deadline:
+                    break
+                await asyncio.sleep(0.01)
+            return ready, pending
+
+        return self._run_sync(do_wait())
+
+    # ------------------------------------------------------------ ref count
+    def on_ref_created(self, ref: ObjectRef):
+        # Called on deserialization in a borrower (via _rehydrate_ref) and on
+        # explicit construction by the owner.
+        if ref.owner_address == self.address:
+            obj = self.owned.get(ref.id)
+            if obj is not None and self.loop is not None:
+                self.loop.call_soon_threadsafe(self._incr_local, ref.id)
+        else:
+            if self.loop is not None:
+                self.loop.call_soon_threadsafe(self._send_incref, ref)
+
+    def _incr_local(self, oid: ObjectID):
+        obj = self.owned.get(oid)
+        if obj is not None:
+            obj.local_refs += 1
+
+    def _send_incref(self, ref: ObjectRef):
+        client = self.worker_clients.get(ref.owner_address)
+        asyncio.get_running_loop().create_task(
+            self._oneway(client, "incref", {"object_id": ref.id})
+        )
+
+    async def _oneway(self, client, method, payload):
+        try:
+            await client.notify(method, payload)
+        except Exception:
+            pass
+
+    def on_ref_deleted(self, oid: ObjectID, owner_address: str):
+        if self._shutdown or self.loop is None or self.loop.is_closed():
+            return
+        if owner_address == self.address:
+            self.loop.call_soon_threadsafe(self._decr_local, oid)
+        else:
+            def send():
+                client = self.worker_clients.get(owner_address)
+                asyncio.get_running_loop().create_task(
+                    self._oneway(client, "decref", {"object_id": oid})
+                )
+            try:
+                self.loop.call_soon_threadsafe(send)
+            except RuntimeError:
+                pass
+
+    def _decr_local(self, oid: ObjectID):
+        obj = self.owned.get(oid)
+        if obj is not None:
+            obj.local_refs -= 1
+            self._maybe_free(oid)
+
+    def _maybe_free(self, oid: ObjectID):
+        obj = self.owned.get(oid)
+        if obj is None:
+            return
+        if obj.local_refs <= 0 and obj.borrows <= 0 and obj.args_holds <= 0:
+            if obj.state == PENDING:
+                return  # task still running; free after completion
+            del self.owned[oid]
+            self.memory_store.free(oid)
+            for agent_addr in obj.locations:
+                client = self.agent_clients.get(agent_addr)
+                asyncio.get_running_loop().create_task(
+                    self._oneway_call_free(client, oid)
+                )
+
+    async def _oneway_call_free(self, client, oid):
+        try:
+            await client.call("free_objects", {"object_ids": [oid]}, retries=1)
+        except Exception:
+            pass
+
+    def handle_incref(self, payload, conn):
+        obj = self.owned.get(payload["object_id"])
+        if obj is not None:
+            obj.borrows += 1
+
+    def handle_decref(self, payload, conn):
+        obj = self.owned.get(payload["object_id"])
+        if obj is not None:
+            obj.borrows -= 1
+            self._maybe_free(payload["object_id"])
+
+    # ------------------------------------------------- owner serving objects
+    async def handle_get_object(self, payload, conn):
+        oid = payload["object_id"]
+        obj = self.owned.get(oid)
+        if obj is None:
+            if self.memory_store.contains(oid):
+                return {
+                    "kind": "inline",
+                    "payload": serialize_to_bytes(self.memory_store.peek(oid)),
+                }
+            return {
+                "kind": "error",
+                "payload": serialize_to_bytes(
+                    ObjectLostError(oid.hex(), "not owned by this worker")
+                ),
+            }
+        await obj.event.wait()
+        if obj.state == ERROR:
+            return {"kind": "error", "payload": serialize_to_bytes(obj.error)}
+        if obj.inline_payload is not None:
+            return {"kind": "inline", "payload": obj.inline_payload}
+        if obj.locations:
+            return {"kind": "shm", "locations": sorted(obj.locations), "size": obj.size}
+        # Value only in local memory store (e.g. small put): serialize now.
+        if self.memory_store.contains(oid):
+            return {
+                "kind": "inline",
+                "payload": serialize_to_bytes(self.memory_store.peek(oid)),
+            }
+        return {
+            "kind": "error",
+            "payload": serialize_to_bytes(ObjectLostError(oid.hex(), "value missing")),
+        }
+
+    def handle_probe_object(self, payload, conn):
+        obj = self.owned.get(payload["object_id"])
+        if obj is None:
+            return {"ready": self.memory_store.contains(payload["object_id"])}
+        return {"ready": obj.event.is_set()}
+
+    # ------------------------------------------------------ task submission
+    def _export_function(self, fn_or_cls, prefix="fn") -> str:
+        pickled = dumps_function(fn_or_cls)
+        key = prefix + ":" + function_key(pickled)
+        if key not in self._exported_fns:
+            self._run_sync(
+                self.cp.call(
+                    "kv_put",
+                    {
+                        "namespace": "functions",
+                        "key": key,
+                        "value": pickled,
+                        "overwrite": False,
+                    },
+                )
+            )
+            self._exported_fns.add(key)
+        return key
+
+    async def _get_function(self, function_id: str):
+        fn = self._fn_cache.get(function_id)
+        if fn is None:
+            data = await self.cp.call(
+                "kv_get", {"namespace": "functions", "key": function_id}
+            )
+            if data is None:
+                raise RuntimeError(f"function {function_id} not found in KV")
+            fn = loads_function(data)
+            self._fn_cache[function_id] = fn
+        return fn
+
+    def _prepare_args(self, args, kwargs) -> Tuple[bytes, List[ObjectRef]]:
+        """Top-level ObjectRefs become resolve-markers (Ray semantics: task
+        args are resolved to values; nested refs stay refs).  Returns the
+        payload and the list of refs to hold until the task completes."""
+        held: List[ObjectRef] = []
+
+        def convert(v):
+            if isinstance(v, ObjectRef):
+                held.append(v)
+                return _RefMarker(v.id, v.owner_address)
+            return v
+
+        conv_args = [convert(a) for a in args]
+        conv_kwargs = {k: convert(v) for k, v in kwargs.items()}
+        # Bookkeeping for refs nested one container-level deep.
+        for v in list(args) + list(kwargs.values()):
+            if isinstance(v, (list, tuple)):
+                held.extend(x for x in v if isinstance(x, ObjectRef))
+            elif isinstance(v, dict):
+                held.extend(x for x in v.values() if isinstance(x, ObjectRef))
+        payload = serialize_to_bytes((conv_args, conv_kwargs))
+        return payload, held
+
+    def _hold_args(self, held: List[ObjectRef]):
+        for r in held:
+            if r.owner_address == self.address:
+                obj = self.owned.get(r.id)
+                if obj is not None:
+                    obj.args_holds += 1
+
+    def _release_args(self, spec: TaskSpec):
+        for r in getattr(spec, "_held_refs", ()):  # type: ignore[attr-defined]
+            if r.owner_address == self.address:
+                obj = self.owned.get(r.id)
+                if obj is not None:
+                    obj.args_holds -= 1
+                    self._maybe_free(r.id)
+
+    def submit_task(
+        self,
+        fn,
+        args,
+        kwargs,
+        *,
+        name: str = "",
+        num_returns: int = 1,
+        resources: Optional[Dict[str, float]] = None,
+        strategy=None,
+        max_retries: int = 0,
+        placement_group_id=None,
+        bundle_index: int = -1,
+        env_vars: Optional[Dict[str, str]] = None,
+        function_id: Optional[str] = None,
+    ) -> List[ObjectRef]:
+        function_id = function_id or self._export_function(fn)
+        payload, held = self._prepare_args(args, kwargs)
+        spec = TaskSpec(
+            task_id=new_task_id(),
+            job_id=self.job_id,
+            function_id=function_id,
+            name=name or getattr(fn, "__name__", "task"),
+            args_payload=payload,
+            num_returns=num_returns,
+            resources=resources or {"CPU": 1},
+            strategy=strategy,
+            max_retries=max_retries,
+            owner_address=self.address,
+            placement_group_id=placement_group_id,
+            bundle_index=bundle_index,
+            env_vars=env_vars or {},
+        )
+        spec._held_refs = held  # type: ignore[attr-defined]
+        refs = []
+        return_ids = spec.return_ids()
+
+        def setup():
+            self._hold_args(held)
+            for oid in return_ids:
+                obj = self._new_owned(oid, lineage=spec)
+                obj.local_refs += 1
+            pool = self.lease_pools.get(spec.scheduling_class)
+            if pool is None:
+                pool = _LeasePool(self, spec.scheduling_class, spec)
+                self.lease_pools[spec.scheduling_class] = pool
+            pool.submit(spec)
+
+        self.loop.call_soon_threadsafe(setup)
+        for oid in return_ids:
+            ref = ObjectRef.__new__(ObjectRef)
+            ref.id = oid
+            ref.owner_address = self.address
+            ref._worker = self
+            refs.append(ref)
+        return refs
+
+    def _handle_task_reply(self, spec: TaskSpec, reply: dict):
+        self._release_args(spec)
+        if reply.get("error") is not None:
+            exc = deserialize_from_bytes(reply["error"])
+            self._fail_task_returns(spec, exc)
+            return
+        for oid, ret in zip(spec.return_ids(), reply["returns"]):
+            obj = self.owned.get(oid)
+            if obj is None:
+                obj = self._new_owned(oid)
+            if ret[0] == "inline":
+                obj.inline_payload = ret[1]
+                obj.size = len(ret[1])
+            else:  # ("shm", agent_addr, size)
+                obj.locations.add(ret[1])
+                obj.size = ret[2]
+            obj.state = READY
+            obj.event.set()
+            self._maybe_free(oid)
+
+    def _fail_task_returns(self, spec: TaskSpec, exc: BaseException):
+        self._release_args(spec)
+        for oid in spec.return_ids():
+            obj = self.owned.get(oid)
+            if obj is None:
+                obj = self._new_owned(oid)
+            obj.state = ERROR
+            obj.error = exc
+            obj.event.set()
+
+    # --------------------------------------------------------------- actors
+    def create_actor(
+        self,
+        cls,
+        args,
+        kwargs,
+        *,
+        name=None,
+        namespace="",
+        resources=None,
+        max_restarts=0,
+        max_task_retries=0,
+        max_concurrency=1,
+        strategy=None,
+        placement_group_id=None,
+        bundle_index=-1,
+        env_vars=None,
+        detached=False,
+        get_if_exists=False,
+    ) -> Tuple[ActorID, ActorSpec]:
+        class_id = self._export_function(cls, prefix="cls")
+        payload, held = self._prepare_args(args, kwargs)
+        actor_id = ActorID.from_random()
+        spec = ActorSpec(
+            actor_id=actor_id,
+            job_id=self.job_id,
+            class_id=class_id,
+            name=name,
+            namespace=namespace,
+            ctor_args_payload=payload,
+            resources=resources or {"CPU": 1},
+            max_restarts=max_restarts,
+            max_task_retries=max_task_retries,
+            max_concurrency=max_concurrency,
+            strategy=strategy,
+            placement_group_id=placement_group_id,
+            bundle_index=bundle_index,
+            env_vars=env_vars or {},
+            detached=detached,
+            owner_address=self.address,
+        )
+
+        async def register():
+            state = self._actor_state(actor_id)
+            await self._subscribe_actor(state)
+            info = await self.cp.call(
+                "register_actor", {"spec": spec, "get_if_exists": get_if_exists},
+                timeout=GlobalConfig.worker_startup_timeout_s + 30,
+            )
+            self._apply_actor_info(info)
+            return info
+
+        info = self._run_sync(register())
+        real_id = info["actor_id"]
+        return real_id, spec
+
+    def _actor_state(self, actor_id: ActorID) -> _ActorState:
+        st = self.actors.get(actor_id)
+        if st is None:
+            st = _ActorState(actor_id)
+            self.actors[actor_id] = st
+        return st
+
+    async def _subscribe_actor(self, state: _ActorState):
+        if not state.subscribed:
+            state.subscribed = True
+            await self.cp.call(
+                "subscribe", {"channels": ["actor:" + state.actor_id.hex()]}
+            )
+
+    def _apply_actor_info(self, info: dict):
+        state = self._actor_state(info["actor_id"])
+        state.state = info["state"]
+        state.address = info["address"]
+        if info.get("incarnation", 0) != state.incarnation:
+            # New incarnation ⇒ the executor's per-caller sequence restarts.
+            state.next_seq = 0
+        state.incarnation = info.get("incarnation", 0)
+        state.death_cause = info.get("death_cause") or ""
+        state.max_task_retries = info.get("max_task_retries", 0)
+        state.changed.set()
+        state.changed = asyncio.Event()
+
+    def _on_push(self, method: str, payload):
+        if method == "pub":
+            channel = payload["channel"]
+            if channel.startswith("actor:"):
+                self._apply_actor_info(payload["message"])
+
+    def get_actor_by_name(self, name: str, namespace: str = ""):
+        async def lookup():
+            return await self.cp.call(
+                "get_named_actor", {"name": name, "namespace": namespace}
+            )
+
+        return self._run_sync(lookup())
+
+    def submit_actor_task(
+        self,
+        actor_id: ActorID,
+        method_name: str,
+        args,
+        kwargs,
+        *,
+        num_returns: int = 1,
+        name: str = "",
+    ) -> List[ObjectRef]:
+        payload, held = self._prepare_args(args, kwargs)
+        spec = TaskSpec(
+            task_id=new_task_id(),
+            job_id=self.job_id,
+            function_id="",  # actor methods dispatch by name
+            name=name or method_name,
+            args_payload=payload,
+            num_returns=num_returns,
+            owner_address=self.address,
+            actor_id=actor_id,
+        )
+        spec.method_name = method_name  # type: ignore[attr-defined]
+        spec._held_refs = held  # type: ignore[attr-defined]
+        return_ids = spec.return_ids()
+
+        def setup():
+            self._hold_args(held)
+            for oid in return_ids:
+                obj = self._new_owned(oid)
+                obj.local_refs += 1
+            asyncio.get_running_loop().create_task(self._submit_actor_task(spec))
+
+        self.loop.call_soon_threadsafe(setup)
+        refs = []
+        for oid in return_ids:
+            ref = ObjectRef.__new__(ObjectRef)
+            ref.id = oid
+            ref.owner_address = self.address
+            ref._worker = self
+            refs.append(ref)
+        return refs
+
+    async def _submit_actor_task(self, spec: TaskSpec, attempt: int = 0):
+        state = self._actor_state(spec.actor_id)
+        await self._subscribe_actor(state)
+        # Wait for the actor to be schedulable.
+        deadline = time.monotonic() + GlobalConfig.worker_startup_timeout_s * 2
+        while state.state in ("PENDING_CREATION", "RESTARTING"):
+            if time.monotonic() > deadline:
+                self._fail_task_returns(
+                    spec, ActorDiedError(spec.actor_id.hex(), "creation timed out")
+                )
+                return
+            changed = state.changed
+            try:
+                await asyncio.wait_for(changed.wait(), timeout=1.0)
+            except asyncio.TimeoutError:
+                # Re-poll the control plane in case we missed a pub.
+                info = await self.cp.call(
+                    "get_actor_info", {"actor_id": spec.actor_id}
+                )
+                if info is not None:
+                    self._apply_actor_info(info)
+        if state.state == "DEAD":
+            self._fail_task_returns(
+                spec, ActorDiedError(spec.actor_id.hex(), state.death_cause)
+            )
+            return
+        incarnation = state.incarnation
+        seq = state.next_seq
+        state.next_seq += 1
+        client = self.worker_clients.get(state.address)
+        try:
+            reply = await client.call(
+                "actor_push_task",
+                {
+                    "spec": spec,
+                    "caller": self.address,
+                    "seq": seq,
+                    "incarnation": incarnation,
+                },
+                timeout=86400.0,
+                retries=1,
+            )
+            self._handle_task_reply(spec, reply)
+        except (RpcConnectionError, RpcRemoteError) as e:
+            if isinstance(e, RpcRemoteError):
+                self._fail_task_returns(spec, e)
+                return
+            # Connection died: actor crashed or restarting.
+            self.worker_clients.invalidate(state.address)
+            if attempt < state.max_task_retries:
+                await asyncio.sleep(0.2)
+                await self._submit_actor_task(spec, attempt + 1)
+            else:
+                self._fail_task_returns(
+                    spec,
+                    ActorDiedError(
+                        spec.actor_id.hex(), f"connection lost during call: {e}"
+                    ),
+                )
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        self._run_sync(
+            self.cp.call(
+                "kill_actor", {"actor_id": actor_id, "no_restart": no_restart}
+            )
+        )
+
+    # ------------------------------------------------------------ execution
+    async def _resolve_args(self, payload: bytes):
+        args, kwargs = deserialize_from_bytes(payload)
+
+        async def resolve(v):
+            if isinstance(v, _RefMarker):
+                ref = ObjectRef(v.object_id, v.owner_address, _worker=self)
+                return await self._get_one(ref)
+            return v
+
+        args = [await resolve(a) for a in args]
+        kwargs = {k: await resolve(v) for k, v in kwargs.items()}
+        return args, kwargs
+
+    async def _package_returns(self, spec: TaskSpec, result) -> List[tuple]:
+        if spec.num_returns == 1:
+            values = [result]
+        else:
+            values = list(result)
+            if len(values) != spec.num_returns:
+                raise ValueError(
+                    f"task {spec.name} declared {spec.num_returns} returns "
+                    f"but produced {len(values)}"
+                )
+        out = []
+        for i, value in enumerate(values):
+            payload = serialize_to_bytes(value)
+            if len(payload) <= GlobalConfig.max_inline_object_bytes:
+                out.append(("inline", payload))
+            else:
+                oid = ObjectID.for_task_return(spec.task_id, i)
+                loop = asyncio.get_running_loop()
+                await loop.run_in_executor(
+                    None, self.shm_store.create_from_bytes, oid, payload
+                )
+                await self.agent.call(
+                    "seal_object", {"object_id": oid, "size": len(payload)}
+                )
+                out.append(("shm", self.agent_address, len(payload)))
+        return out
+
+    async def _execute(self, spec: TaskSpec, fn) -> dict:
+        try:
+            args, kwargs = await self._resolve_args(spec.args_payload)
+            self._current_task_name = spec.name
+            loop = asyncio.get_running_loop()
+            if asyncio.iscoroutinefunction(fn):
+                result = await fn(*args, **kwargs)
+            else:
+                result = await loop.run_in_executor(
+                    self._task_executor, lambda: fn(*args, **kwargs)
+                )
+            returns = await self._package_returns(spec, result)
+            return {"returns": returns, "error": None}
+        except BaseException as e:  # noqa: BLE001
+            import traceback as tb
+
+            err = TaskError(e, tb.format_exc(), spec.name)
+            return {"returns": None, "error": serialize_to_bytes(err)}
+
+    async def handle_push_task(self, payload, conn):
+        spec: TaskSpec = payload["spec"]
+        fn = await self._get_function(spec.function_id)
+        async with self._task_semaphore:
+            return await self._execute(spec, fn)
+
+    async def handle_actor_init(self, payload, conn):
+        spec: ActorSpec = payload["spec"]
+        try:
+            cls = await self._get_function(spec.class_id)
+            args, kwargs = await self._resolve_args(spec.ctor_args_payload)
+            loop = asyncio.get_running_loop()
+            instance = await loop.run_in_executor(
+                self._task_executor, lambda: cls(*args, **kwargs)
+            )
+            self.actor_instance = instance
+            self.actor_spec = spec
+            self.actor_incarnation = payload.get("incarnation", 0)
+            self._actor_exec_lock = asyncio.Semaphore(max(1, spec.max_concurrency))
+            if spec.max_concurrency > 1:
+                self._task_executor = ThreadPoolExecutor(
+                    max_workers=spec.max_concurrency, thread_name_prefix="actor"
+                )
+            return {"ok": True}
+        except BaseException as e:  # noqa: BLE001
+            import traceback as tb
+
+            logger.error("actor init failed: %s\n%s", e, tb.format_exc())
+            return {"ok": False, "error": f"{e!r}\n{tb.format_exc()}"}
+
+    async def handle_actor_push_task(self, payload, conn):
+        spec: TaskSpec = payload["spec"]
+        caller = payload["caller"]
+        seq = payload["seq"]
+        key = (caller, payload.get("incarnation", 0))
+        st = self._actor_seq_state.setdefault(
+            key, {"expected": 0, "waiters": {}}
+        )
+        # In-order execution per caller: wait for our turn.
+        while st["expected"] < seq:
+            ev = st["waiters"].setdefault(seq, asyncio.Event())
+            await ev.wait()
+        if self.actor_instance is None:
+            raise RuntimeError("actor not initialized")
+        method = getattr(self.actor_instance, getattr(spec, "method_name", spec.name))
+        try:
+            async with self._actor_exec_lock:
+                # Advance the sequence as soon as execution begins so that
+                # max_concurrency > 1 allows overlap.
+                st["expected"] = seq + 1
+                ev = st["waiters"].pop(seq + 1, None)
+                if ev:
+                    ev.set()
+                return await self._execute(spec, method)
+        finally:
+            if st["expected"] <= seq:
+                st["expected"] = seq + 1
+                ev = st["waiters"].pop(seq + 1, None)
+                if ev:
+                    ev.set()
+
+    def handle_ping(self, payload, conn):
+        return "pong"
+
+    def handle_exit_worker(self, payload, conn):
+        logger.info("worker exiting on request")
+        asyncio.get_running_loop().call_later(0.05, os._exit, 0)
+        return True

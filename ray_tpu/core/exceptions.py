@@ -1,0 +1,61 @@
+"""User-visible exception types (reference: ray ``python/ray/exceptions.py``)."""
+
+from __future__ import annotations
+
+import traceback
+
+
+class RayTpuError(Exception):
+    pass
+
+
+class TaskError(RayTpuError):
+    """A task raised an exception; re-raised at ``get``.  Carries the remote
+    traceback so the user sees where the failure happened."""
+
+    def __init__(self, cause: BaseException, remote_tb: str, task_name: str = ""):
+        self.cause = cause
+        self.remote_traceback = remote_tb
+        self.task_name = task_name
+        super().__init__(f"task {task_name!r} failed: {cause!r}\n{remote_tb}")
+
+    @classmethod
+    def from_exception(cls, exc: BaseException, task_name: str = "") -> "TaskError":
+        return cls(exc, traceback.format_exc(), task_name)
+
+    def __reduce__(self):
+        try:
+            import pickle
+
+            pickle.dumps(self.cause)
+            cause = self.cause
+        except Exception:
+            cause = RuntimeError(repr(self.cause))
+        return (TaskError, (cause, self.remote_traceback, self.task_name))
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker executing the task died (process exit / node loss)."""
+
+
+class ActorDiedError(RayTpuError):
+    def __init__(self, actor_id_hex: str, cause: str = ""):
+        self.actor_id_hex = actor_id_hex
+        super().__init__(f"actor {actor_id_hex[:12]} is dead: {cause}")
+
+
+class ActorUnavailableError(RayTpuError):
+    """Actor is restarting; the call may be retried."""
+
+
+class ObjectLostError(RayTpuError):
+    def __init__(self, object_hex: str, cause: str = ""):
+        super().__init__(f"object {object_hex[:16]} lost: {cause}")
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    pass

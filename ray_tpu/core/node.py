@@ -1,0 +1,221 @@
+"""Node/process supervisor: starts and monitors the per-node system processes.
+
+Equivalent of the reference's Node class + services (ray
+``python/ray/_private/node.py``, ``services.py``): the head path spawns the
+control plane, every node spawns a node agent; processes log to the session
+directory and are killed as a group on shutdown.  Also provides the
+in-process multi-node ``Cluster`` test fixture (the reference's key testing
+trick, ray ``python/ray/cluster_utils.py:135``): multiple node agents on one
+machine, each believing it is a distinct node.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Dict, List, Optional
+
+from . import shm, tpu_detect
+from .config import GlobalConfig
+from .rpc import RpcClient, find_free_port
+
+_HEAD_INFO_FILE = "/tmp/ray_tpu/head_info.json"
+
+
+def _wait_for_server(address: str, timeout: float = 30.0) -> None:
+    """Block until an RpcServer answers ping at address."""
+
+    async def try_ping():
+        client = RpcClient(address)
+        await client.connect()
+        reply = await client.call("ping", timeout=2)
+        await client.close()
+        return reply == "pong"
+
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            if asyncio.run(try_ping()):
+                return
+        except Exception as e:  # noqa: BLE001
+            last = e
+        time.sleep(0.05)
+    raise TimeoutError(f"server at {address} did not come up: {last}")
+
+
+class ProcessGroup:
+    def __init__(self):
+        self.procs: List[subprocess.Popen] = []
+
+    def spawn(self, argv: List[str], log_path: str, env: Optional[dict] = None):
+        full_env = dict(os.environ)
+        if env:
+            full_env.update(env)
+        full_env.update(GlobalConfig.overrides_as_env())
+        out = open(log_path, "ab")
+        proc = subprocess.Popen(
+            argv, stdout=out, stderr=subprocess.STDOUT, env=full_env,
+            start_new_session=True,
+        )
+        self.procs.append(proc)
+        return proc
+
+    def kill_all(self):
+        for proc in self.procs:
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+        deadline = time.monotonic() + 3
+        for proc in self.procs:
+            try:
+                proc.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        self.procs.clear()
+
+
+class Node:
+    """Manages the system processes for one logical node (and, on the head,
+    the control plane)."""
+
+    def __init__(
+        self,
+        head: bool,
+        cp_address: Optional[str] = None,
+        resources: Optional[Dict[str, float]] = None,
+        labels: Optional[Dict[str, str]] = None,
+        session_id: Optional[str] = None,
+        num_cpus: Optional[float] = None,
+    ):
+        self.head = head
+        self.session_id = session_id or shm.new_session_id()
+        self.log_dir = os.path.join(
+            tempfile.gettempdir(), "ray_tpu", f"session_{self.session_id}"
+        )
+        os.makedirs(self.log_dir, exist_ok=True)
+        self.pg = ProcessGroup()
+        self.cp_address = cp_address
+        self.agent_address: Optional[str] = None
+
+        detected_res, detected_labels = tpu_detect.detect_resources_and_labels()
+        res: Dict[str, float] = {
+            "CPU": float(num_cpus if num_cpus is not None else (os.cpu_count() or 1)),
+        }
+        res.update(detected_res)
+        if resources:
+            res.update(resources)
+        self.resources = res
+        lbls = dict(detected_labels)
+        if labels:
+            lbls.update(labels)
+        self.labels = lbls
+
+    def start(self):
+        env = {"RAY_TPU_LOG_DIR": self.log_dir}
+        if self.head:
+            cp_port = find_free_port()
+            self.cp_address = f"127.0.0.1:{cp_port}"
+            self.pg.spawn(
+                [
+                    sys.executable, "-m", "ray_tpu.core.control_plane",
+                    "--port", str(cp_port),
+                    "--session-id", self.session_id,
+                ],
+                os.path.join(self.log_dir, "control_plane.log"),
+                env,
+            )
+            _wait_for_server(self.cp_address)
+        assert self.cp_address
+        agent_port = find_free_port()
+        self.agent_address = f"127.0.0.1:{agent_port}"
+        self.pg.spawn(
+            [
+                sys.executable, "-m", "ray_tpu.core.node_agent",
+                "--port", str(agent_port),
+                "--cp-address", self.cp_address,
+                "--session-id", self.session_id,
+                "--resources", json.dumps(self.resources),
+                "--labels", json.dumps(self.labels),
+            ],
+            os.path.join(self.log_dir, "node_agent.log"),
+            env,
+        )
+        _wait_for_server(self.agent_address)
+        if self.head:
+            os.makedirs(os.path.dirname(_HEAD_INFO_FILE), exist_ok=True)
+            with open(_HEAD_INFO_FILE, "w") as f:
+                json.dump(
+                    {"cp_address": self.cp_address, "session_id": self.session_id}, f
+                )
+        return self
+
+    def stop(self):
+        self.pg.kill_all()
+        shm.cleanup_session(self.session_id)
+
+
+class Cluster:
+    """In-process multi-node test cluster: one control plane + N node agents
+    on this machine (ray ``cluster_utils.Cluster`` analog).  Nodes can be
+    added and killed freely to exercise fault-tolerance paths."""
+
+    def __init__(self):
+        self.head_node: Optional[Node] = None
+        self.worker_nodes: List[Node] = []
+
+    @property
+    def cp_address(self) -> str:
+        assert self.head_node is not None
+        return self.head_node.cp_address  # type: ignore[return-value]
+
+    def add_node(self, num_cpus: float = 1, resources=None, labels=None) -> Node:
+        if self.head_node is None:
+            node = Node(
+                head=True, resources=resources, labels=labels, num_cpus=num_cpus
+            )
+            node.start()
+            self.head_node = node
+        else:
+            node = Node(
+                head=False,
+                cp_address=self.cp_address,
+                resources=resources,
+                labels=labels,
+                session_id=self.head_node.session_id,
+                num_cpus=num_cpus,
+            )
+            node.start()
+            self.worker_nodes.append(node)
+        return node
+
+    def kill_node(self, node: Node):
+        node.pg.kill_all()
+        if node in self.worker_nodes:
+            self.worker_nodes.remove(node)
+
+    def shutdown(self):
+        for node in self.worker_nodes:
+            node.stop()
+        self.worker_nodes.clear()
+        if self.head_node:
+            self.head_node.stop()
+            self.head_node = None
+
+
+def read_head_info() -> Optional[dict]:
+    try:
+        with open(_HEAD_INFO_FILE) as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return None

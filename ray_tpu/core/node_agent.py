@@ -1,0 +1,645 @@
+"""Per-node agent — the raylet equivalent.
+
+One process per node (Ray ``src/ray/raylet/node_manager.h``).  Owns:
+  - the worker pool (spawn/cache/kill worker processes; Ray ``worker_pool.h``)
+  - the lease protocol: queue + grant worker leases against local resources,
+    spillback to other nodes via the control plane's view
+    (Ray ``cluster_lease_manager.h`` / ``local_lease_manager.h``)
+  - instance-granular TPU chip accounting → ``TPU_VISIBLE_CHIPS`` isolation
+    for leased workers (reference precedent:
+    ray ``python/ray/_private/accelerators/tpu.py``)
+  - placement-group bundle reservations (2-phase prepare/commit; Ray
+    ``node_manager.h:589``)
+  - the node object directory for the shm tier + chunked node-to-node object
+    pulls (Ray ``object_manager/``)
+  - worker lifecycle monitoring; actor-death reporting to the control plane.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .config import GlobalConfig
+from .ids import ActorID, NodeID, ObjectID, PlacementGroupID, WorkerID
+from .object_store import NodeObjectDirectory, ShmObjectStore
+from .resources import NodeResources, ResourceInstanceSet, ResourceSet
+from .rpc import ClientPool, RetryableRpcClient, RpcServer
+from .task_spec import ActorSpec
+
+logger = logging.getLogger(__name__)
+
+
+class WorkerHandle:
+    def __init__(self, worker_id: WorkerID, proc: subprocess.Popen, env_key: tuple):
+        self.worker_id = worker_id
+        self.proc = proc
+        self.env_key = env_key  # pool key: (tpu_chips_tuple, extra_env_items)
+        self.address: Optional[str] = None
+        self.ready = asyncio.Event()
+        self.leased = False
+        self.is_actor = False
+        self.actor_id: Optional[ActorID] = None
+        self.last_idle = time.monotonic()
+
+
+class Lease:
+    def __init__(self, lease_id: int, worker: WorkerHandle, resources: ResourceSet,
+                 instances: Dict[str, List[int]], pg_id: Optional[PlacementGroupID],
+                 bundle_index: int):
+        self.lease_id = lease_id
+        self.worker = worker
+        self.resources = resources
+        self.instances = instances
+        self.pg_id = pg_id
+        self.bundle_index = bundle_index
+
+
+class BundlePool:
+    """Resources reserved for one placement-group bundle on this node."""
+
+    def __init__(self, spec: Dict[str, float]):
+        self.total = ResourceSet(spec)
+        self.available = ResourceSet(spec)
+        self.committed = False
+
+
+class NodeAgent:
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        cp_address: str,
+        session_id: str,
+        resources: Dict[str, float],
+        labels: Dict[str, str],
+        node_id: Optional[NodeID] = None,
+    ):
+        self.node_id = node_id or NodeID.from_random()
+        self.session_id = session_id
+        self.cp_address = cp_address
+        self.server = RpcServer(self, host, port)
+        self.cp_client = RetryableRpcClient(cp_address)
+        self.agent_clients = ClientPool()  # peers, for remote pulls
+        self.resources = NodeResources(resources, labels)
+        self.instances = ResourceInstanceSet(resources)
+        self.directory = NodeObjectDirectory(
+            session_id, GlobalConfig.object_store_memory_bytes
+        )
+        self.shm_store = ShmObjectStore(session_id)
+        self.workers: Dict[WorkerID, WorkerHandle] = {}
+        self.idle_pool: Dict[tuple, List[WorkerHandle]] = {}
+        self.leases: Dict[int, Lease] = {}
+        self._next_lease_id = 1
+        self.bundles: Dict[Tuple[PlacementGroupID, int], BundlePool] = {}
+        self._lease_queue: List[tuple] = []  # (payload, future)
+        self._pull_futures: Dict[ObjectID, asyncio.Future] = {}
+        self._bg: List[asyncio.Task] = []
+
+    # ------------------------------------------------------------- lifecycle
+    async def start(self) -> str:
+        addr = await self.server.start()
+        reply = await self.cp_client.call(
+            "register_node",
+            {
+                "node_id": self.node_id,
+                "agent_address": addr,
+                "snapshot": self._snapshot(),
+            },
+        )
+        assert reply["ok"]
+        loop = asyncio.get_running_loop()
+        self._bg.append(loop.create_task(self._heartbeat_loop()))
+        self._bg.append(loop.create_task(self._monitor_workers_loop()))
+        logger.info("node agent %s on %s", self.node_id.hex()[:8], addr)
+        return addr
+
+    async def stop(self):
+        for t in self._bg:
+            t.cancel()
+        for w in self.workers.values():
+            self._kill_worker_proc(w)
+        self.directory.cleanup()
+        await self.server.stop()
+        await self.cp_client.close()
+        await self.agent_clients.close_all()
+
+    def _snapshot(self) -> dict:
+        return {
+            "total": self.resources.total.to_dict(),
+            "available": self.resources.available.to_dict(),
+            "labels": dict(self.resources.labels),
+        }
+
+    async def _heartbeat_loop(self):
+        period = GlobalConfig.health_check_period_s
+        while True:
+            try:
+                reply = await self.cp_client.call(
+                    "heartbeat",
+                    {"node_id": self.node_id, "snapshot": self._snapshot()},
+                    retries=1,
+                )
+                if reply.get("reregister"):
+                    await self.cp_client.call(
+                        "register_node",
+                        {
+                            "node_id": self.node_id,
+                            "agent_address": self.server.address,
+                            "snapshot": self._snapshot(),
+                        },
+                    )
+            except Exception:
+                pass
+            await asyncio.sleep(period)
+
+    # --------------------------------------------------------------- workers
+    def _spawn_worker(self, env_extra: Dict[str, str], env_key: tuple) -> WorkerHandle:
+        worker_id = WorkerID.from_random()
+        env = dict(os.environ)
+        env.update(env_extra)
+        env.update(
+            RAY_TPU_WORKER_ID=worker_id.hex(),
+            RAY_TPU_AGENT_ADDRESS=self.server.address,
+            RAY_TPU_CP_ADDRESS=self.cp_address,
+            RAY_TPU_SESSION_ID=self.session_id,
+            RAY_TPU_NODE_ID=self.node_id.hex(),
+        )
+        log_dir = os.environ.get("RAY_TPU_LOG_DIR", "/tmp/ray_tpu")
+        os.makedirs(log_dir, exist_ok=True)
+        out = open(os.path.join(log_dir, f"worker-{worker_id.hex()[:12]}.log"), "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.worker_main"],
+            env=env,
+            stdout=out,
+            stderr=subprocess.STDOUT,
+            start_new_session=True,
+        )
+        handle = WorkerHandle(worker_id, proc, env_key)
+        self.workers[worker_id] = handle
+        return handle
+
+    def handle_register_worker(self, payload, conn):
+        worker_id = payload["worker_id"]
+        handle = self.workers.get(worker_id)
+        if handle is None:
+            return {"ok": False}
+        handle.address = payload["address"]
+        handle.ready.set()
+        conn.metadata["worker_id"] = worker_id
+        return {"ok": True}
+
+    async def _pop_worker(self, env_extra: Dict[str, str]) -> WorkerHandle:
+        env_key = tuple(sorted(env_extra.items()))
+        pool = self.idle_pool.get(env_key)
+        while pool:
+            handle = pool.pop()
+            if handle.proc.poll() is None:
+                handle.leased = True
+                return handle
+        handle = self._spawn_worker(env_extra, env_key)
+        handle.leased = True
+        await asyncio.wait_for(
+            handle.ready.wait(), timeout=GlobalConfig.worker_startup_timeout_s
+        )
+        return handle
+
+    def _return_worker(self, handle: WorkerHandle):
+        handle.leased = False
+        handle.last_idle = time.monotonic()
+        if handle.proc.poll() is None and not handle.is_actor:
+            self.idle_pool.setdefault(handle.env_key, []).append(handle)
+
+    def _kill_worker_proc(self, handle: WorkerHandle):
+        try:
+            if handle.proc.poll() is None:
+                handle.proc.terminate()
+        except Exception:
+            pass
+
+    async def _monitor_workers_loop(self):
+        while True:
+            await asyncio.sleep(0.5)
+            for worker_id, handle in list(self.workers.items()):
+                if handle.proc.poll() is not None:
+                    del self.workers[worker_id]
+                    pool = self.idle_pool.get(handle.env_key)
+                    if pool and handle in pool:
+                        pool.remove(handle)
+                    # Release any lease held by this worker.
+                    for lease_id, lease in list(self.leases.items()):
+                        if lease.worker is handle:
+                            self._release_lease(lease_id)
+                    if handle.is_actor and handle.actor_id is not None:
+                        try:
+                            await self.cp_client.call(
+                                "actor_worker_died",
+                                {
+                                    "actor_id": handle.actor_id,
+                                    "cause": f"worker exited with code "
+                                    f"{handle.proc.returncode}",
+                                },
+                                retries=2,
+                            )
+                        except Exception:
+                            pass
+
+    async def handle_kill_worker(self, payload, conn):
+        for handle in self.workers.values():
+            if handle.address == payload["worker_address"]:
+                handle.is_actor = False  # suppress death report: intentional
+                handle.actor_id = None
+                self._kill_worker_proc(handle)
+                return True
+        return False
+
+    # ---------------------------------------------------------------- leases
+    def _resource_pool(self, pg_id, bundle_index, resources: Optional[ResourceSet] = None):
+        """Resolve the PG bundle pool a lease draws from (None = node pool).
+        For the wildcard index (-1), picks the lowest-indexed bundle of the
+        group that can actually fit ``resources`` right now."""
+        if pg_id is None:
+            return None
+        pool = self.bundles.get((pg_id, bundle_index))
+        if pool is None and bundle_index == -1:
+            fallback = None
+            for (pid, _bi), p in sorted(
+                self.bundles.items(), key=lambda kv: kv[0][1]
+            ):
+                if pid != pg_id:
+                    continue
+                if fallback is None:
+                    fallback = p
+                if resources is None or resources.is_subset_of(p.available):
+                    return p
+            return fallback  # all full: caller re-queues against this one
+        return pool
+
+    async def handle_request_lease(self, payload, conn):
+        """Grant a worker lease, queue it, or reply with a spillback target."""
+        fut = asyncio.get_running_loop().create_future()
+        self._lease_queue.append((payload, fut))
+        self._drain_lease_queue()
+        return await fut
+
+    def _drain_lease_queue(self):
+        still_waiting = []
+        for payload, fut in self._lease_queue:
+            if fut.done():
+                continue
+            granted = self._try_grant(payload, fut)
+            if not granted:
+                still_waiting.append((payload, fut))
+        self._lease_queue = still_waiting
+
+    def _try_grant(self, payload, fut) -> bool:
+        resources = ResourceSet(payload.get("resources") or {})
+        pg_id = payload.get("placement_group_id")
+        bundle_index = payload.get("bundle_index", -1)
+        bundle = self._resource_pool(pg_id, bundle_index, resources)
+        if pg_id is not None:
+            if bundle is None:
+                fut.set_exception(
+                    ValueError(f"placement group {pg_id} has no bundle on this node")
+                )
+                return True
+            if not resources.is_subset_of(bundle.available):
+                return False
+            bundle.available = bundle.available - resources
+        else:
+            if not self.resources.could_ever_fit(resources):
+                asyncio.get_running_loop().create_task(
+                    self._spillback(payload, fut, resources)
+                )
+                return True
+            if not self.resources.acquire(resources):
+                return False
+        instances = self._acquire_instances(resources)
+        if instances is None:
+            # Accounting says the amount fits but chip instances are too
+            # fragmented right now — undo and stay queued.
+            if bundle is not None:
+                bundle.available = bundle.available + resources
+            else:
+                self.resources.release(resources)
+            return False
+        asyncio.get_running_loop().create_task(
+            self._finish_grant(payload, fut, resources, instances, pg_id, bundle_index)
+        )
+        return True
+
+    def _acquire_instances(self, resources: ResourceSet) -> Optional[Dict[str, List[int]]]:
+        """Returns granted instance ids per unit resource, or None if any
+        requested unit resource can't be instance-assigned (never grant a
+        TPU lease without chip isolation)."""
+        instances: Dict[str, List[int]] = {}
+        acquired: List[tuple] = []
+        for name in ResourceInstanceSet.UNIT_RESOURCES:
+            amount = resources.get(name)
+            if amount > 0 and name in self.instances.instances:
+                got = self.instances.acquire(name, amount)
+                if got is None:
+                    for n, a, ids in acquired:
+                        self.instances.release(n, a, ids)
+                    return None
+                instances[name] = got
+                acquired.append((name, amount, got))
+        return instances
+
+    def _release_instances(self, resources: ResourceSet, instances: Dict[str, List[int]]):
+        for name, ids in instances.items():
+            self.instances.release(name, resources.get(name), ids)
+
+    async def _finish_grant(self, payload, fut, resources, instances, pg_id, bundle_index):
+        env_extra = dict(payload.get("env_vars") or {})
+        if "TPU" in instances:
+            chips = ",".join(str(i) for i in instances["TPU"])
+            env_extra[GlobalConfig.tpu_visible_chips_env] = chips
+            env_extra["TPU_VISIBLE_DEVICES"] = chips
+        try:
+            worker = await self._pop_worker(env_extra)
+        except Exception as e:  # noqa: BLE001
+            self._release_pool_resources(resources, instances, pg_id, bundle_index)
+            if not fut.done():
+                fut.set_exception(e)
+            return
+        lease_id = self._next_lease_id
+        self._next_lease_id += 1
+        self.leases[lease_id] = Lease(
+            lease_id, worker, resources, instances, pg_id, bundle_index
+        )
+        if not fut.done():
+            fut.set_result(
+                {
+                    "granted": True,
+                    "lease_id": lease_id,
+                    "worker_address": worker.address,
+                    "worker_id": worker.worker_id,
+                    "instances": instances,
+                }
+            )
+
+    async def _spillback(self, payload, fut, resources: ResourceSet):
+        try:
+            reply = await self.cp_client.call(
+                "pick_node_for_lease",
+                {
+                    "resources": resources.to_dict(),
+                    "strategy": payload.get("strategy"),
+                    "preferred": None,
+                },
+            )
+        except Exception as e:  # noqa: BLE001
+            if not fut.done():
+                fut.set_exception(e)
+            return
+        if not fut.done():
+            if reply.get("infeasible"):
+                fut.set_exception(ValueError(reply["error"]))
+            elif reply.get("node_id") is None:
+                fut.set_result({"granted": False, "retry": True})
+            else:
+                fut.set_result(
+                    {"granted": False, "spillback": reply["agent_address"]}
+                )
+
+    def _release_pool_resources(self, resources, instances, pg_id, bundle_index):
+        self._release_instances(resources, instances)
+        if pg_id is not None:
+            bundle = self._resource_pool(pg_id, bundle_index)
+            if bundle is not None:
+                bundle.available = bundle.available + resources
+        else:
+            self.resources.release(resources)
+
+    def _release_lease(self, lease_id: int):
+        lease = self.leases.pop(lease_id, None)
+        if lease is None:
+            return
+        self._release_pool_resources(
+            lease.resources, lease.instances, lease.pg_id, lease.bundle_index
+        )
+        self._return_worker(lease.worker)
+        self._drain_lease_queue()
+
+    def handle_return_lease(self, payload, conn):
+        self._release_lease(payload["lease_id"])
+        return True
+
+    # ---------------------------------------------------------------- actors
+    async def handle_create_actor_worker(self, payload, conn):
+        spec: ActorSpec = payload["spec"]
+        resources = ResourceSet(spec.resources)
+        bundle = self._resource_pool(spec.placement_group_id, spec.bundle_index, resources)
+        if bundle is not None:
+            if not resources.is_subset_of(bundle.available):
+                raise ValueError("bundle resources exhausted")
+            bundle.available = bundle.available - resources
+        else:
+            if not self.resources.acquire(resources):
+                raise ValueError("insufficient resources for actor")
+        instances = self._acquire_instances(resources)
+        if instances is None:
+            if bundle is not None:
+                bundle.available = bundle.available + resources
+            else:
+                self.resources.release(resources)
+            raise ValueError("accelerator instances fragmented; retry")
+        env_extra = dict(spec.env_vars)
+        if "TPU" in instances:
+            chips = ",".join(str(i) for i in instances["TPU"])
+            env_extra[GlobalConfig.tpu_visible_chips_env] = chips
+            env_extra["TPU_VISIBLE_DEVICES"] = chips
+        try:
+            # Actors always get a fresh worker (their process is their state).
+            env_key = tuple(sorted(env_extra.items()))
+            worker = self._spawn_worker(env_extra, env_key)
+            worker.leased = True
+            worker.is_actor = True
+            worker.actor_id = spec.actor_id
+            await asyncio.wait_for(
+                worker.ready.wait(), timeout=GlobalConfig.worker_startup_timeout_s
+            )
+            # Initialize the actor instance in the worker.
+            from .rpc import RetryableRpcClient as _C
+
+            wclient = _C(worker.address)
+            reply = await wclient.call(
+                "actor_init",
+                {"spec": spec, "incarnation": payload.get("incarnation", 0)},
+                timeout=GlobalConfig.worker_startup_timeout_s,
+            )
+            await wclient.close()
+            if not reply.get("ok"):
+                raise RuntimeError(f"actor init failed: {reply.get('error')}")
+        except Exception:
+            self._release_instances(resources, instances)
+            if bundle is not None:
+                bundle.available = bundle.available + resources
+            else:
+                self.resources.release(resources)
+            raise
+        lease_id = self._next_lease_id
+        self._next_lease_id += 1
+        self.leases[lease_id] = Lease(
+            lease_id,
+            worker,
+            resources,
+            instances,
+            spec.placement_group_id,
+            spec.bundle_index,
+        )
+        return {"worker_address": worker.address, "worker_id": worker.worker_id}
+
+    # ---------------------------------------------------- placement bundles
+    def handle_prepare_bundles(self, payload, conn):
+        pg_id: PlacementGroupID = payload["pg_id"]
+        reserved = []
+        for idx, spec in payload["bundles"].items():
+            rs = ResourceSet(spec)
+            if not self.resources.acquire(rs):
+                for i in reserved:
+                    pool = self.bundles.pop((pg_id, i))
+                    self.resources.release(pool.total)
+                return {"ok": False}
+            self.bundles[(pg_id, idx)] = BundlePool(spec)
+            reserved.append(idx)
+        return {"ok": True}
+
+    def handle_commit_bundles(self, payload, conn):
+        pg_id = payload["pg_id"]
+        for key, pool in self.bundles.items():
+            if key[0] == pg_id:
+                pool.committed = True
+        return True
+
+    def handle_cancel_bundles(self, payload, conn):
+        return self._drop_bundles(payload["pg_id"])
+
+    def handle_return_bundles(self, payload, conn):
+        return self._drop_bundles(payload["pg_id"])
+
+    def _drop_bundles(self, pg_id):
+        for key in [k for k in self.bundles if k[0] == pg_id]:
+            pool = self.bundles.pop(key)
+            self.resources.release(pool.total)
+        self._drain_lease_queue()
+        return True
+
+    # --------------------------------------------------------------- objects
+    def handle_seal_object(self, payload, conn):
+        self.directory.seal(payload["object_id"], payload["size"])
+        return True
+
+    def handle_free_objects(self, payload, conn):
+        for oid in payload["object_ids"]:
+            self.directory.free(oid)
+        return True
+
+    def handle_object_info(self, payload, conn):
+        size = self.directory.size_of(payload["object_id"])
+        return {"exists": size is not None, "size": size}
+
+    def handle_get_object_chunk(self, payload, conn):
+        oid = payload["object_id"]
+        if not self.directory.contains(oid) and not self.shm_store.contains(oid):
+            return {"exists": False}
+        view = self.shm_store.raw_bytes(oid)
+        off, length = payload["offset"], payload["length"]
+        return {"exists": True, "total": len(view), "data": bytes(view[off : off + length])}
+
+    async def handle_pull_object(self, payload, conn):
+        """Pull an object from a remote node into local shm (dedup'd)."""
+        oid: ObjectID = payload["object_id"]
+        if self.directory.contains(oid):
+            return {"ok": True}
+        fut = self._pull_futures.get(oid)
+        if fut is None:
+            fut = asyncio.get_running_loop().create_task(
+                self._do_pull(oid, payload["from_agent"])
+            )
+            self._pull_futures[oid] = fut
+        try:
+            await fut
+        finally:
+            self._pull_futures.pop(oid, None)
+        return {"ok": True}
+
+    async def _do_pull(self, oid: ObjectID, from_agent: str):
+        client = self.agent_clients.get(from_agent)
+        chunk = GlobalConfig.object_chunk_bytes
+        first = await client.call(
+            "get_object_chunk", {"object_id": oid, "offset": 0, "length": chunk}
+        )
+        if not first["exists"]:
+            raise KeyError(f"object {oid} not on {from_agent}")
+        total = first["total"]
+        parts = [first["data"]]
+        got = len(first["data"])
+        while got < total:
+            part = await client.call(
+                "get_object_chunk",
+                {"object_id": oid, "offset": got, "length": chunk},
+            )
+            parts.append(part["data"])
+            got += len(part["data"])
+        payload = b"".join(parts)
+        size = self.shm_store.create_from_bytes(oid, payload)
+        self.directory.seal(oid, size)
+
+    def handle_ping(self, payload, conn):
+        return "pong"
+
+    def handle_debug_state(self, payload, conn):
+        return {
+            "node_id": self.node_id.hex(),
+            "resources": self._snapshot(),
+            "num_workers": len(self.workers),
+            "idle": {str(k): len(v) for k, v in self.idle_pool.items()},
+            "leases": len(self.leases),
+            "queued_leases": len(self._lease_queue),
+            "objects": len(self.directory.object_ids()),
+            "object_bytes": self.directory.used,
+            "rpc_stats": dict(self.server.stats),
+        }
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, required=True)
+    parser.add_argument("--cp-address", required=True)
+    parser.add_argument("--session-id", required=True)
+    parser.add_argument("--resources", required=True, help="JSON dict")
+    parser.add_argument("--labels", default="{}", help="JSON dict")
+    args = parser.parse_args()
+    import json
+
+    logging.basicConfig(
+        level=GlobalConfig.log_level,
+        format="%(asctime)s %(levelname)s node_agent: %(message)s",
+    )
+
+    async def run():
+        agent = NodeAgent(
+            args.host,
+            args.port,
+            args.cp_address,
+            args.session_id,
+            json.loads(args.resources),
+            json.loads(args.labels),
+        )
+        await agent.start()
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,205 @@
+"""Per-process and per-node object stores.
+
+Two tiers, mirroring the reference:
+  - ``MemoryStore``: in-process store for small/inlined objects and futures;
+    ``get`` blocks on async fill (Ray
+    ``src/ray/core_worker/store_provider/memory_store/memory_store.h``).
+  - ``ShmObjectStore``: node-local shared-memory store for large objects,
+    zero-copy reads across processes on the same node (plasma analog).
+
+The node agent hosts the authoritative index of sealed shm objects on its
+node and serves chunked remote pulls; workers create/read segments directly
+through this module (the plasma-client analog).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import shm
+from .ids import ObjectID
+from .serialization import deserialize_from_bytes, serialize_to_bytes
+
+
+class _Entry:
+    __slots__ = ("value", "event", "exception", "ts")
+
+    def __init__(self):
+        self.value = None
+        self.exception = None
+        self.event = asyncio.Event()
+        self.ts = time.monotonic()
+
+
+class MemoryStore:
+    """In-process object store; values indexed by ObjectID.  All methods are
+    called from the core-worker event loop."""
+
+    def __init__(self):
+        self._entries: Dict[ObjectID, _Entry] = {}
+
+    def put(self, object_id: ObjectID, value: Any):
+        entry = self._entries.setdefault(object_id, _Entry())
+        entry.value = value
+        entry.event.set()
+
+    def put_exception(self, object_id: ObjectID, exc: BaseException):
+        entry = self._entries.setdefault(object_id, _Entry())
+        entry.exception = exc
+        entry.event.set()
+
+    def contains(self, object_id: ObjectID) -> bool:
+        e = self._entries.get(object_id)
+        return e is not None and e.event.is_set()
+
+    def peek(self, object_id: ObjectID):
+        e = self._entries.get(object_id)
+        if e is None or not e.event.is_set():
+            raise KeyError(object_id)
+        if e.exception is not None:
+            raise e.exception
+        return e.value
+
+    async def get(self, object_id: ObjectID, timeout: Optional[float] = None):
+        entry = self._entries.setdefault(object_id, _Entry())
+        if not entry.event.is_set():
+            await asyncio.wait_for(entry.event.wait(), timeout=timeout)
+        if entry.exception is not None:
+            raise entry.exception
+        return entry.value
+
+    def free(self, object_id: ObjectID):
+        self._entries.pop(object_id, None)
+
+    def __len__(self):
+        return len(self._entries)
+
+
+class ShmObjectStore:
+    """Client-side access to the node's shared-memory object tier.
+
+    Objects are written by the creating worker directly into /dev/shm and
+    *sealed* with the node agent (which indexes + size-accounts them).
+    Readers attach by name — zero syscalls through the agent on the node-local
+    read path, matching plasma's mmap fast path.
+    """
+
+    def __init__(self, session_id: str):
+        self.session_id = session_id
+        # Attachments are cached for the life of the process: numpy views
+        # returned to user code borrow the mapping.
+        self._attached: Dict[ObjectID, shm.ShmSegment] = {}
+
+    def create(self, object_id: ObjectID, value: Any) -> int:
+        """Serialize ``value`` into a new shm segment.  Returns size."""
+        payload = serialize_to_bytes(value)
+        seg = shm.ShmSegment.create(
+            shm.segment_name(self.session_id, object_id.hex()), len(payload)
+        )
+        seg.view()[: len(payload)] = payload
+        self._attached[object_id] = seg
+        return len(payload)
+
+    def create_from_bytes(self, object_id: ObjectID, payload: bytes) -> int:
+        seg = shm.ShmSegment.create(
+            shm.segment_name(self.session_id, object_id.hex()), len(payload)
+        )
+        seg.view()[: len(payload)] = payload
+        self._attached[object_id] = seg
+        return len(payload)
+
+    def contains(self, object_id: ObjectID) -> bool:
+        if object_id in self._attached:
+            return True
+        try:
+            self._attached[object_id] = shm.ShmSegment.attach(
+                shm.segment_name(self.session_id, object_id.hex())
+            )
+            return True
+        except FileNotFoundError:
+            return False
+
+    def get(self, object_id: ObjectID) -> Any:
+        seg = self._attached.get(object_id)
+        if seg is None:
+            seg = shm.ShmSegment.attach(
+                shm.segment_name(self.session_id, object_id.hex())
+            )
+            self._attached[object_id] = seg
+        return deserialize_from_bytes(seg.view())
+
+    def raw_bytes(self, object_id: ObjectID) -> memoryview:
+        seg = self._attached.get(object_id)
+        if seg is None:
+            seg = shm.ShmSegment.attach(
+                shm.segment_name(self.session_id, object_id.hex())
+            )
+            self._attached[object_id] = seg
+        return seg.view()
+
+    def release(self, object_id: ObjectID):
+        seg = self._attached.pop(object_id, None)
+        if seg is not None:
+            seg.close()
+
+
+class NodeObjectDirectory:
+    """Node-agent-side index of sealed shm objects (sizes, LRU order) plus
+    eviction.  The agent also answers chunked pulls from remote nodes."""
+
+    def __init__(self, session_id: str, capacity_bytes: int):
+        self.session_id = session_id
+        self.capacity = capacity_bytes
+        self.used = 0
+        self._objects: Dict[ObjectID, Tuple[int, float]] = {}  # size, seal_ts
+        self._pinned: Dict[ObjectID, int] = {}
+
+    def seal(self, object_id: ObjectID, size: int):
+        if object_id not in self._objects:
+            self._objects[object_id] = (size, time.monotonic())
+            self.used += size
+            if self.used > self.capacity:
+                self._evict()
+
+    def contains(self, object_id: ObjectID) -> bool:
+        return object_id in self._objects
+
+    def size_of(self, object_id: ObjectID) -> Optional[int]:
+        entry = self._objects.get(object_id)
+        return entry[0] if entry else None
+
+    def pin(self, object_id: ObjectID):
+        self._pinned[object_id] = self._pinned.get(object_id, 0) + 1
+
+    def unpin(self, object_id: ObjectID):
+        n = self._pinned.get(object_id, 0) - 1
+        if n <= 0:
+            self._pinned.pop(object_id, None)
+        else:
+            self._pinned[object_id] = n
+
+    def free(self, object_id: ObjectID):
+        entry = self._objects.pop(object_id, None)
+        if entry is not None:
+            self.used -= entry[0]
+            shm.unlink_by_name(shm.segment_name(self.session_id, object_id.hex()))
+
+    def _evict(self):
+        """LRU-evict unpinned sealed objects until under capacity."""
+        victims = sorted(
+            (oid for oid in self._objects if oid not in self._pinned),
+            key=lambda oid: self._objects[oid][1],
+        )
+        for oid in victims:
+            if self.used <= self.capacity:
+                break
+            self.free(oid)
+
+    def object_ids(self) -> List[ObjectID]:
+        return list(self._objects)
+
+    def cleanup(self):
+        for oid in list(self._objects):
+            self.free(oid)

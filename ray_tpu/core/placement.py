@@ -1,0 +1,123 @@
+"""Placement groups — Python frontend.
+
+Equivalent of ray ``python/ray/util/placement_group.py``: gang resource
+reservation via the control plane's two-phase commit.  The TPU-first addition
+is ``SlicePlacementGroup``: reserve an entire TPU slice by topology as one
+atomic unit (reference precedent: ray ``python/ray/util/tpu.py:52``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from .core_worker import global_worker
+from .ids import PlacementGroupID
+from .scheduler import PlacementGroupStrategy
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: PlacementGroupID, bundles: List[Dict[str, float]]):
+        self.id = pg_id
+        self.bundles = bundles
+
+    def ready(self, timeout: Optional[float] = None) -> bool:
+        """Block until the group is created (2-phase commit finished)."""
+        worker = global_worker()
+        deadline = None if timeout is None else time.monotonic() + timeout
+
+        while True:
+            info = worker._run_sync(
+                worker.cp.call("get_placement_group", {"pg_id": self.id})
+            )
+            if info is None:
+                raise ValueError(f"placement group {self.id} unknown")
+            if info["state"] == "CREATED":
+                return True
+            if info["state"] == "REMOVED":
+                raise ValueError(f"placement group {self.id} was removed")
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            time.sleep(0.05)
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self.bundles)
+
+    def bundle_specs(self) -> List[Dict[str, float]]:
+        return list(self.bundles)
+
+    def __reduce__(self):
+        return (PlacementGroup, (self.id, self.bundles))
+
+
+def placement_group(
+    bundles: List[Dict[str, float]],
+    strategy: str = "PACK",
+    name: str = "",
+) -> PlacementGroup:
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(f"strategy must be one of {VALID_STRATEGIES}")
+    if not bundles or any(not b for b in bundles):
+        raise ValueError("bundles must be a non-empty list of non-empty dicts")
+    worker = global_worker()
+    pg_id = PlacementGroupID.from_random()
+    worker._run_sync(
+        worker.cp.call(
+            "create_placement_group",
+            {"pg_id": pg_id, "bundles": bundles, "strategy": strategy, "name": name},
+        )
+    )
+    return PlacementGroup(pg_id, bundles)
+
+
+def remove_placement_group(pg: PlacementGroup):
+    worker = global_worker()
+    worker._run_sync(worker.cp.call("remove_placement_group", {"pg_id": pg.id}))
+
+
+def placement_group_strategy(
+    pg: PlacementGroup, bundle_index: int = -1
+) -> PlacementGroupStrategy:
+    """Scheduling-strategy object for @remote(scheduling_strategy=…)."""
+    return PlacementGroupStrategy(pg.id.hex(), bundle_index)
+
+
+class SlicePlacementGroup:
+    """Reserve a whole TPU slice (all hosts of a pod) as one gang unit.
+
+    One bundle per host, each requesting the host's chips; STRICT_SPREAD so
+    each bundle lands on a distinct host of the slice.  Workers of a
+    JaxTrainer-style gang schedule into these bundles, guaranteeing the ICI
+    mesh is fully owned by one job.
+    """
+
+    def __init__(
+        self,
+        num_hosts: int,
+        chips_per_host: int = 4,
+        accelerator_version: str = "",
+        name: str = "",
+    ):
+        self.num_hosts = num_hosts
+        self.chips_per_host = chips_per_host
+        resource = f"TPU-{accelerator_version}" if accelerator_version else "TPU"
+        bundles = [
+            {"TPU": float(chips_per_host)} for _ in range(num_hosts)
+        ]
+        if accelerator_version:
+            for b in bundles:
+                b[resource] = float(chips_per_host)
+        strategy = "STRICT_SPREAD" if num_hosts > 1 else "PACK"
+        self.pg = placement_group(bundles, strategy=strategy, name=name)
+
+    def ready(self, timeout: Optional[float] = None) -> bool:
+        return self.pg.ready(timeout)
+
+    def strategy_for_host(self, host_index: int) -> PlacementGroupStrategy:
+        return placement_group_strategy(self.pg, host_index)
+
+    def remove(self):
+        remove_placement_group(self.pg)

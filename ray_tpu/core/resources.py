@@ -1,0 +1,192 @@
+"""Resource accounting data model.
+
+Equivalent of the reference's scheduling data model
+(Ray ``src/ray/common/scheduling/fixed_point.h``, ``resource_set.h``,
+``cluster_resource_data.h``): fixed-point arithmetic (no float drift when
+repeatedly acquiring/releasing 0.1 CPU), per-node totals/availables, and
+instance-granular accounting for accelerator chips so a task holding
+``TPU: 2`` knows *which* chips it holds (drives TPU_VISIBLE_CHIPS isolation).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+PRECISION = 10000  # fixed-point denominator
+
+
+def to_fixed(v: float) -> int:
+    return int(round(v * PRECISION))
+
+
+def from_fixed(v: int) -> float:
+    return v / PRECISION
+
+
+class ResourceSet:
+    """Immutable-ish mapping resource-name -> fixed-point amount."""
+
+    __slots__ = ("_amounts",)
+
+    def __init__(self, amounts: Optional[Dict[str, float]] = None, _fixed=None):
+        if _fixed is not None:
+            self._amounts = {k: v for k, v in _fixed.items() if v != 0}
+        else:
+            self._amounts = {
+                k: to_fixed(v) for k, v in (amounts or {}).items() if v != 0
+            }
+
+    @classmethod
+    def _from_fixed(cls, fixed: Dict[str, int]) -> "ResourceSet":
+        return cls(_fixed=fixed)
+
+    def to_dict(self) -> Dict[str, float]:
+        return {k: from_fixed(v) for k, v in self._amounts.items()}
+
+    def get(self, name: str) -> float:
+        return from_fixed(self._amounts.get(name, 0))
+
+    def is_empty(self) -> bool:
+        return not self._amounts
+
+    def is_subset_of(self, other: "ResourceSet") -> bool:
+        return all(other._amounts.get(k, 0) >= v for k, v in self._amounts.items())
+
+    def __add__(self, other: "ResourceSet") -> "ResourceSet":
+        out = dict(self._amounts)
+        for k, v in other._amounts.items():
+            out[k] = out.get(k, 0) + v
+        return ResourceSet._from_fixed(out)
+
+    def __sub__(self, other: "ResourceSet") -> "ResourceSet":
+        out = dict(self._amounts)
+        for k, v in other._amounts.items():
+            out[k] = out.get(k, 0) - v
+        return ResourceSet._from_fixed(out)
+
+    def non_negative(self) -> bool:
+        return all(v >= 0 for v in self._amounts.values())
+
+    def __eq__(self, other):
+        return isinstance(other, ResourceSet) and self._amounts == other._amounts
+
+    def __repr__(self):
+        return f"ResourceSet({self.to_dict()})"
+
+    def __getstate__(self):
+        return self._amounts
+
+    def __setstate__(self, state):
+        self._amounts = state
+
+
+class ResourceInstanceSet:
+    """Instance-granular accounting for discrete resources (TPU chips).
+
+    For a node with 4 TPU chips, ``instances['TPU'] == [1.0, 1.0, 1.0, 1.0]``
+    (fixed-point).  Acquiring ``TPU: 2`` returns the indices of the chips
+    granted, which the worker-pool turns into TPU_VISIBLE_CHIPS env isolation
+    (reference precedent: ray ``python/ray/_private/accelerators/tpu.py``).
+    """
+
+    UNIT_RESOURCES = ("TPU", "GPU")
+
+    def __init__(self, totals: Dict[str, float]):
+        self.instances: Dict[str, List[int]] = {}
+        for name, amount in totals.items():
+            if name in self.UNIT_RESOURCES and amount == int(amount):
+                self.instances[name] = [PRECISION] * int(amount)
+
+    def acquire(self, name: str, amount: float) -> Optional[List[int]]:
+        """Greedy-pack instances; returns granted instance ids or None.
+        Mixed requests (e.g. 1.5 chips) take whole chips for the integer part
+        and pack the remainder onto a partially-free instance."""
+        insts = self.instances.get(name)
+        if insts is None:
+            return None
+        need = to_fixed(amount)
+        whole, frac = divmod(need, PRECISION)
+        granted: List[int] = []
+        for i, avail in enumerate(insts):
+            if len(granted) >= whole:
+                break
+            if avail == PRECISION:
+                granted.append(i)
+        if len(granted) < whole:
+            return None
+        frac_idx = None
+        if frac > 0:
+            # Pack the fraction onto the instance with least (but enough) room
+            # among instances not already claimed whole.
+            for i, avail in enumerate(insts):
+                if i in granted:
+                    continue
+                if avail >= frac and (frac_idx is None or avail < insts[frac_idx]):
+                    frac_idx = i
+            if frac_idx is None:
+                return None
+        for i in granted:
+            insts[i] = 0
+        if frac_idx is not None:
+            insts[frac_idx] -= frac
+            granted.append(frac_idx)
+        return granted
+
+    def release(self, name: str, amount: float, instance_ids: List[int]):
+        """Inverse of acquire: whole-chip ids come first in instance_ids, the
+        fractional id (if any) last — mirror that layout when releasing."""
+        insts = self.instances.get(name)
+        if insts is None or not instance_ids:
+            return
+        whole, frac = divmod(to_fixed(amount), PRECISION)
+        for i in instance_ids[:whole]:
+            insts[i] = PRECISION
+        if frac > 0:
+            i = instance_ids[-1]
+            insts[i] = min(PRECISION, insts[i] + frac)
+
+
+class NodeResources:
+    """A node's total + available resources, plus labels (ICI topology etc.)."""
+
+    def __init__(self, total: Dict[str, float], labels: Optional[Dict[str, str]] = None):
+        self.total = ResourceSet(total)
+        self.available = ResourceSet(total)
+        self.labels = labels or {}
+
+    def can_fit(self, request: ResourceSet) -> bool:
+        return request.is_subset_of(self.available)
+
+    def could_ever_fit(self, request: ResourceSet) -> bool:
+        return request.is_subset_of(self.total)
+
+    def acquire(self, request: ResourceSet) -> bool:
+        if not self.can_fit(request):
+            return False
+        self.available = self.available - request
+        return True
+
+    def release(self, request: ResourceSet):
+        self.available = self.available + request
+        # Clamp against accounting bugs.
+        for k, v in self.available._amounts.items():
+            cap = self.total._amounts.get(k, 0)
+            if v > cap:
+                self.available._amounts[k] = cap
+
+    def utilization(self) -> float:
+        """Max utilization across resource kinds (drives hybrid policy)."""
+        best = 0.0
+        for k, tot in self.total._amounts.items():
+            if tot <= 0:
+                continue
+            used = tot - self.available._amounts.get(k, 0)
+            best = max(best, used / tot)
+        return best
+
+    def snapshot(self) -> dict:
+        return {
+            "total": self.total.to_dict(),
+            "available": self.available.to_dict(),
+            "labels": dict(self.labels),
+        }

@@ -1,0 +1,420 @@
+"""Asyncio RPC substrate for the control/data plane.
+
+Role-equivalent of the reference's gRPC wrappers + retryable clients + chaos
+injection (Ray ``src/ray/rpc/grpc_server.h``, ``rpc/retryable_grpc_client.h``,
+``rpc/rpc_chaos.h``).  We deliberately use a lean length-prefixed pickle
+protocol over TCP instead of gRPC: every system process runs a single asyncio
+event loop (the analog of the reference's one-``instrumented_io_context``-per-
+process discipline), and the hot paths (lease grant, task push) are one
+round-trip with zero protobuf marshalling overhead.
+
+Wire format: [8-byte little-endian length][pickle(frame)]
+  request frame :  (msg_id, method, payload)        msg_id > 0
+  oneway frame  :  (0, method, payload)
+  reply frame   :  (-msg_id, kind, payload)         kind in ('R', 'E')
+
+Fault injection: set config ``testing_rpc_failure`` to
+``"method:p_req:p_resp,…"`` (or ``*`` for all methods) to randomly fail
+requests before send / replies after receive — the analog of
+``RAY_testing_rpc_failure`` (Ray ``src/ray/rpc/rpc_chaos.h:24-44``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import pickle
+import random
+import socket
+import time
+import traceback
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from .config import GlobalConfig
+
+logger = logging.getLogger(__name__)
+
+Address = str  # "host:port"
+
+
+class RpcError(Exception):
+    pass
+
+
+class RpcConnectionError(RpcError):
+    """Transport-level failure; safe to retry idempotent calls."""
+
+
+class RpcRemoteError(RpcError):
+    """The remote handler raised; carries the remote traceback string."""
+
+    def __init__(self, method, exc, tb):
+        super().__init__(f"remote error in {method}: {exc}\n{tb}")
+        self.method = method
+        self.cause = exc
+        self.remote_traceback = tb
+
+
+class _ChaosInjector:
+    """Parses the testing_rpc_failure spec once; rolls dice per call."""
+
+    def __init__(self):
+        self._rules: Dict[str, Tuple[float, float]] = {}
+        spec = GlobalConfig.testing_rpc_failure
+        if spec:
+            for entry in spec.split(","):
+                parts = entry.strip().split(":")
+                if len(parts) == 3:
+                    self._rules[parts[0]] = (float(parts[1]), float(parts[2]))
+
+    def enabled(self) -> bool:
+        return bool(self._rules)
+
+    def _probs(self, method) -> Tuple[float, float]:
+        return self._rules.get(method) or self._rules.get("*") or (0.0, 0.0)
+
+    def fail_request(self, method) -> bool:
+        return random.random() < self._probs(method)[0]
+
+    def fail_response(self, method) -> bool:
+        return random.random() < self._probs(method)[1]
+
+
+def parse_address(addr: Address) -> Tuple[str, int]:
+    host, port = addr.rsplit(":", 1)
+    return host, int(port)
+
+
+def find_free_port(host: str = "127.0.0.1") -> int:
+    with socket.socket() as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+_LEN = 8
+
+
+async def _read_frame(reader: asyncio.StreamReader):
+    hdr = await reader.readexactly(_LEN)
+    length = int.from_bytes(hdr, "little")
+    data = await reader.readexactly(length)
+    return pickle.loads(data)
+
+
+def _encode_frame(frame) -> bytes:
+    data = pickle.dumps(frame, protocol=5)
+    return len(data).to_bytes(_LEN, "little") + data
+
+
+class RpcServer:
+    """Serves a handler object: each RPC method ``m`` dispatches to
+    ``handler.handle_m(payload, ctx)`` (async or sync).  ``ctx`` exposes the
+    peer connection for server-push (pubsub)."""
+
+    def __init__(self, handler, host: str = "127.0.0.1", port: int = 0):
+        self._handler = handler
+        self._host = host
+        self._port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._conns: set = set()
+        # Per-handler latency stats (analog of event_stats.h).
+        self.stats: Dict[str, list] = {}
+
+    @property
+    def address(self) -> Address:
+        return f"{self._host}:{self._port}"
+
+    async def start(self):
+        self._server = await asyncio.start_server(
+            self._on_connection, self._host, self._port
+        )
+        self._port = self._server.sockets[0].getsockname()[1]
+        return self.address
+
+    async def stop(self):
+        # Close live connections first: in py3.12 Server.wait_closed() blocks
+        # until every connection handler returns.
+        for conn in list(self._conns):
+            conn.close()
+        if self._server:
+            self._server.close()
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), timeout=1.0)
+            except Exception:
+                pass
+
+    async def _on_connection(self, reader, writer):
+        conn = ServerConnection(reader, writer)
+        self._conns.add(conn)
+        try:
+            while True:
+                try:
+                    frame = await _read_frame(reader)
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    break
+                msg_id, method, payload = frame
+                # Handlers run as independent tasks so one slow call never
+                # blocks the connection (actor ordering is enforced above
+                # this layer by sequence numbers, not by transport order).
+                asyncio.get_running_loop().create_task(
+                    self._dispatch(conn, msg_id, method, payload)
+                )
+        finally:
+            self._conns.discard(conn)
+            conn.close()
+            if hasattr(self._handler, "on_connection_closed"):
+                try:
+                    res = self._handler.on_connection_closed(conn)
+                    if asyncio.iscoroutine(res):
+                        await res
+                except Exception:
+                    logger.exception("on_connection_closed failed")
+
+    async def _dispatch(self, conn, msg_id, method, payload):
+        start = time.perf_counter()
+        try:
+            fn = getattr(self._handler, "handle_" + method, None)
+            if fn is None:
+                raise RpcError(f"no handler for method {method!r}")
+            result = fn(payload, conn)
+            if asyncio.iscoroutine(result):
+                result = await result
+            if msg_id > 0:
+                await conn.send((-msg_id, "R", result))
+        except Exception as e:  # noqa: BLE001 - serialize any handler error
+            if msg_id > 0:
+                try:
+                    await conn.send((-msg_id, "E", (e, traceback.format_exc())))
+                except Exception:
+                    logger.exception("failed to send error reply for %s", method)
+            else:
+                logger.exception("oneway handler %s failed", method)
+        finally:
+            self.stats.setdefault(method, [0, 0.0])
+            s = self.stats[method]
+            s[0] += 1
+            s[1] += time.perf_counter() - start
+
+
+class ServerConnection:
+    """Server-side view of a client connection; supports server-push."""
+
+    def __init__(self, reader, writer):
+        self._reader = reader
+        self._writer = writer
+        self._lock = asyncio.Lock()
+        self.metadata: Dict[str, Any] = {}  # handlers can stash identity here
+
+    async def send(self, frame):
+        async with self._lock:
+            self._writer.write(_encode_frame(frame))
+            await self._writer.drain()
+
+    async def push(self, method: str, payload):
+        """One-way server→client message (pubsub delivery)."""
+        await self.send((0, method, payload))
+
+    def close(self):
+        try:
+            self._writer.close()
+        except Exception:
+            pass
+
+    @property
+    def peername(self):
+        try:
+            return self._writer.get_extra_info("peername")
+        except Exception:
+            return None
+
+
+class RpcClient:
+    """A connection to one RpcServer.  Safe for concurrent calls from one
+    event loop.  Push messages from the server are delivered to
+    ``push_handler(method, payload)`` if set."""
+
+    def __init__(self, address: Address, push_handler: Optional[Callable] = None):
+        self.address = address
+        self._push_handler = push_handler
+        self._reader = None
+        self._writer = None
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._next_id = 1
+        self._lock = asyncio.Lock()
+        self._read_task = None
+        self._closed = False
+        self._chaos = _ChaosInjector()
+
+    async def connect(self):
+        host, port = parse_address(self.address)
+        self._reader, self._writer = await asyncio.wait_for(
+            asyncio.open_connection(host, port),
+            timeout=GlobalConfig.rpc_connect_timeout_s,
+        )
+        self._read_task = asyncio.get_running_loop().create_task(self._read_loop())
+        return self
+
+    async def _read_loop(self):
+        try:
+            while True:
+                frame = await _read_frame(self._reader)
+                msg_id, kind, payload = frame
+                if msg_id == 0:
+                    if self._push_handler:
+                        try:
+                            res = self._push_handler(kind, payload)
+                            if asyncio.iscoroutine(res):
+                                asyncio.get_running_loop().create_task(res)
+                        except Exception:
+                            logger.exception("push handler failed for %s", kind)
+                    continue
+                fut = self._pending.pop(-msg_id, None)
+                if fut is not None and not fut.done():
+                    if kind == "R":
+                        fut.set_result(payload)
+                    else:
+                        exc, tb = payload
+                        fut.set_exception(RpcRemoteError("?", exc, tb))
+        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+            pass
+        except Exception:
+            logger.exception("rpc client read loop error (%s)", self.address)
+        finally:
+            self._closed = True  # peer gone: force reconnect on next use
+            self._fail_all_pending(RpcConnectionError(f"connection to {self.address} lost"))
+
+    def _fail_all_pending(self, exc):
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(exc)
+        self._pending.clear()
+
+    @property
+    def connected(self) -> bool:
+        return (
+            self._writer is not None
+            and not self._closed
+            and not self._writer.is_closing()
+        )
+
+    async def call(self, method: str, payload=None, timeout: Optional[float] = None):
+        if not self.connected:
+            raise RpcConnectionError(f"not connected to {self.address}")
+        if self._chaos.enabled() and self._chaos.fail_request(method):
+            raise RpcConnectionError(f"[chaos] dropped request {method}")
+        async with self._lock:
+            msg_id = self._next_id
+            self._next_id += 1
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[msg_id] = fut
+        try:
+            self._writer.write(_encode_frame((msg_id, method, payload)))
+            await self._writer.drain()
+        except (ConnectionError, RuntimeError) as e:
+            self._pending.pop(msg_id, None)
+            raise RpcConnectionError(str(e)) from e
+        timeout = timeout if timeout is not None else GlobalConfig.rpc_call_timeout_s
+        try:
+            result = await asyncio.wait_for(fut, timeout=timeout)
+        except asyncio.TimeoutError:
+            self._pending.pop(msg_id, None)
+            raise RpcError(f"rpc {method} to {self.address} timed out after {timeout}s")
+        if self._chaos.enabled() and self._chaos.fail_response(method):
+            raise RpcConnectionError(f"[chaos] dropped response {method}")
+        return result
+
+    async def notify(self, method: str, payload=None):
+        if not self.connected:
+            raise RpcConnectionError(f"not connected to {self.address}")
+        self._writer.write(_encode_frame((0, method, payload)))
+        await self._writer.drain()
+
+    async def close(self):
+        self._closed = True
+        if self._read_task:
+            self._read_task.cancel()
+        if self._writer:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+
+
+class RetryableRpcClient:
+    """Reconnecting client with exponential backoff — the analog of
+    ``RetryableGrpcClient``.  Only retries on transport failures, never on
+    remote exceptions; callers must ensure retried methods are idempotent."""
+
+    def __init__(self, address: Address, push_handler=None):
+        self.address = address
+        self._push_handler = push_handler
+        self._client: Optional[RpcClient] = None
+        self._connect_lock = asyncio.Lock()
+
+    async def _ensure(self) -> RpcClient:
+        if self._client and self._client.connected:
+            return self._client
+        async with self._connect_lock:
+            if self._client and self._client.connected:
+                return self._client
+            self._client = RpcClient(self.address, self._push_handler)
+            await self._client.connect()
+            return self._client
+
+    async def call(self, method: str, payload=None, timeout=None, retries=None):
+        retries = retries if retries is not None else GlobalConfig.rpc_max_retries
+        delay = GlobalConfig.rpc_retry_base_delay_s
+        last_exc = None
+        for _attempt in range(max(1, retries)):
+            try:
+                client = await self._ensure()
+                return await client.call(method, payload, timeout)
+            except (RpcConnectionError, ConnectionError, OSError, asyncio.TimeoutError) as e:
+                last_exc = e
+                self._client = None
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, GlobalConfig.rpc_retry_max_delay_s)
+        raise RpcConnectionError(
+            f"rpc {method} to {self.address} failed after {retries} attempts: {last_exc}"
+        )
+
+    async def notify(self, method: str, payload=None):
+        client = await self._ensure()
+        await client.notify(method, payload)
+
+    async def close(self):
+        if self._client:
+            await self._client.close()
+            self._client = None
+
+
+class ClientPool:
+    """Cached clients keyed by address (analog of CoreWorkerClientPool /
+    RayletClientPool)."""
+
+    def __init__(self, retryable: bool = True):
+        self._retryable = retryable
+        self._clients: Dict[Address, Any] = {}
+
+    def get(self, address: Address, push_handler=None):
+        client = self._clients.get(address)
+        if client is None:
+            client = (
+                RetryableRpcClient(address, push_handler)
+                if self._retryable
+                else RpcClient(address, push_handler)
+            )
+            self._clients[address] = client
+        return client
+
+    def invalidate(self, address: Address):
+        self._clients.pop(address, None)
+
+    async def close_all(self):
+        for c in self._clients.values():
+            try:
+                await c.close()
+            except Exception:
+                pass
+        self._clients.clear()

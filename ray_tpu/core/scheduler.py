@@ -1,0 +1,215 @@
+"""Cluster-level scheduling policies.
+
+Equivalent of the reference's policy layer (Ray
+``src/ray/raylet/scheduling/policy/hybrid_scheduling_policy.h``,
+``bundle_scheduling_policy.h``): given an eventually-consistent view of all
+nodes' resources (gossiped by the control plane), pick a node for a lease or
+a set of nodes for a placement-group's bundles.
+
+Policies:
+  - hybrid (default): pack onto best-utilized feasible nodes until a node
+    crosses ``scheduler_spread_threshold`` utilization, then spread; ties
+    broken by top-k random choice to avoid herding.
+  - spread: round-robin across feasible nodes.
+  - node-affinity: pin to a node (soft or hard).
+  - label-match: restrict to nodes whose labels satisfy a selector
+    (used for ICI-topology-aware placement, e.g. {"tpu-slice": "v5e-16"}).
+  - bundle pack/spread with STRICT variants for placement groups.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional
+
+from .ids import NodeID
+from .resources import NodeResources, ResourceSet
+from .config import GlobalConfig
+
+
+class SchedulingStrategy:
+    """Base marker; concrete strategies below are plain picklable structs."""
+
+
+class DefaultStrategy(SchedulingStrategy):
+    pass
+
+
+class SpreadStrategy(SchedulingStrategy):
+    pass
+
+
+class NodeAffinityStrategy(SchedulingStrategy):
+    def __init__(self, node_id_hex: str, soft: bool = False):
+        self.node_id_hex = node_id_hex
+        self.soft = soft
+
+
+class NodeLabelStrategy(SchedulingStrategy):
+    def __init__(self, hard: Dict[str, str]):
+        self.hard = hard
+
+
+class PlacementGroupStrategy(SchedulingStrategy):
+    def __init__(self, pg_id_hex: str, bundle_index: int = -1):
+        self.pg_id_hex = pg_id_hex
+        self.bundle_index = bundle_index
+
+
+def _feasible(
+    nodes: Dict[NodeID, NodeResources], request: ResourceSet, available: bool
+) -> List[NodeID]:
+    out = []
+    for nid, res in nodes.items():
+        ok = res.can_fit(request) if available else res.could_ever_fit(request)
+        if ok:
+            out.append(nid)
+    return out
+
+
+class ClusterScheduler:
+    """Holds the cluster resource view; pure policy, no IO."""
+
+    def __init__(self):
+        self.nodes: Dict[NodeID, NodeResources] = {}
+        self._spread_rr = 0
+
+    def update_node(self, node_id: NodeID, snapshot: dict):
+        nr = self.nodes.get(node_id)
+        if nr is None:
+            nr = NodeResources(snapshot["total"], snapshot.get("labels"))
+            self.nodes[node_id] = nr
+        nr.total = ResourceSet(snapshot["total"])
+        nr.available = ResourceSet(snapshot["available"])
+        nr.labels = snapshot.get("labels", {})
+
+    def remove_node(self, node_id: NodeID):
+        self.nodes.pop(node_id, None)
+
+    # ------------------------------------------------------------------ tasks
+    def pick_node(
+        self,
+        request: ResourceSet,
+        strategy: Optional[SchedulingStrategy] = None,
+        preferred: Optional[NodeID] = None,
+    ) -> Optional[NodeID]:
+        """Returns a node id, or None if infeasible right now.  Raises
+        ValueError if no node could *ever* satisfy the request."""
+        if isinstance(strategy, NodeAffinityStrategy):
+            target = NodeID.from_hex(strategy.node_id_hex)
+            nr = self.nodes.get(target)
+            if nr is not None and nr.can_fit(request):
+                return target
+            if not strategy.soft:
+                return None
+            strategy = None  # soft: fall through to hybrid
+        candidates = self.nodes
+        if isinstance(strategy, NodeLabelStrategy):
+            candidates = {
+                nid: nr
+                for nid, nr in self.nodes.items()
+                if all(nr.labels.get(k) == v for k, v in strategy.hard.items())
+            }
+        feasible_now = _feasible(candidates, request, available=True)
+        if not feasible_now:
+            if not _feasible(candidates, request, available=False):
+                if not candidates:
+                    return None
+                raise InfeasibleError(
+                    f"no node can ever satisfy {request.to_dict()} "
+                    f"(strategy={type(strategy).__name__ if strategy else 'default'})"
+                )
+            return None
+        if isinstance(strategy, SpreadStrategy):
+            feasible_now.sort(key=lambda n: self.nodes[n].utilization())
+            return feasible_now[0]
+        return self._hybrid_pick(feasible_now, preferred)
+
+    def _hybrid_pick(
+        self, feasible: List[NodeID], preferred: Optional[NodeID]
+    ) -> NodeID:
+        threshold = GlobalConfig.scheduler_spread_threshold
+        # Prefer the local/preferred node if it is under the pack threshold.
+        if preferred is not None and preferred in feasible:
+            if self.nodes[preferred].utilization() < threshold:
+                return preferred
+        below = [n for n in feasible if self.nodes[n].utilization() < threshold]
+        if below:
+            # Pack: highest utilization first (fill nodes up), top-k random.
+            below.sort(key=lambda n: -self.nodes[n].utilization())
+            k = max(1, int(len(below) * GlobalConfig.scheduler_top_k_fraction))
+            return random.choice(below[:k])
+        # All above threshold: spread to least utilized.
+        feasible.sort(key=lambda n: self.nodes[n].utilization())
+        return feasible[0]
+
+    # ---------------------------------------------------------------- bundles
+    def pick_nodes_for_bundles(
+        self,
+        bundles: List[ResourceSet],
+        strategy: str,
+    ) -> Optional[List[NodeID]]:
+        """Two-phase-commit phase 0: choose a node per bundle (same node may
+        appear multiple times for PACK).  Returns None if currently
+        infeasible.  Simulates acquisition against a scratch copy of the view
+        so co-scheduled bundles don't double-book."""
+        scratch: Dict[NodeID, NodeResources] = {}
+        for nid, nr in self.nodes.items():
+            copy = NodeResources(nr.total.to_dict(), dict(nr.labels))
+            copy.available = ResourceSet(nr.available.to_dict())
+            scratch[nid] = copy
+
+        assignment: List[Optional[NodeID]] = [None] * len(bundles)
+
+        def try_assign(order_nodes: List[NodeID], idx: int) -> bool:
+            for nid in order_nodes:
+                if scratch[nid].acquire(bundles[idx]):
+                    assignment[idx] = nid
+                    return True
+            return False
+
+        if strategy in ("STRICT_PACK",):
+            for nid, nr in scratch.items():
+                total_needed = bundles[0]
+                for b in bundles[1:]:
+                    total_needed = total_needed + b
+                if total_needed.is_subset_of(nr.available):
+                    return [nid] * len(bundles)
+            return None
+        if strategy in ("STRICT_SPREAD",):
+            used: set = set()
+            for i, b in enumerate(bundles):
+                cands = [
+                    n
+                    for n in scratch
+                    if n not in used and scratch[n].can_fit(b)
+                ]
+                cands.sort(key=lambda n: scratch[n].utilization())
+                if not cands:
+                    return None
+                scratch[cands[0]].acquire(b)
+                assignment[i] = cands[0]
+                used.add(cands[0])
+            return assignment  # type: ignore[return-value]
+        if strategy == "SPREAD":
+            for i, b in enumerate(bundles):
+                cands = sorted(
+                    (n for n in scratch if scratch[n].can_fit(b)),
+                    key=lambda n: scratch[n].utilization(),
+                )
+                if not try_assign(cands, i):
+                    return None
+            return assignment  # type: ignore[return-value]
+        # PACK (default): minimize node count — fill best-utilized first.
+        for i, b in enumerate(bundles):
+            cands = sorted(
+                (n for n in scratch if scratch[n].can_fit(b)),
+                key=lambda n: -scratch[n].utilization(),
+            )
+            if not try_assign(cands, i):
+                return None
+        return assignment  # type: ignore[return-value]
+
+
+class InfeasibleError(Exception):
+    """Raised when a request can never be satisfied by the current cluster."""

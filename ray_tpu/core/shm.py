@@ -1,0 +1,102 @@
+"""Shared-memory segments backed by mmap'd files in /dev/shm.
+
+Equivalent of the plasma store's memory substrate (Ray
+``src/ray/object_manager/plasma/``: dlmalloc over mmap'd /dev/shm with fd
+passing).  We use one named file per object instead of a single arena +
+allocator: the kernel's tmpfs is the allocator, segments are named by object
+id so any process on the node can attach without fd passing, and unlinking is
+the eviction primitive.  A C++ arena allocator can replace this under the same
+interface later without touching callers.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import secrets
+from typing import Optional
+
+SHM_DIR = "/dev/shm"
+_PREFIX = "rtpu"
+
+
+def segment_name(session_id: str, object_hex: str) -> str:
+    return f"{_PREFIX}_{session_id}_{object_hex[:24]}"
+
+
+def _path(name: str) -> str:
+    return os.path.join(SHM_DIR, name)
+
+
+class ShmSegment:
+    """A single mmap'd shared-memory segment."""
+
+    def __init__(self, name: str, mm: mmap.mmap, size: int, created: bool):
+        self.name = name
+        self.mm = mm
+        self.size = size
+        self.created = created
+        self._closed = False
+
+    @classmethod
+    def create(cls, name: str, size: int) -> "ShmSegment":
+        path = _path(name)
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+        try:
+            os.ftruncate(fd, size)
+            mm = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+        return cls(name, mm, size, created=True)
+
+    @classmethod
+    def attach(cls, name: str) -> "ShmSegment":
+        path = _path(name)
+        fd = os.open(path, os.O_RDWR)
+        try:
+            size = os.fstat(fd).st_size
+            mm = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+        return cls(name, mm, size, created=False)
+
+    def view(self) -> memoryview:
+        return memoryview(self.mm)
+
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            try:
+                self.mm.close()
+            except (BufferError, ValueError):
+                # Exported numpy views still alive; leave the mapping in
+                # place — the OS reclaims it when the process exits.
+                self._closed = False
+
+    def unlink(self):
+        try:
+            os.unlink(_path(self.name))
+        except FileNotFoundError:
+            pass
+
+
+def unlink_by_name(name: str):
+    try:
+        os.unlink(_path(name))
+    except FileNotFoundError:
+        pass
+
+
+def cleanup_session(session_id: str):
+    """Remove all segments belonging to a session (called on shutdown)."""
+    prefix = f"{_PREFIX}_{session_id}_"
+    try:
+        for entry in os.listdir(SHM_DIR):
+            if entry.startswith(prefix):
+                unlink_by_name(entry)
+    except FileNotFoundError:
+        pass
+
+
+def new_session_id() -> str:
+    return secrets.token_hex(4)

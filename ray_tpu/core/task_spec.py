@@ -1,0 +1,150 @@
+"""Task/actor specifications and object references.
+
+Equivalent of the reference's ``TaskSpecification`` (Ray
+``src/ray/common/task/task_spec.h``) and ``ObjectRef``.  Specs are plain
+picklable structs; function bodies are NOT embedded — they are exported once
+per job to the control-plane KV store keyed by a content hash (the
+function-manager pattern, Ray ``python/ray/_private/function_manager.py``)
+and fetched+cached by workers.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from .ids import ActorID, JobID, ObjectID, PlacementGroupID, TaskID
+from .scheduler import SchedulingStrategy
+
+
+def function_key(pickled_fn: bytes) -> str:
+    return "fn:" + hashlib.sha256(pickled_fn).hexdigest()[:32]
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    job_id: JobID
+    function_id: str  # KV key of the exported function
+    name: str  # human-readable, for errors/state API
+    # Serialized positional/keyword args.  ObjectRefs inside are replaced by
+    # _RefMarker sentinels during serialization (see core_worker).
+    args_payload: bytes
+    num_returns: int = 1
+    resources: Dict[str, float] = field(default_factory=dict)
+    strategy: Optional[SchedulingStrategy] = None
+    max_retries: int = 0
+    retry_exceptions: bool = False
+    owner_address: str = ""  # core-worker RPC address of the owner
+    # Actor fields
+    actor_id: Optional[ActorID] = None  # set for actor tasks
+    actor_creation: bool = False
+    sequence_number: int = -1  # per-(caller, actor) ordering
+    # Placement group
+    placement_group_id: Optional[PlacementGroupID] = None
+    bundle_index: int = -1
+    # Runtime env (round-1: env vars only)
+    env_vars: Dict[str, str] = field(default_factory=dict)
+
+    @property
+    def scheduling_class(self) -> Tuple:
+        """Tasks with equal scheduling class can share leased workers."""
+        return (
+            tuple(sorted(self.resources.items())),
+            self.placement_group_id,
+            tuple(sorted(self.env_vars.items())),
+        )
+
+    def return_ids(self) -> List[ObjectID]:
+        return [ObjectID.for_task_return(self.task_id, i) for i in range(self.num_returns)]
+
+
+@dataclass
+class ActorSpec:
+    actor_id: ActorID
+    job_id: JobID
+    class_id: str  # KV key of exported class
+    name: Optional[str]  # named actor (None = anonymous)
+    namespace: str
+    ctor_args_payload: bytes
+    resources: Dict[str, float]
+    max_restarts: int
+    max_task_retries: int
+    max_concurrency: int
+    strategy: Optional[SchedulingStrategy] = None
+    placement_group_id: Optional[PlacementGroupID] = None
+    bundle_index: int = -1
+    env_vars: Dict[str, str] = field(default_factory=dict)
+    detached: bool = False
+    owner_address: str = ""
+
+
+class ObjectRef:
+    """Distributed future.  Owner-based: carries the address of the worker
+    that owns the object's metadata and value (ownership model from the
+    reference's NSDI'21 design — Ray ``src/ray/core_worker/reference_counter.h``).
+
+    Picklable; when deserialized inside a worker, the local core worker
+    registers a borrow so the owner keeps the object alive.
+    """
+
+    __slots__ = ("id", "owner_address", "_worker", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, owner_address: str, _worker=None):
+        self.id = object_id
+        self.owner_address = owner_address
+        self._worker = _worker
+        if _worker is not None:
+            _worker.on_ref_created(self)
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def __repr__(self):
+        return f"ObjectRef({self.id.hex()[:16]}, owner={self.owner_address})"
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __del__(self):
+        worker = self._worker
+        if worker is not None:
+            try:
+                worker.on_ref_deleted(self.id, self.owner_address)
+            except Exception:
+                pass
+
+    def __reduce__(self):
+        # Deserializing side re-binds to its local core worker (borrow).
+        return (_rehydrate_ref, (self.id, self.owner_address))
+
+    # Allow `await ref` inside async actors / driver coroutines.
+    def __await__(self):
+        from .core_worker import global_worker
+
+        w = global_worker()
+        return w.get_async(self).__await__()
+
+
+def _rehydrate_ref(object_id: ObjectID, owner_address: str) -> ObjectRef:
+    from .core_worker import try_global_worker
+
+    w = try_global_worker()
+    return ObjectRef(object_id, owner_address, _worker=w)
+
+
+class _RefMarker:
+    """Placeholder for an ObjectRef inside serialized task args; the executor
+    resolves markers to values (or back to refs for nested refs) before
+    invoking user code."""
+
+    __slots__ = ("object_id", "owner_address", "nested")
+
+    def __init__(self, object_id: ObjectID, owner_address: str, nested: bool = False):
+        self.object_id = object_id
+        self.owner_address = owner_address
+        self.nested = nested

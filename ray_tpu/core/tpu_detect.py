@@ -1,0 +1,84 @@
+"""TPU chip / slice detection without initializing the runtime.
+
+Equivalent of the reference's TPUAcceleratorManager detection path (ray
+``python/ray/_private/accelerators/tpu.py:267-672``): chips are discovered
+from device files and GCE metadata env vars — never by importing jax, which
+would grab the chips.  Publishes:
+  - ``TPU``: number of chips on this host
+  - ``TPU-{version}`` resource (e.g. ``TPU-v5e``): same count, typed
+  - ``TPU-{pod_name}-head``: 1 on worker 0 of a pod slice (gang anchor)
+  - labels: accelerator type, topology, worker id — used for
+    ICI-topology-aware label scheduling.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+from typing import Dict, Tuple
+
+
+def num_local_chips() -> int:
+    override = os.environ.get("RAY_TPU_NUM_CHIPS")
+    if override is not None:
+        return int(override)
+    # TPU VM device files: /dev/accel* (older) or /dev/vfio/* (newer PCIe).
+    chips = glob.glob("/dev/accel*")
+    if chips:
+        return len(chips)
+    vfio = [p for p in glob.glob("/dev/vfio/*") if re.fullmatch(r".*/\d+", p)]
+    if vfio:
+        return len(vfio)
+    return 0
+
+
+def accelerator_type() -> str:
+    env = os.environ.get("TPU_ACCELERATOR_TYPE", "")  # e.g. "v5litepod-16"
+    if env:
+        m = re.match(r"(v\d+[a-z]*)", env)
+        if m:
+            version = m.group(1)
+            return {"v5litepod": "v5e", "v5p": "v5p"}.get(version, version)
+    return os.environ.get("RAY_TPU_ACCELERATOR_VERSION", "")
+
+
+def pod_name() -> str:
+    return os.environ.get("TPU_NAME", os.environ.get("RAY_TPU_POD_NAME", ""))
+
+
+def worker_id() -> int:
+    return int(os.environ.get("TPU_WORKER_ID", "0"))
+
+
+def topology() -> str:
+    return os.environ.get("TPU_TOPOLOGY", os.environ.get("RAY_TPU_TOPOLOGY", ""))
+
+
+VALID_TOPOLOGY_RE = re.compile(r"^\d+x\d+(x\d+)?$")
+
+
+def validate_topology(topo: str) -> bool:
+    return bool(VALID_TOPOLOGY_RE.match(topo))
+
+
+def detect_resources_and_labels() -> Tuple[Dict[str, float], Dict[str, str]]:
+    resources: Dict[str, float] = {}
+    labels: Dict[str, str] = {}
+    chips = num_local_chips()
+    if chips > 0:
+        resources["TPU"] = float(chips)
+        version = accelerator_type()
+        if version:
+            resources[f"TPU-{version}"] = float(chips)
+            labels["tpu-version"] = version
+        pod = pod_name()
+        if pod:
+            labels["tpu-pod-name"] = pod
+            labels["tpu-worker-id"] = str(worker_id())
+            if worker_id() == 0:
+                resources[f"TPU-{pod}-head"] = 1.0
+        topo = topology()
+        if topo:
+            labels["tpu-topology"] = topo
+    return resources, labels

@@ -1,0 +1,72 @@
+"""Worker process entrypoint (spawned by the node agent).
+
+Registers with the node agent, then serves the core-worker protocol loop
+forever (the analog of ``CoreWorker::RunTaskExecutionLoop``, Ray
+``src/ray/core_worker/core_worker.h:251`` — except execution here is
+push-driven via RPC handlers, so the loop just runs the event loop).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+
+
+def main():
+    from .config import GlobalConfig
+    from .core_worker import CoreWorker, set_global_worker
+    from .ids import NodeID, WorkerID
+    from .rpc import RetryableRpcClient
+
+    logging.basicConfig(
+        level=GlobalConfig.log_level,
+        format="%(asctime)s %(levelname)s worker: %(message)s",
+    )
+    worker_id = WorkerID.from_hex(os.environ["RAY_TPU_WORKER_ID"])
+    agent_address = os.environ["RAY_TPU_AGENT_ADDRESS"]
+    cp_address = os.environ["RAY_TPU_CP_ADDRESS"]
+    session_id = os.environ["RAY_TPU_SESSION_ID"]
+    node_id = NodeID.from_hex(os.environ["RAY_TPU_NODE_ID"])
+
+    async def run():
+        worker = CoreWorker(
+            CoreWorker.WORKER,
+            cp_address,
+            agent_address,
+            session_id,
+            node_id,
+            worker_id=worker_id,
+        )
+        set_global_worker(worker)
+        address = await worker.async_start()
+        # Keep a dedicated registration connection open: the agent uses its
+        # closure as a liveness signal in addition to process polling.
+        reg = RetryableRpcClient(agent_address)
+        reply = await reg.call(
+            "register_worker",
+            {"worker_id": worker_id, "address": address, "pid": os.getpid()},
+        )
+        if not reply.get("ok"):
+            raise SystemExit("agent rejected worker registration")
+        # Liveness watchdog: a worker must not outlive its node agent
+        # (reference: workers die when the raylet's IPC socket closes).
+        failures = 0
+        while True:
+            await asyncio.sleep(2.0)
+            try:
+                await reg.call("ping", timeout=2.0, retries=1)
+                failures = 0
+            except Exception:
+                failures += 1
+                if failures >= 3:
+                    logging.getLogger(__name__).warning(
+                        "node agent unreachable; worker exiting"
+                    )
+                    os._exit(1)
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,36 @@
+import os
+import sys
+
+# Virtual 8-device CPU mesh for sharding/collective tests without TPU
+# hardware (must be set before jax is imported anywhere).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("RAY_TPU_log_level", "INFO")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ray_start_regular():
+    import ray_tpu
+
+    ctx = ray_tpu.init(num_cpus=4)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    import ray_tpu
+    from ray_tpu.core.node import Cluster
+
+    cluster = Cluster()
+    yield cluster
+    ray_tpu.shutdown()
+    cluster.shutdown()

@@ -1,0 +1,303 @@
+"""End-to-end tests of the public task/actor/object/placement-group API on a
+single-node cluster.  One module-scoped cluster amortizes process startup
+(this machine has a single CPU core)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ctx = ray_tpu.init(num_cpus=8)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def test_task_roundtrip(cluster):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2), timeout=60) == 3
+
+
+def test_task_chain_ref_args(cluster):
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    ref = inc.remote(0)
+    for _ in range(4):
+        ref = inc.remote(ref)
+    assert ray_tpu.get(ref, timeout=60) == 5
+
+
+def test_many_small_tasks(cluster):
+    @ray_tpu.remote
+    def sq(x):
+        return x * x
+
+    refs = [sq.remote(i) for i in range(100)]
+    assert ray_tpu.get(refs, timeout=60) == [i * i for i in range(100)]
+
+
+def test_multiple_returns(cluster):
+    @ray_tpu.remote(num_returns=2)
+    def divmod_(a, b):
+        return a // b, a % b
+
+    q, r = divmod_.remote(7, 3)
+    assert ray_tpu.get([q, r], timeout=60) == [2, 1]
+
+
+def test_put_get_small_and_large(cluster):
+    small = ray_tpu.put({"k": [1, 2, 3]})
+    assert ray_tpu.get(small, timeout=30) == {"k": [1, 2, 3]}
+    big = np.arange(1_000_000, dtype=np.float32)  # 4 MB → shm path
+    ref = ray_tpu.put(big)
+    out = ray_tpu.get(ref, timeout=30)
+    np.testing.assert_array_equal(big, out)
+
+
+def test_large_arg_and_return(cluster):
+    @ray_tpu.remote
+    def double(a):
+        return a * 2
+
+    big = np.ones(1_000_000, dtype=np.float32)
+    out = ray_tpu.get(double.remote(ray_tpu.put(big)), timeout=60)
+    assert out.dtype == np.float32 and float(out.sum()) == 2_000_000.0
+
+
+def test_nested_ref_stays_ref(cluster):
+    @ray_tpu.remote
+    def probe(container):
+        inner = container["ref"]
+        assert isinstance(inner, ray_tpu.ObjectRef)
+        return ray_tpu.get(inner, timeout=30)
+
+    inner = ray_tpu.put(99)
+    assert ray_tpu.get(probe.remote({"ref": inner}), timeout=60) == 99
+
+
+def test_error_propagation(cluster):
+    @ray_tpu.remote
+    def boom():
+        raise KeyError("missing")
+
+    with pytest.raises(ray_tpu.TaskError) as ei:
+        ray_tpu.get(boom.remote(), timeout=60)
+    assert isinstance(ei.value.cause, KeyError)
+    assert "boom" in ei.value.remote_traceback
+
+
+def test_error_through_dependency(cluster):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("x")
+
+    @ray_tpu.remote
+    def use(v):
+        return v
+
+    with pytest.raises(ray_tpu.TaskError):
+        ray_tpu.get(use.remote(boom.remote()), timeout=60)
+
+
+def test_wait(cluster):
+    @ray_tpu.remote
+    def fast():
+        return 1
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+        return 2
+
+    f, s = fast.remote(), slow.remote()
+    ready, pending = ray_tpu.wait([f, s], num_returns=1, timeout=30)
+    assert ready == [f] and pending == [s]
+
+
+def test_get_timeout(cluster):
+    @ray_tpu.remote
+    def sleepy():
+        time.sleep(30)
+
+    with pytest.raises(ray_tpu.GetTimeoutError):
+        ray_tpu.get(sleepy.remote(), timeout=0.5)
+
+
+def test_nested_task_submission(cluster):
+    @ray_tpu.remote
+    def inner(x):
+        return x * 10
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x), timeout=30) + 1
+
+    assert ray_tpu.get(outer.remote(4), timeout=60) == 41
+
+
+def test_actor_basic(cluster):
+    @ray_tpu.remote
+    class Acc:
+        def __init__(self):
+            self.total = 0
+
+        def add(self, x):
+            self.total += x
+            return self.total
+
+    a = Acc.remote()
+    refs = [a.add.remote(i) for i in range(10)]
+    results = ray_tpu.get(refs, timeout=60)
+    # Ordered execution: running totals.
+    assert results == [0, 1, 3, 6, 10, 15, 21, 28, 36, 45]
+
+
+def test_actor_ordering_strict(cluster):
+    @ray_tpu.remote
+    class Log:
+        def __init__(self):
+            self.seen = []
+
+        def rec(self, i):
+            self.seen.append(i)
+            return len(self.seen)
+
+        def dump(self):
+            return self.seen
+
+    log = Log.remote()
+    for i in range(20):
+        log.rec.remote(i)
+    assert ray_tpu.get(log.dump.remote(), timeout=60) == list(range(20))
+
+
+def test_named_actor_and_get_actor(cluster):
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self, v):
+            self.v = v
+
+        def get(self):
+            return self.v
+
+    Holder.options(name="holder-x").remote(123)
+    h = ray_tpu.get_actor("holder-x")
+    assert ray_tpu.get(h.get.remote(), timeout=60) == 123
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("does-not-exist")
+
+
+def test_actor_handle_passed_to_task(cluster):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+    @ray_tpu.remote
+    def bump(c):
+        return ray_tpu.get(c.inc.remote(), timeout=30)
+
+    c = Counter.remote()
+    assert ray_tpu.get(bump.remote(c), timeout=60) == 1
+    assert ray_tpu.get(c.inc.remote(), timeout=60) == 2
+
+
+def test_kill_actor(cluster):
+    @ray_tpu.remote
+    class Victim:
+        def ping(self):
+            return "ok"
+
+    v = Victim.remote()
+    assert ray_tpu.get(v.ping.remote(), timeout=60) == "ok"
+    ray_tpu.kill(v)
+    time.sleep(1.0)
+    with pytest.raises((ray_tpu.ActorDiedError, ray_tpu.TaskError)):
+        ray_tpu.get(v.ping.remote(), timeout=30)
+
+
+def test_async_actor(cluster):
+    @ray_tpu.remote
+    class AsyncActor:
+        async def work(self, x):
+            import asyncio
+
+            await asyncio.sleep(0.01)
+            return x + 1
+
+    a = AsyncActor.remote()
+    assert ray_tpu.get(a.work.remote(41), timeout=60) == 42
+
+
+def test_placement_group_lifecycle(cluster):
+    pg = ray_tpu.placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=20)
+
+    @ray_tpu.remote
+    def where():
+        return "ran"
+
+    strat = ray_tpu.placement_group_strategy(pg, 0)
+    assert (
+        ray_tpu.get(where.options(scheduling_strategy=strat).remote(), timeout=60)
+        == "ran"
+    )
+    ray_tpu.remove_placement_group(pg)
+
+
+def test_placement_group_infeasible_pending(cluster):
+    # More CPUs than the cluster has: stays PENDING, doesn't crash.
+    pg = ray_tpu.placement_group([{"CPU": 64}], strategy="PACK")
+    assert not pg.ready(timeout=0.5)
+    ray_tpu.remove_placement_group(pg)
+
+
+def test_cluster_resources(cluster):
+    total = ray_tpu.cluster_resources()
+    assert total.get("CPU") == 8.0
+
+
+def test_state_summary(cluster):
+    state = ray_tpu.state_summary()
+    assert len(state["nodes"]) == 1
+    assert isinstance(state["actors"], list)
+
+
+def test_max_retries_on_worker_crash(cluster):
+    import os
+
+    marker = "/tmp/ray_tpu_crash_once_%d" % time.time_ns()
+
+    @ray_tpu.remote(max_retries=2)
+    def crash_once():
+        if not os.path.exists(marker):
+            open(marker, "w").close()
+            os._exit(1)  # simulate worker crash
+        return "recovered"
+
+    assert ray_tpu.get(crash_once.remote(), timeout=90) == "recovered"
+    os.unlink(marker)
+
+
+def test_no_retries_surfaces_crash(cluster):
+    @ray_tpu.remote(max_retries=0)
+    def die():
+        import os
+
+        os._exit(1)
+
+    with pytest.raises(ray_tpu.WorkerCrashedError):
+        ray_tpu.get(die.remote(), timeout=60)

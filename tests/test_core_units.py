@@ -1,0 +1,233 @@
+"""Unit tests for the core substrate: ids, config, resources, scheduler,
+serialization (no cluster processes involved)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from ray_tpu.core.config import Config
+from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, TaskID, new_task_id
+from ray_tpu.core.resources import (
+    NodeResources,
+    ResourceInstanceSet,
+    ResourceSet,
+)
+from ray_tpu.core.scheduler import (
+    ClusterScheduler,
+    InfeasibleError,
+    NodeAffinityStrategy,
+    NodeLabelStrategy,
+    SpreadStrategy,
+)
+from ray_tpu.core.serialization import (
+    deserialize_from_bytes,
+    serialize_to_bytes,
+)
+
+
+class TestIDs:
+    def test_roundtrip(self):
+        i = NodeID.from_random()
+        assert NodeID.from_hex(i.hex()) == i
+        assert len(i.binary()) == 16
+
+    def test_job_id_size(self):
+        assert len(JobID.from_random().binary()) == 4
+
+    def test_nil(self):
+        assert ActorID.nil().is_nil()
+        assert not ActorID.from_random().is_nil()
+
+    def test_task_return_ids_deterministic(self):
+        t = new_task_id()
+        a = ObjectID.for_task_return(t, 0)
+        b = ObjectID.for_task_return(t, 0)
+        c = ObjectID.for_task_return(t, 1)
+        assert a == b != c
+
+    def test_unique(self):
+        assert len({new_task_id() for _ in range(1000)}) == 1000
+
+
+class TestConfig:
+    def test_defaults_and_env_override(self):
+        cfg = Config()
+        assert cfg.rpc_max_retries == 8
+        os.environ["RAY_TPU_rpc_max_retries"] = "3"
+        try:
+            assert cfg.rpc_max_retries == 3
+        finally:
+            del os.environ["RAY_TPU_rpc_max_retries"]
+
+    def test_programmatic_override_and_env_ship(self):
+        cfg = Config()
+        cfg.override(scheduler_spread_threshold=0.9)
+        assert cfg.scheduler_spread_threshold == 0.9
+        env = cfg.overrides_as_env()
+        assert env["RAY_TPU_scheduler_spread_threshold"] == "0.9"
+
+    def test_unknown_knob(self):
+        with pytest.raises(ValueError):
+            Config().override(bogus=1)
+
+
+class TestResources:
+    def test_fixed_point_no_drift(self):
+        r = ResourceSet({"CPU": 1.0})
+        tenth = ResourceSet({"CPU": 0.1})
+        for _ in range(10):
+            r = r - tenth
+        assert r.get("CPU") == 0.0
+        assert r.is_empty()
+
+    def test_subset(self):
+        big = ResourceSet({"CPU": 4, "TPU": 8})
+        small = ResourceSet({"CPU": 1, "TPU": 2})
+        assert small.is_subset_of(big)
+        assert not big.is_subset_of(small)
+
+    def test_node_acquire_release(self):
+        nr = NodeResources({"CPU": 4, "TPU": 4})
+        req = ResourceSet({"CPU": 2, "TPU": 2})
+        assert nr.acquire(req)
+        assert nr.available.get("TPU") == 2
+        assert nr.utilization() == 0.5
+        nr.release(req)
+        assert nr.available.get("CPU") == 4
+
+    def test_instance_granularity_whole_chips(self):
+        inst = ResourceInstanceSet({"TPU": 4.0})
+        got = inst.acquire("TPU", 2)
+        assert got == [0, 1]
+        got2 = inst.acquire("TPU", 2)
+        assert got2 == [2, 3]
+        assert inst.acquire("TPU", 1) is None
+        inst.release("TPU", 2, got)
+        assert inst.acquire("TPU", 1) == [0]
+
+    def test_instance_fractional(self):
+        inst = ResourceInstanceSet({"TPU": 2.0})
+        a = inst.acquire("TPU", 0.5)
+        b = inst.acquire("TPU", 0.5)
+        # Both fractions pack onto the same chip.
+        assert a == b
+
+    def test_instance_mixed_whole_plus_fraction(self):
+        inst = ResourceInstanceSet({"TPU": 4.0})
+        a = inst.acquire("TPU", 1.5)  # one whole chip + half of another
+        assert len(a) == 2
+        # 2.5 more can't fit as instances now (only 2 fully-free + one half).
+        b = inst.acquire("TPU", 2.5)
+        assert b is not None  # 2 whole + the remaining half
+        assert inst.acquire("TPU", 0.5) is None
+        inst.release("TPU", 1.5, a)
+        inst.release("TPU", 2.5, b)
+        # Back to fully free.
+        assert inst.acquire("TPU", 4) == [0, 1, 2, 3]
+
+    def test_instance_rejects_overfragmented(self):
+        inst = ResourceInstanceSet({"TPU": 2.0})
+        inst.acquire("TPU", 0.5)
+        # 2 whole chips no longer available.
+        assert inst.acquire("TPU", 2) is None
+
+
+class TestScheduler:
+    def _make(self, n=3, cpus=4):
+        sched = ClusterScheduler()
+        ids = []
+        for _ in range(n):
+            nid = NodeID.from_random()
+            sched.update_node(
+                nid, {"total": {"CPU": cpus}, "available": {"CPU": cpus}, "labels": {}}
+            )
+            ids.append(nid)
+        return sched, ids
+
+    def test_pack_prefers_utilized(self):
+        sched, ids = self._make(2)
+        sched.update_node(
+            ids[0], {"total": {"CPU": 4}, "available": {"CPU": 3}, "labels": {}}
+        )
+        # Node 0 is 25% utilized (below 50% threshold) → pack onto it.
+        picks = {sched.pick_node(ResourceSet({"CPU": 1})) for _ in range(20)}
+        assert picks == {ids[0]}
+
+    def test_spread_above_threshold(self):
+        sched, ids = self._make(2)
+        sched.update_node(
+            ids[0], {"total": {"CPU": 4}, "available": {"CPU": 1}, "labels": {}}
+        )
+        sched.update_node(
+            ids[1], {"total": {"CPU": 4}, "available": {"CPU": 4}, "labels": {}}
+        )
+        assert sched.pick_node(ResourceSet({"CPU": 1}), SpreadStrategy()) == ids[1]
+
+    def test_infeasible_raises(self):
+        sched, _ = self._make(2)
+        with pytest.raises(InfeasibleError):
+            sched.pick_node(ResourceSet({"TPU": 8}))
+
+    def test_busy_returns_none(self):
+        sched, ids = self._make(1, cpus=2)
+        sched.update_node(
+            ids[0], {"total": {"CPU": 2}, "available": {"CPU": 0}, "labels": {}}
+        )
+        assert sched.pick_node(ResourceSet({"CPU": 1})) is None
+
+    def test_node_affinity(self):
+        sched, ids = self._make(3)
+        target = ids[2]
+        strat = NodeAffinityStrategy(target.hex())
+        assert sched.pick_node(ResourceSet({"CPU": 1}), strat) == target
+
+    def test_label_match(self):
+        sched, ids = self._make(2)
+        sched.update_node(
+            ids[1],
+            {
+                "total": {"CPU": 4},
+                "available": {"CPU": 4},
+                "labels": {"tpu-version": "v5e"},
+            },
+        )
+        strat = NodeLabelStrategy({"tpu-version": "v5e"})
+        assert sched.pick_node(ResourceSet({"CPU": 1}), strat) == ids[1]
+
+    def test_bundle_strict_spread(self):
+        sched, ids = self._make(3, cpus=2)
+        bundles = [ResourceSet({"CPU": 2})] * 3
+        picks = sched.pick_nodes_for_bundles(bundles, "STRICT_SPREAD")
+        assert picks is not None and len(set(picks)) == 3
+
+    def test_bundle_strict_pack(self):
+        sched, ids = self._make(3, cpus=8)
+        bundles = [ResourceSet({"CPU": 2})] * 3
+        picks = sched.pick_nodes_for_bundles(bundles, "STRICT_PACK")
+        assert picks is not None and len(set(picks)) == 1
+
+    def test_bundle_infeasible_now(self):
+        sched, ids = self._make(2, cpus=2)
+        bundles = [ResourceSet({"CPU": 2})] * 3
+        assert sched.pick_nodes_for_bundles(bundles, "STRICT_SPREAD") is None
+
+
+class TestSerialization:
+    def test_roundtrip_basic(self):
+        for v in [1, "x", [1, 2], {"a": (1, 2)}, None, b"bytes"]:
+            assert deserialize_from_bytes(serialize_to_bytes(v)) == v
+
+    def test_numpy_zero_copy_buffers(self):
+        arr = np.arange(1000, dtype=np.float64)
+        out = deserialize_from_bytes(serialize_to_bytes(arr))
+        np.testing.assert_array_equal(arr, out)
+
+    def test_closure(self):
+        x = 42
+
+        def f(y):
+            return x + y
+
+        g = deserialize_from_bytes(serialize_to_bytes(f))
+        assert g(1) == 43

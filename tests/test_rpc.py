@@ -1,0 +1,162 @@
+"""RPC layer tests: request/reply, errors, server-push, retry, chaos."""
+
+import asyncio
+
+import pytest
+
+from ray_tpu.core.config import GlobalConfig
+from ray_tpu.core.rpc import (
+    RetryableRpcClient,
+    RpcClient,
+    RpcConnectionError,
+    RpcRemoteError,
+    RpcServer,
+)
+
+
+class EchoHandler:
+    def handle_echo(self, payload, conn):
+        return payload
+
+    async def handle_aecho(self, payload, conn):
+        await asyncio.sleep(0.01)
+        return payload
+
+    def handle_fail(self, payload, conn):
+        raise ValueError("nope")
+
+    async def handle_push_me(self, payload, conn):
+        await conn.push("hello", {"x": 1})
+        return True
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_echo_and_async_echo():
+    async def main():
+        server = RpcServer(EchoHandler())
+        addr = await server.start()
+        client = await RpcClient(addr).connect()
+        assert await client.call("echo", {"a": 1}) == {"a": 1}
+        assert await client.call("aecho", [1, 2]) == [1, 2]
+        await client.close()
+        await server.stop()
+
+    run(main())
+
+
+def test_remote_error_carries_traceback():
+    async def main():
+        server = RpcServer(EchoHandler())
+        addr = await server.start()
+        client = await RpcClient(addr).connect()
+        with pytest.raises(RpcRemoteError) as ei:
+            await client.call("fail")
+        assert "nope" in str(ei.value)
+        assert "handle_fail" in ei.value.remote_traceback
+        await client.close()
+        await server.stop()
+
+    run(main())
+
+
+def test_concurrent_calls_multiplex():
+    async def main():
+        server = RpcServer(EchoHandler())
+        addr = await server.start()
+        client = await RpcClient(addr).connect()
+        results = await asyncio.gather(
+            *[client.call("aecho", i) for i in range(50)]
+        )
+        assert results == list(range(50))
+        await client.close()
+        await server.stop()
+
+    run(main())
+
+
+def test_server_push():
+    async def main():
+        server = RpcServer(EchoHandler())
+        addr = await server.start()
+        got = asyncio.Queue()
+
+        def on_push(method, payload):
+            got.put_nowait((method, payload))
+
+        client = await RpcClient(addr, push_handler=on_push).connect()
+        await client.call("push_me")
+        method, payload = await asyncio.wait_for(got.get(), 2)
+        assert method == "hello" and payload == {"x": 1}
+        await client.close()
+        await server.stop()
+
+    run(main())
+
+
+def test_retryable_reconnects():
+    async def main():
+        server = RpcServer(EchoHandler())
+        addr = await server.start()
+        client = RetryableRpcClient(addr)
+        assert await client.call("echo", 1) == 1
+        # Kill and restart the server on the same port.
+        await server.stop()
+        host, port = addr.split(":")
+        server2 = RpcServer(EchoHandler(), host, int(port))
+        await server2.start()
+        assert await client.call("echo", 2) == 2
+        await client.close()
+        await server2.stop()
+
+    run(main())
+
+
+def test_connection_refused_fails_after_retries():
+    async def main():
+        client = RetryableRpcClient("127.0.0.1:1")  # nothing listens
+        with pytest.raises(RpcConnectionError):
+            await client.call("echo", retries=2)
+
+    run(main())
+
+
+def test_chaos_injection():
+    GlobalConfig.override(testing_rpc_failure="echo:1.0:0.0")
+    try:
+
+        async def main():
+            server = RpcServer(EchoHandler())
+            addr = await server.start()
+            client = await RpcClient(addr).connect()
+            with pytest.raises(RpcConnectionError, match="chaos"):
+                await client.call("echo", 1)
+            # Other methods unaffected.
+            assert await client.call("aecho", 2) == 2
+            await client.close()
+            await server.stop()
+
+        run(main())
+    finally:
+        GlobalConfig.override(testing_rpc_failure="")
+
+
+def test_chaos_retry_to_success():
+    """With 50% request chaos, a retryable client still gets through."""
+    GlobalConfig.override(testing_rpc_failure="echo:0.5:0.0")
+    try:
+
+        async def main():
+            server = RpcServer(EchoHandler())
+            addr = await server.start()
+            client = RetryableRpcClient(addr)
+            for i in range(10):
+                assert await client.call("echo", i, retries=20) == i
+            await client.close()
+            await server.stop()
+
+        run(main())
+    finally:
+        GlobalConfig.override(testing_rpc_failure="")
