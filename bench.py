@@ -1,0 +1,50 @@
+"""Benchmark harness — prints ONE JSON line.
+
+Primary metric: single-client synchronous task throughput, the reference's
+headline control-plane microbenchmark (ray ``python/ray/_private/ray_perf.py``;
+published value 845 tasks/s on m4.16xlarge — BASELINE.md).  Measures the full
+hot path: submit → lease → push → execute → reply → get.
+"""
+
+import json
+import sys
+import time
+
+BASELINE_TASKS_S = 845.0  # reference: release/perf_metrics/microbenchmark.json
+
+
+def bench_tasks_sync(n_warm=30, n=300):
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4)
+
+    @ray_tpu.remote
+    def f():
+        return b"ok"
+
+    for _ in range(n_warm):
+        ray_tpu.get(f.remote(), timeout=60)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ray_tpu.get(f.remote(), timeout=60)
+    dt = time.perf_counter() - t0
+    ray_tpu.shutdown()
+    return n / dt
+
+
+def main():
+    value = bench_tasks_sync()
+    print(
+        json.dumps(
+            {
+                "metric": "single_client_tasks_sync",
+                "value": round(value, 1),
+                "unit": "tasks/s",
+                "vs_baseline": round(value / BASELINE_TASKS_S, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

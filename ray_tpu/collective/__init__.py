@@ -1,0 +1,17 @@
+from .collective import (  # noqa: F401
+    allgather,
+    allreduce,
+    alltoall,
+    barrier,
+    broadcast,
+    destroy_collective_group,
+    get_collective_group_size,
+    get_group,
+    get_rank,
+    init_collective_group,
+    init_local_group,
+    is_group_initialized,
+    reducescatter,
+)
+from .device_objects import DeviceObjectStore, DeviceRef, device_object_store  # noqa: F401
+from .types import Backend, GroupInfo, ReduceOp  # noqa: F401

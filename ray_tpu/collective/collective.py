@@ -1,0 +1,129 @@
+"""Declarative collective API — the ``ray.util.collective`` equivalent.
+
+Reference surface (ray ``python/ray/util/collective/collective.py``):
+``init_collective_group`` (:171), ``create_collective_group`` (:211),
+``allreduce/reduce/broadcast/allgather/reducescatter/barrier`` (:328-725),
+with a per-process ``GroupManager`` (:71).  Backends here are XLA-native
+(see ``types.Backend``): no NCCL communicators or per-peer CUDA streams —
+groups are JAX meshes and every op is one compiled XLA collective.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+from .types import Backend, GroupInfo, ReduceOp
+
+logger = logging.getLogger(__name__)
+
+
+class GroupManager:
+    """Per-process registry of named collective groups."""
+
+    def __init__(self):
+        self._groups: Dict[str, object] = {}
+
+    def create(self, backend: Backend, group_name: str, world_size: int, rank: int,
+               **kwargs):
+        if group_name in self._groups:
+            raise ValueError(f"collective group {group_name!r} already exists")
+        if backend == Backend.LOCAL:
+            from .local_group import LocalXlaGroup
+
+            group = LocalXlaGroup(group_name, kwargs.get("devices"))
+        else:
+            from .xla_group import XlaGroup
+
+            group = XlaGroup(group_name, world_size, rank, **kwargs)
+        self._groups[group_name] = group
+        return group
+
+    def get(self, group_name: str):
+        group = self._groups.get(group_name)
+        if group is None:
+            raise ValueError(
+                f"collective group {group_name!r} is not initialized; call "
+                f"init_collective_group first"
+            )
+        return group
+
+    def destroy(self, group_name: str):
+        group = self._groups.pop(group_name, None)
+        if group is not None and hasattr(group, "shutdown"):
+            group.shutdown()
+
+    def names(self) -> List[str]:
+        return list(self._groups)
+
+
+_manager = GroupManager()
+
+
+def init_collective_group(
+    world_size: int,
+    rank: int,
+    backend: str = "xla",
+    group_name: str = "default",
+    **kwargs,
+):
+    """Initialize a named collective group in this process (each member
+    process/actor calls this with its own rank)."""
+    b = Backend.normalize(backend)
+    return _manager.create(b, group_name, world_size, rank, **kwargs)
+
+
+def init_local_group(group_name: str = "default", devices=None):
+    """Single-controller group over this process's local devices (all ranks
+    live here; ops take per-rank tensor lists)."""
+    return _manager.create(Backend.LOCAL, group_name, 0, 0, devices=devices)
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    try:
+        _manager.get(group_name)
+        return True
+    except ValueError:
+        return False
+
+
+def destroy_collective_group(group_name: str = "default"):
+    _manager.destroy(group_name)
+
+
+def get_group(group_name: str = "default"):
+    return _manager.get(group_name)
+
+
+def get_rank(group_name: str = "default") -> int:
+    g = _manager.get(group_name)
+    return getattr(g, "rank", 0)
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _manager.get(group_name).world_size
+
+
+# ---------------------------------------------------------------------- ops
+def allreduce(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
+    return _manager.get(group_name).allreduce(tensor, op)
+
+
+def allgather(tensor, group_name: str = "default"):
+    return _manager.get(group_name).allgather(tensor)
+
+
+def reducescatter(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
+    return _manager.get(group_name).reducescatter(tensor, op)
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    return _manager.get(group_name).broadcast(tensor, src_rank)
+
+
+def alltoall(tensor, group_name: str = "default"):
+    return _manager.get(group_name).alltoall(tensor)
+
+
+def barrier(group_name: str = "default"):
+    return _manager.get(group_name).barrier()

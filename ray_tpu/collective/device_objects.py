@@ -1,0 +1,99 @@
+"""Device-resident object store — the GPU-objects ("RDT") analog.
+
+Reference: ray ``python/ray/experimental/gpu_object_manager/`` — objects
+created with ``tensor_transport="nccl"`` stay on device and move peer-to-peer,
+bypassing plasma.  TPU-native version: ``jax.Array``s stay resident in HBM in
+the owning actor process, keyed by object id; consumers on the same process
+get the array directly; consumers in other members of a collective group
+receive it via a broadcast/ppermute over ICI instead of a host round-trip.
+
+Integration point: actor methods can return ``DeviceRef``s; the plain object
+plane carries only the (id, shape, dtype, owner_rank) metadata.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+from ray_tpu.core.ids import ObjectID
+
+
+@dataclass
+class DeviceRef:
+    """Metadata handle to a device-resident array (picklable; the tensor
+    itself never leaves HBM unless explicitly fetched)."""
+
+    object_id: ObjectID
+    shape: Tuple[int, ...]
+    dtype: str
+    owner_rank: int = 0
+    group_name: str = "default"
+
+
+class DeviceObjectStore:
+    """Per-process store of device-resident jax.Arrays."""
+
+    def __init__(self):
+        self._objects: Dict[ObjectID, object] = {}
+        self._lock = threading.Lock()
+
+    def put(self, array, group_name: str = "default", rank: int = 0) -> DeviceRef:
+        oid = ObjectID.from_random()
+        with self._lock:
+            self._objects[oid] = array
+        return DeviceRef(
+            oid, tuple(array.shape), str(array.dtype), rank, group_name
+        )
+
+    def get_local(self, ref: DeviceRef):
+        with self._lock:
+            arr = self._objects.get(ref.object_id)
+        if arr is None:
+            raise KeyError(f"device object {ref.object_id} not resident here")
+        return arr
+
+    def contains(self, ref: DeviceRef) -> bool:
+        with self._lock:
+            return ref.object_id in self._objects
+
+    def free(self, ref: DeviceRef):
+        with self._lock:
+            self._objects.pop(ref.object_id, None)
+
+    def fetch(self, ref: DeviceRef):
+        """Resolve a DeviceRef: local hit returns the resident array; remote
+        owner → the owning rank broadcasts over the collective group (all
+        members must call fetch() collectively, like the reference's NCCL
+        transport)."""
+        if self.contains(ref):
+            return self.get_local(ref)
+        from .collective import get_group
+
+        group = get_group(ref.group_name)
+        import numpy as np
+        import jax.numpy as jnp
+
+        placeholder = jnp.zeros(ref.shape, dtype=ref.dtype)
+        return group.broadcast(placeholder, src_rank=ref.owner_rank)
+
+    def serve_fetch(self, ref: DeviceRef):
+        """Owner side of a collective fetch."""
+        from .collective import get_group
+
+        group = get_group(ref.group_name)
+        return group.broadcast(self.get_local(ref), src_rank=ref.owner_rank)
+
+    def __len__(self):
+        return len(self._objects)
+
+
+_store: Optional[DeviceObjectStore] = None
+
+
+def device_object_store() -> DeviceObjectStore:
+    global _store
+    if _store is None:
+        _store = DeviceObjectStore()
+    return _store

@@ -67,14 +67,14 @@ class LocalXlaGroup:
     def _shard_map(self, fn, out_spec_rank_axis=True):
         import jax
         from jax.sharding import PartitionSpec as P
-        from jax.experimental.shard_map import shard_map
+        from jax import shard_map
 
         in_spec = P("world")
         out_spec = P("world") if out_spec_rank_axis else P()
         return jax.jit(
             shard_map(
-                fn, mesh=self.mesh, in_specs=(in_spec,), out_specs=out_spec,
-                check_rep=False,
+                fn, mesh=self.mesh, in_specs=(in_spec,), out_specs=out_spec, check_vma=False,
+                
             )
         )
 

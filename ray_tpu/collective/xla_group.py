@@ -1,0 +1,247 @@
+"""Multi-host XLA collective group.
+
+The TPU-native replacement for the reference's NCCL process group (ray
+``util/collective/collective_group/nccl_collective_group.py:121``): instead
+of exchanging a NCCL unique-id and managing per-peer streams, members
+rendezvous on a JAX coordination-service address (published through the
+control-plane KV — the analog of the unique-id-through-GCS-KV pattern in
+``nccl_util.py``), call ``jax.distributed.initialize``, and all ops compile
+to XLA collectives over the global device mesh: ICI within a slice, DCN
+across slices.
+
+Each member process calls every op with its *local* per-host tensor; results
+come back as local numpy/jax values, exactly like the reference's eager NCCL
+calls — but the op itself is a jitted shard_map, so repeated calls of the
+same shape hit the XLA executable cache.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from .types import Backend, GroupInfo, ReduceOp
+
+logger = logging.getLogger(__name__)
+
+_KV_NAMESPACE = "collective"
+
+
+def _kv_rendezvous(group_name: str, rank: int, world_size: int,
+                   coordinator_port: Optional[int] = None,
+                   timeout: float = 60.0) -> str:
+    """Rank 0 publishes the coordination-service address in the control-plane
+    KV; everyone else polls for it."""
+    from ray_tpu.core.core_worker import global_worker
+    from ray_tpu.core.rpc import find_free_port
+
+    worker = global_worker()
+    key = f"coord:{group_name}"
+    if rank == 0:
+        port = coordinator_port or find_free_port()
+        addr = f"127.0.0.1:{port}"
+        import socket
+
+        try:
+            addr = f"{socket.gethostbyname(socket.gethostname())}:{port}"
+        except Exception:
+            pass
+        worker._run_sync(
+            worker.cp.call(
+                "kv_put",
+                {"namespace": _KV_NAMESPACE, "key": key, "value": addr.encode()},
+            )
+        )
+        return addr
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        val = worker._run_sync(
+            worker.cp.call("kv_get", {"namespace": _KV_NAMESPACE, "key": key})
+        )
+        if val is not None:
+            return val.decode()
+        time.sleep(0.1)
+    raise TimeoutError(f"rendezvous for group {group_name!r} timed out")
+
+
+class XlaGroup:
+    """One member (process) of a multi-host collective group."""
+
+    def __init__(
+        self,
+        group_name: str,
+        world_size: int,
+        rank: int,
+        coordinator_address: Optional[str] = None,
+        local_device_count: Optional[int] = None,
+    ):
+        import jax
+
+        self.group_name = group_name
+        self.world_size = world_size
+        self.rank = rank
+        if coordinator_address is None:
+            coordinator_address = _kv_rendezvous(group_name, rank, world_size)
+        self.coordinator_address = coordinator_address
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=world_size,
+            process_id=rank,
+        )
+        from jax.sharding import Mesh
+
+        devices = jax.devices()
+        self.devices_per_host = len(devices) // world_size
+        self.mesh = Mesh(
+            np.array(devices).reshape(world_size, self.devices_per_host),
+            ("host", "device"),
+        )
+        self._fn_cache: Dict[tuple, object] = {}
+
+    def info(self) -> GroupInfo:
+        return GroupInfo(self.group_name, self.world_size, self.rank, Backend.XLA)
+
+    # ------------------------------------------------------------- plumbing
+    def _global_from_local(self, tensor):
+        """Treat each host's tensor as one shard along the leading axis."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        local = np.asarray(tensor)
+        sharding = NamedSharding(self.mesh, P(("host",)))
+        global_shape = (self.world_size, *local.shape)
+        return jax.make_array_from_process_local_data(
+            sharding, local[None], global_shape
+        )
+
+    def _local_from_global(self, arr):
+        shards = arr.addressable_shards
+        return np.asarray(shards[0].data)
+
+    def _build(self, key, body, out_replicated=False):
+        import jax
+        from jax.sharding import PartitionSpec as P
+        from jax import shard_map
+
+        fn = self._fn_cache.get(key)
+        if fn is None:
+            out_spec = P() if out_replicated else P(("host",))
+            fn = jax.jit(
+                shard_map(
+                    body,
+                    mesh=self.mesh,
+                    in_specs=(P(("host",)),),
+                    out_specs=out_spec,
+                    check_vma=False,
+                    
+                )
+            )
+            self._fn_cache[key] = fn
+        return fn
+
+    # ------------------------------------------------------------------ ops
+    def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        import jax
+        import jax.numpy as jnp
+
+        g = self._global_from_local(tensor)
+
+        def body(x):
+            red = {
+                ReduceOp.SUM: jax.lax.psum,
+                ReduceOp.MAX: jax.lax.pmax,
+                ReduceOp.MIN: jax.lax.pmin,
+                ReduceOp.MEAN: jax.lax.pmean,
+            }.get(op)
+            if red is None:  # PRODUCT
+                return jnp.prod(jax.lax.all_gather(x[0], "host"), axis=0)[None]
+            return red(x, "host")
+
+        out = self._build(("ar", op, g.shape, str(g.dtype)), body)(g)
+        return self._local_from_global(out)[0]
+
+    def allgather(self, tensor):
+        import jax
+
+        g = self._global_from_local(tensor)
+
+        def body(x):
+            return jax.lax.all_gather(x[0], "host")[None]
+
+        out = self._build(("ag", g.shape, str(g.dtype)), body)(g)
+        return list(self._local_from_global(out)[0])
+
+    def reducescatter(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        import jax
+        import jax.numpy as jnp
+
+        g = self._global_from_local(tensor)
+        n = self.world_size
+
+        def body(x):
+            if op == ReduceOp.SUM:
+                return jax.lax.psum_scatter(
+                    x[0], "host", scatter_dimension=0, tiled=True
+                )[None]
+            gathered = jax.lax.all_gather(x[0], "host")
+            reducer = {
+                ReduceOp.MAX: jnp.max,
+                ReduceOp.MIN: jnp.min,
+                ReduceOp.MEAN: jnp.mean,
+                ReduceOp.PRODUCT: jnp.prod,
+            }[op]
+            red = reducer(gathered, axis=0)
+            rank = jax.lax.axis_index("host")
+            chunk = red.shape[0] // n
+            return jax.lax.dynamic_slice_in_dim(red, rank * chunk, chunk)[None]
+
+        out = self._build(("rs", op, g.shape, str(g.dtype)), body)(g)
+        return self._local_from_global(out)[0]
+
+    def broadcast(self, tensor, src_rank: int = 0):
+        import jax
+
+        g = self._global_from_local(tensor)
+
+        def body(x):
+            return jax.lax.all_gather(x[0], "host")[src_rank][None]
+
+        out = self._build(("bc", src_rank, g.shape, str(g.dtype)), body)(g)
+        return self._local_from_global(out)[0]
+
+    def alltoall(self, tensor):
+        import jax
+
+        g = self._global_from_local(tensor)
+
+        def body(x):
+            return jax.lax.all_to_all(
+                x, "host", split_axis=1, concat_axis=0, tiled=False
+            ).reshape(x.shape)
+
+        out = self._build(("a2a", g.shape, str(g.dtype)), body)(g)
+        return self._local_from_global(out)[0]
+
+    def ppermute(self, tensor, shift: int = 1):
+        import jax
+
+        g = self._global_from_local(tensor)
+        n = self.world_size
+        perm = [(i, (i + shift) % n) for i in range(n)]
+
+        def body(x):
+            return jax.lax.ppermute(x, "host", perm)
+
+        out = self._build(("pp", shift, g.shape, str(g.dtype)), body)(g)
+        return self._local_from_global(out)[0]
+
+    def barrier(self):
+        self.allreduce(np.zeros((1,), np.float32))
+
+    def shutdown(self):
+        # jax.distributed can only be initialized once per process; keep the
+        # runtime up but drop the cache.
+        self._fn_cache.clear()

@@ -61,20 +61,25 @@ class RemoteFunction:
     def __init__(self, fn, default_opts: Optional[dict] = None):
         self._fn = fn
         self._opts = default_opts or {}
-        self._function_id = None  # cached per-process export
+        # Export cache keyed by the worker that exported it: a new
+        # ray_tpu.init() means a fresh control-plane KV, so the function must
+        # be re-exported there.
+        self._export_cache = (None, None)  # (worker, function_id)
         functools.update_wrapper(self, fn)
 
     def options(self, **opts) -> "RemoteFunction":
         merged = dict(self._opts)
         merged.update(opts)
         rf = RemoteFunction(self._fn, merged)
-        rf._function_id = self._function_id
+        rf._export_cache = self._export_cache
         return rf
 
     def remote(self, *args, **kwargs):
         worker = global_worker()
-        if self._function_id is None:
-            self._function_id = worker._export_function(self._fn)
+        cached_worker, function_id = self._export_cache
+        if cached_worker is not worker:
+            function_id = worker._export_function(self._fn)
+            self._export_cache = (worker, function_id)
         norm = _normalize_options(self._opts)
         refs = worker.submit_task(
             self._fn,
@@ -90,7 +95,7 @@ class RemoteFunction:
             placement_group_id=norm["placement_group_id"],
             bundle_index=norm["bundle_index"],
             env_vars=norm["env_vars"],
-            function_id=self._function_id,
+            function_id=function_id,
         )
         if self._opts.get("num_returns", 1) == 1:
             return refs[0]

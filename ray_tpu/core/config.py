@@ -45,7 +45,7 @@ _KNOBS: Dict[str, tuple] = {
     "max_tasks_in_flight_per_worker": (int, 10, "Pipelined pushes per leased worker"),
     # -- object store --
     "max_inline_object_bytes": (int, 100 * 1024, "Inline small objects in RPCs"),
-    "object_store_memory_bytes": (int, 2 * 1024**30 if False else 2 * 1024**3, "Per-node shm budget"),
+    "object_store_memory_bytes": (int, 2 * 1024**3, "Per-node shm budget"),
     "object_chunk_bytes": (int, 5 * 1024 * 1024, "Chunk size for node-to-node transfer"),
     "memory_store_fallback_bytes": (int, 512 * 1024 * 1024, "In-process store budget"),
     # -- workers --

@@ -146,11 +146,9 @@ class ControlPlane:
     def on_connection_closed(self, conn: ServerConnection):
         for channel in conn.metadata.get("channels", ()):
             self._subs.get(channel, set()).discard(conn)
-        # Driver connection death ⇒ its non-detached job is finished.
-        job_id = conn.metadata.get("job_id")
-        if job_id is not None and job_id in self.jobs:
-            self.jobs[job_id]["state"] = "FINISHED"
-            asyncio.get_running_loop().create_task(self._cleanup_job(job_id))
+        # Job liveness is heartbeat-based (see _health_check_loop), NOT
+        # connection-based: a transient TCP reset must not kill the job's
+        # actors — the driver's RetryableRpcClient reconnects transparently.
 
     async def _cleanup_job(self, job_id: JobID):
         """Kill the job's non-detached actors."""
@@ -207,6 +205,15 @@ class ControlPlane:
             for node_id, entry in list(self.nodes.items()):
                 if entry.alive and now - entry.last_heartbeat > timeout:
                     await self._on_node_dead(node_id)
+            for job_id, job in list(self.jobs.items()):
+                if (
+                    job["state"] == "RUNNING"
+                    and now - job.get("last_heartbeat", now) > timeout
+                ):
+                    job["state"] = "FINISHED"
+                    logger.info("job %s lost its driver; cleaning up",
+                                job_id.hex())
+                    await self._cleanup_job(job_id)
 
     async def _on_node_dead(self, node_id: NodeID):
         entry = self.nodes.get(node_id)
@@ -252,9 +259,17 @@ class ControlPlane:
             "state": "RUNNING",
             "driver_address": payload.get("driver_address"),
             "start_time": time.time(),
+            "last_heartbeat": time.monotonic(),
         }
         conn.metadata["job_id"] = job_id
         return {"ok": True, "session_id": self.session_id}
+
+    def handle_job_heartbeat(self, payload, conn):
+        job = self.jobs.get(payload["job_id"])
+        if job is None:
+            return {"ok": False, "reregister": True}
+        job["last_heartbeat"] = time.monotonic()
+        return {"ok": True}
 
     def handle_list_jobs(self, payload, conn):
         return {jid: dict(info) for jid, info in self.jobs.items()}
@@ -297,15 +312,24 @@ class ControlPlane:
         node = self.nodes[node_id]
         client = self.agent_clients.get(node.agent_address)
         try:
+            # The agent's handler may wait for a worker spawn AND an
+            # actor_init (each bounded by worker_startup_timeout_s) plus the
+            # user __init__ runtime — our deadline must dominate both.
             reply = await client.call(
                 "create_actor_worker",
                 {"spec": spec, "incarnation": entry.incarnation},
-                timeout=GlobalConfig.worker_startup_timeout_s,
+                timeout=GlobalConfig.worker_startup_timeout_s * 2 + 30,
             )
         except Exception as e:  # noqa: BLE001
             logger.warning("actor %s creation on node failed: %s", spec.actor_id, e)
             if spec.actor_id not in self._pending_actors:
                 self._pending_actors.append(spec.actor_id)
+            return
+        if reply.get("init_error"):
+            # User constructor raised: permanent failure, never retried.
+            entry.state = DEAD
+            entry.death_cause = f"actor __init__ failed: {reply['init_error']}"
+            self._publish_actor(entry)
             return
         entry.node_id = node_id
         entry.address = reply["worker_address"]

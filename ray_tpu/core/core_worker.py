@@ -109,6 +109,9 @@ class _ActorState:
         self.changed = asyncio.Event()
         self.next_seq = 0
         self.subscribed = False
+        # Serializes wait-for-ALIVE + seq assignment so submission order is
+        # preserved even when waiters wake in arbitrary order.
+        self.submit_lock = asyncio.Lock()
 
 
 class _LeasePool:
@@ -210,11 +213,16 @@ class _LeasePool:
                 retries=1,
             )
             self.worker._handle_task_reply(spec, reply)
-        except (RpcConnectionError, RpcRemoteError) as e:
-            is_crash = isinstance(e, RpcConnectionError)
+        except RpcRemoteError as e:
+            # The worker is healthy — the handler itself raised (e.g. the
+            # function failed to deserialize).  Fail the task, KEEP the lease.
+            self.worker._fail_task_returns(spec, e)
+        except RpcConnectionError as e:
+            # Worker died: drop the lease (resources are released by the
+            # agent's worker monitor) and retry if allowed.
             lease["dead"] = True
             self._drop_lease(lease, returned=False)
-            if is_crash and attempt < spec.max_retries:
+            if attempt < spec.max_retries:
                 logger.warning(
                     "task %s attempt %d failed (%s); retrying", spec.name, attempt, e
                 )
@@ -222,9 +230,7 @@ class _LeasePool:
             else:
                 self.worker._fail_task_returns(
                     spec,
-                    WorkerCrashedError(f"worker died executing {spec.name}: {e}")
-                    if is_crash
-                    else e,
+                    WorkerCrashedError(f"worker died executing {spec.name}: {e}"),
                 )
             return
         finally:
@@ -326,7 +332,27 @@ class CoreWorker:
                 "register_job",
                 {"job_id": self.job_id, "driver_address": self.address},
             )
+            self.loop.create_task(self._job_heartbeat_loop())
         return self.address
+
+    async def _job_heartbeat_loop(self):
+        """Job liveness signal; survives transient control-plane reconnects
+        (and re-registers if the control plane restarted)."""
+        period = GlobalConfig.health_check_period_s
+        while not self._shutdown:
+            await asyncio.sleep(period)
+            try:
+                reply = await self.cp.call(
+                    "job_heartbeat", {"job_id": self.job_id}, retries=1
+                )
+                if reply.get("reregister"):
+                    await self.cp.call(
+                        "register_job",
+                        {"job_id": self.job_id, "driver_address": self.address},
+                        retries=1,
+                    )
+            except Exception:
+                pass
 
     def start_threaded(self):
         """Driver mode: run the protocol loop on a background thread."""
@@ -453,7 +479,9 @@ class CoreWorker:
         if self.memory_store.contains(oid):
             return self.memory_store.peek(oid)
         owner = self.worker_clients.get(ref.owner_address)
-        reply = await owner.call("get_object", {"object_id": oid})
+        # The owner's handler blocks until the producing task finishes, which
+        # can be arbitrarily long — don't let the default RPC deadline fire.
+        reply = await owner.call("get_object", {"object_id": oid}, timeout=86400.0)
         kind = reply["kind"]
         if kind == "inline":
             value = deserialize_from_bytes(reply["payload"])
@@ -694,18 +722,32 @@ class CoreWorker:
 
         def convert(v):
             if isinstance(v, ObjectRef):
-                held.append(v)
+                # scan() below records the hold; convert only rewrites.
                 return _RefMarker(v.id, v.owner_address)
             return v
 
         conv_args = [convert(a) for a in args]
         conv_kwargs = {k: convert(v) for k, v in kwargs.items()}
-        # Bookkeeping for refs nested one container-level deep.
-        for v in list(args) + list(kwargs.values()):
-            if isinstance(v, (list, tuple)):
-                held.extend(x for x in v if isinstance(x, ObjectRef))
+
+        # Hold refs nested anywhere inside standard containers so the owner
+        # keeps them alive while the task is in flight (refs inside arbitrary
+        # user objects are still covered by the worker's deserialize-time
+        # incref, with a small window — same caveat as the reference's
+        # borrower protocol).
+        def scan(v, depth=0):
+            if depth > 10:
+                return
+            if isinstance(v, ObjectRef):
+                held.append(v)
+            elif isinstance(v, (list, tuple, set, frozenset)):
+                for x in v:
+                    scan(x, depth + 1)
             elif isinstance(v, dict):
-                held.extend(x for x in v.values() if isinstance(x, ObjectRef))
+                for x in v.values():
+                    scan(x, depth + 1)
+
+        for v in list(args) + list(kwargs.values()):
+            scan(v, 1)
         payload = serialize_to_bytes((conv_args, conv_kwargs))
         return payload, held
 
@@ -953,32 +995,34 @@ class CoreWorker:
     async def _submit_actor_task(self, spec: TaskSpec, attempt: int = 0):
         state = self._actor_state(spec.actor_id)
         await self._subscribe_actor(state)
-        # Wait for the actor to be schedulable.
-        deadline = time.monotonic() + GlobalConfig.worker_startup_timeout_s * 2
-        while state.state in ("PENDING_CREATION", "RESTARTING"):
-            if time.monotonic() > deadline:
+        # Wait-for-ALIVE and seq assignment happen under a FIFO lock so two
+        # concurrent submissions can't swap order via the poll fallback.
+        async with state.submit_lock:
+            deadline = time.monotonic() + GlobalConfig.worker_startup_timeout_s * 2
+            while state.state in ("PENDING_CREATION", "RESTARTING"):
+                if time.monotonic() > deadline:
+                    self._fail_task_returns(
+                        spec, ActorDiedError(spec.actor_id.hex(), "creation timed out")
+                    )
+                    return
+                changed = state.changed
+                try:
+                    await asyncio.wait_for(changed.wait(), timeout=1.0)
+                except asyncio.TimeoutError:
+                    # Re-poll the control plane in case we missed a pub.
+                    info = await self.cp.call(
+                        "get_actor_info", {"actor_id": spec.actor_id}
+                    )
+                    if info is not None:
+                        self._apply_actor_info(info)
+            if state.state == "DEAD":
                 self._fail_task_returns(
-                    spec, ActorDiedError(spec.actor_id.hex(), "creation timed out")
+                    spec, ActorDiedError(spec.actor_id.hex(), state.death_cause)
                 )
                 return
-            changed = state.changed
-            try:
-                await asyncio.wait_for(changed.wait(), timeout=1.0)
-            except asyncio.TimeoutError:
-                # Re-poll the control plane in case we missed a pub.
-                info = await self.cp.call(
-                    "get_actor_info", {"actor_id": spec.actor_id}
-                )
-                if info is not None:
-                    self._apply_actor_info(info)
-        if state.state == "DEAD":
-            self._fail_task_returns(
-                spec, ActorDiedError(spec.actor_id.hex(), state.death_cause)
-            )
-            return
-        incarnation = state.incarnation
-        seq = state.next_seq
-        state.next_seq += 1
+            incarnation = state.incarnation
+            seq = state.next_seq
+            state.next_seq += 1
         client = self.worker_clients.get(state.address)
         try:
             reply = await client.call(
@@ -1119,24 +1163,34 @@ class CoreWorker:
         while st["expected"] < seq:
             ev = st["waiters"].setdefault(seq, asyncio.Event())
             await ev.wait()
-        if self.actor_instance is None:
-            raise RuntimeError("actor not initialized")
-        method = getattr(self.actor_instance, getattr(spec, "method_name", spec.name))
-        try:
-            async with self._actor_exec_lock:
-                # Advance the sequence as soon as execution begins so that
-                # max_concurrency > 1 allows overlap.
-                st["expected"] = seq + 1
-                ev = st["waiters"].pop(seq + 1, None)
-                if ev:
-                    ev.set()
-                return await self._execute(spec, method)
-        finally:
+
+        def advance():
+            # Always advance the sequence, even on lookup errors — a wedged
+            # sequence would hang every later call from this caller.
             if st["expected"] <= seq:
                 st["expected"] = seq + 1
                 ev = st["waiters"].pop(seq + 1, None)
                 if ev:
                     ev.set()
+
+        try:
+            if self.actor_instance is None:
+                raise RuntimeError("actor not initialized")
+            method = getattr(
+                self.actor_instance, getattr(spec, "method_name", spec.name)
+            )
+            async with self._actor_exec_lock:
+                # Advance as soon as execution begins so max_concurrency > 1
+                # allows overlap.
+                advance()
+                return await self._execute(spec, method)
+        except BaseException as e:  # noqa: BLE001 - report as task error
+            from .serialization import serialize_to_bytes as _ser
+
+            return {"returns": None,
+                    "error": _ser(TaskError.from_exception(e, spec.name))}
+        finally:
+            advance()
 
     def handle_ping(self, payload, conn):
         return "pong"

@@ -356,16 +356,32 @@ class NodeAgent:
         for name, ids in instances.items():
             self.instances.release(name, resources.get(name), ids)
 
-    async def _finish_grant(self, payload, fut, resources, instances, pg_id, bundle_index):
-        env_extra = dict(payload.get("env_vars") or {})
+    @staticmethod
+    def _apply_chip_isolation(env_extra: Dict[str, str], instances):
+        """TPU leases expose exactly their chips; non-TPU leases must not
+        touch the accelerator at all — workers that import jax fall back to
+        CPU (reference precedent: empty TPU_VISIBLE_CHIPS; here we also
+        neutralize the axon-tunnel sitecustomize, which force-registers the
+        TPU backend in every child process)."""
         if "TPU" in instances:
             chips = ",".join(str(i) for i in instances["TPU"])
             env_extra[GlobalConfig.tpu_visible_chips_env] = chips
             env_extra["TPU_VISIBLE_DEVICES"] = chips
+        else:
+            env_extra.setdefault(GlobalConfig.tpu_visible_chips_env, "")
+            env_extra.setdefault("TPU_VISIBLE_DEVICES", "")
+            if "axon" in os.environ.get("JAX_PLATFORMS", ""):
+                env_extra.setdefault("JAX_PLATFORMS", "cpu")
+                env_extra.setdefault("PALLAS_AXON_POOL_IPS", "")
+
+    async def _finish_grant(self, payload, fut, resources, instances, pg_id, bundle_index):
+        env_extra = dict(payload.get("env_vars") or {})
+        self._apply_chip_isolation(env_extra, instances)
         try:
             worker = await self._pop_worker(env_extra)
         except Exception as e:  # noqa: BLE001
             self._release_pool_resources(resources, instances, pg_id, bundle_index)
+            self._drain_lease_queue()
             if not fut.done():
                 fut.set_exception(e)
             return
@@ -452,10 +468,7 @@ class NodeAgent:
                 self.resources.release(resources)
             raise ValueError("accelerator instances fragmented; retry")
         env_extra = dict(spec.env_vars)
-        if "TPU" in instances:
-            chips = ",".join(str(i) for i in instances["TPU"])
-            env_extra[GlobalConfig.tpu_visible_chips_env] = chips
-            env_extra["TPU_VISIBLE_DEVICES"] = chips
+        self._apply_chip_isolation(env_extra, instances)
         try:
             # Actors always get a fresh worker (their process is their state).
             env_key = tuple(sorted(env_extra.items()))
@@ -477,13 +490,31 @@ class NodeAgent:
             )
             await wclient.close()
             if not reply.get("ok"):
-                raise RuntimeError(f"actor init failed: {reply.get('error')}")
+                # Application error (user __init__ raised): kill the worker,
+                # report non-retryably so the control plane marks the actor
+                # DEAD instead of respawning forever.
+                worker.is_actor = False
+                worker.actor_id = None
+                self._kill_worker_proc(worker)
+                self._release_instances(resources, instances)
+                if bundle is not None:
+                    bundle.available = bundle.available + resources
+                else:
+                    self.resources.release(resources)
+                self._drain_lease_queue()
+                return {"init_error": str(reply.get("error"))}
         except Exception:
+            worker_handle = locals().get("worker")
+            if worker_handle is not None:
+                worker_handle.is_actor = False
+                worker_handle.actor_id = None
+                self._kill_worker_proc(worker_handle)
             self._release_instances(resources, instances)
             if bundle is not None:
                 bundle.available = bundle.available + resources
             else:
                 self.resources.release(resources)
+            self._drain_lease_queue()
             raise
         lease_id = self._next_lease_id
         self._next_lease_id += 1

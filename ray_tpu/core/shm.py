@@ -40,8 +40,15 @@ class ShmSegment:
 
     @classmethod
     def create(cls, name: str, size: int) -> "ShmSegment":
+        """Create (or atomically replace) a segment.  Replacement matters for
+        task retries: return-object names are deterministic per task id, and
+        a crashed attempt may have left a sealed segment behind."""
         path = _path(name)
-        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+        except FileExistsError:
+            os.unlink(path)
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
         try:
             os.ftruncate(fd, size)
             mm = mmap.mmap(fd, size)

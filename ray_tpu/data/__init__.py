@@ -1,0 +1,10 @@
+from .dataset import (  # noqa: F401
+    DataIterator,
+    Dataset,
+    from_items,
+    range_dataset,
+    read_csv,
+    read_json,
+    read_numpy,
+    read_parquet,
+)

@@ -1,0 +1,236 @@
+"""Streaming datasets — the Ray Data equivalent (subset).
+
+Reference architecture (ray ``python/ray/data/``): lazy logical plan over
+*blocks* stored in the object store, executed by parallel tasks, consumed by
+trainers via ``streaming_split`` per-worker shards.  This is the round-1
+subset of that design (SURVEY.md §7: "streaming executor subset:
+read→map→shuffle→split ingest"):
+
+  - a Dataset is a list of block ObjectRefs + a chain of pending per-block
+    transforms (fused and applied lazily, in parallel, by remote tasks);
+  - wide ops (shuffle, repartition) materialize;
+  - ``streaming_split(n)`` gives each training worker a DataIterator that
+    pulls only its own blocks and applies the transform chain on the fly —
+    blocks stay in shared memory until iterated.
+
+TPU note: ``iter_batches`` yields contiguous numpy batches sized for the
+step; device placement (host→HBM) belongs to the training loop so transfers
+overlap with compute.
+"""
+
+from __future__ import annotations
+
+import random as _random
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+import ray_tpu
+
+Block = List[Any]  # a block is a list of rows (dicts or scalars)
+
+
+def _apply_chain(block: Block, transforms) -> Block:
+    for t in transforms:
+        block = t(block)
+    return block
+
+
+@ray_tpu.remote
+def _transform_block(block: Block, transforms) -> Block:
+    return _apply_chain(block, transforms)
+
+
+class Dataset:
+    def __init__(self, block_refs: List, transforms: Optional[List] = None):
+        self._block_refs = list(block_refs)
+        self._transforms = list(transforms or [])
+
+    # ------------------------------------------------------------ transforms
+    def _chain(self, fn) -> "Dataset":
+        return Dataset(self._block_refs, self._transforms + [fn])
+
+    def map(self, fn: Callable[[Any], Any]) -> "Dataset":
+        return self._chain(lambda block: [fn(r) for r in block])
+
+    def filter(self, fn: Callable[[Any], bool]) -> "Dataset":
+        return self._chain(lambda block: [r for r in block if fn(r)])
+
+    def flat_map(self, fn: Callable[[Any], Sequence[Any]]) -> "Dataset":
+        return self._chain(
+            lambda block: [o for r in block for o in fn(r)]
+        )
+
+    def map_batches(self, fn: Callable[[Block], Block]) -> "Dataset":
+        return self._chain(lambda block: list(fn(block)))
+
+    # ------------------------------------------------------------- wide ops
+    def materialize(self) -> "Dataset":
+        """Execute pending transforms in parallel (one task per block)."""
+        if not self._transforms:
+            return self
+        refs = [
+            _transform_block.remote(b, self._transforms)
+            for b in self._block_refs
+        ]
+        return Dataset(refs, [])
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        rows = self.take_all()
+        return from_items(rows, parallelism=num_blocks)
+
+    def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
+        rows = self.take_all()
+        rng = _random.Random(seed)
+        rng.shuffle(rows)
+        return from_items(rows, parallelism=max(1, len(self._block_refs)))
+
+    def union(self, other: "Dataset") -> "Dataset":
+        a = self.materialize()
+        b = other.materialize()
+        return Dataset(a._block_refs + b._block_refs, [])
+
+    def sort(self, key: Callable = None) -> "Dataset":
+        rows = sorted(self.take_all(), key=key)
+        return from_items(rows, parallelism=max(1, len(self._block_refs)))
+
+    # ------------------------------------------------------------ consumers
+    def iter_blocks(self) -> Iterator[Block]:
+        for ref in self._block_refs:
+            block = ray_tpu.get(ref, timeout=300)
+            yield _apply_chain(block, self._transforms)
+
+    def iter_rows(self) -> Iterator[Any]:
+        for block in self.iter_blocks():
+            yield from block
+
+    def iter_batches(self, batch_size: int = 256,
+                     drop_last: bool = False) -> Iterator[Block]:
+        buf: Block = []
+        for block in self.iter_blocks():
+            buf.extend(block)
+            while len(buf) >= batch_size:
+                yield buf[:batch_size]
+                buf = buf[batch_size:]
+        if buf and not drop_last:
+            yield buf
+
+    def take(self, n: int = 20) -> Block:
+        out: Block = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> Block:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        if not self._transforms:
+            # Fast path: count rows per block remotely.
+            counts = ray_tpu.get(
+                [_transform_block.remote(b, [lambda blk: [len(blk)]])
+                 for b in self._block_refs],
+                timeout=300,
+            )
+            return sum(c[0] for c in counts)
+        return sum(1 for _ in self.iter_rows())
+
+    def num_blocks(self) -> int:
+        return len(self._block_refs)
+
+    def schema(self):
+        first = self.take(1)
+        if not first:
+            return None
+        row = first[0]
+        if isinstance(row, dict):
+            return {k: type(v).__name__ for k, v in row.items()}
+        return type(row).__name__
+
+    # --------------------------------------------------------------- splits
+    def split(self, n: int) -> List["Dataset"]:
+        """Split blocks round-robin into n datasets."""
+        groups: List[List] = [[] for _ in range(n)]
+        for i, ref in enumerate(self._block_refs):
+            groups[i % n].append(ref)
+        return [Dataset(g, self._transforms) for g in groups]
+
+    def streaming_split(self, n: int) -> List["DataIterator"]:
+        """Per-trainer shards (reference: ray ``data/dataset.py:1881``)."""
+        return [DataIterator(ds) for ds in self.split(n)]
+
+    def __repr__(self):
+        return (
+            f"Dataset(blocks={len(self._block_refs)}, "
+            f"pending_transforms={len(self._transforms)})"
+        )
+
+
+class DataIterator:
+    """A consumable shard handed to one training worker."""
+
+    def __init__(self, dataset: Dataset):
+        self._dataset = dataset
+
+    def iter_batches(self, batch_size: int = 256, drop_last: bool = False):
+        return self._dataset.iter_batches(batch_size, drop_last)
+
+    def iter_rows(self):
+        return self._dataset.iter_rows()
+
+    def count(self) -> int:
+        return self._dataset.count()
+
+    def __reduce__(self):
+        return (DataIterator, (self._dataset,))
+
+
+# ------------------------------------------------------------------ sources
+def from_items(items: Sequence[Any], parallelism: int = 8) -> Dataset:
+    items = list(items)
+    n = max(1, min(parallelism, len(items) or 1))
+    size = (len(items) + n - 1) // n
+    refs = [
+        ray_tpu.put(items[i * size : (i + 1) * size]) for i in range(n)
+    ]
+    return Dataset([r for r in refs], [])
+
+
+def range_dataset(n: int, parallelism: int = 8) -> Dataset:
+    return from_items(list(range(n)), parallelism)
+
+
+def read_numpy(arrays: Dict[str, np.ndarray], parallelism: int = 8) -> Dataset:
+    """Rows are dicts of per-column values."""
+    n_rows = len(next(iter(arrays.values())))
+    rows = [{k: v[i] for k, v in arrays.items()} for i in range(n_rows)]
+    return from_items(rows, parallelism)
+
+
+def read_parquet(path: str, parallelism: int = 8) -> Dataset:
+    import pyarrow.parquet as pq
+
+    table = pq.read_table(path)
+    return from_items(table.to_pylist(), parallelism)
+
+
+def read_csv(path: str, parallelism: int = 8) -> Dataset:
+    import csv
+
+    with open(path) as f:
+        rows = list(csv.DictReader(f))
+    return from_items(rows, parallelism)
+
+
+def read_json(path: str, parallelism: int = 8) -> Dataset:
+    import json
+
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                rows.append(json.loads(line))
+    return from_items(rows, parallelism)

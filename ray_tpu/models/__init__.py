@@ -1,0 +1,2 @@
+from .gpt2 import GPT2Config, gpt2_apply, gpt2_init, gpt2_loss, gpt2_param_axes  # noqa: F401
+from .mlp import mlp_apply, mlp_init  # noqa: F401

@@ -1,0 +1,190 @@
+"""GPT-2 family — the flagship LM (BASELINE.md north-star config #4:
+GPT-2-medium LM with streaming data + sharded optimizer).
+
+TPU-first design decisions:
+  - plain-JAX pytree params with *logical* sharding axes
+    (``gpt2_param_axes``) mapped through ``ray_tpu.parallel.sharding`` rules
+    — the same model runs DP, FSDP, TP, and SP by changing the rule table;
+  - layers are stacked on a leading axis and applied with ``lax.scan``
+    (one trace/compile regardless of depth; XLA pipelines the layer loop);
+  - attention is pluggable: dense (XLA-fused), Pallas flash kernel, ring
+    (context parallel over ``seq`` axis), or Ulysses all-to-all;
+  - ``remat=True`` wraps each layer in ``jax.checkpoint`` to trade FLOPs
+    for HBM;
+  - bf16 activations/params with f32 layernorm + softmax accumulation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50304  # 50257 padded up for lane tiling
+    max_seq: int = 1024
+    n_layer: int = 12
+    n_head: int = 12
+    d_model: int = 768
+    dtype: str = "bfloat16"
+    attention: str = "dense"  # dense | flash | ring | ulysses
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_head
+
+    @classmethod
+    def medium(cls, **kw) -> "GPT2Config":
+        return cls(n_layer=24, n_head=16, d_model=1024, **kw)
+
+    @classmethod
+    def small(cls, **kw) -> "GPT2Config":
+        return cls(n_layer=12, n_head=12, d_model=768, **kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "GPT2Config":
+        kw.setdefault("vocab_size", 512)
+        kw.setdefault("max_seq", 128)
+        return cls(n_layer=2, n_head=4, d_model=64, **kw)
+
+
+def gpt2_init(key, cfg: GPT2Config):
+    e, h, d, L = cfg.d_model, cfg.n_head, cfg.head_dim, cfg.n_layer
+    k = iter(jax.random.split(key, 16))
+    dt = jnp.dtype(cfg.dtype)
+    init = lambda kk, shape, scale: (jax.random.normal(kk, shape) * scale).astype(dt)
+    s = 0.02
+    so = s / (2 * L) ** 0.5  # gpt-2 residual-out scaling
+    params = {
+        "wte": init(next(k), (cfg.vocab_size, e), s),
+        "wpe": init(next(k), (cfg.max_seq, e), s),
+        "blocks": {
+            "ln1_g": jnp.ones((L, e), dt),
+            "ln1_b": jnp.zeros((L, e), dt),
+            "wqkv": init(next(k), (L, e, 3, h, d), s),
+            "bqkv": jnp.zeros((L, 3, h, d), dt),
+            "wo": init(next(k), (L, h, d, e), so),
+            "bo": jnp.zeros((L, e), dt),
+            "ln2_g": jnp.ones((L, e), dt),
+            "ln2_b": jnp.zeros((L, e), dt),
+            "wi": init(next(k), (L, e, 4 * e), s),
+            "bi": jnp.zeros((L, 4 * e), dt),
+            "wo2": init(next(k), (L, 4 * e, e), so),
+            "bo2": jnp.zeros((L, e), dt),
+        },
+        "lnf_g": jnp.ones((e,), dt),
+        "lnf_b": jnp.zeros((e,), dt),
+    }
+    return params
+
+
+def gpt2_param_axes():
+    """Logical sharding axes per parameter (leading None = layer-stack axis)."""
+    return {
+        "wte": P("vocab", "embed"),
+        "wpe": P(None, "embed"),
+        "blocks": {
+            "ln1_g": P(None, "norm"),
+            "ln1_b": P(None, "norm"),
+            "wqkv": P(None, "embed", None, "heads", "kv"),
+            "bqkv": P(None, None, "heads", "kv"),
+            "wo": P(None, "heads", "kv", "embed"),
+            "bo": P(None, "norm"),
+            "ln2_g": P(None, "norm"),
+            "ln2_b": P(None, "norm"),
+            "wi": P(None, "embed", "mlp"),
+            "bi": P(None, "mlp"),
+            "wo2": P(None, "mlp", "embed"),
+            "bo2": P(None, "norm"),
+        },
+        "lnf_g": P("norm"),
+        "lnf_b": P("norm"),
+    }
+
+
+def _layernorm(x, g, b, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * g.astype(jnp.float32) + b.astype(jnp.float32)).astype(x.dtype)
+
+
+def _attention(q, k, v, cfg: GPT2Config, mesh):
+    if cfg.attention == "flash":
+        from ..ops.attention import flash_attention
+
+        return flash_attention(q, k, v, causal=True)
+    if cfg.attention == "ring":
+        from ..parallel.ring_attention import ring_attention
+
+        assert mesh is not None, "ring attention requires a mesh"
+        return ring_attention(q, k, v, mesh, causal=True)
+    if cfg.attention == "ulysses":
+        from ..parallel.ulysses import ulysses_attention
+
+        assert mesh is not None, "ulysses attention requires a mesh"
+        return ulysses_attention(q, k, v, mesh, causal=True)
+    from ..ops.attention import reference_attention
+
+    return reference_attention(q, k, v, causal=True)
+
+
+def _block(x, layer, cfg: GPT2Config, mesh):
+    from ..parallel.sharding import with_logical_constraint as wlc
+
+    b, s, e = x.shape
+    h, d = cfg.n_head, cfg.head_dim
+    y = _layernorm(x, layer["ln1_g"], layer["ln1_b"])
+    qkv = jnp.einsum("bse,ethd->bsthd", y, layer["wqkv"]) + layer["bqkv"]
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    q = wlc(q, P("batch", "seq", "heads", "kv"), mesh)
+    k = wlc(k, P("batch", "seq", "heads", "kv"), mesh)
+    v = wlc(v, P("batch", "seq", "heads", "kv"), mesh)
+    o = _attention(q, k, v, cfg, mesh)
+    x = x + (jnp.einsum("bshd,hde->bse", o, layer["wo"]) + layer["bo"]).astype(x.dtype)
+    y = _layernorm(x, layer["ln2_g"], layer["ln2_b"])
+    hdn = jax.nn.gelu(jnp.einsum("bse,ef->bsf", y, layer["wi"]) + layer["bi"])
+    hdn = wlc(hdn, P("batch", "seq", "mlp"), mesh)
+    x = x + (jnp.einsum("bsf,fe->bse", hdn, layer["wo2"]) + layer["bo2"]).astype(x.dtype)
+    return wlc(x, P("batch", "seq", "act_embed"), mesh)
+
+
+def gpt2_apply(params, tokens, cfg: GPT2Config, mesh=None):
+    """tokens: [B, S] int32 → logits [B, S, V]."""
+    from ..parallel.sharding import with_logical_constraint as wlc
+
+    b, s = tokens.shape
+    x = params["wte"][tokens] + params["wpe"][:s][None]
+    x = wlc(x, P("batch", "seq", "act_embed"), mesh)
+
+    block = functools.partial(_block, cfg=cfg, mesh=mesh)
+    if cfg.remat:
+        block = jax.checkpoint(block)
+
+    def scan_body(x, layer):
+        return block(x, layer), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+    x = _layernorm(x, params["lnf_g"], params["lnf_b"])
+    logits = jnp.einsum("bse,ve->bsv", x, params["wte"])
+    return wlc(logits, P("batch", "seq", "vocab"), mesh)
+
+
+def gpt2_loss(params, tokens, cfg: GPT2Config, mesh=None, z_loss: float = 0.0):
+    """Next-token cross-entropy.  tokens: [B, S+1] (inputs = [:, :-1])."""
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits = gpt2_apply(params, inputs, cfg, mesh).astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    nll = (logz - gold).mean()
+    if z_loss > 0:
+        nll = nll + z_loss * (logz ** 2).mean()
+    return nll

@@ -1,0 +1,1 @@
+from .attention import flash_attention, reference_attention  # noqa: F401

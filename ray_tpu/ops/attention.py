@@ -1,0 +1,175 @@
+"""Attention ops: XLA reference implementation + Pallas TPU flash kernel.
+
+The compute-path replacement for what the reference framework delegates to
+external engines (vLLM/FlashAttention CUDA kernels; see SURVEY.md §2.3 — the
+reference has no attention kernels of its own).  TPU-first design:
+
+  - ``reference_attention``: plain jnp einsum softmax — XLA already fuses
+    this well for moderate sequence lengths; used as the CPU/test path and
+    as the ground truth for kernel tests.
+  - ``flash_attention``: blocked online-softmax Pallas kernel (VMEM-tiled,
+    MXU matmuls with f32 accumulation) for long sequences on TPU; falls
+    back to the reference off-TPU.  Forward kernel + custom VJP whose
+    backward rematerializes in plain XLA (Pallas bwd kernel is the known
+    follow-up).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _causal_mask(sq: int, sk: int, q_offset: int = 0, k_offset: int = 0):
+    q_pos = q_offset + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+    k_pos = k_offset + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+    return k_pos <= q_pos
+
+
+def reference_attention(
+    q, k, v, *, causal: bool = True, q_offset: int = 0, k_offset: int = 0,
+    softmax_scale: Optional[float] = None,
+):
+    """q: [B, Sq, H, D]; k/v: [B, Sk, H, D] → [B, Sq, H, D]."""
+    d = q.shape[-1]
+    sq, sk = q.shape[1], k.shape[1]
+    scale = softmax_scale if softmax_scale is not None else d ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        mask = _causal_mask(sq, sk, q_offset, k_offset)
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    return out
+
+
+# --------------------------------------------------------------------------
+# Pallas TPU flash attention (forward kernel)
+# --------------------------------------------------------------------------
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
+                      sk: int, causal: bool, scale: float):
+    """Grid: (batch*heads, Sq/block_q).  Ref tiles (leading dim squeezed):
+    q_ref [block_q, D], k_ref/v_ref [Sk, D], o_ref [block_q, D]."""
+    import jax.experimental.pallas as pl
+
+    iota = jax.lax.broadcasted_iota
+    q_block = pl.program_id(1)
+    q = q_ref[:].astype(jnp.float32) * scale
+
+    m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q, 1), jnp.float32)
+    acc = jnp.zeros(o_ref.shape, jnp.float32)
+    num_k_blocks = sk // block_k
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k_tile = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_tile = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_tile.T, preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = q_block * block_q + iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.dot(p, v_tile, preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    if causal:
+        # Only K blocks up to (and including) the diagonal contribute.
+        num_iter = jnp.minimum(
+            jax.lax.div((q_block + 1) * block_q + block_k - 1, block_k),
+            num_k_blocks,
+        )
+    else:
+        num_iter = num_k_blocks
+    m, l, acc = jax.lax.fori_loop(0, num_iter, body, (m, l, acc))
+    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, causal: bool, scale: float, block_q: int, block_k: int,
+               interpret: bool):
+    import jax.experimental.pallas as pl
+
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    # Fold batch and heads into the grid's first axis.
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+
+    kernel = functools.partial(
+        _flash_fwd_kernel, block_q=block_q, block_k=block_k, sk=sk,
+        causal=causal, scale=scale,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda bh, qb: (bh, qb, 0)),
+            pl.BlockSpec((None, sk, d), lambda bh, qb: (bh, 0, 0)),
+            pl.BlockSpec((None, sk, d), lambda bh, qb: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda bh, qb: (bh, qb, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    scale = q.shape[-1] ** -0.5
+    return _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+def _flash_fwd_rule(q, k, v, causal, block_q, block_k, interpret):
+    out = _flash(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd_rule(causal, block_q, block_k, interpret, res, g):
+    q, k, v = res
+
+    def fwd(q, k, v):
+        return reference_attention(q, k, v, causal=causal)
+
+    _, vjp = jax.vjp(fwd, q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(
+    q, k, v, *, causal: bool = True, block_q: int = 128, block_k: int = 128,
+    force_pallas: bool = False, force_reference: bool = False,
+):
+    """Dispatching flash attention: Pallas kernel on TPU when shapes tile
+    cleanly, XLA reference otherwise.  q/k/v: [B, S, H, D]."""
+    sq, sk = q.shape[1], k.shape[1]
+    bq, bk = min(block_q, sq), min(block_k, sk)
+    use_pallas = force_pallas or (
+        not force_reference
+        and _on_tpu()
+        and sq % bq == 0
+        and sk % bk == 0
+    )
+    if use_pallas:
+        return _flash(q, k, v, causal, bq, bk, not _on_tpu())
+    return reference_attention(q, k, v, causal=causal)

@@ -1,0 +1,13 @@
+from .mesh import MeshConfig, build_mesh, local_mesh  # noqa: F401
+from .pipeline import pipeline_local, pipelined  # noqa: F401
+from .ring_attention import ring_attention, ring_attention_local  # noqa: F401
+from .sharding import (  # noqa: F401
+    DEFAULT_RULES,
+    LogicalRules,
+    logical_sharding,
+    logical_spec,
+    shard_pytree,
+    sharding_tree,
+    with_logical_constraint,
+)
+from .ulysses import ulysses_attention, ulysses_attention_local  # noqa: F401

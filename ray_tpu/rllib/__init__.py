@@ -1,0 +1,2 @@
+from .env import CartPole  # noqa: F401
+from .ppo import PPO, PPOConfig  # noqa: F401

@@ -1,0 +1,60 @@
+"""Built-in environments (gym-API compatible, zero external deps).
+
+The RL workload for BASELINE.md north-star config #3 is PPO; CartPole is the
+standard smoke env.  Implemented in numpy with the classic dynamics so tests
+run anywhere.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class CartPole:
+    """Classic cart-pole balancing; observation (4,), actions {0, 1}."""
+
+    observation_size = 4
+    num_actions = 2
+
+    def __init__(self, seed: int = 0, max_steps: int = 200):
+        self.rng = np.random.default_rng(seed)
+        self.max_steps = max_steps
+        self.gravity = 9.8
+        self.masscart = 1.0
+        self.masspole = 0.1
+        self.length = 0.5
+        self.force_mag = 10.0
+        self.tau = 0.02
+        self.theta_threshold = 12 * 2 * np.pi / 360
+        self.x_threshold = 2.4
+        self.state = None
+        self.steps = 0
+
+    def reset(self):
+        self.state = self.rng.uniform(-0.05, 0.05, size=4).astype(np.float32)
+        self.steps = 0
+        return self.state.copy()
+
+    def step(self, action: int):
+        x, x_dot, theta, theta_dot = self.state
+        force = self.force_mag if action == 1 else -self.force_mag
+        costheta, sintheta = np.cos(theta), np.sin(theta)
+        total_mass = self.masspole + self.masscart
+        polemass_length = self.masspole * self.length
+        temp = (force + polemass_length * theta_dot**2 * sintheta) / total_mass
+        thetaacc = (self.gravity * sintheta - costheta * temp) / (
+            self.length * (4.0 / 3.0 - self.masspole * costheta**2 / total_mass)
+        )
+        xacc = temp - polemass_length * thetaacc * costheta / total_mass
+        x = x + self.tau * x_dot
+        x_dot = x_dot + self.tau * xacc
+        theta = theta + self.tau * theta_dot
+        theta_dot = theta_dot + self.tau * thetaacc
+        self.state = np.array([x, x_dot, theta, theta_dot], np.float32)
+        self.steps += 1
+        done = bool(
+            abs(x) > self.x_threshold
+            or abs(theta) > self.theta_threshold
+            or self.steps >= self.max_steps
+        )
+        return self.state.copy(), 1.0, done, {}

@@ -1,0 +1,292 @@
+"""PPO — algorithm + JAX learner + distributed env runners.
+
+Reference architecture (ray ``rllib/algorithms/algorithm.py:212``,
+``env/env_runner_group.py:70``, ``core/learner/learner_group.py:101``): the
+Algorithm coordinates an EnvRunnerGroup of sampling actors and a Learner
+performing SGD.  TPU-first differences: the policy/value nets and the PPO
+update are pure-JAX jitted functions (the learner step runs on the chip; on
+a slice the same update jits over a device mesh with batch sharded on
+``data``); env runners stay CPU actors that receive broadcast params each
+iteration — sampling scales with actors, learning scales with chips.
+Fault tolerance: dead runners are detected at poll time and replaced
+(the FaultTolerantActorManager pattern, ray ``rllib/utils/actor_manager.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.core.serialization import dumps_function
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class PPOConfig:
+    env_maker: Any = None  # callable () -> env; default CartPole
+    num_env_runners: int = 2
+    rollout_steps: int = 256  # per runner per iteration
+    gamma: float = 0.99
+    gae_lambda: float = 0.95
+    clip_eps: float = 0.2
+    lr: float = 3e-3
+    entropy_coeff: float = 0.01
+    value_coeff: float = 0.5
+    num_sgd_epochs: int = 4
+    minibatch_size: int = 128
+    hidden: int = 32
+    seed: int = 0
+
+    def build(self) -> "PPO":
+        return PPO(self)
+
+
+# ----------------------------------------------------------------- learner
+def _init_policy(key, obs_size: int, num_actions: int, hidden: int):
+    import jax
+
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    scale = lambda fan_in: (2.0 / fan_in) ** 0.5
+    return {
+        "w1": jax.random.normal(k1, (obs_size, hidden)) * scale(obs_size),
+        "b1": np.zeros(hidden, np.float32),
+        "wp": jax.random.normal(k2, (hidden, num_actions)) * 0.01,
+        "bp": np.zeros(num_actions, np.float32),
+        "wv": jax.random.normal(k3, (hidden, 1)) * scale(hidden),
+        "bv": np.zeros(1, np.float32),
+    }
+
+
+def _policy_forward(params, obs):
+    import jax.numpy as jnp
+
+    h = jnp.tanh(obs @ params["w1"] + params["b1"])
+    logits = h @ params["wp"] + params["bp"]
+    value = (h @ params["wv"] + params["bv"])[..., 0]
+    return logits, value
+
+
+class JaxLearner:
+    """Jitted PPO update (clipped surrogate + value + entropy)."""
+
+    def __init__(self, cfg: PPOConfig, obs_size: int, num_actions: int):
+        import jax
+        import optax
+
+        self.cfg = cfg
+        key = jax.random.PRNGKey(cfg.seed)
+        self.params = _init_policy(key, obs_size, num_actions, cfg.hidden)
+        self.tx = optax.adam(cfg.lr)
+        self.opt_state = self.tx.init(self.params)
+
+        clip_eps = cfg.clip_eps
+        vf, ent = cfg.value_coeff, cfg.entropy_coeff
+
+        def loss_fn(params, batch):
+            import jax.numpy as jnp
+
+            logits, value = _policy_forward(params, batch["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][:, None], axis=1
+            )[:, 0]
+            ratio = jnp.exp(logp - batch["logp_old"])
+            adv = batch["advantages"]
+            surrogate = jnp.minimum(
+                ratio * adv,
+                jnp.clip(ratio, 1 - clip_eps, 1 + clip_eps) * adv,
+            )
+            value_loss = jnp.mean((value - batch["returns"]) ** 2)
+            entropy = -jnp.mean(
+                jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1)
+            )
+            loss = -jnp.mean(surrogate) + vf * value_loss - ent * entropy
+            return loss, {
+                "policy_loss": -jnp.mean(surrogate),
+                "value_loss": value_loss,
+                "entropy": entropy,
+            }
+
+        def update(params, opt_state, batch):
+            (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, batch
+            )
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            import optax as _optax
+
+            params = _optax.apply_updates(params, updates)
+            stats["total_loss"] = loss
+            return params, opt_state, stats
+
+        self._update = jax.jit(update)
+
+    def update_minibatches(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        import jax.numpy as jnp
+
+        n = len(batch["obs"])
+        rng = np.random.default_rng(self.cfg.seed)
+        stats = {}
+        mb = min(self.cfg.minibatch_size, n)
+        for _ in range(self.cfg.num_sgd_epochs):
+            perm = rng.permutation(n)
+            for i in range(0, n - mb + 1, mb):
+                idx = perm[i : i + mb]
+                sub = {k: jnp.asarray(v[idx]) for k, v in batch.items()}
+                self.params, self.opt_state, st = self._update(
+                    self.params, self.opt_state, sub
+                )
+                stats = st
+        return {k: float(v) for k, v in stats.items()}
+
+    def get_params(self):
+        import jax
+
+        return jax.tree.map(np.asarray, self.params)
+
+
+# -------------------------------------------------------------- env runner
+@ray_tpu.remote
+class EnvRunner:
+    """Sampling actor: rolls out the current policy in its env copy."""
+
+    def __init__(self, env_maker_payload: bytes, seed: int):
+        from ray_tpu.core.serialization import loads_function
+
+        maker = loads_function(env_maker_payload)
+        self.env = maker()
+        self.rng = np.random.default_rng(seed)
+        self.obs = self.env.reset()
+        self.episode_return = 0.0
+        self.completed_returns: List[float] = []
+
+    def sample(self, params: Dict[str, np.ndarray], num_steps: int):
+        """CPU numpy forward (tiny policy net) — no jax import needed."""
+        obs_buf, act_buf, rew_buf, done_buf, logp_buf, val_buf = (
+            [], [], [], [], [], [],
+        )
+        for _ in range(num_steps):
+            h = np.tanh(self.obs @ params["w1"] + params["b1"])
+            logits = h @ params["wp"] + params["bp"]
+            logits = logits - logits.max()
+            probs = np.exp(logits) / np.exp(logits).sum()
+            action = int(self.rng.choice(len(probs), p=probs))
+            value = float(h @ params["wv"] + params["bv"])
+            obs_buf.append(self.obs)
+            act_buf.append(action)
+            logp_buf.append(float(np.log(probs[action] + 1e-12)))
+            val_buf.append(value)
+            self.obs, reward, done, _ = self.env.step(action)
+            rew_buf.append(reward)
+            done_buf.append(done)
+            self.episode_return += reward
+            if done:
+                self.completed_returns.append(self.episode_return)
+                self.episode_return = 0.0
+                self.obs = self.env.reset()
+        # Bootstrap value for the unfinished tail.
+        h = np.tanh(self.obs @ params["w1"] + params["b1"])
+        last_value = float(h @ params["wv"] + params["bv"])
+        returns, self.completed_returns = self.completed_returns, []
+        return {
+            "obs": np.asarray(obs_buf, np.float32),
+            "actions": np.asarray(act_buf, np.int32),
+            "rewards": np.asarray(rew_buf, np.float32),
+            "dones": np.asarray(done_buf, bool),
+            "logp_old": np.asarray(logp_buf, np.float32),
+            "values": np.asarray(val_buf, np.float32),
+            "last_value": last_value,
+            "episode_returns": returns,
+        }
+
+
+def _compute_gae(traj, gamma: float, lam: float):
+    rewards, values, dones = traj["rewards"], traj["values"], traj["dones"]
+    n = len(rewards)
+    adv = np.zeros(n, np.float32)
+    last_gae = 0.0
+    next_value = traj["last_value"]
+    for t in reversed(range(n)):
+        nonterminal = 0.0 if dones[t] else 1.0
+        delta = rewards[t] + gamma * next_value * nonterminal - values[t]
+        last_gae = delta + gamma * lam * nonterminal * last_gae
+        adv[t] = last_gae
+        next_value = values[t]
+    returns = adv + values
+    return adv, returns
+
+
+# ---------------------------------------------------------------- algorithm
+class PPO:
+    def __init__(self, config: Optional[PPOConfig] = None):
+        from .env import CartPole
+
+        self.config = config or PPOConfig()
+        maker = self.config.env_maker or (lambda: CartPole())
+        self._maker_payload = dumps_function(maker)
+        probe = maker()
+        self.obs_size = probe.observation_size
+        self.num_actions = probe.num_actions
+        self.learner = JaxLearner(self.config, self.obs_size, self.num_actions)
+        self.runners = [
+            self._make_runner(i) for i in range(self.config.num_env_runners)
+        ]
+        self.iteration = 0
+
+    def _make_runner(self, idx: int):
+        return EnvRunner.remote(self._maker_payload, self.config.seed + idx)
+
+    def train(self) -> Dict[str, Any]:
+        self.iteration += 1
+        params = self.learner.get_params()
+        refs = [
+            (i, r.sample.remote(params, self.config.rollout_steps))
+            for i, r in enumerate(self.runners)
+        ]
+        trajs = []
+        episode_returns: List[float] = []
+        for i, ref in refs:
+            try:
+                trajs.append(ray_tpu.get(ref, timeout=300))
+            except Exception as e:  # noqa: BLE001 - replace dead runner
+                logger.warning("env runner %d failed (%s); replacing", i, e)
+                self.runners[i] = self._make_runner(i)
+        if not trajs:
+            raise RuntimeError("all env runners failed")
+        adv_list, ret_list = [], []
+        for t in trajs:
+            adv, ret = _compute_gae(
+                t, self.config.gamma, self.config.gae_lambda
+            )
+            adv_list.append(adv)
+            ret_list.append(ret)
+            episode_returns.extend(t["episode_returns"])
+        batch = {
+            "obs": np.concatenate([t["obs"] for t in trajs]),
+            "actions": np.concatenate([t["actions"] for t in trajs]),
+            "logp_old": np.concatenate([t["logp_old"] for t in trajs]),
+            "advantages": np.concatenate(adv_list),
+            "returns": np.concatenate(ret_list),
+        }
+        adv = batch["advantages"]
+        batch["advantages"] = (adv - adv.mean()) / (adv.std() + 1e-8)
+        stats = self.learner.update_minibatches(batch)
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": (
+                float(np.mean(episode_returns)) if episode_returns else None
+            ),
+            "num_env_steps_sampled": sum(len(t["obs"]) for t in trajs),
+            **stats,
+        }
+
+    def stop(self):
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

@@ -1,0 +1,11 @@
+from .api import (  # noqa: F401
+    delete,
+    get_handle,
+    run,
+    shutdown,
+    start_http_proxy,
+    status,
+)
+from .batching import batch  # noqa: F401
+from .deployment import Application, Deployment, deployment  # noqa: F401
+from .handle import DeploymentHandle  # noqa: F401

@@ -1,0 +1,158 @@
+"""Serve public API: run/get_handle/status/delete/shutdown + HTTP ingress.
+
+Reference: ray ``python/ray/serve/api.py:686`` (serve.run) and the per-node
+proxy (``serve/_private/proxy.py``).  The HTTP proxy here is an aiohttp
+server in the driver (or any) process routing ``POST <route_prefix>`` to the
+deployment handle — one hop to the replica, controller out of the hot path,
+matching the reference's proxy→router→replica design.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Dict, Optional
+
+import ray_tpu
+from ray_tpu.core.serialization import dumps_function
+
+from .controller import CONTROLLER_NAME, ServeController
+from .deployment import Application, Deployment
+from .handle import DeploymentHandle
+
+_http_state: Dict[str, Any] = {}
+
+
+def _get_or_create_controller():
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        return ServeController.options(
+            name=CONTROLLER_NAME, get_if_exists=True, max_concurrency=8
+        ).remote()
+
+
+def run(app, name: str = "", route_prefix: Optional[str] = None) -> DeploymentHandle:
+    """Deploy an Application (or bare Deployment) and return its handle."""
+    if isinstance(app, Deployment):
+        app = Application(app)
+    if not isinstance(app, Application):
+        raise TypeError("serve.run expects an Application or Deployment")
+    d = app.deployment
+    controller = _get_or_create_controller()
+    payload = dumps_function(d.func_or_class)
+    ray_tpu.get(
+        controller.deploy.remote(
+            d.name,
+            payload,
+            app.init_args,
+            app.init_kwargs,
+            d.num_replicas,
+            d.ray_actor_options,
+            d.version,
+            d.max_ongoing_requests,
+            route_prefix or d.route_prefix,
+        ),
+        timeout=120,
+    )
+    return DeploymentHandle(d.name, controller)
+
+
+def get_handle(name: str) -> DeploymentHandle:
+    return DeploymentHandle(name)
+
+
+def status() -> Dict[str, Any]:
+    controller = _get_or_create_controller()
+    return ray_tpu.get(controller.status.remote(), timeout=30)
+
+
+def delete(name: str) -> bool:
+    controller = _get_or_create_controller()
+    return ray_tpu.get(controller.delete_deployment.remote(name), timeout=60)
+
+
+def shutdown():
+    stop_http_proxy()
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        return
+    for name in ray_tpu.get(controller.list_deployments.remote(), timeout=30):
+        ray_tpu.get(controller.delete_deployment.remote(name), timeout=60)
+    ray_tpu.kill(controller)
+
+
+# ------------------------------------------------------------------- HTTP
+def start_http_proxy(host: str = "127.0.0.1", port: int = 8000) -> str:
+    """Serve deployments over HTTP: POST <route_prefix> with a JSON body
+    ``{"args": [...], "kwargs": {...}}`` (or any JSON object passed as the
+    single argument)."""
+    import asyncio
+
+    from aiohttp import web
+
+    controller = _get_or_create_controller()
+    handles: Dict[str, DeploymentHandle] = {}
+
+    async def handle_request(request: "web.Request"):
+        routes = ray_tpu.get(controller.get_routes.remote(), timeout=30)
+        name = routes.get(request.path)
+        if name is None:
+            return web.json_response(
+                {"error": f"no deployment at {request.path}"}, status=404
+            )
+        handle = handles.setdefault(name, DeploymentHandle(name, controller))
+        try:
+            body = await request.json()
+        except Exception:
+            body = None
+        if isinstance(body, dict) and ("args" in body or "kwargs" in body):
+            args = body.get("args", [])
+            kwargs = body.get("kwargs", {})
+        elif body is None:
+            args, kwargs = [], {}
+        else:
+            args, kwargs = [body], {}
+        loop = asyncio.get_running_loop()
+        response = handle.remote(*args, **kwargs)
+        try:
+            result = await loop.run_in_executor(
+                None, lambda: response.result(timeout=60)
+            )
+        except Exception as e:  # noqa: BLE001
+            return web.json_response({"error": str(e)}, status=500)
+        try:
+            return web.json_response({"result": result})
+        except TypeError:
+            return web.json_response({"result": repr(result)})
+
+    app = web.Application()
+    app.router.add_route("*", "/{tail:.*}", handle_request)
+
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    runner_box = {}
+
+    def serve_forever():
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(app)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, host, port)
+        loop.run_until_complete(site.start())
+        runner_box["runner"] = runner
+        started.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=serve_forever, daemon=True, name="serve-http")
+    t.start()
+    started.wait(timeout=10)
+    _http_state.update(loop=loop, thread=t, runner=runner_box.get("runner"))
+    return f"http://{host}:{port}"
+
+
+def stop_http_proxy():
+    loop = _http_state.get("loop")
+    if loop is not None:
+        loop.call_soon_threadsafe(loop.stop)
+        _http_state.clear()

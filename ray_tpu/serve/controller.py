@@ -1,0 +1,152 @@
+"""Serve control plane: controller + replica actors.
+
+Reference architecture (ray ``python/ray/serve/_private/controller.py:107``,
+``deployment_state.py``, ``replica.py``): a singleton controller actor owns
+deployment state and reconciles target vs. actual replica actors (versioned
+in-place updates); replicas wrap the user callable and report queue depth
+used by the router's power-of-two-choices.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Any, Dict, List
+
+import ray_tpu
+from ray_tpu.core.serialization import dumps_function, loads_function
+
+CONTROLLER_NAME = "_serve_controller"
+
+
+@ray_tpu.remote
+class Replica:
+    """Hosts one copy of the user callable."""
+
+    def __init__(self, payload: bytes, init_args, init_kwargs):
+        obj = loads_function(payload)
+        if isinstance(obj, type):
+            self.callable = obj(*init_args, **init_kwargs)
+            self._is_class = True
+        else:
+            self.callable = obj
+            self._is_class = False
+        self._ongoing = 0
+        self._lock = threading.Lock()
+        self._total = 0
+
+    def queue_len(self) -> int:
+        return self._ongoing
+
+    def stats(self) -> Dict[str, Any]:
+        return {"ongoing": self._ongoing, "total": self._total}
+
+    async def handle_request(self, method: str, args, kwargs):
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            if self._is_class:
+                target = getattr(self.callable, method or "__call__")
+            else:
+                target = self.callable
+            result = target(*args, **kwargs)
+            if asyncio.iscoroutine(result):
+                result = await result
+            return result
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    def reconfigure(self, user_config):
+        if hasattr(self.callable, "reconfigure"):
+            self.callable.reconfigure(user_config)
+        return True
+
+    def health_check(self) -> bool:
+        if hasattr(self.callable, "check_health"):
+            self.callable.check_health()
+        return True
+
+
+@ray_tpu.remote
+class ServeController:
+    """Singleton named actor owning all deployment state."""
+
+    def __init__(self):
+        # name -> {"spec": dict, "replicas": [handles], "version": str}
+        self.deployments: Dict[str, dict] = {}
+
+    def deploy(self, name: str, payload: bytes, init_args, init_kwargs,
+               num_replicas: int, ray_actor_options: dict, version: str,
+               max_ongoing_requests: int, route_prefix):
+        import ray_tpu as rt
+
+        entry = self.deployments.get(name)
+        if entry is not None and entry["version"] != version:
+            # Versioned update: replace replicas in place.
+            for h in entry["replicas"]:
+                try:
+                    rt.kill(h)
+                except Exception:
+                    pass
+            entry = None
+        if entry is None:
+            entry = {"replicas": [], "version": version}
+        opts = dict(ray_actor_options or {})
+        opts.setdefault("max_concurrency", max(2, max_ongoing_requests))
+        current = len(entry["replicas"])
+        if num_replicas > current:
+            for _ in range(num_replicas - current):
+                entry["replicas"].append(
+                    Replica.options(**opts).remote(payload, init_args, init_kwargs)
+                )
+        elif num_replicas < current:
+            for h in entry["replicas"][num_replicas:]:
+                try:
+                    rt.kill(h)
+                except Exception:
+                    pass
+            entry["replicas"] = entry["replicas"][:num_replicas]
+        entry["version"] = version
+        entry["route_prefix"] = route_prefix or f"/{name}"
+        entry["max_ongoing_requests"] = max_ongoing_requests
+        self.deployments[name] = entry
+        return {"name": name, "num_replicas": len(entry["replicas"])}
+
+    def get_replicas(self, name: str) -> List:
+        entry = self.deployments.get(name)
+        if entry is None:
+            raise KeyError(f"deployment {name!r} not found")
+        return entry["replicas"]
+
+    def get_routes(self) -> Dict[str, str]:
+        return {
+            e["route_prefix"]: name for name, e in self.deployments.items()
+        }
+
+    def delete_deployment(self, name: str) -> bool:
+        import ray_tpu as rt
+
+        entry = self.deployments.pop(name, None)
+        if entry is None:
+            return False
+        for h in entry["replicas"]:
+            try:
+                rt.kill(h)
+            except Exception:
+                pass
+        return True
+
+    def status(self) -> Dict[str, Any]:
+        return {
+            name: {
+                "num_replicas": len(e["replicas"]),
+                "version": e["version"],
+                "route_prefix": e["route_prefix"],
+            }
+            for name, e in self.deployments.items()
+        }
+
+    def list_deployments(self) -> List[str]:
+        return list(self.deployments)

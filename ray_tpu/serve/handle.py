@@ -1,0 +1,100 @@
+"""DeploymentHandle + router.
+
+Reference: ray ``python/ray/serve/handle.py:757`` → ``router.py:881`` →
+``request_router/pow_2_router.py:52`` — requests route to the replica with
+the shorter queue among two random candidates (power of two choices).
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Any, List, Optional
+
+import ray_tpu
+
+from .controller import CONTROLLER_NAME
+
+_REPLICA_REFRESH_S = 5.0
+
+
+class DeploymentResponse:
+    """Future-like wrapper over the replica call's ObjectRef."""
+
+    def __init__(self, ref):
+        self._ref = ref
+
+    def result(self, timeout: Optional[float] = 60.0):
+        return ray_tpu.get(self._ref, timeout=timeout)
+
+    @property
+    def ref(self):
+        return self._ref
+
+
+class _MethodCaller:
+    def __init__(self, handle: "DeploymentHandle", method: str):
+        self._handle = handle
+        self._method = method
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._handle._invoke(self._method, args, kwargs)
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, controller=None):
+        self.deployment_name = deployment_name
+        self._controller = controller
+        self._replicas: List = []
+        self._refreshed = 0.0
+        self._rr = 0
+
+    def _get_controller(self):
+        if self._controller is None:
+            self._controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        return self._controller
+
+    def _refresh(self, force=False):
+        now = time.monotonic()
+        if force or not self._replicas or now - self._refreshed > _REPLICA_REFRESH_S:
+            self._replicas = ray_tpu.get(
+                self._get_controller().get_replicas.remote(self.deployment_name),
+                timeout=30,
+            )
+            self._refreshed = now
+
+    def _pick_replica(self):
+        """Power-of-two-choices by queue depth (2+ replicas), else direct."""
+        self._refresh()
+        if not self._replicas:
+            raise RuntimeError(
+                f"deployment {self.deployment_name!r} has no replicas"
+            )
+        if len(self._replicas) == 1:
+            return self._replicas[0]
+        a, b = random.sample(self._replicas, 2)
+        try:
+            qa, qb = ray_tpu.get(
+                [a.queue_len.remote(), b.queue_len.remote()], timeout=5
+            )
+        except Exception:
+            self._refresh(force=True)
+            return self._replicas[self._rr % len(self._replicas)]
+        return a if qa <= qb else b
+
+    def _invoke(self, method: str, args, kwargs) -> DeploymentResponse:
+        replica = self._pick_replica()
+        self._rr += 1
+        ref = replica.handle_request.remote(method, args, kwargs)
+        return DeploymentResponse(ref)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._invoke("__call__", args, kwargs)
+
+    def __getattr__(self, name: str) -> Any:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _MethodCaller(self, name)
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self.deployment_name,))

@@ -1,0 +1,10 @@
+from .checkpoint import Checkpoint  # noqa: F401
+from .config import (  # noqa: F401
+    CheckpointConfig,
+    FailureConfig,
+    Result,
+    RunConfig,
+    ScalingConfig,
+)
+from .session import get_checkpoint, get_context, report  # noqa: F401
+from .trainer import DataParallelTrainer, JaxTrainer  # noqa: F401

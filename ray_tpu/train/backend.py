@@ -1,0 +1,72 @@
+"""Training backends: per-framework distributed-runtime setup.
+
+Reference: ray ``python/ray/train/backend.py`` (Backend.on_start/on_shutdown)
+and the Jax backend at ``train/v2/jax/config.py:21-101`` (rank-0 address
+broadcast, then per-worker ``jax.distributed.initialize``).  Here the Jax
+backend is the *default*: rank 0 picks a coordinator port, the address is
+shipped through the worker-group actors, and every worker initializes the
+JAX coordination service, after which the whole slice is one device mesh and
+in-step collectives ride ICI.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+
+class Backend:
+    def on_start(self, worker_group) -> None:  # noqa: D401
+        pass
+
+    def on_shutdown(self, worker_group) -> None:
+        pass
+
+
+class JaxBackend(Backend):
+    """Bootstraps ``jax.distributed`` across the worker group."""
+
+    def __init__(self, platform: str = "", coordinator_port: int = 0):
+        self.platform = platform  # "" = leave the env's platform alone
+        self.coordinator_port = coordinator_port
+
+    def on_start(self, worker_group):
+        import ray_tpu
+
+        n = len(worker_group.workers)
+        if n <= 1 and not self.platform:
+            return  # single worker: nothing to rendezvous
+        addr = ray_tpu.get(
+            worker_group.workers[0].get_coordinator_address.remote(
+                self.coordinator_port
+            ),
+            timeout=60,
+        )
+        ray_tpu.get(
+            [
+                w.init_jax_distributed.remote(addr, n, rank, self.platform)
+                for rank, w in enumerate(worker_group.workers)
+            ],
+            timeout=300,
+        )
+
+
+class TorchBackend(Backend):
+    """CPU torch.distributed (gloo) process group for parity with the
+    reference's TorchTrainer (ray ``train/torch/config.py:73-122``)."""
+
+    def on_start(self, worker_group):
+        import ray_tpu
+
+        n = len(worker_group.workers)
+        addr = ray_tpu.get(
+            worker_group.workers[0].get_coordinator_address.remote(0),
+            timeout=60,
+        )
+        host, port = addr.rsplit(":", 1)
+        ray_tpu.get(
+            [
+                w.init_torch_distributed.remote(host, int(port), n, rank)
+                for rank, w in enumerate(worker_group.workers)
+            ],
+            timeout=300,
+        )

@@ -1,0 +1,57 @@
+"""Train configuration dataclasses (reference surface: ray
+``python/ray/train/v2/api/config.py`` / ``air/config.py`` — ScalingConfig,
+RunConfig, FailureConfig, CheckpointConfig, Result)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: Optional[Dict[str, float]] = None
+    # TPU gang options: chips per worker host; reserve the slice as one
+    # SlicePlacementGroup so the ICI mesh is owned end-to-end.
+    chips_per_worker: int = 0
+    accelerator_version: str = ""
+    placement_strategy: str = "SPREAD"
+
+    def worker_resources(self) -> Dict[str, float]:
+        if self.resources_per_worker:
+            return dict(self.resources_per_worker)
+        res: Dict[str, float] = {"CPU": 1.0}
+        if self.use_tpu and self.chips_per_worker:
+            res["TPU"] = float(self.chips_per_worker)
+        return res
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: str = ""
+    storage_path: str = ""
+    failure_config: FailureConfig = dataclasses.field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = dataclasses.field(
+        default_factory=CheckpointConfig
+    )
+
+
+@dataclasses.dataclass
+class Result:
+    metrics: Dict[str, Any]
+    checkpoint: Optional[Any]
+    path: str = ""
+    error: Optional[BaseException] = None
+    metrics_history: Optional[list] = None

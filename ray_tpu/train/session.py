@@ -1,0 +1,56 @@
+"""Per-worker training session: the user-facing ``report`` /
+``get_checkpoint`` / ``get_context`` API (reference: ray
+``python/ray/train/v2/api/train_fn_utils.py:22,153``).
+
+``report`` hands metrics (and optionally a checkpoint directory) to the
+worker actor, which queues them for the controller's poll loop.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+from .checkpoint import Checkpoint
+
+_session = threading.local()
+
+
+@dataclass
+class TrainContext:
+    world_rank: int
+    world_size: int
+    local_rank: int
+    node_rank: int
+    trial_name: str = ""
+    latest_checkpoint: Optional[Checkpoint] = None
+    # filled by the worker actor:
+    _report_fn: Any = None
+
+
+def _set_session(ctx: TrainContext):
+    _session.ctx = ctx
+
+
+def _clear_session():
+    _session.ctx = None
+
+
+def get_context() -> TrainContext:
+    ctx = getattr(_session, "ctx", None)
+    if ctx is None:
+        raise RuntimeError(
+            "No train session active — call inside train_loop_per_worker"
+        )
+    return ctx
+
+
+def report(metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):
+    ctx = get_context()
+    if ctx._report_fn is not None:
+        ctx._report_fn(metrics, checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    return get_context().latest_checkpoint

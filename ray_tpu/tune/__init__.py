@@ -1,0 +1,3 @@
+from .search import choice, grid_search, loguniform, randint, uniform  # noqa: F401
+from .schedulers import ASHAScheduler, FIFOScheduler  # noqa: F401
+from .tuner import ResultGrid, TuneConfig, Tuner, TrialResult  # noqa: F401

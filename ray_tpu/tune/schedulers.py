@@ -1,0 +1,65 @@
+"""Trial schedulers (reference: ray ``python/ray/tune/schedulers/`` —
+FIFO and ASHA/async-hyperband early stopping)."""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+
+class FIFOScheduler:
+    def on_result(self, trial_id: str, metrics: Dict) -> str:
+        return "CONTINUE"
+
+
+class ASHAScheduler:
+    """Asynchronous Successive Halving: at each rung (grace_period ×
+    reduction_factor^k iterations), stop trials not in the top 1/rf of
+    completed rung results (ray ``tune/schedulers/async_hyperband.py``)."""
+
+    def __init__(
+        self,
+        metric: str = "loss",
+        mode: str = "min",
+        max_t: int = 100,
+        grace_period: int = 1,
+        reduction_factor: int = 4,
+        time_attr: str = "training_iteration",
+    ):
+        assert mode in ("min", "max")
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        self.time_attr = time_attr
+        # rung level -> list of recorded metric values
+        self._rungs: Dict[int, List[float]] = {}
+        r = grace_period
+        self._rung_levels = []
+        while r < max_t:
+            self._rung_levels.append(r)
+            r *= reduction_factor
+
+    def on_result(self, trial_id: str, metrics: Dict) -> str:
+        t = metrics.get(self.time_attr)
+        value = metrics.get(self.metric)
+        if t is None or value is None:
+            return "CONTINUE"
+        if t >= self.max_t:
+            return "STOP"
+        for rung in reversed(self._rung_levels):
+            if t == rung:
+                recorded = self._rungs.setdefault(rung, [])
+                recorded.append(float(value))
+                if len(recorded) < self.rf:
+                    return "CONTINUE"  # not enough peers to judge
+                ordered = sorted(
+                    recorded, reverse=(self.mode == "max")
+                )
+                cutoff_idx = max(0, len(ordered) // self.rf - 1)
+                cutoff = ordered[cutoff_idx]
+                good = (
+                    value >= cutoff if self.mode == "max" else value <= cutoff
+                )
+                return "CONTINUE" if good else "STOP"
+        return "CONTINUE"

@@ -1,0 +1,202 @@
+"""Tuner — trial orchestration loop.
+
+Reference architecture (ray ``python/ray/tune/tuner.py:43`` +
+``tune/execution/tune_controller.py:68``): an event loop manages trials as
+remote actors, consuming search-algorithm variants, feeding results to the
+scheduler (ASHA early stopping), bounded by max_concurrent_trials.  Trials
+here are actors running the trainable function with a session that queues
+``report`` results (same session machinery as Train, which is how the
+reference layers Train-on-Tune).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.core.serialization import dumps_function, loads_function
+
+from .schedulers import FIFOScheduler
+from .search import generate_variants
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    num_samples: int = 1
+    max_concurrent_trials: int = 2
+    metric: str = "loss"
+    mode: str = "min"
+    scheduler: Any = None
+    seed: Optional[int] = None
+
+
+@dataclasses.dataclass
+class TrialResult:
+    trial_id: str
+    config: Dict[str, Any]
+    metrics: Dict[str, Any]
+    metrics_history: List[Dict[str, Any]]
+    error: Optional[str] = None
+    stopped_early: bool = False
+
+
+class ResultGrid:
+    def __init__(self, results: List[TrialResult], metric: str, mode: str):
+        self.results = results
+        self._metric = metric
+        self._mode = mode
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> TrialResult:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        scored = [
+            r for r in self.results
+            if r.error is None and metric in (r.metrics or {})
+        ]
+        if not scored:
+            raise ValueError("no successful trials with the target metric")
+        return (max if mode == "max" else min)(
+            scored, key=lambda r: r.metrics[metric]
+        )
+
+    def __len__(self):
+        return len(self.results)
+
+
+@ray_tpu.remote
+class _TrialActor:
+    """Runs one trial; queues reported metrics for the controller."""
+
+    def __init__(self, trial_id: str):
+        self.trial_id = trial_id
+        self._lock = threading.Lock()
+        self._queue: List[Dict[str, Any]] = []
+        self._stop = False
+
+    def run(self, fn_payload: bytes, config: Dict[str, Any]):
+        from ray_tpu.train.session import TrainContext, _clear_session, _set_session
+
+        fn = loads_function(fn_payload)
+        iteration = [0]
+
+        def report_fn(metrics, checkpoint):
+            iteration[0] += 1
+            metrics = dict(metrics)
+            metrics.setdefault("training_iteration", iteration[0])
+            with self._lock:
+                self._queue.append(metrics)
+            if self._stop:
+                raise _EarlyStop()
+
+        ctx = TrainContext(
+            world_rank=0, world_size=1, local_rank=0, node_rank=0,
+            trial_name=self.trial_id, _report_fn=report_fn,
+        )
+        _set_session(ctx)
+        try:
+            fn(config)
+            return {"ok": True, "stopped": False}
+        except _EarlyStop:
+            return {"ok": True, "stopped": True}
+        finally:
+            _clear_session()
+
+    def poll(self):
+        with self._lock:
+            q, self._queue = self._queue, []
+            return q
+
+    def request_stop(self):
+        self._stop = True
+        return True
+
+
+class _EarlyStop(BaseException):
+    pass
+
+
+class Tuner:
+    def __init__(
+        self,
+        trainable: Callable[[Dict[str, Any]], None],
+        *,
+        param_space: Optional[Dict[str, Any]] = None,
+        tune_config: Optional[TuneConfig] = None,
+    ):
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+
+    def fit(self) -> ResultGrid:
+        cfg = self.tune_config
+        scheduler = cfg.scheduler or FIFOScheduler()
+        variants = generate_variants(
+            self.param_space, cfg.num_samples, cfg.seed
+        )
+        payload = dumps_function(self.trainable)
+        pending = [
+            (f"trial_{i:04d}", variant) for i, variant in enumerate(variants)
+        ]
+        running: Dict[str, dict] = {}
+        results: List[TrialResult] = []
+
+        while pending or running:
+            while pending and len(running) < cfg.max_concurrent_trials:
+                trial_id, variant = pending.pop(0)
+                # max_concurrency: poll()/request_stop() must stay responsive
+                # while run() executes the trainable.
+                actor = _TrialActor.options(max_concurrency=4).remote(trial_id)
+                running[trial_id] = {
+                    "actor": actor,
+                    "config": variant,
+                    "ref": actor.run.remote(payload, variant),
+                    "history": [],
+                    "stopped": False,
+                }
+            time.sleep(0.05)
+            for trial_id, st in list(running.items()):
+                for metrics in ray_tpu.get(
+                    st["actor"].poll.remote(), timeout=60
+                ):
+                    st["history"].append(metrics)
+                    decision = scheduler.on_result(trial_id, metrics)
+                    if decision == "STOP" and not st["stopped"]:
+                        st["stopped"] = True
+                        st["actor"].request_stop.remote()
+                ready, _ = ray_tpu.wait([st["ref"]], timeout=0)
+                if ready:
+                    error = None
+                    stopped = st["stopped"]
+                    try:
+                        out = ray_tpu.get(st["ref"], timeout=10)
+                        stopped = stopped or out.get("stopped", False)
+                    except Exception as e:  # noqa: BLE001
+                        error = str(e)
+                    # Final drain after completion.
+                    try:
+                        for metrics in ray_tpu.get(
+                            st["actor"].poll.remote(), timeout=30
+                        ):
+                            st["history"].append(metrics)
+                    except Exception:
+                        pass
+                    results.append(
+                        TrialResult(
+                            trial_id=trial_id,
+                            config=st["config"],
+                            metrics=st["history"][-1] if st["history"] else {},
+                            metrics_history=st["history"],
+                            error=error,
+                            stopped_early=stopped,
+                        )
+                    )
+                    try:
+                        ray_tpu.kill(st["actor"])
+                    except Exception:
+                        pass
+                    del running[trial_id]
+        return ResultGrid(results, cfg.metric, cfg.mode)

@@ -2,14 +2,25 @@ import os
 import sys
 
 # Virtual 8-device CPU mesh for sharding/collective tests without TPU
-# hardware (must be set before jax is imported anywhere).
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# hardware.  Two layers of override are needed on this box:
+#  - the env pins JAX_PLATFORMS=axon (single-chip TPU tunnel) — override it
+#    so child processes (workers) come up on CPU;
+#  - a sitecustomize force-registers the axon backend and calls
+#    jax.config.update("jax_platforms", "axon,cpu") in every process where
+#    PALLAS_AXON_POOL_IPS is set — blank it for children, and re-update the
+#    config in this (already customized) process.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("RAY_TPU_log_level", "INFO")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

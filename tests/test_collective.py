@@ -1,0 +1,124 @@
+"""Collective layer tests on an 8-device virtual CPU mesh (conftest sets
+JAX_PLATFORMS=cpu + xla_force_host_platform_device_count=8)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu.collective as col
+from ray_tpu.collective.types import ReduceOp
+
+
+@pytest.fixture(scope="module")
+def group():
+    g = col.init_local_group("t")
+    yield g
+    col.destroy_collective_group("t")
+
+
+def _per_rank(n, shape=(8, 4)):
+    return [np.full(shape, float(i + 1), np.float32) for i in range(n)]
+
+
+def test_allreduce_sum(group):
+    n = group.world_size
+    out = group.allreduce(_per_rank(n))
+    expected = sum(range(1, n + 1))
+    for o in out:
+        np.testing.assert_allclose(np.asarray(o), expected)
+
+
+def test_allreduce_max_min_mean(group):
+    n = group.world_size
+    outs = group.allreduce(_per_rank(n), ReduceOp.MAX)
+    np.testing.assert_allclose(np.asarray(outs[0]), n)
+    outs = group.allreduce(_per_rank(n), ReduceOp.MIN)
+    np.testing.assert_allclose(np.asarray(outs[0]), 1)
+    outs = group.allreduce(_per_rank(n), ReduceOp.MEAN)
+    np.testing.assert_allclose(np.asarray(outs[0]), (n + 1) / 2)
+
+
+def test_allgather(group):
+    n = group.world_size
+    out = group.allgather(_per_rank(n, (2, 2)))
+    # Every rank sees every rank's tensor.
+    for rank_view in out:
+        assert len(rank_view) == n
+        for i, t in enumerate(rank_view):
+            np.testing.assert_allclose(np.asarray(t), i + 1)
+
+
+def test_reducescatter_sum(group):
+    n = group.world_size
+    tensors = [np.arange(n * 2, dtype=np.float32) + i for i in range(n)]
+    out = group.reducescatter(tensors)
+    full = np.sum(np.stack(tensors), axis=0)
+    for i, o in enumerate(out):
+        np.testing.assert_allclose(np.asarray(o), full[i * 2 : (i + 1) * 2])
+
+
+def test_reducescatter_max(group):
+    n = group.world_size
+    tensors = [np.arange(n, dtype=np.float32) * (i + 1) for i in range(n)]
+    out = group.reducescatter(tensors, ReduceOp.MAX)
+    full = np.max(np.stack(tensors), axis=0)
+    for i, o in enumerate(out):
+        np.testing.assert_allclose(np.asarray(o), full[i : i + 1])
+
+
+def test_broadcast(group):
+    n = group.world_size
+    out = group.broadcast(_per_rank(n), src_rank=2)
+    for o in out:
+        np.testing.assert_allclose(np.asarray(o), 3.0)
+
+
+def test_alltoall(group):
+    n = group.world_size
+    # rank i sends chunk j to rank j; chunk values encode (src, dst).
+    tensors = [
+        np.array([i * 100 + j for j in range(n)], np.float32) for i in range(n)
+    ]
+    out = group.alltoall(tensors)
+    for j, o in enumerate(out):
+        np.testing.assert_allclose(
+            np.asarray(o), [i * 100 + j for i in range(n)]
+        )
+
+
+def test_ring_permute(group):
+    n = group.world_size
+    out = group.sendrecv_ring(_per_rank(n), shift=1)
+    # rank i receives from rank i-1.
+    for i, o in enumerate(out):
+        np.testing.assert_allclose(np.asarray(o), ((i - 1) % n) + 1)
+
+
+def test_barrier(group):
+    group.barrier()  # just must not hang
+
+
+def test_api_functions():
+    assert not col.is_group_initialized("api-test")
+    col.init_local_group("api-test")
+    assert col.is_group_initialized("api-test")
+    assert col.get_collective_group_size("api-test") == 8
+    out = col.allreduce([np.ones(4, np.float32)] * 8, "api-test")
+    np.testing.assert_allclose(np.asarray(out[0]), 8.0)
+    col.destroy_collective_group("api-test")
+    assert not col.is_group_initialized("api-test")
+
+
+def test_device_object_store():
+    import jax.numpy as jnp
+
+    store = col.DeviceObjectStore()
+    arr = jnp.arange(16).reshape(4, 4)
+    ref = store.put(arr)
+    assert store.contains(ref)
+    got = store.get_local(ref)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(arr))
+    assert ref.shape == (4, 4)
+    store.free(ref)
+    assert not store.contains(ref)
+    with pytest.raises(KeyError):
+        store.get_local(ref)

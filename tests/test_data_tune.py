@@ -1,0 +1,123 @@
+"""Data + Tune library tests."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+import ray_tpu.data as rdata
+import ray_tpu.tune as tune
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ctx = ray_tpu.init(num_cpus=8)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+class TestData:
+    def test_from_items_and_take(self, cluster):
+        ds = rdata.from_items(list(range(100)), parallelism=4)
+        assert ds.num_blocks() == 4
+        assert ds.take(5) == [0, 1, 2, 3, 4]
+        assert ds.count() == 100
+
+    def test_map_filter_lazy_chain(self, cluster):
+        ds = rdata.range_dataset(20, parallelism=2).map(lambda x: x * 2)
+        ds = ds.filter(lambda x: x % 4 == 0)
+        assert ds.take_all() == [x * 2 for x in range(20) if (x * 2) % 4 == 0]
+
+    def test_map_batches_and_materialize(self, cluster):
+        ds = rdata.range_dataset(16, parallelism=4).map_batches(
+            lambda b: [sum(b)]
+        )
+        m = ds.materialize()
+        assert m._transforms == []
+        assert sorted(m.take_all()) == sorted(
+            [sum(range(i * 4, (i + 1) * 4)) for i in range(4)]
+        )
+
+    def test_shuffle_preserves_rows(self, cluster):
+        ds = rdata.range_dataset(50, parallelism=4).random_shuffle(seed=7)
+        assert sorted(ds.take_all()) == list(range(50))
+
+    def test_iter_batches(self, cluster):
+        ds = rdata.range_dataset(10, parallelism=3)
+        batches = list(ds.iter_batches(batch_size=4))
+        assert [len(b) for b in batches] == [4, 4, 2]
+        assert list(ds.iter_batches(batch_size=4, drop_last=True))[-1] == [4, 5, 6, 7]
+
+    def test_streaming_split_shards(self, cluster):
+        ds = rdata.range_dataset(40, parallelism=4)
+        shards = ds.streaming_split(2)
+        rows = sorted(
+            list(shards[0].iter_rows()) + list(shards[1].iter_rows())
+        )
+        assert rows == list(range(40))
+        assert shards[0].count() + shards[1].count() == 40
+
+    def test_read_numpy(self, cluster):
+        ds = rdata.read_numpy(
+            {"x": np.arange(6), "y": np.arange(6) * 10}, parallelism=2
+        )
+        rows = ds.take_all()
+        assert rows[3]["y"] == 30
+
+
+class TestTune:
+    def test_grid_and_random_variants(self):
+        from ray_tpu.tune.search import generate_variants
+
+        variants = generate_variants(
+            {"a": tune.grid_search([1, 2]), "b": tune.choice([5])},
+            num_samples=3,
+        )
+        assert len(variants) == 6
+        assert all(v["b"] == 5 for v in variants)
+
+    def test_tuner_picks_best(self, cluster):
+        def trainable(config):
+            import ray_tpu.train as train
+
+            train.report({"loss": (config["x"] - 3) ** 2})
+
+        tuner = tune.Tuner(
+            trainable,
+            param_space={"x": tune.grid_search([0, 1, 3, 7])},
+            tune_config=tune.TuneConfig(
+                num_samples=1, metric="loss", mode="min",
+                max_concurrent_trials=2,
+            ),
+        )
+        grid = tuner.fit()
+        assert len(grid) == 4
+        best = grid.get_best_result()
+        assert best.config["x"] == 3
+        assert best.metrics["loss"] == 0
+
+    def test_asha_stops_bad_trials(self, cluster):
+        def trainable(config):
+            import ray_tpu.train as train
+
+            for step in range(1, 9):
+                train.report({"loss": config["quality"] / step,
+                              "training_iteration": step})
+
+        sched = tune.ASHAScheduler(
+            metric="loss", mode="min", max_t=8, grace_period=2,
+            reduction_factor=2,
+        )
+        tuner = tune.Tuner(
+            trainable,
+            param_space={"quality": tune.grid_search([1.0, 10.0, 20.0, 30.0])},
+            tune_config=tune.TuneConfig(
+                num_samples=1, metric="loss", mode="min", scheduler=sched,
+                max_concurrent_trials=4,
+            ),
+        )
+        grid = tuner.fit()
+        assert len(grid) == 4
+        best = grid.get_best_result()
+        assert best.config["quality"] == 1.0
+        # At least one of the bad trials was culled early.
+        assert any(r.stopped_early for r in grid.results)

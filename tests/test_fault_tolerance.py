@@ -1,0 +1,93 @@
+"""Fault-tolerance regression tests for the review findings: actor init
+failure, unknown actor methods, long-running borrowed gets, actor restart."""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ctx = ray_tpu.init(num_cpus=4)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def test_actor_init_failure_is_permanent(cluster):
+    @ray_tpu.remote
+    class Broken:
+        def __init__(self):
+            raise RuntimeError("ctor boom")
+
+        def ping(self):
+            return 1
+
+    b = Broken.remote()
+    with pytest.raises(ray_tpu.ActorDiedError) as ei:
+        ray_tpu.get(b.ping.remote(), timeout=60)
+    assert "ctor boom" in str(ei.value)
+    # No respawn loop: the cluster still works afterwards.
+    @ray_tpu.remote
+    def ok():
+        return "fine"
+
+    assert ray_tpu.get(ok.remote(), timeout=60) == "fine"
+
+
+def test_unknown_method_does_not_wedge_actor(cluster):
+    @ray_tpu.remote
+    class A:
+        def real(self):
+            return 42
+
+    a = A.remote()
+    with pytest.raises(ray_tpu.TaskError):
+        ray_tpu.get(a.nonexistent_method.remote(), timeout=60)
+    # Subsequent calls from the same caller must still execute.
+    assert ray_tpu.get(a.real.remote(), timeout=60) == 42
+
+
+def test_actor_restart_after_crash(cluster):
+    # max_task_retries=0: a retried `die` would kill each new incarnation.
+    @ray_tpu.remote(max_restarts=1, max_task_retries=0)
+    class Phoenix:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+        def die(self):
+            import os
+
+            os._exit(1)
+
+    p = Phoenix.remote()
+    assert ray_tpu.get(p.bump.remote(), timeout=60) == 1
+    p.die.remote()
+    time.sleep(2.0)  # let death be detected and restart happen
+    # State reset after restart (fresh __init__), but the actor is alive.
+    assert ray_tpu.get(p.bump.remote(), timeout=90) == 1
+
+
+def test_borrowed_get_waits_past_rpc_deadline(cluster):
+    """Borrower resolution must not fail at the default 60s RPC timeout.
+    Uses a shortened deadline via config override on the driver side is not
+    possible per-call, so emulate with a 6s task and a 5s-ish default by
+    checking the call simply succeeds (regression: used to use the 60s
+    default; here we just exercise the pending-owner path)."""
+
+    @ray_tpu.remote
+    def slow_value():
+        time.sleep(3)
+        return "slow"
+
+    @ray_tpu.remote
+    def consume(v):
+        return v + "-consumed"
+
+    # consume's worker borrows the pending ref and blocks on the owner.
+    assert ray_tpu.get(consume.remote(slow_value.remote()), timeout=90) == "slow-consumed"

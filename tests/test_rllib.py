@@ -1,0 +1,89 @@
+"""RL stack tests: CartPole dynamics, GAE, PPO end-to-end mechanics, runner
+fault tolerance."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import PPO, PPOConfig, CartPole
+from ray_tpu.rllib.ppo import _compute_gae
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ctx = ray_tpu.init(num_cpus=8)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def test_cartpole_dynamics():
+    env = CartPole(seed=1)
+    obs = env.reset()
+    assert obs.shape == (4,)
+    total = 0.0
+    done = False
+    while not done:
+        obs, r, done, _ = env.step(1)  # constant push falls over quickly
+        total += r
+    assert 1 <= total < 200
+
+
+def test_gae_simple():
+    traj = {
+        "rewards": np.array([1.0, 1.0], np.float32),
+        "values": np.array([0.0, 0.0], np.float32),
+        "dones": np.array([False, True]),
+        "last_value": 5.0,  # ignored: terminal
+    }
+    adv, ret = _compute_gae(traj, gamma=1.0, lam=1.0)
+    # From t=1 terminal: adv=1; t=0: 1 + 1 = 2.
+    np.testing.assert_allclose(adv, [2.0, 1.0])
+    np.testing.assert_allclose(ret, [2.0, 1.0])
+
+
+def test_ppo_trains_and_updates(cluster):
+    cfg = PPOConfig(num_env_runners=2, rollout_steps=128, num_sgd_epochs=2,
+                    minibatch_size=64, seed=3)
+    algo = cfg.build()
+    p0 = algo.learner.get_params()
+    m1 = algo.train()
+    assert m1["training_iteration"] == 1
+    assert m1["num_env_steps_sampled"] == 256
+    assert np.isfinite(m1["total_loss"])
+    p1 = algo.learner.get_params()
+    # Parameters actually moved.
+    assert np.abs(p1["wp"] - p0["wp"]).sum() > 0
+    m2 = algo.train()
+    assert m2["training_iteration"] == 2
+    assert m2["episode_return_mean"] is not None
+    algo.stop()
+
+
+def test_ppo_improves_cartpole(cluster):
+    cfg = PPOConfig(num_env_runners=2, rollout_steps=512, num_sgd_epochs=4,
+                    minibatch_size=128, lr=5e-3, seed=0)
+    algo = cfg.build()
+    first = None
+    last = None
+    for _ in range(6):
+        m = algo.train()
+        if m["episode_return_mean"] is not None:
+            if first is None:
+                first = m["episode_return_mean"]
+            last = m["episode_return_mean"]
+    algo.stop()
+    assert first is not None and last is not None
+    # Learning signal: mean episode return improves.
+    assert last > first
+
+
+def test_runner_failure_replaced(cluster):
+    cfg = PPOConfig(num_env_runners=2, rollout_steps=64, num_sgd_epochs=1)
+    algo = cfg.build()
+    algo.train()
+    # Kill one runner; next train() should replace it and still work.
+    ray_tpu.kill(algo.runners[0])
+    algo.train()
+    m = algo.train()
+    assert m["num_env_steps_sampled"] >= 64
+    algo.stop()

@@ -1,0 +1,140 @@
+"""Train library tests: single- and multi-worker fit, checkpointing,
+failure recovery."""
+
+import os
+import tempfile
+
+import pytest
+
+import ray_tpu
+from ray_tpu.train import (
+    Checkpoint,
+    DataParallelTrainer,
+    FailureConfig,
+    JaxTrainer,
+    Result,
+    RunConfig,
+    ScalingConfig,
+)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ctx = ray_tpu.init(num_cpus=8)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def test_single_worker_fit(cluster):
+    def loop(config):
+        import ray_tpu.train as train
+
+        for step in range(3):
+            train.report({"step": step, "loss": 1.0 / (step + 1)})
+
+    trainer = DataParallelTrainer(
+        loop, train_loop_config={}, scaling_config=ScalingConfig(num_workers=1)
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["step"] == 2
+    assert len(result.metrics_history) == 3
+
+
+def test_multi_worker_fit_and_checkpoint(cluster):
+    def loop(config):
+        import os
+        import tempfile
+
+        import ray_tpu.train as train
+        from ray_tpu.train.checkpoint import Checkpoint as Ck
+
+        ctx = train.get_context()
+        assert ctx.world_size == 2
+        for step in range(2):
+            ckpt = None
+            if ctx.world_rank == 0:
+                d = tempfile.mkdtemp()
+                with open(os.path.join(d, "state.txt"), "w") as f:
+                    f.write(f"step={step}")
+                ckpt = Ck.from_directory(d)
+            train.report({"step": step, "rank": ctx.world_rank}, checkpoint=ckpt)
+
+    storage = tempfile.mkdtemp()
+    trainer = DataParallelTrainer(
+        loop,
+        train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="t2", storage_path=storage),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.checkpoint is not None
+    with open(os.path.join(result.checkpoint.path, "state.txt")) as f:
+        assert f.read() == "step=1"
+
+
+def test_failure_recovery_from_checkpoint(cluster):
+    def loop(config):
+        import os
+        import tempfile
+
+        import ray_tpu.train as train
+        from ray_tpu.train.checkpoint import Checkpoint as Ck
+
+        ctx = train.get_context()
+        start = 0
+        if train.get_checkpoint() is not None:
+            with open(os.path.join(train.get_checkpoint().path, "s.txt")) as f:
+                start = int(f.read()) + 1
+        for step in range(start, 4):
+            d = tempfile.mkdtemp()
+            with open(os.path.join(d, "s.txt"), "w") as f:
+                f.write(str(step))
+            train.report({"step": step}, checkpoint=Ck.from_directory(d))
+            if step == 1 and start == 0:
+                os._exit(1)  # crash mid-training on the first attempt
+
+    storage = tempfile.mkdtemp()
+    trainer = DataParallelTrainer(
+        loop,
+        train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            name="recover", storage_path=storage,
+            failure_config=FailureConfig(max_failures=1),
+        ),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["step"] == 3
+
+
+def test_jax_trainer_spmd_cpu(cluster):
+    """2-worker jax.distributed over CPU: psum across processes."""
+
+    def loop(config):
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import multihost_utils
+
+        import ray_tpu.train as train
+
+        ctx = train.get_context()
+        n = jax.process_count()
+        # Cross-process allgather over the jax.distributed world.
+        arr = jnp.ones((4,)) * (ctx.world_rank + 1)
+        total = float(jnp.sum(multihost_utils.process_allgather(arr)))
+        train.report({"total": total, "processes": n})
+
+    trainer = JaxTrainer(
+        loop,
+        train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=2),
+        jax_platform="cpu",
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["processes"] == 2
+    # ranks contribute 4*1 + 4*2 = 12
+    assert result.metrics["total"] == 12.0
