@@ -59,3 +59,4 @@ from .core.scheduler import (  # noqa: F401
     NodeLabelStrategy,
     SpreadStrategy,
 )
+from . import dag  # noqa: F401,E402

@@ -101,6 +101,12 @@ class RemoteFunction:
             return refs[0]
         return refs
 
+    def bind(self, *args, **kwargs):
+        """Lazily bind this function into a DAG node (ray DAG .bind analog)."""
+        from ..dag.nodes import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"Remote function {self._fn.__name__!r} cannot be called directly; "
@@ -127,6 +133,12 @@ class ActorMethod:
             num_returns=self._num_returns,
         )
         return refs[0] if self._num_returns == 1 else refs
+
+    def bind(self, *args, **kwargs):
+        """Lazily bind this method into a DAG node (ray DAG .bind analog)."""
+        from ..dag.nodes import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._method_name, args, kwargs)
 
 
 class ActorHandle:

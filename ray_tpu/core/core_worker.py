@@ -1176,9 +1176,19 @@ class CoreWorker:
         try:
             if self.actor_instance is None:
                 raise RuntimeError("actor not initialized")
-            method = getattr(
-                self.actor_instance, getattr(spec, "method_name", spec.name)
-            )
+            method_name = getattr(spec, "method_name", spec.name)
+            if method_name == "__rtpu_dag_exec_loop__":
+                # Compiled-graph execution loop (ray dag/compiled_dag_node.py
+                # analog): a long-lived task that reads/writes shm channels
+                # instead of per-call RPC.  Dispatched to the dag module with
+                # the actor instance bound.
+                import functools
+
+                from ..dag.worker_loop import dag_exec_loop
+
+                method = functools.partial(dag_exec_loop, self.actor_instance)
+            else:
+                method = getattr(self.actor_instance, method_name)
             async with self._actor_exec_lock:
                 # Advance as soon as execution begins so max_concurrency > 1
                 # allows overlap.

@@ -1,0 +1,386 @@
+"""ctypes binding to the C++ native data plane (src/native/rtpu_store.cc).
+
+The native library provides the node-local shared-memory arena object store
+(plasma analog, ray ``src/ray/object_manager/plasma/``) and mutable-object
+channels (ray ``src/ray/core_worker/experimental_mutable_object_manager.h``).
+It is built on first use via the Makefile; if no toolchain is present the
+callers fall back to the pure-Python shm path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_LIB_PATH = os.path.join(_REPO_ROOT, "build", "librtpu_native.so")
+_SRC_DIR = os.path.join(_REPO_ROOT, "src", "native")
+
+_lib = None
+_lib_lock = threading.Lock()
+_load_failed = False
+
+
+def _build() -> bool:
+    """Build the library under an flock: concurrent first-use from several
+    processes (driver, agent, workers) must not interleave writes to the
+    same .so."""
+    try:
+        os.makedirs(os.path.dirname(_LIB_PATH), exist_ok=True)
+        import fcntl
+
+        with open(os.path.join(os.path.dirname(_LIB_PATH), ".build.lock"), "w") as lk:
+            fcntl.flock(lk, fcntl.LOCK_EX)
+            if not os.path.exists(_LIB_PATH):
+                subprocess.run(
+                    ["make", "-C", _SRC_DIR],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+        return os.path.exists(_LIB_PATH)
+    except Exception:
+        return False
+
+
+def _declare(lib):
+    u64, i64, u32 = ctypes.c_uint64, ctypes.c_int64, ctypes.c_uint32
+    p = ctypes.c_void_p
+    cp = ctypes.c_char_p
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    sigs = {
+        "rtpu_arena_create": (p, [cp, u64, u64]),
+        "rtpu_arena_create2": (p, [cp, u64, u64, ctypes.c_int]),
+        "rtpu_arena_attach": (p, [cp]),
+        "rtpu_arena_close": (None, [p]),
+        "rtpu_arena_base": (ctypes.c_void_p, [p]),
+        "rtpu_arena_capacity": (u64, [p]),
+        "rtpu_arena_used": (u64, [p]),
+        "rtpu_arena_live": (u64, [p]),
+        "rtpu_alloc": (u64, [p, cp, u64]),
+        "rtpu_seal": (ctypes.c_int, [p, cp]),
+        "rtpu_lookup": (ctypes.c_int, [p, cp, ctypes.POINTER(u64), ctypes.POINTER(u64)]),
+        "rtpu_acquire": (ctypes.c_int, [p, cp, ctypes.POINTER(u64), ctypes.POINTER(u64)]),
+        "rtpu_release_ref": (ctypes.c_int, [p, cp]),
+        "rtpu_delete": (ctypes.c_int, [p, cp]),
+        "rtpu_evict_lru": (u64, [p, u64, cp, u64, u8p, u64]),
+        "rtpu_chan_create": (p, [cp, u64, u64]),
+        "rtpu_chan_attach": (p, [cp]),
+        "rtpu_chan_close": (None, [p]),
+        "rtpu_chan_buf": (ctypes.c_void_p, [p]),
+        "rtpu_chan_capacity": (u64, [p]),
+        "rtpu_chan_write_begin": (ctypes.c_int, [p, i64]),
+        "rtpu_chan_write_end": (ctypes.c_int, [p, u64, u32]),
+        "rtpu_chan_read_begin": (i64, [p, u64, ctypes.POINTER(u64), ctypes.POINTER(u32), i64]),
+        "rtpu_chan_read_end": (ctypes.c_int, [p]),
+        "rtpu_chan_set_closed": (None, [p]),
+        "rtpu_chan_is_closed": (ctypes.c_int, [p]),
+    }
+    for name, (res, args) in sigs.items():
+        fn = getattr(lib, name)
+        fn.restype = res
+        fn.argtypes = args
+
+
+def get_lib():
+    """Load (building if needed) the native library, or None."""
+    global _lib, _load_failed
+    if _lib is not None:
+        return _lib
+    if _load_failed:
+        return None
+    with _lib_lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if not os.path.exists(_LIB_PATH) and not _build():
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+            _declare(lib)
+            _lib = lib
+        except OSError:
+            _load_failed = True
+    return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def _default_n_slots(capacity: int) -> int:
+    # ~1 slot per 4KiB of capacity, but never let the table eat more than
+    # 1/8 of the arena (48B/slot).
+    return max(64, min(capacity // 4096, capacity // (8 * 48)))
+
+
+_pin_cls_cache: dict = {}
+
+
+def _pinned_view(arena: "NativeArena", oid: bytes, address: int, size: int) -> memoryview:
+    """A memoryview over the object's payload that owns one reader pin.
+
+    Zero-copy consumers (numpy views reconstructed by pickle5) hold the
+    exporting ctypes buffer alive through the buffer protocol; when the last
+    view is collected the buffer's finalizer releases the pin — the
+    PlasmaBuffer-destructor analog in the reference."""
+
+    cls = _pin_cls_cache.get(size)
+    if cls is None:
+
+        def _del(self):
+            rel = self.__dict__.get("_release")
+            if rel is not None:
+                rel()
+
+        cls = type("_PinArr", (ctypes.c_uint8 * size,), {"__del__": _del})
+        if len(_pin_cls_cache) < 1024:
+            _pin_cls_cache[size] = cls
+    arr = cls.from_address(address)
+    # Closure also keeps the arena handle alive while views exist.
+    arr._release = lambda: arena._release_pin(oid)
+    return memoryview(arr).cast("B")
+
+
+class NativeArena:
+    """A node-wide shared-memory arena: object table + allocator, shared by
+    every process that attaches.  Payload views are zero-copy memoryviews of
+    the single mmap."""
+
+    def __init__(self, handle, lib):
+        self._h = handle
+        self._lib = lib
+        self._local_pins = 0
+        base = lib.rtpu_arena_base(handle)
+        cap = lib.rtpu_arena_capacity(handle)
+        self._buf = (ctypes.c_uint8 * cap).from_address(base)
+        self._mv = memoryview(self._buf).cast("B")
+
+    @classmethod
+    def create(cls, path: str, capacity: int, n_slots: int = 0) -> "NativeArena":
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        if n_slots <= 0:
+            n_slots = _default_n_slots(capacity)
+        h = lib.rtpu_arena_create(path.encode(), capacity, n_slots)
+        if not h:
+            raise OSError(f"failed to create arena at {path}")
+        return cls(h, lib)
+
+    @classmethod
+    def attach(cls, path: str) -> "NativeArena":
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        h = lib.rtpu_arena_attach(path.encode())
+        if not h:
+            raise FileNotFoundError(f"no arena at {path}")
+        return cls(h, lib)
+
+    @classmethod
+    def open_shared(cls, path: str, capacity: int) -> "NativeArena":
+        """Attach to the arena at ``path``, creating it exclusively if absent.
+        Safe under concurrent callers: exactly one creates; attachers spin
+        briefly until the creator publishes the header."""
+        import time as _time
+
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        deadline = _time.monotonic() + 10.0
+        while True:
+            if os.path.exists(path):
+                h = lib.rtpu_arena_attach(path.encode())
+                if h:
+                    return cls(h, lib)
+            else:
+                h = lib.rtpu_arena_create2(
+                    path.encode(), capacity, _default_n_slots(capacity), 1
+                )
+                if h:
+                    return cls(h, lib)
+            if _time.monotonic() > deadline:
+                raise OSError(f"could not open shared arena at {path}")
+            _time.sleep(0.01)
+
+    # -- object lifecycle ---------------------------------------------------
+    def alloc(self, object_id: bytes, size: int) -> Optional[memoryview]:
+        off = self._lib.rtpu_alloc(self._h, object_id, size)
+        if off == 0:
+            return None
+        return self._mv[off : off + size]
+
+    def seal(self, object_id: bytes) -> bool:
+        return bool(self._lib.rtpu_seal(self._h, object_id))
+
+    def lookup(self, object_id: bytes) -> Optional[memoryview]:
+        """Unpinned peek — only safe for short-lived reads under the caller's
+        own lifetime guarantees.  Prefer :meth:`acquire` for anything that
+        escapes the current call."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        if not self._lib.rtpu_lookup(self._h, object_id, ctypes.byref(off), ctypes.byref(size)):
+            return None
+        return self._mv[off.value : off.value + size.value]
+
+    def acquire(self, object_id: bytes) -> Optional[memoryview]:
+        """Pinned zero-copy view: the payload cannot be freed or evicted
+        until every view (and any numpy array built over it) is collected."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        if not self._lib.rtpu_acquire(self._h, object_id, ctypes.byref(off), ctypes.byref(size)):
+            return None
+        base = self._lib.rtpu_arena_base(self._h)
+        self._local_pins += 1
+        return _pinned_view(self, object_id, base + off.value, size.value)
+
+    def _release_pin(self, object_id: bytes):
+        if self._h:
+            self._lib.rtpu_release_ref(self._h, object_id)
+            self._local_pins -= 1
+
+    def contains(self, object_id: bytes) -> bool:
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        return bool(
+            self._lib.rtpu_lookup(self._h, object_id, ctypes.byref(off), ctypes.byref(size))
+        )
+
+    def delete(self, object_id: bytes) -> bool:
+        return bool(self._lib.rtpu_delete(self._h, object_id))
+
+    def evict_lru(self, need_bytes: int, pinned: List[bytes], max_evict: int = 256) -> List[bytes]:
+        skip = b"".join(pinned)
+        out = (ctypes.c_uint8 * (max_evict * 16))()
+        n = self._lib.rtpu_evict_lru(
+            self._h, need_bytes, skip, len(pinned), out, max_evict
+        )
+        raw = bytes(out)
+        return [raw[i * 16 : (i + 1) * 16] for i in range(n)]
+
+    # -- stats --------------------------------------------------------------
+    @property
+    def used(self) -> int:
+        return self._lib.rtpu_arena_used(self._h)
+
+    @property
+    def capacity(self) -> int:
+        return self._lib.rtpu_arena_capacity(self._h)
+
+    @property
+    def n_live(self) -> int:
+        return self._lib.rtpu_arena_live(self._h)
+
+    def close(self):
+        if self._h:
+            if self._local_pins > 0:
+                # Zero-copy views still alive in this process: leave the
+                # mapping in place (reclaimed at process exit) rather than
+                # unmapping memory under live readers.
+                return
+            try:
+                self._mv.release()
+            except BufferError:
+                return
+            self._lib.rtpu_arena_close(self._h)
+            self._h = None
+
+
+class NativeChannel:
+    """Single-writer N-reader mutable object in shared memory (the substrate
+    for compiled-graph channels).  Blocking reads/writes with timeouts; the
+    writer overwrites in place once all readers consumed the prior value."""
+
+    CLOSED = -2
+    TIMEOUT = -1
+
+    def __init__(self, handle, lib, path: str):
+        self._h = handle
+        self._lib = lib
+        self.path = path
+        base = lib.rtpu_chan_buf(handle)
+        cap = lib.rtpu_chan_capacity(handle)
+        self._buf = (ctypes.c_uint8 * cap).from_address(base)
+        self._mv = memoryview(self._buf).cast("B")
+        self.capacity = cap
+        self._last_version = 0
+
+    @classmethod
+    def create(cls, path: str, capacity: int, n_readers: int) -> "NativeChannel":
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        h = lib.rtpu_chan_create(path.encode(), capacity, n_readers)
+        if not h:
+            raise OSError(f"failed to create channel at {path}")
+        return cls(h, lib, path)
+
+    @classmethod
+    def attach(cls, path: str) -> "NativeChannel":
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        h = lib.rtpu_chan_attach(path.encode())
+        if not h:
+            raise FileNotFoundError(f"no channel at {path}")
+        return cls(h, lib, path)
+
+    def write(self, payload: bytes, timeout: Optional[float] = None, error: int = 0):
+        """Block until readers drained the previous value, then publish."""
+        if len(payload) > self.capacity:
+            raise ValueError(
+                f"payload {len(payload)} exceeds channel capacity {self.capacity}"
+            )
+        tmo = -1 if timeout is None else int(timeout * 1000)
+        rc = self._lib.rtpu_chan_write_begin(self._h, tmo)
+        if rc == self.CLOSED:
+            raise ChannelClosedError(self.path)
+        if rc == self.TIMEOUT:
+            raise TimeoutError(f"channel write timed out: {self.path}")
+        self._mv[: len(payload)] = payload
+        self._lib.rtpu_chan_write_end(self._h, len(payload), error)
+
+    def read(self, timeout: Optional[float] = None) -> Tuple[bytes, int]:
+        """Block for the next version; returns (payload, error_flag)."""
+        size = ctypes.c_uint64()
+        err = ctypes.c_uint32()
+        tmo = -1 if timeout is None else int(timeout * 1000)
+        v = self._lib.rtpu_chan_read_begin(
+            self._h, self._last_version, ctypes.byref(size), ctypes.byref(err), tmo
+        )
+        if v == self.CLOSED:
+            raise ChannelClosedError(self.path)
+        if v == self.TIMEOUT:
+            raise TimeoutError(f"channel read timed out: {self.path}")
+        payload = bytes(self._mv[: size.value])
+        self._last_version = v
+        self._lib.rtpu_chan_read_end(self._h)
+        return payload, err.value
+
+    def close_channel(self):
+        """Mark closed, waking all blocked parties (they raise)."""
+        self._lib.rtpu_chan_set_closed(self._h)
+
+    @property
+    def closed(self) -> bool:
+        return bool(self._lib.rtpu_chan_is_closed(self._h))
+
+    def detach(self):
+        if self._h:
+            self._mv.release()
+            self._lib.rtpu_chan_close(self._h)
+            self._h = None
+
+    def unlink(self):
+        try:
+            os.unlink(self.path)
+        except FileNotFoundError:
+            pass
+
+
+class ChannelClosedError(RuntimeError):
+    pass

@@ -162,6 +162,9 @@ class Node:
 
     def stop(self):
         self.pg.kill_all()
+        from .object_store import drop_arena
+
+        drop_arena(self.session_id)
         shm.cleanup_session(self.session_id)
 
 

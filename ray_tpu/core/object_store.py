@@ -15,12 +15,60 @@ through this module (the plasma-client analog).
 from __future__ import annotations
 
 import asyncio
+import os
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-from . import shm
+from . import native, shm
+from .config import GlobalConfig
 from .ids import ObjectID
 from .serialization import deserialize_from_bytes, serialize_to_bytes
+
+# --------------------------------------------------------------------------
+# Native arena tier.  When the C++ library is available every process on the
+# node maps one shared arena (object table + allocator in shm) — the plasma
+# analog, minus the store-server round trip.  Per-object tmpfs files remain
+# the fallback tier (toolchain-less hosts, or arena-full overflow).
+# --------------------------------------------------------------------------
+
+_arena_cache: Dict[str, Optional["native.NativeArena"]] = {}
+
+
+def arena_path(session_id: str) -> str:
+    return os.path.join(shm.SHM_DIR, f"{shm._PREFIX}_{session_id}_arena")
+
+
+def get_arena(session_id: str) -> Optional["native.NativeArena"]:
+    """Per-process handle to the session's shared arena (None if the native
+    library is unavailable)."""
+    if session_id in _arena_cache:
+        return _arena_cache[session_id]
+    if not native.available():
+        _arena_cache[session_id] = None
+        return None
+    try:
+        a = native.NativeArena.open_shared(
+            arena_path(session_id), GlobalConfig.object_store_memory_bytes
+        )
+    except OSError:
+        a = None
+    _arena_cache[session_id] = a
+    return a
+
+
+def drop_arena(session_id: str):
+    a = _arena_cache.pop(session_id, None)
+    if a is not None:
+        a.close()
+
+
+def delete_from_tiers(session_id: str, object_id: ObjectID):
+    """Remove an object from whichever shm tier holds it (arena delete is
+    deferred past live reader pins by the native layer)."""
+    arena = get_arena(session_id)
+    if arena is not None:
+        arena.delete(object_id.binary())
+    shm.unlink_by_name(shm.segment_name(session_id, object_id.hex()))
 
 
 class _Entry:
@@ -91,18 +139,26 @@ class ShmObjectStore:
         # Attachments are cached for the life of the process: numpy views
         # returned to user code borrow the mapping.
         self._attached: Dict[ObjectID, shm.ShmSegment] = {}
+        self._arena = get_arena(session_id)
 
+    # -- write path ---------------------------------------------------------
     def create(self, object_id: ObjectID, value: Any) -> int:
-        """Serialize ``value`` into a new shm segment.  Returns size."""
-        payload = serialize_to_bytes(value)
-        seg = shm.ShmSegment.create(
-            shm.segment_name(self.session_id, object_id.hex()), len(payload)
-        )
-        seg.view()[: len(payload)] = payload
-        self._attached[object_id] = seg
-        return len(payload)
+        """Serialize ``value`` into the shm tier.  Returns size."""
+        return self.create_from_bytes(object_id, serialize_to_bytes(value))
 
     def create_from_bytes(self, object_id: ObjectID, payload: bytes) -> int:
+        if self._arena is not None:
+            buf = self._arena.alloc(object_id.binary(), len(payload))
+            if buf is None and self._arena.contains(object_id.binary()):
+                # Deterministic return-object names: a retried task re-creates
+                # its return object (reference: plasma create-and-seal replace).
+                self._arena.delete(object_id.binary())
+                buf = self._arena.alloc(object_id.binary(), len(payload))
+            if buf is not None:
+                buf[: len(payload)] = payload
+                self._arena.seal(object_id.binary())
+                return len(payload)
+            # Arena full: overflow to a per-object tmpfs file.
         seg = shm.ShmSegment.create(
             shm.segment_name(self.session_id, object_id.hex()), len(payload)
         )
@@ -110,7 +166,10 @@ class ShmObjectStore:
         self._attached[object_id] = seg
         return len(payload)
 
+    # -- read path ----------------------------------------------------------
     def contains(self, object_id: ObjectID) -> bool:
+        if self._arena is not None and self._arena.contains(object_id.binary()):
+            return True
         if object_id in self._attached:
             return True
         try:
@@ -122,15 +181,15 @@ class ShmObjectStore:
             return False
 
     def get(self, object_id: ObjectID) -> Any:
-        seg = self._attached.get(object_id)
-        if seg is None:
-            seg = shm.ShmSegment.attach(
-                shm.segment_name(self.session_id, object_id.hex())
-            )
-            self._attached[object_id] = seg
-        return deserialize_from_bytes(seg.view())
+        return deserialize_from_bytes(self.raw_bytes(object_id))
 
     def raw_bytes(self, object_id: ObjectID) -> memoryview:
+        if self._arena is not None:
+            # Pinned view: eviction/delete of the block is deferred until the
+            # returned view (and any numpy array built over it) is collected.
+            mv = self._arena.acquire(object_id.binary())
+            if mv is not None:
+                return mv
         seg = self._attached.get(object_id)
         if seg is None:
             seg = shm.ShmSegment.attach(
@@ -143,6 +202,11 @@ class ShmObjectStore:
         seg = self._attached.pop(object_id, None)
         if seg is not None:
             seg.close()
+
+    def delete(self, object_id: ObjectID):
+        """Remove the object from whichever shm tier holds it."""
+        self.release(object_id)
+        delete_from_tiers(self.session_id, object_id)
 
 
 class NodeObjectDirectory:
@@ -184,7 +248,7 @@ class NodeObjectDirectory:
         entry = self._objects.pop(object_id, None)
         if entry is not None:
             self.used -= entry[0]
-            shm.unlink_by_name(shm.segment_name(self.session_id, object_id.hex()))
+            delete_from_tiers(self.session_id, object_id)
 
     def _evict(self):
         """LRU-evict unpinned sealed objects until under capacity."""

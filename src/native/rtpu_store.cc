@@ -1,0 +1,615 @@
+// rtpu_store.cc — TPU-native framework's node-local shared-memory data plane.
+//
+// Native equivalent of the reference's plasma store substrate
+// (ray src/ray/object_manager/plasma/: dlmalloc arena over mmap'd /dev/shm,
+// object table, eviction hooks) re-designed as a *symmetric* arena: there is
+// no store server process — every worker process on the node maps the same
+// arena file and operates on it under a process-shared mutex.  This removes
+// the unix-socket round trip and fd-passing (plasma's fling.cc) from the hot
+// put/get path entirely; the node agent keeps only the distributed index.
+//
+// Also hosts mutable-object channels (seqlock + process-shared condvar), the
+// substrate for compiled-graph channels (reference:
+// src/ray/core_worker/experimental_mutable_object_manager.h).
+//
+// Exposed as a flat C ABI consumed from Python via ctypes
+// (ray_tpu/core/native.py).
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <time.h>
+#include <unistd.h>
+
+#define RTPU_API extern "C" __attribute__((visibility("default")))
+
+namespace {
+
+constexpr uint64_t kMagic = 0x52545055'41524E41ULL;  // "RTPUARNA"
+constexpr uint32_t kVersion = 1;
+constexpr uint64_t kAlign = 64;  // cacheline-align payloads
+constexpr uint64_t kIdSize = 16;
+
+inline uint64_t align_up(uint64_t v, uint64_t a) { return (v + a - 1) & ~(a - 1); }
+
+// ---------------------------------------------------------------------------
+// Arena layout:
+//   [ArenaHeader][HashSlot * n_slots][data region ...]
+// Free blocks inside the data region form an offset-sorted singly linked
+// list threaded through the blocks themselves (FreeBlock headers), rooted
+// at ArenaHeader::free_head.  All offsets are from arena base.
+// ---------------------------------------------------------------------------
+
+enum SlotState : uint8_t {
+  SLOT_EMPTY = 0,
+  SLOT_ALLOCATED = 1,  // created, not yet sealed (writer filling it)
+  SLOT_SEALED = 2,     // immutable, readable
+  SLOT_TOMBSTONE = 3,  // deleted; probe chains continue through it
+};
+
+struct HashSlot {
+  uint8_t id[kIdSize];
+  uint8_t state;
+  uint8_t pending;   // delete requested while readers hold pins
+  uint16_t pad;
+  uint32_t refcnt;   // cross-process reader pins (plasma client refcount)
+  uint64_t offset;   // payload offset from arena base
+  uint64_t size;     // payload size (bytes)
+  int64_t seal_ns;   // monotonic seal time, for LRU eviction
+};
+static_assert(sizeof(HashSlot) == 48, "slot layout");
+
+struct FreeBlock {
+  uint64_t size;  // total block size including this header
+  uint64_t next;  // offset of next free block (0 = end)
+};
+
+struct ArenaHeader {
+  uint64_t magic;
+  uint32_t version;
+  uint32_t pad0;
+  pthread_mutex_t mu;  // process-shared
+  uint64_t capacity;   // total file size
+  uint64_t data_start; // offset of data region
+  uint64_t n_slots;    // power of two
+  uint64_t n_live;     // live (allocated+sealed) entries
+  uint64_t used;       // bytes allocated in data region (incl. block headers)
+  uint64_t free_head;  // offset of first free block (0 = none)
+};
+
+struct Arena {
+  ArenaHeader* hdr;
+  uint8_t* base;
+  uint64_t map_size;
+  HashSlot* slots() const {
+    return reinterpret_cast<HashSlot*>(base + align_up(sizeof(ArenaHeader), kAlign));
+  }
+};
+
+inline int64_t now_ns() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return int64_t(ts.tv_sec) * 1000000000LL + ts.tv_nsec;
+}
+
+uint64_t hash_id(const uint8_t* id) {
+  // FNV-1a over the 16-byte id.
+  uint64_t h = 1469598103934665603ULL;
+  for (uint64_t i = 0; i < kIdSize; i++) { h ^= id[i]; h *= 1099511628211ULL; }
+  return h;
+}
+
+// Find the slot for `id`, or the first insertable slot if absent.
+// Returns nullptr if table is full and id absent.
+HashSlot* find_slot(const Arena* a, const uint8_t* id, bool for_insert) {
+  const uint64_t mask = a->hdr->n_slots - 1;
+  uint64_t i = hash_id(id) & mask;
+  HashSlot* first_tomb = nullptr;
+  for (uint64_t probe = 0; probe <= mask; probe++, i = (i + 1) & mask) {
+    HashSlot* s = &a->slots()[i];
+    if (s->state == SLOT_EMPTY) {
+      if (!for_insert) return nullptr;
+      return first_tomb ? first_tomb : s;
+    }
+    if (s->state == SLOT_TOMBSTONE) {
+      if (for_insert && !first_tomb) first_tomb = s;
+      continue;
+    }
+    if (memcmp(s->id, id, kIdSize) == 0) return s;
+  }
+  return for_insert ? first_tomb : nullptr;
+}
+
+// First-fit allocation from the offset-sorted free list.
+uint64_t alloc_block(Arena* a, uint64_t need) {
+  need = align_up(need + sizeof(FreeBlock), kAlign);
+  uint64_t prev_off = 0;
+  uint64_t cur = a->hdr->free_head;
+  while (cur) {
+    FreeBlock* fb = reinterpret_cast<FreeBlock*>(a->base + cur);
+    if (fb->size >= need) {
+      uint64_t remain = fb->size - need;
+      uint64_t next;
+      if (remain >= kAlign + sizeof(FreeBlock)) {
+        uint64_t rest_off = cur + need;
+        FreeBlock* rest = reinterpret_cast<FreeBlock*>(a->base + rest_off);
+        rest->size = remain;
+        rest->next = fb->next;
+        next = rest_off;
+      } else {
+        need = fb->size;  // absorb the sliver
+        next = fb->next;
+      }
+      if (prev_off) reinterpret_cast<FreeBlock*>(a->base + prev_off)->next = next;
+      else a->hdr->free_head = next;
+      FreeBlock* hdrb = reinterpret_cast<FreeBlock*>(a->base + cur);
+      hdrb->size = need;
+      hdrb->next = 0;  // in-use marker not needed; size kept for free()
+      a->hdr->used += need;
+      return cur + sizeof(FreeBlock);
+    }
+    prev_off = cur;
+    cur = fb->next;
+  }
+  return 0;
+}
+
+// Insert block back, keeping the list offset-sorted, coalescing neighbors.
+void free_block(Arena* a, uint64_t payload_off) {
+  uint64_t blk = payload_off - sizeof(FreeBlock);
+  FreeBlock* fb = reinterpret_cast<FreeBlock*>(a->base + blk);
+  a->hdr->used -= fb->size;
+  uint64_t prev = 0, cur = a->hdr->free_head;
+  while (cur && cur < blk) {
+    prev = cur;
+    cur = reinterpret_cast<FreeBlock*>(a->base + cur)->next;
+  }
+  fb->next = cur;
+  if (prev) reinterpret_cast<FreeBlock*>(a->base + prev)->next = blk;
+  else a->hdr->free_head = blk;
+  // coalesce with next
+  if (cur && blk + fb->size == cur) {
+    FreeBlock* nb = reinterpret_cast<FreeBlock*>(a->base + cur);
+    fb->size += nb->size;
+    fb->next = nb->next;
+  }
+  // coalesce with prev
+  if (prev) {
+    FreeBlock* pb = reinterpret_cast<FreeBlock*>(a->base + prev);
+    if (prev + pb->size == blk) {
+      pb->size += fb->size;
+      pb->next = fb->next;
+    }
+  }
+}
+
+// create: 0 = attach existing, 1 = replace existing, 2 = exclusive (fail
+// with -EEXIST if the file already exists — used for races where another
+// process may be creating the same arena).
+int map_file(const char* path, int create, uint64_t size, Arena* out) {
+  int fd;
+  if (create) {
+    fd = open(path, O_CREAT | O_EXCL | O_RDWR, 0600);
+    if (fd < 0 && errno == EEXIST && create == 1) {
+      unlink(path);
+      fd = open(path, O_CREAT | O_EXCL | O_RDWR, 0600);
+    }
+    if (fd < 0) return -errno;
+    if (ftruncate(fd, (off_t)size) != 0) { int e = errno; close(fd); return -e; }
+  } else {
+    fd = open(path, O_RDWR);
+    if (fd < 0) return -errno;
+    struct stat st;
+    if (fstat(fd, &st) != 0) { int e = errno; close(fd); return -e; }
+    size = (uint64_t)st.st_size;
+  }
+  void* mem = mmap(nullptr, size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return -errno;
+  out->base = static_cast<uint8_t*>(mem);
+  out->hdr = reinterpret_cast<ArenaHeader*>(mem);
+  out->map_size = size;
+  return 0;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// Arena C API
+// ---------------------------------------------------------------------------
+
+RTPU_API void* rtpu_arena_create2(const char* path, uint64_t capacity,
+                                  uint64_t n_slots, int excl) {
+  if (n_slots == 0) n_slots = 1;
+  // round n_slots to power of two
+  uint64_t p = 1; while (p < n_slots) p <<= 1; n_slots = p;
+  Arena* a = new Arena();
+  if (map_file(path, excl ? 2 : 1, capacity, a) != 0) { delete a; return nullptr; }
+  ArenaHeader* h = a->hdr;
+  memset(h, 0, sizeof(ArenaHeader));
+  h->version = kVersion;
+  h->capacity = capacity;
+  h->n_slots = n_slots;
+  uint64_t slots_off = align_up(sizeof(ArenaHeader), kAlign);
+  uint64_t data_start = align_up(slots_off + n_slots * sizeof(HashSlot), kAlign);
+  if (data_start + kAlign + sizeof(FreeBlock) > capacity) {
+    // metadata would not fit; reject rather than scribble past the mapping
+    munmap(a->base, a->map_size);
+    unlink(path);
+    delete a;
+    return nullptr;
+  }
+  h->data_start = data_start;
+  memset(a->base + slots_off, 0, n_slots * sizeof(HashSlot));
+  // one big free block
+  FreeBlock* fb = reinterpret_cast<FreeBlock*>(a->base + data_start);
+  fb->size = capacity - data_start;
+  fb->next = 0;
+  h->free_head = data_start;
+  pthread_mutexattr_t attr;
+  pthread_mutexattr_init(&attr);
+  pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&h->mu, &attr);
+  pthread_mutexattr_destroy(&attr);
+  // Publish the magic last: attachers spin until they observe it, so a
+  // concurrent attach never sees a half-initialized header.
+  __atomic_store_n(&h->magic, kMagic, __ATOMIC_RELEASE);
+  return a;
+}
+
+RTPU_API void* rtpu_arena_create(const char* path, uint64_t capacity, uint64_t n_slots) {
+  return rtpu_arena_create2(path, capacity, n_slots, 0);
+}
+
+RTPU_API void* rtpu_arena_attach(const char* path) {
+  Arena* a = new Arena();
+  if (map_file(path, 0, 0, a) != 0) { delete a; return nullptr; }
+  if (__atomic_load_n(&a->hdr->magic, __ATOMIC_ACQUIRE) != kMagic ||
+      a->hdr->version != kVersion) {
+    munmap(a->base, a->map_size);
+    delete a;
+    return nullptr;
+  }
+  return a;
+}
+
+RTPU_API void rtpu_arena_close(void* ap) {
+  Arena* a = static_cast<Arena*>(ap);
+  if (!a) return;
+  munmap(a->base, a->map_size);
+  delete a;
+}
+
+RTPU_API uint8_t* rtpu_arena_base(void* ap) { return static_cast<Arena*>(ap)->base; }
+RTPU_API uint64_t rtpu_arena_capacity(void* ap) { return static_cast<Arena*>(ap)->hdr->capacity; }
+RTPU_API uint64_t rtpu_arena_used(void* ap) { return static_cast<Arena*>(ap)->hdr->used; }
+RTPU_API uint64_t rtpu_arena_live(void* ap) { return static_cast<Arena*>(ap)->hdr->n_live; }
+
+static void lock_arena(ArenaHeader* h) {
+  int rc = pthread_mutex_lock(&h->mu);
+  if (rc == EOWNERDEAD) pthread_mutex_consistent(&h->mu);  // holder crashed
+}
+
+// Allocate an unsealed object.  Returns payload offset, 0 on failure
+// (exists already, table full, or out of memory).
+RTPU_API uint64_t rtpu_alloc(void* ap, const uint8_t* id, uint64_t size) {
+  Arena* a = static_cast<Arena*>(ap);
+  ArenaHeader* h = a->hdr;
+  lock_arena(h);
+  HashSlot* s = find_slot(a, id, /*for_insert=*/true);
+  if (!s || (s->state == SLOT_ALLOCATED || s->state == SLOT_SEALED)) {
+    pthread_mutex_unlock(&h->mu);
+    return 0;
+  }
+  uint64_t off = alloc_block(a, size ? size : 1);
+  if (!off) { pthread_mutex_unlock(&h->mu); return 0; }
+  memcpy(s->id, id, kIdSize);
+  s->state = SLOT_ALLOCATED;
+  s->offset = off;
+  s->size = size;
+  s->seal_ns = 0;
+  h->n_live++;
+  pthread_mutex_unlock(&h->mu);
+  return off;
+}
+
+RTPU_API int rtpu_seal(void* ap, const uint8_t* id) {
+  Arena* a = static_cast<Arena*>(ap);
+  lock_arena(a->hdr);
+  HashSlot* s = find_slot(a, id, false);
+  int ok = 0;
+  if (s && s->state == SLOT_ALLOCATED) {
+    s->state = SLOT_SEALED;
+    s->seal_ns = now_ns();
+    ok = 1;
+  }
+  pthread_mutex_unlock(&a->hdr->mu);
+  return ok;
+}
+
+// Look up a sealed object.  Returns 1 and fills offset/size, else 0.
+RTPU_API int rtpu_lookup(void* ap, const uint8_t* id, uint64_t* offset, uint64_t* size) {
+  Arena* a = static_cast<Arena*>(ap);
+  lock_arena(a->hdr);
+  HashSlot* s = find_slot(a, id, false);
+  int ok = 0;
+  if (s && s->state == SLOT_SEALED && !s->pending) {
+    *offset = s->offset;
+    *size = s->size;
+    ok = 1;
+  }
+  pthread_mutex_unlock(&a->hdr->mu);
+  return ok;
+}
+
+// Look up + pin: the object cannot be freed or evicted until a matching
+// rtpu_release_ref.  The plasma client-refcount analog — readers holding
+// zero-copy views pin the payload.
+RTPU_API int rtpu_acquire(void* ap, const uint8_t* id, uint64_t* offset, uint64_t* size) {
+  Arena* a = static_cast<Arena*>(ap);
+  lock_arena(a->hdr);
+  HashSlot* s = find_slot(a, id, false);
+  int ok = 0;
+  if (s && s->state == SLOT_SEALED && !s->pending) {
+    s->refcnt++;
+    *offset = s->offset;
+    *size = s->size;
+    ok = 1;
+  }
+  pthread_mutex_unlock(&a->hdr->mu);
+  return ok;
+}
+
+static void slot_free_locked(Arena* a, HashSlot* s) {
+  free_block(a, s->offset);
+  s->state = SLOT_TOMBSTONE;
+  s->pending = 0;
+  a->hdr->n_live--;
+}
+
+RTPU_API int rtpu_release_ref(void* ap, const uint8_t* id) {
+  Arena* a = static_cast<Arena*>(ap);
+  lock_arena(a->hdr);
+  HashSlot* s = find_slot(a, id, false);
+  int ok = 0;
+  if (s && s->state == SLOT_SEALED && s->refcnt > 0) {
+    s->refcnt--;
+    if (s->refcnt == 0 && s->pending) slot_free_locked(a, s);
+    ok = 1;
+  }
+  pthread_mutex_unlock(&a->hdr->mu);
+  return ok;
+}
+
+// Delete (or schedule deletion of) an object.  If readers hold pins the
+// payload is hidden from further lookups and freed on the last release.
+RTPU_API int rtpu_delete(void* ap, const uint8_t* id) {
+  Arena* a = static_cast<Arena*>(ap);
+  lock_arena(a->hdr);
+  HashSlot* s = find_slot(a, id, false);
+  int ok = 0;
+  if (s && s->state == SLOT_SEALED && s->refcnt > 0) {
+    s->pending = 1;
+    ok = 1;
+  } else if (s && (s->state == SLOT_SEALED || s->state == SLOT_ALLOCATED)) {
+    slot_free_locked(a, s);
+    ok = 1;
+  }
+  pthread_mutex_unlock(&a->hdr->mu);
+  return ok;
+}
+
+// LRU-evict sealed objects (oldest seal time first) until at least
+// `need_bytes` are free or nothing evictable remains.  `skip`/`n_skip` is an
+// array of pinned ids never evicted.  Returns number of objects evicted;
+// evicted ids are written into `out_ids` (caller provides n_out*16 bytes).
+RTPU_API uint64_t rtpu_evict_lru(void* ap, uint64_t need_bytes,
+                                 const uint8_t* skip, uint64_t n_skip,
+                                 uint8_t* out_ids, uint64_t n_out) {
+  Arena* a = static_cast<Arena*>(ap);
+  ArenaHeader* h = a->hdr;
+  lock_arena(h);
+  uint64_t evicted = 0;
+  while (h->capacity - h->data_start - h->used < need_bytes && evicted < n_out) {
+    HashSlot* best = nullptr;
+    for (uint64_t i = 0; i < h->n_slots; i++) {
+      HashSlot* s = &a->slots()[i];
+      if (s->state != SLOT_SEALED || s->refcnt > 0 || s->pending) continue;
+      bool pinned = false;
+      for (uint64_t k = 0; k < n_skip; k++) {
+        if (memcmp(skip + k * kIdSize, s->id, kIdSize) == 0) { pinned = true; break; }
+      }
+      if (pinned) continue;
+      if (!best || s->seal_ns < best->seal_ns) best = s;
+    }
+    if (!best) break;
+    memcpy(out_ids + evicted * kIdSize, best->id, kIdSize);
+    slot_free_locked(a, best);
+    evicted++;
+  }
+  pthread_mutex_unlock(&h->mu);
+  return evicted;
+}
+
+// ---------------------------------------------------------------------------
+// Mutable-object channel: single-writer, N-reader, in its own shm file.
+// Layout: [ChanHeader][payload capacity bytes]
+// Writer blocks until all registered readers consumed the previous version;
+// readers block until a version newer than their last-seen appears.
+// (Reference semantics: core_worker/experimental_mutable_object_manager.h)
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct ChanHeader {
+  uint64_t magic;
+  uint32_t version_tag;
+  uint32_t pad;
+  pthread_mutex_t mu;
+  pthread_cond_t cv;
+  uint64_t capacity;     // payload capacity
+  uint64_t data_off;     // offset of payload from file base
+  uint64_t version;      // seqlock: odd = write in progress
+  uint64_t payload_size; // size of current payload
+  uint64_t n_readers;    // registered readers
+  uint64_t n_read;       // readers that consumed current version
+  uint32_t closed;
+  uint32_t error;
+};
+
+constexpr uint64_t kChanMagic = 0x52545055'4348414EULL;  // "RTPUCHAN"
+
+struct Chan {
+  ChanHeader* hdr;
+  uint8_t* base;
+  uint64_t map_size;
+};
+
+}  // namespace
+
+RTPU_API void* rtpu_chan_create(const char* path, uint64_t capacity, uint64_t n_readers) {
+  uint64_t data_off = align_up(sizeof(ChanHeader), kAlign);
+  uint64_t size = data_off + capacity;
+  Arena tmp;
+  if (map_file(path, 1, size, &tmp) != 0) return nullptr;
+  Chan* c = new Chan{reinterpret_cast<ChanHeader*>(tmp.base), tmp.base, tmp.map_size};
+  ChanHeader* h = c->hdr;
+  memset(h, 0, sizeof(ChanHeader));
+  h->magic = kChanMagic;
+  h->capacity = capacity;
+  h->data_off = data_off;
+  h->n_readers = n_readers;
+  h->n_read = n_readers;  // first write proceeds immediately
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&h->mu, &ma);
+  pthread_mutexattr_destroy(&ma);
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_cond_init(&h->cv, &ca);
+  pthread_condattr_destroy(&ca);
+  return c;
+}
+
+RTPU_API void* rtpu_chan_attach(const char* path) {
+  Arena tmp;
+  if (map_file(path, 0, 0, &tmp) != 0) return nullptr;
+  Chan* c = new Chan{reinterpret_cast<ChanHeader*>(tmp.base), tmp.base, tmp.map_size};
+  if (c->hdr->magic != kChanMagic) {
+    munmap(c->base, c->map_size);
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+RTPU_API void rtpu_chan_close(void* cp) {
+  Chan* c = static_cast<Chan*>(cp);
+  if (!c) return;
+  munmap(c->base, c->map_size);
+  delete c;
+}
+
+RTPU_API uint8_t* rtpu_chan_buf(void* cp) {
+  Chan* c = static_cast<Chan*>(cp);
+  return c->base + c->hdr->data_off;
+}
+RTPU_API uint64_t rtpu_chan_capacity(void* cp) { return static_cast<Chan*>(cp)->hdr->capacity; }
+
+static int chan_timedwait(ChanHeader* h, int64_t deadline_ns) {
+  if (deadline_ns < 0) return pthread_cond_wait(&h->cv, &h->mu);
+  timespec ts;
+  ts.tv_sec = deadline_ns / 1000000000LL;
+  ts.tv_nsec = deadline_ns % 1000000000LL;
+  return pthread_cond_timedwait(&h->cv, &h->mu, &ts);
+}
+
+static int64_t deadline_from_ms(int64_t timeout_ms) {
+  if (timeout_ms < 0) return -1;
+  timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return int64_t(ts.tv_sec) * 1000000000LL + ts.tv_nsec + timeout_ms * 1000000LL;
+}
+
+// Begin a write: waits for all readers to consume the previous payload.
+// Returns 0 ok, -1 timeout, -2 closed.
+RTPU_API int rtpu_chan_write_begin(void* cp, int64_t timeout_ms) {
+  ChanHeader* h = static_cast<Chan*>(cp)->hdr;
+  int64_t dl = deadline_from_ms(timeout_ms);
+  int rc = pthread_mutex_lock(&h->mu);
+  if (rc == EOWNERDEAD) pthread_mutex_consistent(&h->mu);
+  while (h->n_read < h->n_readers && !h->closed) {
+    if (chan_timedwait(h, dl) == ETIMEDOUT) { pthread_mutex_unlock(&h->mu); return -1; }
+  }
+  if (h->closed) { pthread_mutex_unlock(&h->mu); return -2; }
+  h->version++;  // odd: write in progress
+  pthread_mutex_unlock(&h->mu);
+  return 0;
+}
+
+RTPU_API int rtpu_chan_write_end(void* cp, uint64_t payload_size, uint32_t error) {
+  ChanHeader* h = static_cast<Chan*>(cp)->hdr;
+  int rc = pthread_mutex_lock(&h->mu);
+  if (rc == EOWNERDEAD) pthread_mutex_consistent(&h->mu);
+  h->payload_size = payload_size;
+  h->error = error;
+  h->n_read = 0;
+  h->version++;  // even: committed
+  pthread_cond_broadcast(&h->cv);
+  pthread_mutex_unlock(&h->mu);
+  return 0;
+}
+
+// Block until a version newer than last_version is committed.  On success
+// returns the new version (>0) and fills size/error; -1 timeout, -2 closed.
+RTPU_API int64_t rtpu_chan_read_begin(void* cp, uint64_t last_version,
+                                      uint64_t* size, uint32_t* error,
+                                      int64_t timeout_ms) {
+  ChanHeader* h = static_cast<Chan*>(cp)->hdr;
+  int64_t dl = deadline_from_ms(timeout_ms);
+  int rc = pthread_mutex_lock(&h->mu);
+  if (rc == EOWNERDEAD) pthread_mutex_consistent(&h->mu);
+  while ((h->version <= last_version || (h->version & 1)) && !h->closed) {
+    if (chan_timedwait(h, dl) == ETIMEDOUT) { pthread_mutex_unlock(&h->mu); return -1; }
+  }
+  if (h->closed) { pthread_mutex_unlock(&h->mu); return -2; }
+  *size = h->payload_size;
+  *error = h->error;
+  int64_t v = (int64_t)h->version;
+  pthread_mutex_unlock(&h->mu);
+  return v;
+}
+
+// Mark the current version consumed by one reader (call once per read).
+RTPU_API int rtpu_chan_read_end(void* cp) {
+  ChanHeader* h = static_cast<Chan*>(cp)->hdr;
+  int rc = pthread_mutex_lock(&h->mu);
+  if (rc == EOWNERDEAD) pthread_mutex_consistent(&h->mu);
+  h->n_read++;
+  pthread_cond_broadcast(&h->cv);
+  pthread_mutex_unlock(&h->mu);
+  return 0;
+}
+
+RTPU_API void rtpu_chan_set_closed(void* cp) {
+  ChanHeader* h = static_cast<Chan*>(cp)->hdr;
+  int rc = pthread_mutex_lock(&h->mu);
+  if (rc == EOWNERDEAD) pthread_mutex_consistent(&h->mu);
+  h->closed = 1;
+  pthread_cond_broadcast(&h->cv);
+  pthread_mutex_unlock(&h->mu);
+}
+
+RTPU_API int rtpu_chan_is_closed(void* cp) {
+  return (int)static_cast<Chan*>(cp)->hdr->closed;
+}

@@ -1,0 +1,224 @@
+"""Compiled-graph (DAG) tests.
+
+Model: reference ``python/ray/dag/tests/`` + ``tests/test_channel.py`` —
+linear pipelines, fan-out/fan-in, input attributes, error propagation,
+teardown, and the classic uncompiled execute path.
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core import native
+from ray_tpu.dag import InputNode, MultiOutputNode
+
+
+@ray_tpu.remote
+class Adder:
+    def __init__(self, delta):
+        self.delta = delta
+
+    def add(self, x):
+        return x + self.delta
+
+    def combine(self, a, b):
+        return a + b
+
+    def boom(self, x):
+        raise ValueError("boom!")
+
+    def tick(self):
+        return 7
+
+    def big(self, x):
+        import numpy as np
+
+        return np.zeros(1_000_000)
+
+
+@ray_tpu.remote
+def double(x):
+    return 2 * x
+
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason="native channels unavailable"
+)
+
+
+class TestClassicDAG:
+    def test_function_and_method_nodes(self, ray_start_regular):
+        a = Adder.remote(10)
+        with InputNode() as inp:
+            mid = a.add.bind(inp)
+            out = double.bind(mid)
+        assert ray_tpu.get(out.execute(5), timeout=90) == 30
+
+    def test_multi_output(self, ray_start_regular):
+        a = Adder.remote(1)
+        b = Adder.remote(2)
+        with InputNode() as inp:
+            dag = MultiOutputNode([a.add.bind(inp), b.add.bind(inp)])
+        refs = dag.execute(10)
+        assert ray_tpu.get(refs, timeout=90) == [11, 12]
+
+
+@needs_native
+class TestCompiledDAG:
+    def test_linear_pipeline(self, ray_start_regular):
+        a = Adder.remote(1)
+        b = Adder.remote(10)
+        with InputNode() as inp:
+            dag = b.add.bind(a.add.bind(inp))
+        cdag = dag.experimental_compile()
+        try:
+            assert cdag.execute(5).get() == 16
+            # Pipelined executions, results in order.
+            refs = [cdag.execute(i) for i in range(3)]
+            assert [r.get() for r in refs] == [11, 12, 13]
+        finally:
+            cdag.teardown()
+
+    def test_fan_out_fan_in(self, ray_start_regular):
+        a = Adder.remote(1)
+        b = Adder.remote(2)
+        c = Adder.remote(0)
+        with InputNode() as inp:
+            x = a.add.bind(inp)
+            y = b.add.bind(inp)
+            dag = c.combine.bind(x, y)
+        cdag = dag.experimental_compile()
+        try:
+            assert cdag.execute(10).get() == 23  # (10+1)+(10+2)
+        finally:
+            cdag.teardown()
+
+    def test_input_attributes(self, ray_start_regular):
+        a = Adder.remote(0)
+        with InputNode() as inp:
+            dag = a.combine.bind(inp[0], inp[1])
+        cdag = dag.experimental_compile()
+        try:
+            assert cdag.execute(3, 4).get() == 7
+        finally:
+            cdag.teardown()
+
+    def test_multi_output_compiled(self, ray_start_regular):
+        a = Adder.remote(1)
+        b = Adder.remote(2)
+        with InputNode() as inp:
+            dag = MultiOutputNode([a.add.bind(inp), b.add.bind(inp)])
+        cdag = dag.experimental_compile()
+        try:
+            assert cdag.execute(1).get() == [2, 3]
+        finally:
+            cdag.teardown()
+
+    def test_same_actor_chain_stays_local(self, ray_start_regular):
+        a = Adder.remote(1)
+        with InputNode() as inp:
+            dag = a.add.bind(a.add.bind(a.add.bind(inp)))
+        cdag = dag.experimental_compile()
+        try:
+            assert cdag.execute(0).get() == 3
+        finally:
+            cdag.teardown()
+
+    def test_error_propagates_and_pipeline_survives(self, ray_start_regular):
+        a = Adder.remote(1)
+        b = Adder.remote(1)
+        with InputNode() as inp:
+            dag = b.add.bind(a.boom.bind(inp))
+        cdag = dag.experimental_compile()
+        try:
+            with pytest.raises(ValueError, match="boom"):
+                cdag.execute(1).get()
+            # The loop keeps running after an error tick.
+            with pytest.raises(ValueError, match="boom"):
+                cdag.execute(2).get()
+        finally:
+            cdag.teardown()
+
+    def test_no_input_dag(self, ray_start_regular):
+        a = Adder.remote(0)
+        dag = a.tick.bind()
+        cdag = dag.experimental_compile()
+        try:
+            assert cdag.execute().get() == 7
+            assert cdag.execute().get() == 7
+        finally:
+            cdag.teardown()
+
+    def test_oversized_result_surfaces_error(self, ray_start_regular):
+        a = Adder.remote(0)
+        with InputNode() as inp:
+            dag = a.big.bind(inp)
+        cdag = dag.experimental_compile(buffer_size_bytes=64 * 1024)
+        try:
+            with pytest.raises(ValueError, match="exceeds the channel buffer"):
+                cdag.execute(1).get()
+        finally:
+            cdag.teardown()
+
+    def test_oversized_input_rejected_at_execute(self, ray_start_regular):
+        import numpy as np
+
+        a = Adder.remote(0)
+        with InputNode() as inp:
+            dag = a.add.bind(inp)
+        cdag = dag.experimental_compile(buffer_size_bytes=64 * 1024)
+        try:
+            with pytest.raises(ValueError, match="exceeds channel capacity"):
+                cdag.execute(np.zeros(1_000_000))
+            # pipeline unaffected
+            assert cdag.execute(5).get() == 5
+        finally:
+            cdag.teardown()
+
+    def test_duplicate_output_node(self, ray_start_regular):
+        a = Adder.remote(1)
+        with InputNode() as inp:
+            x = a.add.bind(inp)
+            dag = MultiOutputNode([x, x])
+        cdag = dag.experimental_compile()
+        try:
+            assert cdag.execute(1).get() == [2, 2]
+        finally:
+            cdag.teardown()
+
+    def test_bad_input_arity_surfaces_error(self, ray_start_regular):
+        a = Adder.remote(0)
+        with InputNode() as inp:
+            dag = a.combine.bind(inp[0], inp[1])
+        cdag = dag.experimental_compile()
+        try:
+            with pytest.raises(IndexError):
+                cdag.execute(1).get()  # needs two args
+            assert cdag.execute(1, 2).get() == 3
+        finally:
+            cdag.teardown()
+
+    def test_error_in_one_output_keeps_pipeline_synced(self, ray_start_regular):
+        a = Adder.remote(1)
+        b = Adder.remote(2)
+        with InputNode() as inp:
+            dag = MultiOutputNode([a.boom.bind(inp), b.add.bind(inp)])
+        cdag = dag.experimental_compile()
+        try:
+            r1 = cdag.execute(1)
+            r2 = cdag.execute(10)
+            with pytest.raises(ValueError, match="boom"):
+                r1.get()
+            with pytest.raises(ValueError, match="boom"):
+                r2.get()
+        finally:
+            cdag.teardown()
+
+    def test_teardown_frees_actor(self, ray_start_regular):
+        a = Adder.remote(5)
+        with InputNode() as inp:
+            dag = a.add.bind(inp)
+        cdag = dag.experimental_compile()
+        assert cdag.execute(1).get() == 6
+        cdag.teardown()
+        # After teardown the actor serves ordinary calls again.
+        assert ray_tpu.get(a.add.remote(2), timeout=90) == 7

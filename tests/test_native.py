@@ -1,0 +1,212 @@
+"""Tests for the C++ native data plane (arena store + channels).
+
+Mirrors the reference's plasma store tests
+(ray src/ray/object_manager/plasma/ + python/ray/tests/test_object_store*.py)
+and mutable-object tests (python/ray/tests/test_channel.py).
+"""
+
+import multiprocessing
+import os
+import time
+
+import pytest
+
+from ray_tpu.core import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable"
+)
+
+
+def _arena_path(tmp_path, name="arena"):
+    # /dev/shm in prod; any tmpfs-ish path works for tests
+    return str(tmp_path / name)
+
+
+def oid(i: int) -> bytes:
+    return i.to_bytes(16, "big")
+
+
+class TestArena:
+    def test_alloc_seal_lookup(self, tmp_path):
+        a = native.NativeArena.create(_arena_path(tmp_path), 1 << 20)
+        buf = a.alloc(oid(1), 11)
+        assert buf is not None
+        buf[:] = b"hello arena"
+        assert a.lookup(oid(1)) is None  # not sealed yet
+        assert a.seal(oid(1))
+        got = a.lookup(oid(1))
+        assert bytes(got) == b"hello arena"
+        assert a.n_live == 1
+        a.close()
+
+    def test_duplicate_alloc_rejected(self, tmp_path):
+        a = native.NativeArena.create(_arena_path(tmp_path), 1 << 20)
+        assert a.alloc(oid(1), 8) is not None
+        assert a.alloc(oid(1), 8) is None
+        a.close()
+
+    def test_delete_and_reuse(self, tmp_path):
+        a = native.NativeArena.create(_arena_path(tmp_path), 1 << 20)
+        b1 = a.alloc(oid(1), 100)
+        b1[:5] = b"aaaaa"
+        a.seal(oid(1))
+        used_before = a.used
+        assert a.delete(oid(1))
+        assert a.used < used_before
+        assert a.lookup(oid(1)) is None
+        # space is reusable
+        assert a.alloc(oid(2), 100) is not None
+        a.close()
+
+    def test_out_of_memory_returns_none(self, tmp_path):
+        a = native.NativeArena.create(_arena_path(tmp_path), 1 << 16)
+        assert a.alloc(oid(1), 1 << 20) is None
+        a.close()
+
+    def test_free_list_coalescing(self, tmp_path):
+        a = native.NativeArena.create(_arena_path(tmp_path), 1 << 20)
+        for i in range(10):
+            assert a.alloc(oid(i), 4096) is not None
+            a.seal(oid(i))
+        for i in range(10):
+            a.delete(oid(i))
+        # after freeing everything a near-capacity block must be allocatable
+        big = a.capacity - (a.capacity - a.used) // 100  # just probe large
+        assert a.alloc(oid(99), 800 * 1024) is not None
+        a.close()
+
+    def test_many_objects(self, tmp_path):
+        a = native.NativeArena.create(_arena_path(tmp_path), 8 << 20)
+        n = 1000
+        for i in range(n):
+            buf = a.alloc(oid(i), 64)
+            buf[:8] = i.to_bytes(8, "big")
+            a.seal(oid(i))
+        assert a.n_live == n
+        for i in range(0, n, 97):
+            assert bytes(a.lookup(oid(i))[:8]) == i.to_bytes(8, "big")
+        a.close()
+
+    def test_lru_eviction(self, tmp_path):
+        a = native.NativeArena.create(_arena_path(tmp_path), 1 << 20)
+        for i in range(3):
+            a.alloc(oid(i), 1024)
+            a.seal(oid(i))
+            time.sleep(0.002)
+        evicted = a.evict_lru(a.capacity, pinned=[oid(0)])
+        # oid(0) pinned; 1 and 2 evicted oldest-first
+        assert oid(0) not in evicted
+        assert evicted[0] == oid(1)
+        assert a.contains(oid(0))
+        assert not a.contains(oid(1))
+        a.close()
+
+    def test_cross_process_visibility(self, tmp_path):
+        path = _arena_path(tmp_path)
+        a = native.NativeArena.create(path, 1 << 20)
+        buf = a.alloc(oid(7), 5)
+        buf[:] = b"xproc"
+        a.seal(oid(7))
+
+        ctx = multiprocessing.get_context("spawn")
+        q = ctx.Queue()
+        p = ctx.Process(target=_arena_child, args=(path, q))
+        p.start()
+        assert q.get(timeout=20) == b"xproc"
+        p.join(20)
+        assert bytes(a.lookup(oid(8))) == b"back"
+        a.close()
+
+
+def _arena_child(path, q):
+    b = native.NativeArena.attach(path)
+    got = b.lookup(oid(7))
+    q.put(bytes(got) if got is not None else None)
+    # child writes, parent reads
+    w = b.alloc(oid(8), 4)
+    w[:] = b"back"
+    b.seal(oid(8))
+    b.close()
+
+
+def _chan_writer(path, n):
+    ch = native.NativeChannel.attach(path)
+    for i in range(n):
+        ch.write(f"msg-{i}".encode(), timeout=30)
+    ch.detach()
+
+
+class TestChannel:
+    def test_write_read_single_process(self, tmp_path):
+        path = str(tmp_path / "chan")
+        w = native.NativeChannel.create(path, 1024, n_readers=1)
+        r = native.NativeChannel.attach(path)
+        w.write(b"v1")
+        data, err = r.read(timeout=5)
+        assert data == b"v1" and err == 0
+        w.write(b"v2", timeout=5)  # reader drained, write proceeds
+        data, _ = r.read(timeout=5)
+        assert data == b"v2"
+        w.detach()
+        r.detach()
+
+    def test_backpressure_blocks_writer(self, tmp_path):
+        path = str(tmp_path / "chan")
+        w = native.NativeChannel.create(path, 64, n_readers=1)
+        w.write(b"first")
+        with pytest.raises(TimeoutError):
+            w.write(b"second", timeout=0.1)  # nobody read yet
+        w.detach()
+
+    def test_error_flag_propagates(self, tmp_path):
+        path = str(tmp_path / "chan")
+        w = native.NativeChannel.create(path, 64, n_readers=1)
+        r = native.NativeChannel.attach(path)
+        w.write(b"boom", error=1)
+        data, err = r.read(timeout=5)
+        assert err == 1 and data == b"boom"
+        w.detach()
+        r.detach()
+
+    def test_close_wakes_reader(self, tmp_path):
+        path = str(tmp_path / "chan")
+        w = native.NativeChannel.create(path, 64, n_readers=1)
+        r = native.NativeChannel.attach(path)
+        w.close_channel()
+        with pytest.raises(native.ChannelClosedError):
+            r.read(timeout=5)
+        w.detach()
+        r.detach()
+
+    def test_cross_process_stream(self, tmp_path):
+        path = str(tmp_path / "chan")
+        n = 50
+        r = native.NativeChannel.create(path, 1024, n_readers=1)
+        ctx = multiprocessing.get_context("spawn")
+        p = ctx.Process(target=_chan_writer, args=(path, n))
+        p.start()
+        got = []
+        for _ in range(n):
+            data, _ = r.read(timeout=30)
+            got.append(data.decode())
+        p.join(30)
+        assert got == [f"msg-{i}" for i in range(n)]
+        r.detach()
+
+    def test_two_readers_both_see_each_version(self, tmp_path):
+        path = str(tmp_path / "chan")
+        w = native.NativeChannel.create(path, 256, n_readers=2)
+        r1 = native.NativeChannel.attach(path)
+        r2 = native.NativeChannel.attach(path)
+        w.write(b"a")
+        assert r1.read(timeout=5)[0] == b"a"
+        # writer must still block: r2 hasn't read
+        with pytest.raises(TimeoutError):
+            w.write(b"b", timeout=0.1)
+        assert r2.read(timeout=5)[0] == b"a"
+        w.write(b"b", timeout=5)
+        assert r1.read(timeout=5)[0] == b"b"
+        assert r2.read(timeout=5)[0] == b"b"
+        for c in (w, r1, r2):
+            c.detach()
