@@ -1,2 +1,18 @@
 from .gpt2 import GPT2Config, gpt2_apply, gpt2_init, gpt2_loss, gpt2_param_axes  # noqa: F401
 from .mlp import mlp_apply, mlp_init  # noqa: F401
+from .moe import (  # noqa: F401
+    MoEConfig,
+    moe_apply,
+    moe_ffn,
+    moe_init,
+    moe_loss,
+    moe_param_axes,
+)
+from .resnet import (  # noqa: F401
+    ResNetConfig,
+    resnet_apply,
+    resnet_init,
+    resnet_loss,
+    resnet_param_axes,
+)
+from .vit import ViTConfig, vit_apply, vit_init, vit_loss, vit_param_axes  # noqa: F401

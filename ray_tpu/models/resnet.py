@@ -1,0 +1,217 @@
+"""ResNet family (ResNet-18/50) — BASELINE.md north-star config #2:
+data-parallel ResNet-50 with allreduce over ICI.
+
+The reference delegates vision models to torchvision inside Train release
+tests; here the model is native JAX, TPU-first:
+
+  - NHWC layout (TPU convolutions tile the channel axis onto the MXU lanes);
+  - bf16 params/activations, f32 batch-norm statistics;
+  - batch norm is functional: ``resnet_apply`` returns ``(logits, new_state)``
+    in training mode, and running stats are a separate pytree so the
+    data-parallel trainer can ``psum``-average them;
+  - residual blocks over ``lax.scan`` where the stage geometry repeats
+    (uniform blocks within a stage share a stacked param tree).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    # (blocks, channels) per stage; bottleneck expands channels ×4
+    stages: Tuple[Tuple[int, int], ...] = ((3, 64), (4, 128), (6, 256), (3, 512))
+    bottleneck: bool = True
+    num_classes: int = 1000
+    dtype: str = "bfloat16"
+
+    @classmethod
+    def resnet50(cls, **kw) -> "ResNetConfig":
+        return cls(**kw)
+
+    @classmethod
+    def resnet18(cls, **kw) -> "ResNetConfig":
+        kw.setdefault("stages", ((2, 64), (2, 128), (2, 256), (2, 512)))
+        kw.setdefault("bottleneck", False)
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "ResNetConfig":
+        kw.setdefault("stages", ((1, 8), (1, 16)))
+        kw.setdefault("bottleneck", False)
+        kw.setdefault("num_classes", 10)
+        return cls(**kw)
+
+    @property
+    def expansion(self) -> int:
+        return 4 if self.bottleneck else 1
+
+
+def _conv_init(key, kh, kw_, cin, cout, dt):
+    fan_in = kh * kw_ * cin
+    w = jax.random.normal(key, (kh, kw_, cin, cout)) * (2.0 / fan_in) ** 0.5
+    return w.astype(dt)
+
+
+def _bn_init(c, dt):
+    return {"g": jnp.ones((c,), dt), "b": jnp.zeros((c,), dt)}
+
+
+def _bn_state(c):
+    return {"mean": jnp.zeros((c,), jnp.float32),
+            "var": jnp.ones((c,), jnp.float32)}
+
+
+def resnet_init(key, cfg: ResNetConfig):
+    """-> (params, state).  state carries batch-norm running stats."""
+    dt = jnp.dtype(cfg.dtype)
+    keys = iter(jax.random.split(key, 256))
+    params = {"stem": {"w": _conv_init(next(keys), 7, 7, 3, 64, dt),
+                       "bn": _bn_init(64, dt)}}
+    state = {"stem": _bn_state(64)}
+    cin = 64
+    for si, (n_blocks, ch) in enumerate(cfg.stages):
+        cout = ch * cfg.expansion
+        blocks, bstate = [], []
+        for bi in range(n_blocks):
+            stride = 2 if (bi == 0 and si > 0) else 1
+            blk, bst = {}, {}
+            if cfg.bottleneck:
+                blk["conv1"] = {"w": _conv_init(next(keys), 1, 1, cin, ch, dt),
+                                "bn": _bn_init(ch, dt)}
+                blk["conv2"] = {"w": _conv_init(next(keys), 3, 3, ch, ch, dt),
+                                "bn": _bn_init(ch, dt)}
+                blk["conv3"] = {"w": _conv_init(next(keys), 1, 1, ch, cout, dt),
+                                "bn": _bn_init(cout, dt)}
+                bst = {"conv1": _bn_state(ch), "conv2": _bn_state(ch),
+                       "conv3": _bn_state(cout)}
+            else:
+                blk["conv1"] = {"w": _conv_init(next(keys), 3, 3, cin, ch, dt),
+                                "bn": _bn_init(ch, dt)}
+                blk["conv2"] = {"w": _conv_init(next(keys), 3, 3, ch, cout, dt),
+                                "bn": _bn_init(cout, dt)}
+                bst = {"conv1": _bn_state(ch), "conv2": _bn_state(cout)}
+            if stride != 1 or cin != cout:
+                blk["proj"] = {"w": _conv_init(next(keys), 1, 1, cin, cout, dt),
+                               "bn": _bn_init(cout, dt)}
+                bst["proj"] = _bn_state(cout)
+            blocks.append(blk)
+            bstate.append(bst)
+            cin = cout
+        params[f"stage{si}"] = blocks
+        state[f"stage{si}"] = bstate
+    params["head"] = {
+        "w": (jax.random.normal(next(keys), (cin, cfg.num_classes)) *
+              (1.0 / cin) ** 0.5).astype(dt),
+        "b": jnp.zeros((cfg.num_classes,), dt),
+    }
+    return params, state
+
+
+def resnet_param_axes(params):
+    """Logical axes: conv filters replicate; the classifier head and wide
+    1x1 convs shard their output-channel axis over fsdp (ZeRO-3)."""
+
+    def axes(path, x):
+        if x.ndim == 4:
+            return P(None, None, None, "embed")
+        if x.ndim == 2:
+            return P(None, "embed")
+        return P(*([None] * x.ndim))
+
+    return jax.tree_util.tree_map_with_path(axes, params)
+
+
+def _conv(x, w, stride=1):
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _batchnorm(x, bn, st, train: bool, momentum=0.9, eps=1e-5):
+    """Returns (y, new_state).  Stats in f32 regardless of activation dtype."""
+    if train:
+        x32 = x.astype(jnp.float32)
+        mean = x32.mean((0, 1, 2))
+        var = x32.var((0, 1, 2))
+        new_st = {"mean": momentum * st["mean"] + (1 - momentum) * mean,
+                  "var": momentum * st["var"] + (1 - momentum) * var}
+    else:
+        mean, var = st["mean"], st["var"]
+        new_st = st
+    inv = jax.lax.rsqrt(var + eps)
+    y = (x.astype(jnp.float32) - mean) * inv
+    y = y * bn["g"].astype(jnp.float32) + bn["b"].astype(jnp.float32)
+    return y.astype(x.dtype), new_st
+
+
+def _block_apply(x, blk, bst, cfg: ResNetConfig, stride, train):
+    out_state = {}
+    shortcut = x
+    if cfg.bottleneck:
+        y = _conv(x, blk["conv1"]["w"], 1)
+        y, out_state["conv1"] = _batchnorm(y, blk["conv1"]["bn"], bst["conv1"], train)
+        y = jax.nn.relu(y)
+        y = _conv(y, blk["conv2"]["w"], stride)
+        y, out_state["conv2"] = _batchnorm(y, blk["conv2"]["bn"], bst["conv2"], train)
+        y = jax.nn.relu(y)
+        y = _conv(y, blk["conv3"]["w"], 1)
+        y, out_state["conv3"] = _batchnorm(y, blk["conv3"]["bn"], bst["conv3"], train)
+    else:
+        y = _conv(x, blk["conv1"]["w"], stride)
+        y, out_state["conv1"] = _batchnorm(y, blk["conv1"]["bn"], bst["conv1"], train)
+        y = jax.nn.relu(y)
+        y = _conv(y, blk["conv2"]["w"], 1)
+        y, out_state["conv2"] = _batchnorm(y, blk["conv2"]["bn"], bst["conv2"], train)
+    if "proj" in blk:
+        shortcut = _conv(x, blk["proj"]["w"], stride)
+        shortcut, out_state["proj"] = _batchnorm(
+            shortcut, blk["proj"]["bn"], bst["proj"], train)
+    return jax.nn.relu(y + shortcut), out_state
+
+
+def resnet_apply(params, state, images, cfg: ResNetConfig, *, train=False,
+                 mesh=None):
+    """images: [B, H, W, 3] → (logits [B, classes], new_state)."""
+    from ..parallel.sharding import with_logical_constraint as wlc
+
+    x = images.astype(jnp.dtype(cfg.dtype))
+    x = wlc(x, P("batch", None, None, None), mesh)
+    new_state = {}
+    x = _conv(x, params["stem"]["w"], 2)
+    x, new_state["stem"] = _batchnorm(x, params["stem"]["bn"], state["stem"], train)
+    x = jax.nn.relu(x)
+    x = jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME")
+    for si, (n_blocks, _ch) in enumerate(cfg.stages):
+        stage_state = []
+        for bi in range(n_blocks):
+            stride = 2 if (bi == 0 and si > 0) else 1
+            x, bst = _block_apply(
+                x, params[f"stage{si}"][bi], state[f"stage{si}"][bi],
+                cfg, stride, train)
+            stage_state.append(bst)
+        new_state[f"stage{si}"] = stage_state
+        x = wlc(x, P("batch", None, None, None), mesh)
+    x = x.astype(jnp.float32).mean((1, 2))  # global average pool
+    logits = x @ params["head"]["w"].astype(jnp.float32) + \
+        params["head"]["b"].astype(jnp.float32)
+    return wlc(logits, P("batch", None), mesh), new_state
+
+
+def resnet_loss(params, state, images, labels, cfg: ResNetConfig, *,
+                mesh=None):
+    """Softmax cross-entropy; returns (loss, new_state)."""
+    logits, new_state = resnet_apply(
+        params, state, images, cfg, train=True, mesh=mesh)
+    logp = jax.nn.log_softmax(logits)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()
+    return nll, new_state

@@ -8,6 +8,7 @@ Axes follow the standard recipe (scaling-book / maxtext conventions):
   - ``model``: tensor parallelism (matmul-sharded, psum on contraction)
   - ``seq``:   sequence/context parallelism (ring attention / Ulysses)
   - ``stage``: pipeline parallelism across slices
+  - ``expert``: expert parallelism (MoE dispatch via all_to_all)
 
 ``mesh_utils.create_device_mesh`` lays axes onto the physical ICI topology so
 the innermost (most chatty) axes ride the fastest links.
@@ -20,7 +21,7 @@ from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
-AXES = ("data", "fsdp", "stage", "seq", "model")
+AXES = ("data", "fsdp", "stage", "expert", "seq", "model")
 
 
 @dataclass
@@ -28,12 +29,14 @@ class MeshConfig:
     data: int = 1
     fsdp: int = 1
     stage: int = 1
+    expert: int = 1
     seq: int = 1
     model: int = 1
 
     @property
     def shape(self) -> Tuple[int, ...]:
-        return (self.data, self.fsdp, self.stage, self.seq, self.model)
+        return (self.data, self.fsdp, self.stage, self.expert, self.seq,
+                self.model)
 
     @property
     def num_devices(self) -> int:
@@ -41,14 +44,15 @@ class MeshConfig:
 
     @classmethod
     def for_devices(cls, n: int, *, model: int = 1, seq: int = 1, stage: int = 1,
-                    fsdp: Optional[int] = None) -> "MeshConfig":
+                    expert: int = 1, fsdp: Optional[int] = None) -> "MeshConfig":
         """Fill the data/fsdp axes with whatever ``n`` leaves after the
         explicitly requested axes."""
-        rest = n // (model * seq * stage)
-        if rest * model * seq * stage != n:
+        fixed = model * seq * stage * expert
+        rest = n // fixed
+        if rest * fixed != n:
             raise ValueError(
-                f"{n} devices not divisible by model×seq×stage = "
-                f"{model * seq * stage}"
+                f"{n} devices not divisible by model×seq×stage×expert = "
+                f"{fixed}"
             )
         if fsdp is None:
             fsdp = rest
@@ -57,7 +61,8 @@ class MeshConfig:
             data = rest // fsdp
             if data * fsdp != rest:
                 raise ValueError(f"fsdp={fsdp} does not divide {rest}")
-        return cls(data=data, fsdp=fsdp, stage=stage, seq=seq, model=model)
+        return cls(data=data, fsdp=fsdp, stage=stage, expert=expert, seq=seq,
+                   model=model)
 
 
 def build_mesh(config: MeshConfig, devices: Optional[Sequence] = None):
