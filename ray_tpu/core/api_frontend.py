@@ -14,6 +14,7 @@ from typing import Any, Dict, Optional
 
 from .core_worker import global_worker
 from .ids import ActorID
+from .runtime_env import resolve_runtime_env
 from .scheduler import (
     NodeAffinityStrategy,
     NodeLabelStrategy,
@@ -52,7 +53,7 @@ def _normalize_options(opts: Dict[str, Any]) -> Dict[str, Any]:
         "strategy": strategy,
         "placement_group_id": pg_id,
         "bundle_index": bundle_index,
-        "env_vars": (opts.get("runtime_env") or {}).get("env_vars", {}),
+        "env_vars": resolve_runtime_env(opts.get("runtime_env")),
     }
     return out
 
@@ -65,6 +66,9 @@ class RemoteFunction:
         # ray_tpu.init() means a fresh control-plane KV, so the function must
         # be re-exported there.
         self._export_cache = (None, None)  # (worker, function_id)
+        # Options are immutable after .options(); normalize (incl. runtime-env
+        # packaging, which hashes directory trees) once, not per .remote().
+        self._norm_cache: Optional[dict] = None
         functools.update_wrapper(self, fn)
 
     def options(self, **opts) -> "RemoteFunction":
@@ -80,7 +84,9 @@ class RemoteFunction:
         if cached_worker is not worker:
             function_id = worker._export_function(self._fn)
             self._export_cache = (worker, function_id)
-        norm = _normalize_options(self._opts)
+        if self._norm_cache is None:
+            self._norm_cache = _normalize_options(self._opts)
+        norm = self._norm_cache
         refs = worker.submit_task(
             self._fn,
             args,
@@ -161,6 +167,7 @@ class ActorClass:
     def __init__(self, cls, default_opts: Optional[dict] = None):
         self._cls = cls
         self._opts = default_opts or {}
+        self._norm_cache: Optional[dict] = None
 
     def options(self, **opts) -> "ActorClass":
         merged = dict(self._opts)
@@ -171,7 +178,9 @@ class ActorClass:
         worker = global_worker()
         opts = dict(self._opts)
         opts["_actor"] = True
-        norm = _normalize_options(opts)
+        if self._norm_cache is None:
+            self._norm_cache = _normalize_options(opts)
+        norm = self._norm_cache
         actor_id, _spec = worker.create_actor(
             self._cls,
             args,

@@ -683,6 +683,40 @@ class CoreWorker:
             return {"ready": self.memory_store.contains(payload["object_id"])}
         return {"ready": obj.event.is_set()}
 
+    # ------------------------------------------------------------ cluster KV
+    # Public façade over the control plane's KV table (the reference's
+    # ``ray.experimental.internal_kv`` / GCS InternalKV, gcs_kv_manager.cc).
+    def kv_put(self, namespace: str, key: str, value, overwrite: bool = True):
+        return self._run_sync(
+            self.cp.call(
+                "kv_put",
+                {"namespace": namespace, "key": key, "value": value,
+                 "overwrite": overwrite},
+            )
+        )
+
+    def kv_get(self, namespace: str, key: str):
+        return self._run_sync(
+            self.cp.call("kv_get", {"namespace": namespace, "key": key})
+        )
+
+    def kv_del(self, namespace: str, key: str) -> bool:
+        return self._run_sync(
+            self.cp.call("kv_del", {"namespace": namespace, "key": key})
+        )
+
+    def kv_keys(self, namespace: str, prefix: str = ""):
+        return self._run_sync(
+            self.cp.call(
+                "kv_keys", {"namespace": namespace, "prefix": prefix}
+            )
+        )
+
+    def kv_exists(self, namespace: str, key: str) -> bool:
+        return self._run_sync(
+            self.cp.call("kv_exists", {"namespace": namespace, "key": key})
+        )
+
     # ------------------------------------------------------ task submission
     def _export_function(self, fn_or_cls, prefix="fn") -> str:
         pickled = dumps_function(fn_or_cls)

@@ -1,0 +1,153 @@
+"""Runtime environments — per-task/actor working_dir, py_modules, env_vars.
+
+Role-equivalent of the reference's runtime-env subsystem
+(``python/ray/_private/runtime_env/``: plugins for working_dir/py_modules/
+env_vars, URI-cached packages).  TPU-native simplification: no per-node HTTP
+agent process — packaging happens in the driver (content-addressed staging
+into a shared cache directory) and application happens in the worker process
+at startup.  The staged-package path rides the worker's env (the analog of
+the reference shipping runtime-env URIs in the task spec and resolving them
+through the agent), so it participates in the worker pool's env-key and
+workers are cached per runtime env exactly like the reference's
+per-(language, runtime-env-hash) worker pool (``raylet/worker_pool.h:281``).
+
+Supported keys (the reference's most-used subset):
+  - ``env_vars``: dict of str → str set in the worker process.
+  - ``working_dir``: local directory, staged by content hash; worker chdirs
+    into the staged copy and prepends it to ``sys.path``.
+  - ``py_modules``: list of local dirs/files staged the same way and
+    prepended to ``sys.path``.
+
+conda/pip/uv/container envs are intentionally out of scope (they imply
+package installation, which this image forbids); requesting them raises.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import sys
+from typing import Any, Dict, List, Optional
+
+# Env vars used to ship the resolved env to the worker process.
+WORKING_DIR_ENV = "RAY_TPU_RT_WORKING_DIR"
+PY_MODULES_ENV = "RAY_TPU_RT_PY_MODULES"
+
+_UNSUPPORTED = ("conda", "pip", "uv", "container", "image_uri")
+
+
+def _cache_root() -> str:
+    root = os.environ.get("RAY_TPU_LOG_DIR", "/tmp/ray_tpu")
+    return os.path.join(root, "runtime_env_cache")
+
+
+def _hash_path(path: str) -> str:
+    """Content hash of a file or directory tree (names + bytes)."""
+    h = hashlib.sha1()
+    if os.path.isfile(path):
+        h.update(os.path.basename(path).encode())
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        return h.hexdigest()
+    for dirpath, dirnames, filenames in os.walk(path):
+        # Prune before descent (must mutate in place, pre-sort) and never
+        # hash/stage caches — the reference excludes these too.
+        dirnames[:] = sorted(
+            d for d in dirnames if d not in ("__pycache__", ".git")
+        )
+        for name in sorted(filenames):
+            full = os.path.join(dirpath, name)
+            rel = os.path.relpath(full, path)
+            h.update(rel.encode())
+            try:
+                with open(full, "rb") as f:
+                    for chunk in iter(lambda: f.read(1 << 20), b""):
+                        h.update(chunk)
+            except OSError:
+                continue
+    return h.hexdigest()
+
+
+def package_path(path: str) -> str:
+    """Stage ``path`` into the content-addressed cache; returns staged path.
+
+    Idempotent: same content → same cache entry (the analog of the
+    reference's GCS-KV URI cache for working_dir/py_modules packages).
+    """
+    path = os.path.abspath(os.path.expanduser(path))
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"runtime_env path does not exist: {path}")
+    digest = _hash_path(path)
+    base = os.path.basename(path.rstrip("/")) or "pkg"
+    # Stage under a digest directory, keeping the original basename — imports
+    # of a staged package need the module's own name on disk.
+    staged = os.path.join(_cache_root(), digest[:16], base)
+    if os.path.exists(staged):
+        return staged
+    tmp = f"{staged}.tmp.{os.getpid()}"
+    shutil.rmtree(tmp, ignore_errors=True)
+    os.makedirs(os.path.dirname(tmp), exist_ok=True)
+    if os.path.isdir(path):
+        shutil.copytree(
+            path, tmp,
+            ignore=shutil.ignore_patterns("__pycache__", ".git"),
+        )
+    else:
+        shutil.copy2(path, tmp)
+    try:
+        os.rename(tmp, staged)
+    except OSError:
+        # Lost a concurrent staging race; the winner's copy is equivalent.
+        shutil.rmtree(tmp, ignore_errors=True)
+    return staged
+
+
+def resolve_runtime_env(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, str]:
+    """Driver side: normalize a runtime_env dict into worker env vars.
+
+    Returns the env-var dict that fully describes the environment (and hence
+    keys the worker pool's idle cache).
+    """
+    if not runtime_env:
+        return {}
+    for key in _UNSUPPORTED:
+        if runtime_env.get(key):
+            raise ValueError(
+                f"runtime_env[{key!r}] is not supported: package installation "
+                "is unavailable; pre-bake dependencies into the image"
+            )
+    unknown = set(runtime_env) - {"env_vars", "working_dir", "py_modules"}
+    if unknown:
+        raise ValueError(f"unknown runtime_env keys: {sorted(unknown)}")
+    env: Dict[str, str] = dict(runtime_env.get("env_vars") or {})
+    for k, v in env.items():
+        if not isinstance(k, str) or not isinstance(v, str):
+            raise TypeError("runtime_env['env_vars'] must be Dict[str, str]")
+    wd = runtime_env.get("working_dir")
+    if wd:
+        env[WORKING_DIR_ENV] = package_path(wd)
+    mods: List[str] = []
+    for m in runtime_env.get("py_modules") or []:
+        mods.append(package_path(m))
+    if mods:
+        env[PY_MODULES_ENV] = json.dumps(mods)
+    return env
+
+
+def apply_runtime_env_in_worker() -> None:
+    """Worker side: chdir into the staged working_dir, extend sys.path."""
+    wd = os.environ.get(WORKING_DIR_ENV)
+    if wd and os.path.isdir(wd):
+        os.chdir(wd)
+        if wd not in sys.path:
+            sys.path.insert(0, wd)
+    mods = os.environ.get(PY_MODULES_ENV)
+    if mods:
+        for m in json.loads(mods):
+            # m is <cache>/<digest>/<module-name>; importing needs the parent.
+            parent = os.path.dirname(m)
+            if parent not in sys.path:
+                sys.path.insert(0, parent)

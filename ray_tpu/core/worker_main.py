@@ -18,6 +18,9 @@ def main():
     from .core_worker import CoreWorker, set_global_worker
     from .ids import NodeID, WorkerID
     from .rpc import RetryableRpcClient
+    from .runtime_env import apply_runtime_env_in_worker
+
+    apply_runtime_env_in_worker()
 
     logging.basicConfig(
         level=GlobalConfig.log_level,

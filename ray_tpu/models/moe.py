@@ -40,6 +40,12 @@ class MoEConfig:
     attention: str = "dense"
     remat: bool = False
 
+    def __post_init__(self):
+        # Routing implements top-1 and top-2 (GShard-style second expert);
+        # a silently-ignored larger top_k would still inflate capacity().
+        if self.top_k not in (1, 2):
+            raise ValueError(f"top_k must be 1 or 2, got {self.top_k}")
+
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_head

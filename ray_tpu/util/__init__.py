@@ -1,0 +1,11 @@
+"""``ray_tpu.util`` — user-facing utilities over the core task/actor API.
+
+Role-equivalent of the reference's ``python/ray/util/``: ActorPool
+(``util/actor_pool.py``), distributed Queue (``util/queue.py``), user
+metrics (``util/metrics.py``), and TPU slice helpers (``util/tpu.py``).
+"""
+
+from .actor_pool import ActorPool  # noqa: F401
+from .queue import Empty, Full, Queue  # noqa: F401
+from . import metrics  # noqa: F401
+from . import tpu  # noqa: F401

@@ -1,0 +1,121 @@
+"""ActorPool — load-balance a stream of tasks over a fixed set of actors.
+
+Role-equivalent of the reference's ``ray.util.ActorPool``
+(``python/ray/util/actor_pool.py``): submit ``fn(actor, value)`` calls to
+whichever actor is free, harvest results in submission order or as they
+finish.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List, TypeVar
+
+from .. import api as _api
+
+V = TypeVar("V")
+
+
+class ActorPool:
+    def __init__(self, actors: Iterable[Any]):
+        self._idle: List[Any] = list(actors)
+        if not self._idle:
+            raise ValueError("ActorPool needs at least one actor")
+        # future (ObjectRef) → actor that produced it
+        self._future_to_actor = {}
+        # submission order bookkeeping for get_next()
+        self._index_to_future = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+        self._pending_submits: List[tuple] = []
+
+    # ------------------------------------------------------------- submit
+    def submit(self, fn: Callable[[Any, V], Any], value: V):
+        """Schedule ``fn(actor, value)`` on a free actor (queue if none)."""
+        if self._idle:
+            actor = self._idle.pop()
+            future = fn(actor, value)
+            self._future_to_actor[future] = actor
+            self._index_to_future[self._next_task_index] = future
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def has_next(self) -> bool:
+        return bool(self._index_to_future) or bool(self._pending_submits)
+
+    def has_free(self) -> bool:
+        return bool(self._idle) and not self._pending_submits
+
+    # ------------------------------------------------------------ harvest
+    def _on_done(self, future):
+        actor = self._future_to_actor.pop(future)
+        if self._pending_submits:
+            fn, value = self._pending_submits.pop(0)
+            new_future = fn(actor, value)
+            self._future_to_actor[new_future] = actor
+            self._index_to_future[self._next_task_index] = new_future
+            self._next_task_index += 1
+        else:
+            self._idle.append(actor)
+
+    def get_next(self, timeout: float = None):
+        """Next result in submission order."""
+        if self._next_return_index >= self._next_task_index:
+            raise StopIteration("no pending results")
+        # Don't mutate pool state until the get succeeds — a timeout must
+        # leave the pool intact so the caller can retry.
+        future = self._index_to_future[self._next_return_index]
+        value = _api.get(future, timeout=timeout)
+        del self._index_to_future[self._next_return_index]
+        self._next_return_index += 1
+        self._on_done(future)
+        return value
+
+    def get_next_unordered(self, timeout: float = None):
+        """Next result in completion order."""
+        if not self._index_to_future:
+            raise StopIteration("no pending results")
+        ready, _ = _api.wait(
+            list(self._index_to_future.values()), num_returns=1, timeout=timeout
+        )
+        if not ready:
+            raise TimeoutError("get_next_unordered timed out")
+        future = ready[0]
+        for idx, fut in self._index_to_future.items():
+            if fut is future or fut == future:
+                del self._index_to_future[idx]
+                break
+        value = _api.get(future)
+        self._on_done(future)
+        return value
+
+    # --------------------------------------------------------------- maps
+    def map(self, fn: Callable[[Any, V], Any], values: Iterable[V]):
+        """Ordered lazy map over the pool."""
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable[[Any, V], Any], values: Iterable[V]):
+        """Unordered lazy map (results as they complete)."""
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    # -------------------------------------------------------- pool mgmt
+    def push(self, actor):
+        """Add an idle actor to the pool."""
+        if self._pending_submits:
+            fn, value = self._pending_submits.pop(0)
+            future = fn(actor, value)
+            self._future_to_actor[future] = actor
+            self._index_to_future[self._next_task_index] = future
+            self._next_task_index += 1
+        else:
+            self._idle.append(actor)
+
+    def pop_idle(self):
+        """Remove and return an idle actor, or None."""
+        return self._idle.pop() if self._idle else None

@@ -1,0 +1,204 @@
+"""User-defined application metrics — Counter, Gauge, Histogram.
+
+Role-equivalent of the reference's ``ray.util.metrics``
+(``python/ray/util/metrics.py``): tagged metrics recorded in-process and
+aggregated cluster-wide.  TPU-native simplification: instead of an
+OpenCensus→agent→Prometheus pipeline, each worker keeps a local registry
+and pushes deltas to the control-plane KV on record (batched); the head
+exposes the merged view via ``snapshot()`` / the state API, and
+``prometheus_text()`` renders the standard exposition format for scraping.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Sequence, Tuple
+
+_REGISTRY_NS = "metrics"
+_FLUSH_INTERVAL_S = 2.0
+
+_lock = threading.Lock()
+_local: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], dict] = {}
+_dirty = False
+_last_flush = 0.0
+
+
+def _tag_key(tags: Optional[Dict[str, str]]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((tags or {}).items()))
+
+
+def _record(name: str, kind: str, tags, value: float, buckets=None):
+    global _dirty
+    key = (name, _tag_key(tags))
+    with _lock:
+        ent = _local.get(key)
+        if ent is None:
+            ent = {"kind": kind, "value": 0.0, "count": 0, "sum": 0.0,
+                   "buckets": list(buckets or []), "bucket_counts": None}
+            if ent["buckets"]:
+                ent["bucket_counts"] = [0] * (len(ent["buckets"]) + 1)
+            _local[key] = ent
+        if kind == "counter":
+            ent["value"] += value
+        elif kind == "gauge":
+            ent["value"] = value
+        else:  # histogram
+            ent["count"] += 1
+            ent["sum"] += value
+            for i, b in enumerate(ent["buckets"]):
+                if value <= b:
+                    ent["bucket_counts"][i] += 1
+                    break
+            else:
+                ent["bucket_counts"][-1] += 1
+        _dirty = True
+    _maybe_flush()
+
+
+def _maybe_flush(force: bool = False):
+    """Push this worker's metric state to the control-plane KV (best effort)."""
+    global _dirty, _last_flush
+    now = time.monotonic()
+    if not force and (not _dirty or now - _last_flush < _FLUSH_INTERVAL_S):
+        return
+    from ..core.core_worker import try_global_worker
+
+    w = try_global_worker()
+    if w is None:
+        return
+    with _lock:
+        payload = {
+            f"{name}|{dict(tags)}": {
+                "name": name, "tags": dict(tags), **{
+                    k: v for k, v in ent.items() if k != "bucket_counts"
+                },
+                "bucket_counts": ent["bucket_counts"],
+            }
+            for (name, tags), ent in _local.items()
+        }
+        _dirty = False
+        _last_flush = now
+    try:
+        w.kv_put(_REGISTRY_NS, f"worker:{w.worker_id.hex()}", payload)
+    except Exception:
+        pass
+
+
+class _Metric:
+    kind = ""
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Sequence[str]] = None):
+        if not name:
+            raise ValueError("metric name must be non-empty")
+        self._name = name
+        self._description = description
+        self._tag_keys = tuple(tag_keys or ())
+        self._default_tags: Dict[str, str] = {}
+
+    @property
+    def info(self) -> dict:
+        return {"name": self._name, "description": self._description,
+                "tag_keys": self._tag_keys, "default_tags": self._default_tags}
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self._default_tags = dict(tags)
+        return self
+
+    def _merged(self, tags):
+        merged = dict(self._default_tags)
+        merged.update(tags or {})
+        extra = set(merged) - set(self._tag_keys)
+        if extra:
+            raise ValueError(f"undeclared tag keys {sorted(extra)} for {self._name}")
+        return merged
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
+        if value <= 0:
+            raise ValueError("Counter.inc value must be > 0")
+        _record(self._name, "counter", self._merged(tags), value)
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        _record(self._name, "gauge", self._merged(tags), float(value))
+
+
+DEFAULT_HISTOGRAM_BOUNDARIES = [
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 50.0, 100.0,
+]
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, description="", boundaries=None, tag_keys=None):
+        super().__init__(name, description, tag_keys)
+        self._boundaries = list(boundaries or DEFAULT_HISTOGRAM_BOUNDARIES)
+        if sorted(self._boundaries) != self._boundaries:
+            raise ValueError("histogram boundaries must be sorted ascending")
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        _record(self._name, "histogram", self._merged(tags), float(value),
+                buckets=self._boundaries)
+
+
+# ------------------------------------------------------------- aggregation
+def flush():
+    """Force-push local metrics to the cluster registry."""
+    _maybe_flush(force=True)
+
+
+def snapshot() -> Dict[str, dict]:
+    """Cluster-wide merged metric view (counters summed across workers,
+    gauges last-writer-wins, histograms merged)."""
+    from ..core.core_worker import global_worker
+
+    w = global_worker()
+    flush()
+    merged: Dict[str, dict] = {}
+    for key in w.kv_keys(_REGISTRY_NS):
+        data = w.kv_get(_REGISTRY_NS, key)
+        if not data:
+            continue
+        for mkey, ent in data.items():
+            cur = merged.get(mkey)
+            if cur is None:
+                merged[mkey] = dict(ent)
+            elif ent["kind"] == "counter":
+                cur["value"] += ent["value"]
+            elif ent["kind"] == "gauge":
+                cur["value"] = ent["value"]
+            else:
+                cur["count"] += ent["count"]
+                cur["sum"] += ent["sum"]
+                if cur.get("bucket_counts") and ent.get("bucket_counts"):
+                    cur["bucket_counts"] = [
+                        a + b for a, b in
+                        zip(cur["bucket_counts"], ent["bucket_counts"])
+                    ]
+    return merged
+
+
+def prometheus_text() -> str:
+    """Render the merged view in Prometheus exposition format."""
+    lines = []
+    for mkey, ent in sorted(snapshot().items()):
+        name = ent["name"]
+        labels = ",".join(f'{k}="{v}"' for k, v in sorted(ent["tags"].items()))
+        label_s = "{" + labels + "}" if labels else ""
+        if ent["kind"] == "histogram":
+            lines.append(f"# TYPE {name} histogram")
+            lines.append(f"{name}_count{label_s} {ent['count']}")
+            lines.append(f"{name}_sum{label_s} {ent['sum']}")
+        else:
+            lines.append(f"# TYPE {name} {ent['kind']}")
+            lines.append(f"{name}{label_s} {ent['value']}")
+    return "\n".join(lines) + ("\n" if lines else "")

@@ -1,0 +1,156 @@
+"""Distributed Queue — a FIFO queue shared across tasks and actors.
+
+Role-equivalent of the reference's ``ray.util.queue.Queue``
+(``python/ray/util/queue.py``): a named-actor-backed queue with the
+``queue.Queue`` API (put/get with block+timeout, qsize/empty/full,
+put_nowait/get_nowait, batch variants).
+
+Design note: the actor's methods never block (they return "would block"
+status instead) and clients poll with backoff.  This keeps the queue actor
+responsive regardless of its concurrency setting — a blocked consumer can
+never starve producers of actor threads.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any, List, Optional
+
+from .. import api as _api
+from ..core.api_frontend import remote
+
+
+class Empty(Exception):
+    """Raised by get(block=False)/get(timeout=...) on an empty queue."""
+
+
+class Full(Exception):
+    """Raised by put(block=False)/put(timeout=...) on a full queue."""
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int = 0):
+        self.maxsize = maxsize
+        self.items: deque = deque()
+
+    def try_put(self, items: List[Any]) -> int:
+        """Append as many of ``items`` as capacity allows; returns count."""
+        if self.maxsize <= 0:
+            self.items.extend(items)
+            return len(items)
+        space = self.maxsize - len(self.items)
+        accepted = items[: max(0, space)]
+        self.items.extend(accepted)
+        return len(accepted)
+
+    def try_get(self, n: int = 1) -> List[Any]:
+        out = []
+        while self.items and len(out) < n:
+            out.append(self.items.popleft())
+        return out
+
+    def put_back(self, items: List[Any]):
+        """Return harvested-but-unconsumed items to the FRONT of the queue
+        (used when a batched get times out with a partial harvest)."""
+        self.items.extendleft(reversed(items))
+
+    def qsize(self) -> int:
+        return len(self.items)
+
+    def shutdown_drain(self) -> List[Any]:
+        out = list(self.items)
+        self.items.clear()
+        return out
+
+
+_POLL_S = 0.01
+_POLL_MAX_S = 0.2
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0, *, actor_options: Optional[dict] = None):
+        opts = dict(actor_options or {})
+        opts.setdefault("num_cpus", 0)
+        self.maxsize = maxsize
+        self.actor = remote(**opts)(_QueueActor).remote(maxsize)
+
+    # ---------------------------------------------------------------- put
+    def put(self, item: Any, block: bool = True, timeout: Optional[float] = None):
+        self.put_batch([item], block=block, timeout=timeout)
+
+    def put_nowait(self, item: Any):
+        self.put(item, block=False)
+
+    def put_batch(self, items: List[Any], block: bool = True,
+                  timeout: Optional[float] = None):
+        items = list(items)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        delay = _POLL_S
+        total = len(items)
+        while items:
+            accepted = _api.get(self.actor.try_put.remote(items))
+            items = items[accepted:]
+            if not items:
+                return
+            if not block:
+                raise Full(
+                    f"queue is full ({total - len(items)}/{total} items "
+                    "were accepted before it filled; do not re-put those)"
+                )
+            if deadline is not None and time.monotonic() >= deadline:
+                raise Full(
+                    f"queue put timed out ({total - len(items)}/{total} "
+                    "items were accepted; do not re-put those)"
+                )
+            time.sleep(delay)
+            delay = min(delay * 2, _POLL_MAX_S)
+
+    # ---------------------------------------------------------------- get
+    def get(self, block: bool = True, timeout: Optional[float] = None) -> Any:
+        return self.get_batch(1, block=block, timeout=timeout)[0]
+
+    def get_nowait(self) -> Any:
+        return self.get(block=False)
+
+    def get_batch(self, n: int = 1, block: bool = True,
+                  timeout: Optional[float] = None) -> List[Any]:
+        out: List[Any] = []
+        deadline = None if timeout is None else time.monotonic() + timeout
+        delay = _POLL_S
+
+        def give_up(msg):
+            # A partial harvest must go back to the queue's front, or the
+            # already-dequeued items would be lost from the cluster.
+            if out:
+                _api.get(self.actor.put_back.remote(out))
+            raise Empty(msg)
+
+        while len(out) < n:
+            got = _api.get(self.actor.try_get.remote(n - len(out)))
+            out.extend(got)
+            if len(out) >= n:
+                return out
+            if not block:
+                give_up("queue is empty")
+            if deadline is not None and time.monotonic() >= deadline:
+                give_up("queue get timed out")
+            time.sleep(delay)
+            delay = min(delay * 2, _POLL_MAX_S)
+        return out
+
+    # --------------------------------------------------------------- info
+    def qsize(self) -> int:
+        return _api.get(self.actor.qsize.remote())
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def full(self) -> bool:
+        return self.maxsize > 0 and self.qsize() >= self.maxsize
+
+    def shutdown(self) -> List[Any]:
+        """Drain remaining items and kill the backing actor."""
+        items = _api.get(self.actor.shutdown_drain.remote())
+        _api.kill(self.actor)
+        return items

@@ -1,0 +1,60 @@
+"""TPU slice helpers — user-facing API over chip detection + slice PGs.
+
+Role-equivalent of the reference's ``ray.util.tpu``
+(``python/ray/util/tpu.py:16,29,52``): current-pod introspection plus
+whole-slice reservation.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..core.placement import SlicePlacementGroup  # noqa: F401  (re-export)
+from ..core import tpu_detect as _detect
+
+
+def get_current_pod_name() -> Optional[str]:
+    """Name of the TPU pod slice this host belongs to (None off-TPU)."""
+    return _detect.pod_name() or None
+
+
+def get_current_pod_worker_count() -> int:
+    """Number of hosts in the current pod slice (1 off-TPU / single host)."""
+    topo = _detect.topology()
+    if topo:
+        dims = [int(d) for d in topo.split("x")]
+        total_chips = 1
+        for d in dims:
+            total_chips *= d
+        chips = _detect.num_local_chips() or 4
+        return max(1, total_chips // chips)
+    return 1
+
+
+def get_num_tpu_chips_on_node() -> int:
+    return _detect.num_local_chips()
+
+
+def get_current_accelerator_type() -> str:
+    return _detect.accelerator_type()
+
+
+def reserve_tpu_slice(
+    num_hosts: int,
+    chips_per_host: int = 4,
+    accelerator_version: str = "",
+    timeout: Optional[float] = None,
+) -> SlicePlacementGroup:
+    """Reserve a whole slice; blocks until the gang reservation commits."""
+    spg = SlicePlacementGroup(
+        num_hosts=num_hosts,
+        chips_per_host=chips_per_host,
+        accelerator_version=accelerator_version,
+    )
+    if not spg.ready(timeout):
+        spg.remove()
+        raise TimeoutError(
+            f"TPU slice reservation ({num_hosts} hosts × {chips_per_host} "
+            "chips) did not become ready"
+        )
+    return spg
