@@ -37,11 +37,13 @@ from .api import (  # noqa: F401
     nodes,
     placement_group,
     placement_group_strategy,
+    profile,
     put,
     remote,
     remove_placement_group,
     shutdown,
     state_summary,
+    timeline,
     wait,
 )
 from .core.exceptions import (  # noqa: F401

@@ -1,0 +1,7 @@
+"""``python -m ray_tpu`` → the CLI (see ``ray_tpu/scripts/cli.py``)."""
+
+import sys
+
+from .scripts.cli import main
+
+sys.exit(main())

@@ -199,3 +199,26 @@ def state_summary() -> dict:
 def timeline_stats() -> dict:
     worker = global_worker()
     return worker._run_sync(worker.agent.call("debug_state"))
+
+
+def timeline(filename: Optional[str] = None) -> List[dict]:
+    """Dump the task timeline as Chrome-trace events (``ray timeline``
+    analog; reference ``python/ray/_private/state.py:441,527``).  Load the
+    written JSON in chrome://tracing or Perfetto."""
+    from .util.state.api import StateApiClient, chrome_trace_events
+
+    events = chrome_trace_events(
+        StateApiClient().list_task_events(limit=100000)
+    )
+    if filename:
+        import json as _json
+
+        with open(filename, "w") as f:
+            _json.dump(events, f)
+    return events
+
+
+def profile(event_name: str, extra: Optional[dict] = None):
+    """Context manager recording a user profile span into the timeline
+    (``ray.timeline`` profile-event analog)."""
+    return global_worker().task_events.profile(event_name, extra)

@@ -57,6 +57,11 @@ _KNOBS: Dict[str, tuple] = {
     "actor_max_restarts_default": (int, 0, "Default actor restarts"),
     # -- TPU --
     "tpu_visible_chips_env": (str, "TPU_VISIBLE_CHIPS", "Env var used for chip isolation"),
+    # -- task events / observability --
+    "enable_task_events": (bool, True, "Record task lifecycle events"),
+    "task_events_flush_period_s": (float, 0.5, "Worker buffer flush period"),
+    "task_events_max_buffer": (int, 10000, "Per-worker unflushed event cap"),
+    "task_events_max_stored": (int, 100000, "Control-plane stored task cap"),
     # -- logging --
     "log_level": (str, "INFO", "Python log level for system processes"),
     "session_dir": (str, "", "Session directory (default: /tmp/ray_tpu/session_*)"),

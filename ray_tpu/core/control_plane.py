@@ -29,6 +29,7 @@ from .ids import ActorID, JobID, NodeID, PlacementGroupID
 from .resources import ResourceSet
 from .rpc import ClientPool, RpcServer, ServerConnection
 from .scheduler import ClusterScheduler, InfeasibleError
+from .task_events import TaskEventStore
 from .task_spec import ActorSpec
 
 logger = logging.getLogger(__name__)
@@ -107,6 +108,7 @@ class ControlPlane:
         self._pending_actors: List[ActorID] = []
         self._pending_pgs: List[PlacementGroupID] = []
         self._bg_tasks: List[asyncio.Task] = []
+        self.task_event_store = TaskEventStore()
 
     # ------------------------------------------------------------- lifecycle
     async def start(self) -> str:
@@ -537,6 +539,24 @@ class ControlPlane:
         return {
             "node_id": node_id,
             "agent_address": self.nodes[node_id].agent_address,
+        }
+
+    # ------------------------------------------------------------ task events
+    def handle_task_events(self, payload, conn):
+        """Worker task-event flush (GcsTaskManager::HandleAddTaskEventData
+        analog)."""
+        self.task_event_store.add_batch(
+            payload.get("events", ()), payload.get("profile_events", ())
+        )
+        return True
+
+    def handle_list_task_events(self, payload, conn):
+        return {
+            "tasks": self.task_event_store.list_tasks(
+                payload.get("filters"), payload.get("limit", 1000)
+            ),
+            "profile_events": self.task_event_store.profile_events(),
+            "num_dropped": self.task_event_store.num_dropped,
         }
 
     def handle_ping(self, payload, conn):

@@ -319,6 +319,7 @@ class CoreWorker:
         self._actor_seq_state: Dict[tuple, dict] = {}  # (caller, inc) -> {expected, buffer}
         self._current_task_name = ""
         self._shutdown = False
+        self.task_events = None  # TaskEventBuffer, created on the loop
 
     # ------------------------------------------------------------- lifecycle
     async def async_start(self):
@@ -327,6 +328,12 @@ class CoreWorker:
         self.address = await self.server.start()
         self.cp = RetryableRpcClient(self.cp_address, push_handler=self._on_push)
         self.agent = RetryableRpcClient(self.agent_address)
+        from .task_events import TaskEventBuffer
+
+        self.task_events = TaskEventBuffer(
+            self.cp, self.node_id.hex(), self.worker_id.hex()
+        )
+        self.task_events.start()
         if self.mode == self.DRIVER:
             await self.cp.call(
                 "register_job",
@@ -399,6 +406,11 @@ class CoreWorker:
 
     async def async_shutdown(self):
         self._shutdown = True
+        if self.task_events is not None:
+            try:
+                await asyncio.wait_for(self.task_events.stop(), timeout=2)
+            except Exception:
+                pass
         await self.server.stop()
         for pool in (self.worker_clients, self.agent_clients):
             await pool.close_all()
@@ -839,6 +851,13 @@ class CoreWorker:
 
         def setup():
             self._hold_args(held)
+            self.task_events.record(
+                spec.task_id.hex(),
+                spec.name,
+                "PENDING_SUBMISSION",
+                job_id_hex=spec.job_id.hex(),
+                resources=spec.resources,
+            )
             for oid in return_ids:
                 obj = self._new_owned(oid, lineage=spec)
                 obj.local_refs += 1
@@ -1011,6 +1030,13 @@ class CoreWorker:
 
         def setup():
             self._hold_args(held)
+            self.task_events.record(
+                spec.task_id.hex(),
+                spec.name,
+                "PENDING_SUBMISSION",
+                job_id_hex=spec.job_id.hex(),
+                actor_id_hex=spec.actor_id.hex(),
+            )
             for oid in return_ids:
                 obj = self._new_owned(oid)
                 obj.local_refs += 1
@@ -1137,6 +1163,11 @@ class CoreWorker:
         return out
 
     async def _execute(self, spec: TaskSpec, fn) -> dict:
+        ev_kw = {
+            "job_id_hex": spec.job_id.hex(),
+            "actor_id_hex": spec.actor_id.hex() if spec.actor_id else "",
+        }
+        self.task_events.record(spec.task_id.hex(), spec.name, "RUNNING", **ev_kw)
         try:
             args, kwargs = await self._resolve_args(spec.args_payload)
             self._current_task_name = spec.name
@@ -1148,10 +1179,16 @@ class CoreWorker:
                     self._task_executor, lambda: fn(*args, **kwargs)
                 )
             returns = await self._package_returns(spec, result)
+            self.task_events.record(
+                spec.task_id.hex(), spec.name, "FINISHED", **ev_kw
+            )
             return {"returns": returns, "error": None}
         except BaseException as e:  # noqa: BLE001
             import traceback as tb
 
+            self.task_events.record(
+                spec.task_id.hex(), spec.name, "FAILED", error=repr(e), **ev_kw
+            )
             err = TaskError(e, tb.format_exc(), spec.name)
             return {"returns": None, "error": serialize_to_bytes(err)}
 

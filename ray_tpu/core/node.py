@@ -97,8 +97,10 @@ class Node:
         labels: Optional[Dict[str, str]] = None,
         session_id: Optional[str] = None,
         num_cpus: Optional[float] = None,
+        port: Optional[int] = None,
     ):
         self.head = head
+        self.port = port
         self.session_id = session_id or shm.new_session_id()
         self.log_dir = os.path.join(
             tempfile.gettempdir(), "ray_tpu", f"session_{self.session_id}"
@@ -124,7 +126,7 @@ class Node:
     def start(self):
         env = {"RAY_TPU_LOG_DIR": self.log_dir}
         if self.head:
-            cp_port = find_free_port()
+            cp_port = self.port or find_free_port()
             self.cp_address = f"127.0.0.1:{cp_port}"
             self.pg.spawn(
                 [

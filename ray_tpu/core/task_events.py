@@ -1,0 +1,217 @@
+"""Task event recording: per-worker buffer flushed to the control plane.
+
+Role-equivalent of the reference's ``TaskEventBuffer`` (ray
+``src/ray/core_worker/task_event_buffer.h:297``) + ``GcsTaskManager`` (ray
+``src/ray/gcs/gcs_task_manager.h:97``): every worker batches task
+state-transition and user profile events and periodically flushes them to the
+control plane, which keeps a bounded per-task store powering the state API
+(``list_tasks``), ``summarize_tasks``, and the Chrome-trace timeline dump
+(ray ``python/ray/_private/state.py:441,527``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+from .config import GlobalConfig
+
+logger = logging.getLogger(__name__)
+
+# Task lifecycle states (reference: rpc::TaskStatus).
+PENDING_SUBMISSION = "PENDING_SUBMISSION"
+RUNNING = "RUNNING"
+FINISHED = "FINISHED"
+FAILED = "FAILED"
+
+
+class TaskEventBuffer:
+    """Buffers task events in-process; a background loop flushes them to the
+    control plane.  Lossy by design: if the control plane is unreachable the
+    batch is dropped after one retry (events are observability, not truth)."""
+
+    def __init__(self, cp_client, node_id_hex: str, worker_id_hex: str):
+        self._cp = cp_client
+        self._node = node_id_hex
+        self._worker = worker_id_hex
+        self._events: List[dict] = []
+        self._profile_events: List[dict] = []
+        self._task: Optional[asyncio.Task] = None
+        self._stopped = False
+
+    # ------------------------------------------------------------- recording
+    def record(
+        self,
+        task_id_hex: str,
+        name: str,
+        state: str,
+        *,
+        attempt: int = 0,
+        job_id_hex: str = "",
+        actor_id_hex: str = "",
+        error: Optional[str] = None,
+        resources: Optional[Dict[str, float]] = None,
+    ) -> None:
+        if not GlobalConfig.enable_task_events:
+            return
+        self._events.append(
+            {
+                "task_id": task_id_hex,
+                "attempt": attempt,
+                "name": name,
+                "state": state,
+                "ts": time.time(),
+                "job_id": job_id_hex,
+                "actor_id": actor_id_hex,
+                "node_id": self._node,
+                "worker_id": self._worker,
+                "error": error,
+                "resources": resources,
+            }
+        )
+        if len(self._events) > GlobalConfig.task_events_max_buffer:
+            # Shed oldest half under backpressure.
+            del self._events[: len(self._events) // 2]
+
+    @contextlib.contextmanager
+    def profile(self, event_name: str, extra: Optional[dict] = None):
+        """User profile span (``ray.timeline`` profile-event analog); shows up
+        as its own row in the timeline dump."""
+        start = time.time()
+        try:
+            yield
+        finally:
+            if GlobalConfig.enable_task_events:
+                self._profile_events.append(
+                    {
+                        "name": event_name,
+                        "start": start,
+                        "end": time.time(),
+                        "worker_id": self._worker,
+                        "node_id": self._node,
+                        "extra": extra,
+                    }
+                )
+                if len(self._profile_events) > GlobalConfig.task_events_max_buffer:
+                    del self._profile_events[: len(self._profile_events) // 2]
+
+    # --------------------------------------------------------------- flushing
+    def start(self) -> None:
+        if self._task is None and GlobalConfig.enable_task_events:
+            self._task = asyncio.get_running_loop().create_task(self._flush_loop())
+
+    async def stop(self) -> None:
+        self._stopped = True
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        await self.flush()
+
+    async def flush(self) -> None:
+        if not self._events and not self._profile_events:
+            return
+        events, self._events = self._events, []
+        profiles, self._profile_events = self._profile_events, []
+        try:
+            await self._cp.call(
+                "task_events",
+                {"events": events, "profile_events": profiles},
+                retries=2,
+            )
+        except Exception as e:  # noqa: BLE001 — observability is best-effort
+            logger.debug("task-event flush dropped %d events: %s", len(events), e)
+
+    async def _flush_loop(self) -> None:
+        period = GlobalConfig.task_events_flush_period_s
+        while not self._stopped:
+            await asyncio.sleep(period)
+            await self.flush()
+
+
+class TaskEventStore:
+    """Control-plane side: bounded store of per-task merged events (the
+    ``GcsTaskManager`` analog).  One entry per (task_id, attempt); state
+    transitions merge into ``state_ts``; oldest finished entries evicted
+    beyond the cap."""
+
+    # Batches from the submitter and the executor arrive on independent flush
+    # timers, so merges must be state-ranked, not last-write-wins: a late
+    # PENDING_SUBMISSION must never regress a task already FINISHED.
+    _STATE_RANK = {PENDING_SUBMISSION: 0, RUNNING: 1, FINISHED: 2, FAILED: 2}
+
+    def __init__(self):
+        self._tasks: Dict[tuple, dict] = {}
+        self._profile_events: List[dict] = []
+        self.num_dropped = 0
+
+    def add_batch(self, events: List[dict], profile_events: List[dict]) -> None:
+        for ev in events:
+            key = (ev["task_id"], ev["attempt"])
+            entry = self._tasks.get(key)
+            if entry is None:
+                entry = {
+                    "task_id": ev["task_id"],
+                    "attempt": ev["attempt"],
+                    "name": ev["name"],
+                    "job_id": ev["job_id"],
+                    "actor_id": ev["actor_id"],
+                    "node_id": ev["node_id"],
+                    "worker_id": ev["worker_id"],
+                    "state": ev["state"],
+                    "state_ts": {},
+                    "error": None,
+                    "resources": ev.get("resources"),
+                }
+                self._tasks[key] = entry
+            rank = self._STATE_RANK.get(ev["state"], 0)
+            if rank >= self._STATE_RANK.get(entry["state"], 0):
+                entry["state"] = ev["state"]
+            entry["state_ts"][ev["state"]] = ev["ts"]
+            # The executing worker knows node/worker; the submitter doesn't.
+            if ev["state"] in (RUNNING, FINISHED, FAILED):
+                entry["node_id"] = ev["node_id"]
+                entry["worker_id"] = ev["worker_id"]
+            if ev.get("error"):
+                entry["error"] = ev["error"]
+            if ev.get("resources"):
+                entry["resources"] = ev["resources"]
+        self._profile_events.extend(profile_events)
+        cap = GlobalConfig.task_events_max_stored
+        if len(self._tasks) > cap:
+            overflow = len(self._tasks) - cap
+            # dicts iterate in insertion order: evict oldest *terminal*
+            # entries first; still-running tasks are what operators look for.
+            evicted = 0
+            for key in list(self._tasks):
+                if evicted >= overflow:
+                    break
+                if self._tasks[key]["state"] in (FINISHED, FAILED):
+                    del self._tasks[key]
+                    evicted += 1
+            if evicted < overflow:  # everything is live; evict oldest anyway
+                for key in list(self._tasks)[: overflow - evicted]:
+                    del self._tasks[key]
+                    evicted += 1
+            self.num_dropped += evicted
+        if len(self._profile_events) > cap:
+            del self._profile_events[: len(self._profile_events) - cap]
+
+    def list_tasks(
+        self, filters: Optional[Dict[str, Any]] = None, limit: int = 1000
+    ) -> List[dict]:
+        out = []
+        for entry in reversed(list(self._tasks.values())):
+            if filters and any(
+                str(entry.get(k)) != str(v) for k, v in filters.items()
+            ):
+                continue
+            out.append(dict(entry, state_ts=dict(entry["state_ts"])))
+            if len(out) >= limit:
+                break
+        return out
+
+    def profile_events(self) -> List[dict]:
+        return list(self._profile_events)

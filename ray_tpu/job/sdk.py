@@ -1,0 +1,254 @@
+"""Job submission SDK.
+
+Reference: ``JobSubmissionClient`` (ray ``dashboard/modules/job/sdk.py:36``,
+``submit_job:126``) + ``JobManager``/``JobSupervisor`` (ray
+``dashboard/modules/job/job_manager.py:61``).  Architecture kept: one
+detached supervisor actor per job owns the entrypoint subprocess; job
+metadata lives in the control-plane KV so any client can list jobs.  The
+supervisor is placed like any actor (it requests no resources), and the
+entrypoint subprocess inherits the cluster address so its own
+``ray_tpu.init(address=…)`` joins the same cluster.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+_KV_NS = "_job_submissions"
+
+
+class JobStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+    TERMINAL = (SUCCEEDED, FAILED, STOPPED)
+
+
+@dataclass
+class JobInfo:
+    submission_id: str
+    entrypoint: str
+    status: str = JobStatus.PENDING
+    message: str = ""
+    start_time: float = 0.0
+    end_time: float = 0.0
+    metadata: Dict[str, str] = field(default_factory=dict)
+    driver_exit_code: Optional[int] = None
+
+
+class JobSupervisor:
+    """Detached actor owning one job's entrypoint subprocess (ray
+    ``dashboard/modules/job/job_manager.py`` JobSupervisor analog)."""
+
+    def __init__(self, submission_id: str, entrypoint: str,
+                 metadata: Optional[Dict[str, str]] = None,
+                 env_vars: Optional[Dict[str, str]] = None):
+        self._info = JobInfo(
+            submission_id=submission_id,
+            entrypoint=entrypoint,
+            metadata=metadata or {},
+        )
+        self._env_vars = env_vars or {}
+        self._proc: Optional[subprocess.Popen] = None
+        self._log_path = os.path.join(
+            os.environ.get("RAY_TPU_LOG_DIR", "/tmp/ray_tpu"),
+            f"job-{submission_id}.log",
+        )
+        self._lock = threading.Lock()
+        self._publish()
+
+    def _publish(self):
+        import ray_tpu
+
+        worker = ray_tpu.api.global_worker()
+        worker.kv_put(_KV_NS, self._info.submission_id, self._info.__dict__)
+
+    def run(self) -> str:
+        """Start the entrypoint subprocess and reap it in the background."""
+        env = dict(os.environ)
+        env.update(self._env_vars)
+        env["RAY_TPU_JOB_SUBMISSION_ID"] = self._info.submission_id
+        with self._lock:
+            if self._proc is not None:
+                return self._info.status
+            out = open(self._log_path, "ab")
+            try:
+                self._proc = subprocess.Popen(
+                    self._info.entrypoint,
+                    shell=True,
+                    stdout=out,
+                    stderr=subprocess.STDOUT,
+                    env=env,
+                    start_new_session=True,
+                )
+            except OSError as e:
+                self._info.status = JobStatus.FAILED
+                self._info.message = f"failed to start entrypoint: {e}"
+                self._publish()
+                return self._info.status
+            self._info.status = JobStatus.RUNNING
+            self._info.start_time = time.time()
+            self._publish()
+        threading.Thread(target=self._reap, daemon=True).start()
+        return self._info.status
+
+    def _reap(self):
+        code = self._proc.wait()
+        with self._lock:
+            if self._info.status == JobStatus.RUNNING:
+                self._info.status = (
+                    JobStatus.SUCCEEDED if code == 0 else JobStatus.FAILED
+                )
+                self._info.message = f"entrypoint exited with code {code}"
+            self._info.driver_exit_code = code
+            self._info.end_time = time.time()
+            self._publish()
+
+    def status(self) -> dict:
+        return dict(self._info.__dict__)
+
+    def logs(self, tail_bytes: int = 1 << 20) -> str:
+        try:
+            with open(self._log_path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - tail_bytes))
+                return f.read().decode(errors="replace")
+        except FileNotFoundError:
+            return ""
+
+    def stop(self) -> bool:
+        with self._lock:
+            if self._proc is None or self._proc.poll() is not None:
+                return False
+            self._info.status = JobStatus.STOPPED
+            self._info.message = "stopped by user"
+        try:
+            os.killpg(os.getpgid(self._proc.pid), 15)
+        except OSError:
+            pass
+
+        def force_kill():
+            time.sleep(3)
+            if self._proc.poll() is None:
+                try:
+                    os.killpg(os.getpgid(self._proc.pid), 9)
+                except OSError:
+                    pass
+
+        threading.Thread(target=force_kill, daemon=True).start()
+        self._publish()
+        return True
+
+
+def _supervisor_name(submission_id: str) -> str:
+    return f"_rtpu_job:{submission_id}"
+
+
+class JobSubmissionClient:
+    """Submit and manage jobs on a running cluster (ray
+    ``dashboard/modules/job/sdk.py:36`` analog; transport is the cluster's
+    own actor RPC instead of the dashboard's REST endpoint)."""
+
+    def __init__(self, address: Optional[str] = None):
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(address=address or "auto")
+        self._ray = ray_tpu
+
+    def submit_job(
+        self,
+        *,
+        entrypoint: str,
+        submission_id: Optional[str] = None,
+        runtime_env: Optional[dict] = None,
+        metadata: Optional[Dict[str, str]] = None,
+    ) -> str:
+        from ..core.runtime_env import resolve_runtime_env
+
+        submission_id = submission_id or f"rtpu-job-{uuid.uuid4().hex[:10]}"
+        if self.get_job_info(submission_id) is not None:
+            raise ValueError(f"job {submission_id!r} already exists")
+        env_vars = resolve_runtime_env(runtime_env) or {}
+        supervisor_cls = self._ray.remote(num_cpus=0)(JobSupervisor)
+        supervisor = supervisor_cls.options(
+            name=_supervisor_name(submission_id),
+            lifetime="detached",
+        ).remote(submission_id, entrypoint, metadata, env_vars)
+        # Synchronous start so submit errors surface here.
+        self._ray.get(supervisor.run.remote(), timeout=60)
+        return submission_id
+
+    def _supervisor(self, submission_id: str):
+        try:
+            return self._ray.get_actor(_supervisor_name(submission_id))
+        except ValueError:
+            return None
+
+    def get_job_info(self, submission_id: str) -> Optional[JobInfo]:
+        worker = self._ray.api.global_worker()
+        raw = worker.kv_get(_KV_NS, submission_id)
+        if raw is None:
+            return None
+        return JobInfo(**raw)
+
+    def get_job_status(self, submission_id: str) -> Optional[str]:
+        info = self.get_job_info(submission_id)
+        return info.status if info else None
+
+    def get_job_logs(self, submission_id: str) -> str:
+        sup = self._supervisor(submission_id)
+        if sup is None:
+            return ""
+        return self._ray.get(sup.logs.remote(), timeout=30)
+
+    def stop_job(self, submission_id: str) -> bool:
+        sup = self._supervisor(submission_id)
+        if sup is None:
+            return False
+        return self._ray.get(sup.stop.remote(), timeout=30)
+
+    def delete_job(self, submission_id: str) -> bool:
+        info = self.get_job_info(submission_id)
+        if info is None:
+            return False
+        if info.status not in JobStatus.TERMINAL:
+            raise RuntimeError(
+                f"job {submission_id!r} is {info.status}; stop it first"
+            )
+        sup = self._supervisor(submission_id)
+        if sup is not None:
+            self._ray.kill(sup)
+        worker = self._ray.api.global_worker()
+        worker.kv_del(_KV_NS, submission_id)
+        return True
+
+    def list_jobs(self) -> List[JobInfo]:
+        worker = self._ray.api.global_worker()
+        out = []
+        for key in worker.kv_keys(_KV_NS):
+            raw = worker.kv_get(_KV_NS, key)
+            if raw is not None:
+                out.append(JobInfo(**raw))
+        return out
+
+    def wait_until_finished(
+        self, submission_id: str, timeout: float = 300, poll_s: float = 0.5
+    ) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            status = self.get_job_status(submission_id)
+            if status in JobStatus.TERMINAL:
+                return status
+            time.sleep(poll_s)
+        raise TimeoutError(f"job {submission_id} still running after {timeout}s")

@@ -1,0 +1,1 @@
+"""Command-line tooling (reference: ray ``python/ray/scripts/scripts.py``)."""

@@ -1,0 +1,291 @@
+"""``ray-tpu`` CLI: start/stop nodes, inspect cluster state, manage jobs.
+
+Role-equivalent of the reference's click CLI (ray
+``python/ray/scripts/scripts.py``: ``ray start:682``, ``ray stop:1225``,
+``ray status``) plus the state CLI (``ray list/get/summary``, ray
+``python/ray/util/state/state_cli.py``) and ``ray timeline``
+(``scripts.py:241``).  Invokable as ``python -m ray_tpu <cmd>``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+from typing import List, Optional
+
+
+def _fmt_table(rows: List[dict], columns: List[str]) -> str:
+    if not rows:
+        return "(none)"
+    widths = {c: len(c) for c in columns}
+    str_rows = []
+    for row in rows:
+        sr = {c: str(row.get(c, "")) for c in columns}
+        str_rows.append(sr)
+        for c in columns:
+            widths[c] = max(widths[c], len(sr[c]))
+    lines = ["  ".join(c.ljust(widths[c]) for c in columns)]
+    lines.append("  ".join("-" * widths[c] for c in columns))
+    for sr in str_rows:
+        lines.append("  ".join(sr[c].ljust(widths[c]) for c in columns))
+    return "\n".join(lines)
+
+
+def _parse_filters(pairs: Optional[List[str]]) -> Optional[dict]:
+    if not pairs:
+        return None
+    out = {}
+    for pair in pairs:
+        if "=" not in pair:
+            raise SystemExit(f"--filter expects key=value, got {pair!r}")
+        k, v = pair.split("=", 1)
+        out[k] = v
+    return out
+
+
+# ------------------------------------------------------------------ commands
+def cmd_start(args) -> int:
+    from ..core import node as node_mod
+
+    resources = json.loads(args.resources) if args.resources else None
+    labels = json.loads(args.labels) if args.labels else None
+    if args.head:
+        node = node_mod.Node(
+            head=True,
+            resources=resources,
+            labels=labels,
+            num_cpus=args.num_cpus,
+            port=args.port,
+        )
+        node.start()
+        print(f"head started: control plane at {node.cp_address}")
+        print(f"session: {node.session_id}")
+        print(f"logs: {node.log_dir}")
+        print("join workers with:\n"
+              f"  ray-tpu start --address={node.cp_address}")
+    else:
+        if not args.address:
+            raise SystemExit("worker start requires --address=<head host:port>")
+        # Adopt the local head's session only when actually joining THAT
+        # head — a stale/foreign head_info.json must not alias shm arenas.
+        info = node_mod.read_head_info()
+        if info and info.get("cp_address") == args.address:
+            session = info["session_id"]
+        else:
+            session = "remote-" + args.address.replace(":", "-")
+        node = node_mod.Node(
+            head=False,
+            cp_address=args.address,
+            resources=resources,
+            labels=labels,
+            session_id=session,
+            num_cpus=args.num_cpus,
+        )
+        node.start()
+        print(f"node started, joined {args.address}")
+        print(f"logs: {node.log_dir}")
+    if args.block:
+        try:
+            while all(p.poll() is None for p in node.pg.procs):
+                time.sleep(1)
+            print("a system process exited; shutting node down", file=sys.stderr)
+            node.stop()
+            return 1
+        except KeyboardInterrupt:
+            node.stop()
+    return 0
+
+
+def _iter_ray_tpu_pids():
+    """Find local ray_tpu system processes by /proc cmdline scan."""
+    markers = (
+        "ray_tpu.core.control_plane",
+        "ray_tpu.core.node_agent",
+        "ray_tpu.core.worker_main",
+    )
+    for pid_dir in os.listdir("/proc"):
+        if not pid_dir.isdigit():
+            continue
+        pid = int(pid_dir)
+        if pid == os.getpid():
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmdline = f.read().replace(b"\0", b" ").decode(errors="replace")
+        except OSError:
+            continue
+        if any(m in cmdline for m in markers):
+            yield pid, cmdline
+
+
+def cmd_stop(args) -> int:
+    found = list(_iter_ray_tpu_pids())
+    for pid, cmdline in found:
+        try:
+            os.kill(pid, signal.SIGTERM)
+            if args.verbose:
+                print(f"SIGTERM {pid}: {cmdline[:90]}")
+        except OSError:
+            pass
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and list(_iter_ray_tpu_pids()):
+        time.sleep(0.2)
+    for pid, _ in _iter_ray_tpu_pids():
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except OSError:
+            pass
+    print(f"stopped {len(found)} process(es)")
+    from ..core.node import _HEAD_INFO_FILE
+
+    try:
+        os.remove(_HEAD_INFO_FILE)
+    except OSError:
+        pass
+    return 0
+
+
+def cmd_status(args) -> int:
+    from ..util.state.api import StateApiClient
+
+    client = StateApiClient(args.address)
+    state = client.get_state()
+    nodes = state["nodes"]
+    alive = [n for n in nodes.values() if n["alive"]]
+    print(f"nodes: {len(alive)} alive / {len(nodes)} total")
+    total, avail = {}, {}
+    for info in alive:
+        for k, v in info["snapshot"]["total"].items():
+            total[k] = total.get(k, 0) + v
+        for k, v in info["snapshot"]["available"].items():
+            avail[k] = avail.get(k, 0) + v
+    print("resources:")
+    for k in sorted(total):
+        print(f"  {avail.get(k, 0):g}/{total[k]:g} {k}")
+    actors = state["actors"]
+    by_state = {}
+    for a in actors:
+        by_state[a["state"]] = by_state.get(a["state"], 0) + 1
+    if actors:
+        print(f"actors: " + ", ".join(f"{v} {k}" for k, v in sorted(by_state.items())))
+    jobs = [j for j in state["jobs"].values() if j["state"] == "RUNNING"]
+    print(f"jobs running: {len(jobs)}")
+    return 0
+
+
+def cmd_list(args) -> int:
+    from ..util.state import api as state_api
+
+    filters = _parse_filters(args.filter)
+    kind = args.kind.replace("-", "_")
+    if kind == "nodes":
+        rows = state_api.list_nodes(args.address)
+        cols = ["node_id", "alive", "total", "available"]
+    elif kind == "actors":
+        rows = state_api.list_actors(args.address, filters)
+        cols = ["actor_id", "name", "state", "incarnation", "death_cause"]
+    elif kind == "tasks":
+        rows = state_api.list_tasks(args.address, filters, args.limit)
+        cols = ["task_id", "name", "state", "attempt", "node_id", "error"]
+    elif kind == "jobs":
+        rows = state_api.list_jobs(args.address)
+        cols = ["job_id", "state", "start_time"]
+    elif kind in ("placement_groups", "pgs"):
+        rows = state_api.list_placement_groups(args.address)
+        cols = ["pg_id", "state", "strategy", "bundles"]
+    else:
+        raise SystemExit(f"unknown entity {args.kind!r}")
+    if args.format == "json":
+        print(json.dumps(rows, default=str, indent=2))
+    else:
+        print(_fmt_table(rows[: args.limit], cols))
+    return 0
+
+
+def cmd_summary(args) -> int:
+    from ..util.state import api as state_api
+
+    if args.kind == "tasks":
+        print(json.dumps(state_api.summarize_tasks(args.address), indent=2))
+    elif args.kind == "actors":
+        print(json.dumps(state_api.summarize_actors(args.address), indent=2))
+    else:
+        raise SystemExit(f"unknown entity {args.kind!r}")
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    from ..util.state.api import StateApiClient, chrome_trace_events
+
+    client = StateApiClient(args.address)
+    events = chrome_trace_events(client.list_task_events(limit=100000))
+    out = args.output or f"ray-tpu-timeline-{int(time.time())}.json"
+    with open(out, "w") as f:
+        json.dump(events, f)
+    print(f"wrote {len(events)} events to {out} "
+          "(open in chrome://tracing or ui.perfetto.dev)")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="ray-tpu", description="ray_tpu cluster CLI"
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("start", help="start a head or worker node")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--address", help="head control-plane host:port (worker)")
+    p.add_argument("--port", type=int, help="control-plane port (head)")
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.add_argument("--resources", help='JSON, e.g. \'{"TPU": 4}\'')
+    p.add_argument("--labels", help="JSON node labels")
+    p.add_argument("--block", action="store_true", help="stay in foreground")
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("stop", help="stop all local ray_tpu processes")
+    p.add_argument("-v", "--verbose", action="store_true")
+    p.set_defaults(fn=cmd_stop)
+
+    p = sub.add_parser("status", help="cluster resource/actor/job summary")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("list", help="list cluster entities")
+    p.add_argument(
+        "kind",
+        choices=["nodes", "actors", "tasks", "jobs", "placement-groups", "pgs"],
+    )
+    p.add_argument("--address", default=None)
+    p.add_argument("--filter", action="append", help="key=value (repeatable)")
+    p.add_argument("--limit", type=int, default=100)
+    p.add_argument("--format", choices=["table", "json"], default="table")
+    p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("summary", help="summarize tasks or actors")
+    p.add_argument("kind", choices=["tasks", "actors"])
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_summary)
+
+    p = sub.add_parser("timeline", help="dump Chrome-trace task timeline")
+    p.add_argument("--address", default=None)
+    p.add_argument("-o", "--output", default=None)
+    p.set_defaults(fn=cmd_timeline)
+
+    from . import job_cli
+
+    job_cli.register(sub)
+    return parser
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

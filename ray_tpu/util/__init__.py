@@ -8,4 +8,5 @@ metrics (``util/metrics.py``), and TPU slice helpers (``util/tpu.py``).
 from .actor_pool import ActorPool  # noqa: F401
 from .queue import Empty, Full, Queue  # noqa: F401
 from . import metrics  # noqa: F401
+from . import state  # noqa: F401
 from . import tpu  # noqa: F401

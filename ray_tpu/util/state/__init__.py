@@ -1,0 +1,22 @@
+"""``ray_tpu.util.state`` — the cluster state API.
+
+Role-equivalent of the reference's ``ray.util.state`` (ray
+``python/ray/util/state/api.py``) backed by the dashboard's
+``StateAggregator``; here the control plane itself aggregates state
+(node/actor/job/placement-group tables + the task-event store), so the
+client talks to it directly.
+"""
+
+from .api import (  # noqa: F401
+    StateApiClient,
+    get_actor,
+    get_node,
+    get_task,
+    list_actors,
+    list_jobs,
+    list_nodes,
+    list_placement_groups,
+    list_tasks,
+    summarize_actors,
+    summarize_tasks,
+)

@@ -1,0 +1,199 @@
+"""State API client: list/get/summarize cluster entities.
+
+Reference: ``ray.util.state.api`` (ray ``python/ray/util/state/api.py``)
+and the ``ray list/get/summary`` CLI (``util/state/state_cli.py``).  The
+client resolves the control-plane address from (in order) an explicit
+``address=``, the connected driver, or the local head-info file, then
+issues ``get_state`` / ``list_task_events`` RPCs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections import Counter
+from typing import Any, Dict, List, Optional
+
+
+def _resolve_address(address: Optional[str]) -> str:
+    if address:
+        return address
+    from ...core.core_worker import try_global_worker
+
+    worker = try_global_worker()
+    if worker is not None:
+        return worker.cp_address
+    from ...core import node as node_mod
+
+    info = node_mod.read_head_info()
+    if info is not None:
+        return info["cp_address"]
+    raise ConnectionError(
+        "no cluster found: pass address=, call ray_tpu.init(), or start a head"
+    )
+
+
+class StateApiClient:
+    """Thin synchronous client over the control-plane state RPCs."""
+
+    def __init__(self, address: Optional[str] = None):
+        self.address = _resolve_address(address)
+
+    def _call(self, method: str, payload: Optional[dict] = None) -> Any:
+        from ...core.core_worker import try_global_worker
+        from ...core.rpc import RpcClient
+
+        worker = try_global_worker()
+        if worker is not None and worker.cp_address == self.address:
+            # Reuse the driver's existing control-plane connection.
+            return worker._run_sync(worker.cp.call(method, payload or {}))
+
+        async def run():
+            client = RpcClient(self.address)
+            await client.connect()
+            try:
+                return await client.call(method, payload or {})
+            finally:
+                await client.close()
+
+        return asyncio.run(run())
+
+    def get_state(self) -> dict:
+        return self._call("get_state")
+
+    def list_task_events(
+        self, filters: Optional[dict] = None, limit: int = 1000
+    ) -> dict:
+        return self._call(
+            "list_task_events", {"filters": filters, "limit": limit}
+        )
+
+    def cluster_view(self) -> dict:
+        return self._call("get_cluster_view")
+
+
+# ------------------------------------------------------------------ listers
+def list_nodes(address: Optional[str] = None) -> List[dict]:
+    state = StateApiClient(address).get_state()
+    return [
+        {"node_id": nid, "alive": info["alive"], **info["snapshot"]}
+        for nid, info in state["nodes"].items()
+    ]
+
+
+def list_actors(
+    address: Optional[str] = None, filters: Optional[dict] = None
+) -> List[dict]:
+    actors = StateApiClient(address).get_state()["actors"]
+    out = []
+    for a in actors:
+        row = dict(a)
+        row["actor_id"] = row["actor_id"].hex()
+        if filters and any(str(row.get(k)) != str(v) for k, v in filters.items()):
+            continue
+        out.append(row)
+    return out
+
+
+def list_jobs(address: Optional[str] = None) -> List[dict]:
+    jobs = StateApiClient(address).get_state()["jobs"]
+    return [{"job_id": jid, **info} for jid, info in jobs.items()]
+
+
+def list_placement_groups(address: Optional[str] = None) -> List[dict]:
+    pgs = StateApiClient(address).get_state()["placement_groups"]
+    out = []
+    for pg in pgs:
+        row = dict(pg)
+        row["pg_id"] = row["pg_id"].hex()
+        out.append(row)
+    return out
+
+
+def list_tasks(
+    address: Optional[str] = None,
+    filters: Optional[dict] = None,
+    limit: int = 1000,
+) -> List[dict]:
+    return StateApiClient(address).list_task_events(filters, limit)["tasks"]
+
+
+# -------------------------------------------------------------------- getters
+def get_node(node_id: str, address: Optional[str] = None) -> Optional[dict]:
+    for row in list_nodes(address):
+        if row["node_id"] == node_id:
+            return row
+    return None
+
+
+def get_actor(actor_id: str, address: Optional[str] = None) -> Optional[dict]:
+    for row in list_actors(address):
+        if row["actor_id"] == actor_id:
+            return row
+    return None
+
+
+def get_task(task_id: str, address: Optional[str] = None) -> Optional[dict]:
+    rows = list_tasks(address, filters={"task_id": task_id}, limit=1)
+    return rows[0] if rows else None
+
+
+# ----------------------------------------------------------------- summaries
+def summarize_tasks(address: Optional[str] = None) -> Dict[str, Any]:
+    """Per-function-name × state counts (``ray summary tasks`` analog)."""
+    tasks = list_tasks(address, limit=100000)
+    by_name: Dict[str, Counter] = {}
+    for t in tasks:
+        by_name.setdefault(t["name"], Counter())[t["state"]] += 1
+    return {
+        "total": len(tasks),
+        "by_name": {k: dict(v) for k, v in sorted(by_name.items())},
+    }
+
+
+def summarize_actors(address: Optional[str] = None) -> Dict[str, Any]:
+    actors = list_actors(address)
+    states = Counter(a["state"] for a in actors)
+    return {"total": len(actors), "by_state": dict(states)}
+
+
+# ------------------------------------------------------------------ timeline
+def chrome_trace_events(reply: dict) -> List[dict]:
+    """Convert a ``list_task_events`` reply into Chrome-trace 'X' events
+    (``ray timeline`` format; reference ``python/ray/_private/state.py:527``)."""
+    events = []
+    for t in reply["tasks"]:
+        ts = t["state_ts"]
+        start = ts.get("RUNNING")
+        if start is None:
+            continue
+        end = ts.get("FINISHED") or ts.get("FAILED") or start
+        events.append(
+            {
+                "name": t["name"],
+                "cat": "task",
+                "ph": "X",
+                "ts": start * 1e6,
+                "dur": max(0.0, end - start) * 1e6,
+                "pid": "node:" + (t["node_id"] or "?")[:8],
+                "tid": "worker:" + (t["worker_id"] or "?")[:8],
+                "args": {
+                    "task_id": t["task_id"],
+                    "state": t["state"],
+                    "error": t.get("error"),
+                },
+            }
+        )
+    for p in reply.get("profile_events", ()):
+        events.append(
+            {
+                "name": p["name"],
+                "cat": "profile",
+                "ph": "X",
+                "ts": p["start"] * 1e6,
+                "dur": max(0.0, p["end"] - p["start"]) * 1e6,
+                "pid": "node:" + (p["node_id"] or "?")[:8],
+                "tid": "worker:" + (p["worker_id"] or "?")[:8],
+                "args": p.get("extra") or {},
+            }
+        )
+    return events
