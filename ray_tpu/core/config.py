@@ -57,6 +57,8 @@ _KNOBS: Dict[str, tuple] = {
     "actor_max_restarts_default": (int, 0, "Default actor restarts"),
     # -- TPU --
     "tpu_visible_chips_env": (str, "TPU_VISIBLE_CHIPS", "Env var used for chip isolation"),
+    # -- data --
+    "data_max_tasks_per_op": (int, 8, "Streaming executor in-flight cap per op"),
     # -- task events / observability --
     "enable_task_events": (bool, True, "Record task lifecycle events"),
     "task_events_flush_period_s": (float, 0.5, "Worker buffer flush period"),

@@ -1,10 +1,25 @@
+from .aggregate import (  # noqa: F401
+    AggregateFn,
+    Count,
+    GroupedData,
+    Max,
+    Mean,
+    Min,
+    Std,
+    Sum,
+)
+from .datasource import Datasource, ReadTask  # noqa: F401
+from .execution import ActorPoolStrategy  # noqa: F401
 from .dataset import (  # noqa: F401
     DataIterator,
     Dataset,
     from_items,
     range_dataset,
+    read_binary_files,
     read_csv,
+    read_datasource,
     read_json,
     read_numpy,
     read_parquet,
+    read_text,
 )

@@ -1,0 +1,198 @@
+"""Aggregations and grouped datasets.
+
+Reference: ray ``python/ray/data/aggregate.py`` (AggregateFn, Count/Sum/…)
+and ``grouped_data.py`` (GroupedData over a hash shuffle).  Aggregations are
+(init, accumulate, merge, finalize) quadruples so they distribute: map tasks
+pre-aggregate per block, reducers merge partials.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Optional, Union
+
+from .block import row_key
+
+
+class AggregateFn:
+    def __init__(
+        self,
+        init: Callable[[], Any],
+        accumulate: Callable[[Any, Any], Any],
+        merge: Callable[[Any, Any], Any],
+        finalize: Callable[[Any], Any] = lambda a: a,
+        name: str = "agg",
+    ):
+        self.init = init
+        self.accumulate = accumulate
+        self.merge = merge
+        self.finalize = finalize
+        self.name = name
+
+
+def _on(on: Union[str, Callable, None]):
+    return lambda row: row_key(row, on)
+
+
+class Count(AggregateFn):
+    def __init__(self):
+        super().__init__(
+            init=lambda: 0,
+            accumulate=lambda a, r: a + 1,
+            merge=lambda a, b: a + b,
+            name="count()",
+        )
+
+
+class Sum(AggregateFn):
+    def __init__(self, on=None):
+        get = _on(on)
+        super().__init__(
+            init=lambda: 0,
+            accumulate=lambda a, r: a + get(r),
+            merge=lambda a, b: a + b,
+            name=f"sum({on})",
+        )
+
+
+class Min(AggregateFn):
+    def __init__(self, on=None):
+        get = _on(on)
+        super().__init__(
+            init=lambda: None,
+            accumulate=lambda a, r: get(r) if a is None else min(a, get(r)),
+            merge=lambda a, b: b if a is None else (a if b is None else min(a, b)),
+            name=f"min({on})",
+        )
+
+
+class Max(AggregateFn):
+    def __init__(self, on=None):
+        get = _on(on)
+        super().__init__(
+            init=lambda: None,
+            accumulate=lambda a, r: get(r) if a is None else max(a, get(r)),
+            merge=lambda a, b: b if a is None else (a if b is None else max(a, b)),
+            name=f"max({on})",
+        )
+
+
+class Mean(AggregateFn):
+    def __init__(self, on=None):
+        get = _on(on)
+        super().__init__(
+            init=lambda: (0, 0.0),
+            accumulate=lambda a, r: (a[0] + 1, a[1] + get(r)),
+            merge=lambda a, b: (a[0] + b[0], a[1] + b[1]),
+            finalize=lambda a: a[1] / a[0] if a[0] else None,
+            name=f"mean({on})",
+        )
+
+
+class Std(AggregateFn):
+    """Parallel variance via Chan et al. pairwise merge."""
+
+    def __init__(self, on=None, ddof: int = 1):
+        get = _on(on)
+
+        def merge(a, b):
+            (n1, m1, s1), (n2, m2, s2) = a, b
+            if n1 == 0:
+                return b
+            if n2 == 0:
+                return a
+            n = n1 + n2
+            d = m2 - m1
+            m = m1 + d * n2 / n
+            s = s1 + s2 + d * d * n1 * n2 / n
+            return (n, m, s)
+
+        def acc(a, r):
+            return merge(a, (1, float(get(r)), 0.0))
+
+        super().__init__(
+            init=lambda: (0, 0.0, 0.0),
+            accumulate=acc,
+            merge=merge,
+            finalize=lambda a: (
+                math.sqrt(a[2] / (a[0] - ddof)) if a[0] > ddof else None
+            ),
+            name=f"std({on})",
+        )
+
+
+def aggregate_block(block, key, aggs) -> dict:
+    """Per-block partial aggregation: key -> [partial per agg]."""
+    partials: dict = {}
+    for row in block:
+        k = row_key(row, key) if key is not None else None
+        accs = partials.get(k)
+        if accs is None:
+            accs = [a.init() for a in aggs]
+            partials[k] = accs
+        for i, a in enumerate(aggs):
+            accs[i] = a.accumulate(accs[i], row)
+    return partials
+
+
+def merge_partials(parts, aggs) -> dict:
+    merged: dict = {}
+    for p in parts:
+        for k, accs in p.items():
+            cur = merged.get(k)
+            if cur is None:
+                merged[k] = list(accs)
+            else:
+                for i, a in enumerate(aggs):
+                    cur[i] = a.merge(cur[i], accs[i])
+    return merged
+
+
+def finalize_partials(merged, key, aggs):
+    """merged key->accs → list of result rows."""
+    rows = []
+    for k in sorted(merged.keys(), key=lambda x: (x is None, x)):
+        accs = merged[k]
+        vals = [a.finalize(acc) for a, acc in zip(aggs, accs)]
+        if key is None:
+            rows.append(vals[0] if len(vals) == 1 else tuple(vals))
+        else:
+            row = {key if isinstance(key, str) else "key": k}
+            for a, v in zip(aggs, vals):
+                row[a.name] = v
+            rows.append(row)
+    return rows
+
+
+class GroupedData:
+    """Returned by ``Dataset.groupby`` (reference
+    ``python/ray/data/grouped_data.py``)."""
+
+    def __init__(self, dataset, key: Union[str, Callable]):
+        self._dataset = dataset
+        self._key = key
+
+    def aggregate(self, *aggs: AggregateFn):
+        return self._dataset._groupby_aggregate(self._key, list(aggs))
+
+    def count(self):
+        return self.aggregate(Count())
+
+    def sum(self, on=None):
+        return self.aggregate(Sum(on))
+
+    def min(self, on=None):
+        return self.aggregate(Min(on))
+
+    def max(self, on=None):
+        return self.aggregate(Max(on))
+
+    def mean(self, on=None):
+        return self.aggregate(Mean(on))
+
+    def std(self, on=None, ddof: int = 1):
+        return self.aggregate(Std(on, ddof))
+
+    def map_groups(self, fn: Callable[[list], list]):
+        """Shuffle rows by key, then apply ``fn`` to each key's row list."""
+        return self._dataset._map_groups(self._key, fn)

@@ -1,0 +1,92 @@
+"""Block utilities.
+
+A *block* is the unit of parallelism: a list of rows, where a row is a dict
+of column values or a bare scalar/array (reference: ray
+``python/ray/data/block.py`` — there blocks are Arrow tables; lists of rows
+keep zero-copy numpy batches available without an Arrow dependency on the
+hot path).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Union
+
+import numpy as np
+
+Block = List[Any]
+Batch = Union[List[Any], Dict[str, np.ndarray], np.ndarray]
+
+
+def to_batch(rows: Block, batch_format: str) -> Batch:
+    """Assemble a list of rows into the requested batch format.
+
+    ``"default"`` → the row list; ``"numpy"`` → dict of stacked column
+    arrays for dict rows, or one stacked array for scalar/array rows (the
+    shape trainers feed to jax.device_put).
+    """
+    if batch_format in ("default", "list"):
+        return rows
+    if batch_format == "numpy":
+        if not rows:
+            return {}
+        if isinstance(rows[0], dict):
+            return {
+                k: np.asarray([r[k] for r in rows]) for k in rows[0].keys()
+            }
+        return np.asarray(rows)
+    raise ValueError(f"unknown batch_format {batch_format!r}")
+
+
+def from_batch(batch: Batch) -> Block:
+    """Inverse of ``to_batch`` for map_batches UDFs that return numpy."""
+    if isinstance(batch, dict):
+        cols = list(batch.keys())
+        if not cols:
+            return []
+        n = len(batch[cols[0]])
+        return [{k: batch[k][i] for k in cols} for i in range(n)]
+    if isinstance(batch, np.ndarray):
+        return list(batch)
+    return list(batch)
+
+
+def block_num_rows(block: Block) -> int:
+    return len(block)
+
+
+def row_key(row: Any, key: Union[str, callable, None]):
+    """Resolve a sort/group key: column name for dict rows, callable, or
+    identity."""
+    if key is None:
+        return row
+    if callable(key):
+        return key(row)
+    return row[key]
+
+
+def stable_hash(value: Any) -> int:
+    """Process-independent hash for exchange partitioning.  Python's builtin
+    ``hash`` is seed-randomized per process for str/bytes, which would send
+    the same key to different reducers from different map workers."""
+    import hashlib
+    import pickle
+
+    if isinstance(value, str):
+        data = b"s" + value.encode()
+    elif isinstance(value, bytes):
+        data = b"b" + value
+    elif isinstance(value, bool):
+        data = b"o" + bytes([value])
+    elif isinstance(value, int):
+        data = b"i" + str(value).encode()
+    elif isinstance(value, float):
+        data = b"f" + repr(value).encode()
+    elif value is None:
+        data = b"n"
+    elif isinstance(value, tuple):
+        data = b"t" + b"|".join(
+            str(stable_hash(v)).encode() for v in value
+        )
+    else:
+        data = b"p" + pickle.dumps(value)
+    return int.from_bytes(hashlib.blake2b(data, digest_size=8).digest(), "big")

@@ -1,119 +1,374 @@
-"""Streaming datasets — the Ray Data equivalent (subset).
+"""Streaming datasets — the Ray Data equivalent.
 
-Reference architecture (ray ``python/ray/data/``): lazy logical plan over
-*blocks* stored in the object store, executed by parallel tasks, consumed by
-trainers via ``streaming_split`` per-worker shards.  This is the round-1
-subset of that design (SURVEY.md §7: "streaming executor subset:
-read→map→shuffle→split ingest"):
+Reference architecture (ray ``python/ray/data/dataset.py:184``): a lazy
+plan over *blocks* in the object store, executed by a pull-based streaming
+executor (``execution.py`` here; reference ``_internal/execution/
+streaming_executor.py:67``), with narrow transforms fused and wide ops
+(shuffle/sort/groupby/repartition) as distributed hash exchanges, consumed
+by trainers via ``streaming_split`` per-worker shards (reference
+``dataset.py:1881``).
 
-  - a Dataset is a list of block ObjectRefs + a chain of pending per-block
-    transforms (fused and applied lazily, in parallel, by remote tasks);
-  - wide ops (shuffle, repartition) materialize;
-  - ``streaming_split(n)`` gives each training worker a DataIterator that
-    pulls only its own blocks and applies the transform chain on the fly —
-    blocks stay in shared memory until iterated.
-
-TPU note: ``iter_batches`` yields contiguous numpy batches sized for the
-step; device placement (host→HBM) belongs to the training loop so transfers
-overlap with compute.
+TPU note: ``iter_batches(batch_format="numpy")`` yields stacked column
+arrays ready for ``jax.device_put``; device placement belongs to the train
+loop so host→HBM transfers overlap compute.
 """
 
 from __future__ import annotations
 
 import random as _random
-from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
+from bisect import bisect_left
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Union
 
 import numpy as np
 
 import ray_tpu
 
-Block = List[Any]  # a block is a list of rows (dicts or scalars)
-
-
-def _apply_chain(block: Block, transforms) -> Block:
-    for t in transforms:
-        block = t(block)
-    return block
+from .aggregate import (
+    AggregateFn,
+    GroupedData,
+    aggregate_block,
+    finalize_partials,
+    merge_partials,
+)
+from .block import Block, from_batch, row_key, stable_hash, to_batch
+from .datasource import (
+    BinaryFilesDatasource,
+    CSVDatasource,
+    Datasource,
+    ItemsDatasource,
+    JSONDatasource,
+    NumpyDatasource,
+    ParquetDatasource,
+    RangeDatasource,
+    ReadTask,
+    TextDatasource,
+    write_block_csv,
+    write_block_json,
+    write_block_parquet,
+)
+from .execution import (
+    ActorPoolStrategy,
+    AllToAllStage,
+    LimitStage,
+    MapStage,
+    OpStats,
+    StreamingExecutor,
+    _ensure_refs,
+    _run_item,
+    apply_chain,
+)
 
 
 @ray_tpu.remote
-def _transform_block(block: Block, transforms) -> Block:
-    return _apply_chain(block, transforms)
+def _write_block(item, transforms, writer, path: str) -> dict:
+    block = apply_chain(item, transforms)
+    writer(block, path)
+    return {"path": path, "num_rows": len(block)}
 
 
 class Dataset:
-    def __init__(self, block_refs: List, transforms: Optional[List] = None):
-        self._block_refs = list(block_refs)
-        self._transforms = list(transforms or [])
+    """A lazy, distributed collection of rows."""
+
+    def __init__(self, inputs: List[Any], stages: Optional[List[Any]] = None):
+        self._inputs = list(inputs)  # ObjectRefs and/or ReadTasks
+        self._stages = list(stages or [])
+        self._last_stats: List[OpStats] = []
+
+    # ---------------------------------------------------------- plan builder
+    def _with_stage(self, stage) -> "Dataset":
+        return Dataset(self._inputs, self._stages + [stage])
+
+    def _narrow(self, name: str, fn: Callable[[Block], Block],
+                compute=None) -> "Dataset":
+        return self._with_stage(MapStage([fn], [name], compute))
 
     # ------------------------------------------------------------ transforms
-    def _chain(self, fn) -> "Dataset":
-        return Dataset(self._block_refs, self._transforms + [fn])
-
     def map(self, fn: Callable[[Any], Any]) -> "Dataset":
-        return self._chain(lambda block: [fn(r) for r in block])
+        return self._narrow("Map", lambda block: [fn(r) for r in block])
 
     def filter(self, fn: Callable[[Any], bool]) -> "Dataset":
-        return self._chain(lambda block: [r for r in block if fn(r)])
+        return self._narrow("Filter", lambda block: [r for r in block if fn(r)])
 
     def flat_map(self, fn: Callable[[Any], Sequence[Any]]) -> "Dataset":
-        return self._chain(
-            lambda block: [o for r in block for o in fn(r)]
+        return self._narrow(
+            "FlatMap", lambda block: [o for r in block for o in fn(r)]
         )
 
-    def map_batches(self, fn: Callable[[Block], Block]) -> "Dataset":
-        return self._chain(lambda block: list(fn(block)))
+    def map_batches(
+        self,
+        fn: Callable,
+        *,
+        batch_format: str = "default",
+        compute: Optional[ActorPoolStrategy] = None,
+        fn_constructor_args: Optional[tuple] = None,
+    ) -> "Dataset":
+        """Apply ``fn`` per block.  ``batch_format="numpy"`` converts blocks
+        to dict-of-arrays for the UDF and back.  ``compute=ActorPoolStrategy``
+        runs the UDF in a pool of actors (stateful/expensive setup, e.g. a
+        loaded model); a *class* UDF is constructed once per actor."""
+        if isinstance(fn, type):
+            ctor_args = fn_constructor_args or ()
+            cls = fn
 
-    # ------------------------------------------------------------- wide ops
-    def materialize(self) -> "Dataset":
-        """Execute pending transforms in parallel (one task per block)."""
-        if not self._transforms:
-            return self
-        refs = [
-            _transform_block.remote(b, self._transforms)
-            for b in self._block_refs
-        ]
-        return Dataset(refs, [])
+            class _Stateful:
+                _instance = None
 
+                @staticmethod
+                def apply(block):
+                    if _Stateful._instance is None:
+                        _Stateful._instance = cls(*ctor_args)
+                    return _Stateful._instance(block)
+
+            call = _Stateful.apply
+        else:
+            call = fn
+
+        def transform(block: Block) -> Block:
+            batch = to_batch(block, batch_format)
+            out = call(batch)
+            return from_batch(out)
+
+        return self._narrow("MapBatches", transform, compute)
+
+    def add_column(self, name: str, fn: Callable[[dict], Any]) -> "Dataset":
+        def add(row):
+            row = dict(row)
+            row[name] = fn(row)
+            return row
+
+        return self.map(add)
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        return self.map(lambda r: {c: r[c] for c in cols})
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        drop = set(cols)
+        return self.map(lambda r: {k: v for k, v in r.items() if k not in drop})
+
+    def limit(self, n: int) -> "Dataset":
+        """Global row limit; the pull-based executor stops upstream work
+        once n rows have been emitted."""
+        return self._with_stage(LimitStage(n))
+
+    # --------------------------------------------------------------- wide ops
     def repartition(self, num_blocks: int) -> "Dataset":
-        rows = self.take_all()
-        return from_items(rows, parallelism=num_blocks)
+        return self._with_stage(
+            AllToAllStage(
+                "Repartition",
+                num_blocks,
+                part_fn=lambda row, i, bidx: (bidx * 1000003 + i) % num_blocks,
+            )
+        )
 
     def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
-        rows = self.take_all()
-        rng = _random.Random(seed)
-        rng.shuffle(rows)
-        return from_items(rows, parallelism=max(1, len(self._block_refs)))
+        base = seed if seed is not None else _random.randrange(1 << 30)
+
+        def part(row, i, bidx):
+            return _random.Random(base * 1000003 + bidx * 8191 + i).randrange(
+                1 << 30
+            )
+
+        def reduce_fn(rows, ridx):
+            _random.Random(base * 7919 + ridx).shuffle(rows)
+            return rows
+
+        return self._with_stage(
+            AllToAllStage("RandomShuffle", None, part, reduce_fn)
+        )
+
+    def sort(self, key: Union[str, Callable, None] = None,
+             descending: bool = False) -> "Dataset":
+        """Distributed sample-partitioned sort (reference
+        ``data/_internal/planner/exchange/sort_task_spec.py``)."""
+
+        def prepare(refs):
+            # Sample keys to pick range boundaries.
+            sample_refs = [
+                _run_item.remote(
+                    r,
+                    [lambda b: sorted(row_key(x, key) for x in b[:: max(1, len(b) // 20)])],
+                )
+                for r in refs
+            ]
+            keys = sorted(
+                k for s in ray_tpu.get(sample_refs, timeout=300) for k in s
+            )
+            n_out = max(1, len(refs))
+            bounds = [
+                keys[int(len(keys) * (i + 1) / n_out)]
+                for i in range(n_out - 1)
+            ] if keys else []
+            return {"bounds": bounds}
+
+        def part(row, i, bidx, bounds=None):
+            return bisect_left(bounds, row_key(row, key)) if bounds else 0
+
+        def reduce_fn(rows, ridx):
+            rows.sort(key=lambda r: row_key(r, key), reverse=descending)
+            return rows
+
+        # Partitions ascend by boundary; for descending order each reducer
+        # sorts descending and the stage emits reducers in reverse order.
+        stage = AllToAllStage(
+            "Sort", None, part, reduce_fn, prepare=prepare,
+            reverse_out=descending,
+        )
+        return self._with_stage(stage)
+
+    def _groupby_aggregate(self, key, aggs: List[AggregateFn]) -> "Dataset":
+        def part(row, i, bidx):
+            return stable_hash(row_key(row, key))
+
+        def reduce_fn(rows, ridx):
+            partials = aggregate_block(rows, key, aggs)
+            merged = merge_partials([partials], aggs)
+            return finalize_partials(merged, key, aggs)
+
+        return self._with_stage(
+            AllToAllStage(f"GroupBy({key})", None, part, reduce_fn)
+        )
+
+    def _map_groups(self, key, fn: Callable[[list], list]) -> "Dataset":
+        def part(row, i, bidx):
+            return stable_hash(row_key(row, key))
+
+        def reduce_fn(rows, ridx):
+            groups: Dict[Any, list] = {}
+            for r in rows:
+                groups.setdefault(row_key(r, key), []).append(r)
+            out = []
+            for k in sorted(groups.keys(), key=lambda x: (x is None, x)):
+                out.extend(fn(groups[k]))
+            return out
+
+        return self._with_stage(
+            AllToAllStage(f"MapGroups({key})", None, part, reduce_fn)
+        )
+
+    def groupby(self, key: Union[str, Callable]) -> GroupedData:
+        return GroupedData(self, key)
+
+    def aggregate(self, *aggs: AggregateFn):
+        """Global (ungrouped) aggregation, returned as a plain value."""
+        try:
+            chain = self._narrow_chain()
+            items = self._frontier()
+        except ValueError:  # wide plan: materialize first
+            chain = []
+            items = list(self._execute())
+        partial_refs = [
+            _run_item.remote(item, chain + [
+                lambda b, aggs=aggs: [aggregate_block(b, None, list(aggs))]
+            ])
+            for item in items
+        ]
+        partials = [
+            p[0] for p in ray_tpu.get(partial_refs, timeout=600)
+        ]
+        merged = merge_partials(partials, list(aggs))
+        rows = finalize_partials(merged, None, list(aggs))
+        return rows[0] if rows else None
+
+    def sum(self, on=None):
+        from .aggregate import Sum
+
+        return self.aggregate(Sum(on))
+
+    def min(self, on=None):
+        from .aggregate import Min
+
+        return self.aggregate(Min(on))
+
+    def max(self, on=None):
+        from .aggregate import Max
+
+        return self.aggregate(Max(on))
+
+    def mean(self, on=None):
+        from .aggregate import Mean
+
+        return self.aggregate(Mean(on))
+
+    def std(self, on=None, ddof: int = 1):
+        from .aggregate import Std
+
+        return self.aggregate(Std(on, ddof))
 
     def union(self, other: "Dataset") -> "Dataset":
-        a = self.materialize()
-        b = other.materialize()
-        return Dataset(a._block_refs + b._block_refs, [])
+        a, b = self.materialize(), other.materialize()
+        return Dataset(a._inputs + b._inputs, [])
 
-    def sort(self, key: Callable = None) -> "Dataset":
-        rows = sorted(self.take_all(), key=key)
-        return from_items(rows, parallelism=max(1, len(self._block_refs)))
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Barrier: pairs rows positionally into (left, right) tuples (or
+        merged dicts when both sides are dicts)."""
+        left, right = self.take_all(), other.take_all()
+        if len(left) != len(right):
+            raise ValueError(
+                f"zip requires equal row counts: {len(left)} vs {len(right)}"
+            )
+        rows = [
+            {**l, **r} if isinstance(l, dict) and isinstance(r, dict) else (l, r)
+            for l, r in zip(left, right)
+        ]
+        return from_items(rows, parallelism=max(1, len(self._inputs)))
 
-    # ------------------------------------------------------------ consumers
+    # -------------------------------------------------------------- execution
+    def _execute(self) -> Iterator:
+        """Stream block refs out of the plan."""
+        ex = StreamingExecutor(self._inputs, self._stages)
+        stream = ex.run()
+        self._last_stats = ex.stats
+        return stream
+
+    def _narrow_chain(self) -> List[Callable]:
+        """The plan's transforms when it is purely narrow (no wide stages,
+        task compute only); raises otherwise."""
+        chain: List[Callable] = []
+        for st in self._stages:
+            if not isinstance(st, MapStage) or st.compute is not None:
+                raise ValueError("plan has wide/actor stages")
+            chain.extend(st.transforms)
+        return chain
+
+    def _frontier(self) -> List[Any]:
+        return list(self._inputs)
+
+    def materialize(self) -> "Dataset":
+        """Execute the full plan; the result holds only block refs."""
+        refs = list(self._execute())
+        ds = Dataset(refs, [])
+        ds._last_stats = self._last_stats
+        return ds
+
+    def stats(self) -> str:
+        if not self._last_stats:
+            return "(not executed yet)"
+        return "\n".join(repr(s) for s in self._last_stats)
+
+    # ------------------------------------------------------------- consumers
     def iter_blocks(self) -> Iterator[Block]:
-        for ref in self._block_refs:
-            block = ray_tpu.get(ref, timeout=300)
-            yield _apply_chain(block, self._transforms)
+        for ref in self._execute():
+            yield ray_tpu.get(ref, timeout=600)
 
     def iter_rows(self) -> Iterator[Any]:
         for block in self.iter_blocks():
             yield from block
 
-    def iter_batches(self, batch_size: int = 256,
-                     drop_last: bool = False) -> Iterator[Block]:
+    def iter_batches(
+        self,
+        batch_size: int = 256,
+        *,
+        batch_format: str = "default",
+        drop_last: bool = False,
+    ) -> Iterator:
         buf: Block = []
         for block in self.iter_blocks():
             buf.extend(block)
             while len(buf) >= batch_size:
-                yield buf[:batch_size]
+                yield to_batch(buf[:batch_size], batch_format)
                 buf = buf[batch_size:]
         if buf and not drop_last:
-            yield buf
+            yield to_batch(buf, batch_format)
 
     def take(self, n: int = 20) -> Block:
         out: Block = []
@@ -127,18 +382,23 @@ class Dataset:
         return list(self.iter_rows())
 
     def count(self) -> int:
-        if not self._transforms:
-            # Fast path: count rows per block remotely.
-            counts = ray_tpu.get(
-                [_transform_block.remote(b, [lambda blk: [len(blk)]])
-                 for b in self._block_refs],
-                timeout=300,
-            )
-            return sum(c[0] for c in counts)
-        return sum(1 for _ in self.iter_rows())
+        if not self._stages:
+            known = [
+                i.metadata.get("num_rows")
+                for i in self._inputs
+                if isinstance(i, ReadTask)
+            ]
+            if len(known) == len(self._inputs) and all(
+                k is not None for k in known
+            ):
+                return sum(known)
+        counted = self._with_stage(
+            MapStage([lambda b: [len(b)]], ["Count"])
+        )
+        return sum(c[0] for c in counted.iter_blocks())
 
     def num_blocks(self) -> int:
-        return len(self._block_refs)
+        return len(self._inputs)
 
     def schema(self):
         first = self.take(1)
@@ -149,13 +409,57 @@ class Dataset:
             return {k: type(v).__name__ for k, v in row.items()}
         return type(row).__name__
 
+    def columns(self) -> Optional[List[str]]:
+        s = self.schema()
+        return list(s.keys()) if isinstance(s, dict) else None
+
+    # ----------------------------------------------------------------- writes
+    def _write(self, writer, dir_path: str, ext: str) -> List[str]:
+        import os
+
+        os.makedirs(dir_path, exist_ok=True)
+        try:
+            chain = self._narrow_chain()
+            items = self._frontier()
+        except ValueError:
+            chain = []
+            items = list(self._execute())
+        refs = [
+            _write_block.remote(
+                item, chain, writer,
+                os.path.join(dir_path, f"block-{i:05d}{ext}"),
+            )
+            for i, item in enumerate(items)
+        ]
+        return [m["path"] for m in ray_tpu.get(refs, timeout=600)]
+
+    def write_parquet(self, dir_path: str) -> List[str]:
+        return self._write(write_block_parquet, dir_path, ".parquet")
+
+    def write_csv(self, dir_path: str) -> List[str]:
+        return self._write(write_block_csv, dir_path, ".csv")
+
+    def write_json(self, dir_path: str) -> List[str]:
+        return self._write(write_block_json, dir_path, ".jsonl")
+
     # --------------------------------------------------------------- splits
     def split(self, n: int) -> List["Dataset"]:
-        """Split blocks round-robin into n datasets."""
+        """Split into n datasets.  A purely-narrow plan splits its *source*
+        blocks and each shard re-applies the (lazy) chain; otherwise the
+        plan is executed first."""
+        try:
+            chain = self._narrow_chain()
+            items = self._frontier()
+            refs = _ensure_refs(items, [])
+            stages = self._stages
+        except ValueError:
+            refs = list(self._execute())
+            stages = []
+            chain = []
         groups: List[List] = [[] for _ in range(n)]
-        for i, ref in enumerate(self._block_refs):
+        for i, ref in enumerate(refs):
             groups[i % n].append(ref)
-        return [Dataset(g, self._transforms) for g in groups]
+        return [Dataset(g, stages) for g in groups]
 
     def streaming_split(self, n: int) -> List["DataIterator"]:
         """Per-trainer shards (reference: ray ``data/dataset.py:1881``)."""
@@ -163,19 +467,25 @@ class Dataset:
 
     def __repr__(self):
         return (
-            f"Dataset(blocks={len(self._block_refs)}, "
-            f"pending_transforms={len(self._transforms)})"
+            f"Dataset(blocks={len(self._inputs)}, "
+            f"stages={[getattr(s, 'name', '?') for s in self._stages]})"
         )
 
 
 class DataIterator:
-    """A consumable shard handed to one training worker."""
+    """A consumable shard handed to one training worker.  Pickles the
+    shard's block refs + lazy transform chain; transforms run in the
+    consuming worker (data-local, reference
+    ``_internal/iterator/stream_split_iterator.py:35``)."""
 
     def __init__(self, dataset: Dataset):
         self._dataset = dataset
 
-    def iter_batches(self, batch_size: int = 256, drop_last: bool = False):
-        return self._dataset.iter_batches(batch_size, drop_last)
+    def iter_batches(self, batch_size: int = 256, *, batch_format: str = "default",
+                     drop_last: bool = False):
+        return self._dataset.iter_batches(
+            batch_size, batch_format=batch_format, drop_last=drop_last
+        )
 
     def iter_rows(self):
         return self._dataset.iter_rows()
@@ -188,49 +498,38 @@ class DataIterator:
 
 
 # ------------------------------------------------------------------ sources
+def read_datasource(ds: Datasource, parallelism: int = 8) -> Dataset:
+    return Dataset(ds.get_read_tasks(parallelism), [])
+
+
 def from_items(items: Sequence[Any], parallelism: int = 8) -> Dataset:
-    items = list(items)
-    n = max(1, min(parallelism, len(items) or 1))
-    size = (len(items) + n - 1) // n
-    refs = [
-        ray_tpu.put(items[i * size : (i + 1) * size]) for i in range(n)
-    ]
-    return Dataset([r for r in refs], [])
+    return read_datasource(ItemsDatasource(items), parallelism)
 
 
 def range_dataset(n: int, parallelism: int = 8) -> Dataset:
-    return from_items(list(range(n)), parallelism)
+    return read_datasource(RangeDatasource(n), parallelism)
 
 
 def read_numpy(arrays: Dict[str, np.ndarray], parallelism: int = 8) -> Dataset:
-    """Rows are dicts of per-column values."""
-    n_rows = len(next(iter(arrays.values())))
-    rows = [{k: v[i] for k, v in arrays.items()} for i in range(n_rows)]
-    return from_items(rows, parallelism)
+    return read_datasource(NumpyDatasource(arrays), parallelism)
 
 
-def read_parquet(path: str, parallelism: int = 8) -> Dataset:
-    import pyarrow.parquet as pq
-
-    table = pq.read_table(path)
-    return from_items(table.to_pylist(), parallelism)
+def read_parquet(path: str, parallelism: int = 8,
+                 columns: Optional[List[str]] = None) -> Dataset:
+    return read_datasource(ParquetDatasource(path, columns), parallelism)
 
 
 def read_csv(path: str, parallelism: int = 8) -> Dataset:
-    import csv
-
-    with open(path) as f:
-        rows = list(csv.DictReader(f))
-    return from_items(rows, parallelism)
+    return read_datasource(CSVDatasource(path), parallelism)
 
 
 def read_json(path: str, parallelism: int = 8) -> Dataset:
-    import json
+    return read_datasource(JSONDatasource(path), parallelism)
 
-    rows = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if line:
-                rows.append(json.loads(line))
-    return from_items(rows, parallelism)
+
+def read_binary_files(path: str, parallelism: int = 8) -> Dataset:
+    return read_datasource(BinaryFilesDatasource(path), parallelism)
+
+
+def read_text(path: str, parallelism: int = 8) -> Dataset:
+    return read_datasource(TextDatasource(path), parallelism)

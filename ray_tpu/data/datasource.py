@@ -1,0 +1,272 @@
+"""Datasources: lazy read tasks + file-based write paths.
+
+Reference: ray ``python/ray/data/datasource/`` — a ``Datasource`` yields
+``ReadTask``s (serializable zero-arg callables producing blocks) so reads
+execute *inside remote tasks*, in parallel, instead of on the driver; writes
+emit one file per block via remote tasks.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .block import Block
+
+
+class ReadTask:
+    """A serializable unit of read work (reference ``ReadTask``:
+    ``python/ray/data/datasource/datasource.py``)."""
+
+    def __init__(self, fn: Callable[[], Block], metadata: Optional[dict] = None):
+        self._fn = fn
+        self.metadata = metadata or {}
+
+    def __call__(self) -> Block:
+        return self._fn()
+
+
+class Datasource:
+    """ABC: implement ``get_read_tasks(parallelism)``."""
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        raise NotImplementedError
+
+    def estimate_inmemory_data_size(self) -> Optional[int]:
+        return None
+
+
+# ----------------------------------------------------------------- in-memory
+class ItemsDatasource(Datasource):
+    def __init__(self, items: Sequence[Any]):
+        self._items = list(items)
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        items = self._items
+        n = max(1, min(parallelism, len(items) or 1))
+        size = (len(items) + n - 1) // n
+        tasks = []
+        for i in range(n):
+            chunk = items[i * size : (i + 1) * size]
+            tasks.append(
+                ReadTask(lambda c=chunk: list(c), {"num_rows": len(chunk)})
+            )
+        return tasks
+
+
+class RangeDatasource(Datasource):
+    def __init__(self, n: int):
+        self._n = n
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        n = self._n
+        k = max(1, min(parallelism, n or 1))
+        size = (n + k - 1) // k
+        tasks = []
+        for i in range(k):
+            lo, hi = i * size, min((i + 1) * size, n)
+            if lo >= hi:
+                continue
+            tasks.append(
+                ReadTask(
+                    lambda a=lo, b=hi: list(range(a, b)),
+                    {"num_rows": hi - lo},
+                )
+            )
+        return tasks
+
+
+class NumpyDatasource(Datasource):
+    """Columnar dict of arrays → row blocks."""
+
+    def __init__(self, arrays: Dict[str, np.ndarray]):
+        self._arrays = {k: np.asarray(v) for k, v in arrays.items()}
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        arrays = self._arrays
+        n_rows = len(next(iter(arrays.values()))) if arrays else 0
+        k = max(1, min(parallelism, n_rows or 1))
+        size = (n_rows + k - 1) // k
+        tasks = []
+        for i in range(k):
+            lo, hi = i * size, min((i + 1) * size, n_rows)
+            if lo >= hi:
+                continue
+            chunk = {c: v[lo:hi] for c, v in arrays.items()}
+            tasks.append(
+                ReadTask(
+                    lambda ch=chunk: [
+                        {c: v[j] for c, v in ch.items()}
+                        for j in range(len(next(iter(ch.values()))))
+                    ],
+                    {"num_rows": hi - lo},
+                )
+            )
+        return tasks
+
+
+# --------------------------------------------------------------------- files
+def _expand_paths(path: str, suffix: str = "") -> List[str]:
+    """A path may be a file, a directory, or a glob."""
+    if os.path.isdir(path):
+        return sorted(
+            _glob.glob(os.path.join(path, f"*{suffix}" if suffix else "*"))
+        )
+    matches = sorted(_glob.glob(path))
+    return matches or [path]
+
+
+class ParquetDatasource(Datasource):
+    """One read task per file (row-group granularity when a single file)."""
+
+    def __init__(self, path: str, columns: Optional[List[str]] = None):
+        self._paths = _expand_paths(path, ".parquet")
+        self._columns = columns
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        cols = self._columns
+        if len(self._paths) == 1 and parallelism > 1:
+            import pyarrow.parquet as pq
+
+            # Split one file by row group so a single large file still
+            # parallelizes.
+            path = self._paths[0]
+            n_groups = pq.ParquetFile(path).num_row_groups
+            tasks = []
+            for g in range(n_groups):
+                def read(p=path, grp=g):
+                    import pyarrow.parquet as pq  # noqa: PLC0415
+
+                    return pq.ParquetFile(p).read_row_group(
+                        grp, columns=cols
+                    ).to_pylist()
+
+                tasks.append(ReadTask(read, {"path": path, "row_group": g}))
+            return tasks
+        tasks = []
+        for path in self._paths:
+            def read(p=path):
+                import pyarrow.parquet as pq  # noqa: PLC0415
+
+                return pq.read_table(p, columns=cols).to_pylist()
+
+            tasks.append(ReadTask(read, {"path": path}))
+        return tasks
+
+
+class CSVDatasource(Datasource):
+    def __init__(self, path: str):
+        self._paths = _expand_paths(path, ".csv")
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        tasks = []
+        for path in self._paths:
+            def read(p=path):
+                import csv  # noqa: PLC0415
+
+                with open(p) as f:
+                    return list(csv.DictReader(f))
+
+            tasks.append(ReadTask(read, {"path": path}))
+        return tasks
+
+
+class JSONDatasource(Datasource):
+    """JSON-lines files."""
+
+    def __init__(self, path: str):
+        self._paths = _expand_paths(path, ".jsonl")
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        tasks = []
+        for path in self._paths:
+            def read(p=path):
+                import json  # noqa: PLC0415
+
+                out = []
+                with open(p) as f:
+                    for line in f:
+                        line = line.strip()
+                        if line:
+                            out.append(json.loads(line))
+                return out
+
+            tasks.append(ReadTask(read, {"path": path}))
+        return tasks
+
+
+class BinaryFilesDatasource(Datasource):
+    """Rows of ``{"path", "bytes"}`` — the image/webdataset substrate."""
+
+    def __init__(self, path: str):
+        self._paths = _expand_paths(path)
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        k = max(1, min(parallelism, len(self._paths) or 1))
+        size = (len(self._paths) + k - 1) // k
+        tasks = []
+        for i in range(k):
+            chunk = self._paths[i * size : (i + 1) * size]
+            if not chunk:
+                continue
+
+            def read(paths=chunk):
+                out = []
+                for p in paths:
+                    with open(p, "rb") as f:
+                        out.append({"path": p, "bytes": f.read()})
+                return out
+
+            tasks.append(ReadTask(read, {"num_files": len(chunk)}))
+        return tasks
+
+
+class TextDatasource(Datasource):
+    """One row per line across the matched files."""
+
+    def __init__(self, path: str):
+        self._paths = _expand_paths(path)
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        tasks = []
+        for path in self._paths:
+            def read(p=path):
+                with open(p) as f:
+                    return [line.rstrip("\n") for line in f]
+
+            tasks.append(ReadTask(read, {"path": path}))
+        return tasks
+
+
+# -------------------------------------------------------------------- writes
+def write_block_parquet(block: Block, path: str) -> str:
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rows = [r if isinstance(r, dict) else {"value": r} for r in block]
+    pq.write_table(pa.Table.from_pylist(rows), path)
+    return path
+
+
+def write_block_csv(block: Block, path: str) -> str:
+    import csv
+
+    rows = [r if isinstance(r, dict) else {"value": r} for r in block]
+    with open(path, "w", newline="") as f:
+        if rows:
+            writer = csv.DictWriter(f, fieldnames=list(rows[0].keys()))
+            writer.writeheader()
+            writer.writerows(rows)
+    return path
+
+
+def write_block_json(block: Block, path: str) -> str:
+    import json
+
+    with open(path, "w") as f:
+        for r in block:
+            f.write(json.dumps(r, default=str) + "\n")
+    return path

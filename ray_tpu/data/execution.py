@@ -1,0 +1,372 @@
+"""Pull-based streaming execution of dataset plans.
+
+Reference architecture: ray ``python/ray/data/_internal/execution/
+streaming_executor.py:67`` + physical operators (``operators/map_operator.py``,
+``actor_pool_map_operator.py``, ``hash_shuffle.py``) — a pipeline of
+operators with bounded in-flight tasks per operator so blocks *stream*
+through the plan under backpressure instead of materializing between stages.
+
+TPU-native simplifications kept deliberate:
+  - order is preserved (head-of-line emission per stage), so ``take`` and
+    train ingest are deterministic;
+  - narrow transforms are fused into a single stage (the reference's
+    OperatorFusionRule) and also fused into the map phase of a following
+    shuffle;
+  - wide ops (shuffle/sort/groupby/repartition) are an internal barrier: a
+    distributed map/reduce exchange over ``num_returns=n`` tasks.
+
+The executor runs in whatever process iterates the dataset; blocks live in
+the object store and move node-to-node only when a consumer pulls them.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any, Callable, Iterator, List, Optional
+
+import ray_tpu
+
+from ..core.config import GlobalConfig
+from .block import Block
+from .datasource import ReadTask
+
+Transform = Callable[[Block], Block]
+
+
+# ------------------------------------------------------------ remote helpers
+def apply_chain(item, transforms: List[Transform]) -> Block:
+    """Materialize one input item (ReadTask or block) through a fused
+    transform chain."""
+    block = item() if isinstance(item, ReadTask) else item
+    for t in transforms:
+        block = t(block)
+    return block
+
+
+@ray_tpu.remote
+def _run_item(item, transforms: List[Transform]) -> Block:
+    return apply_chain(item, transforms)
+
+
+@ray_tpu.remote
+def _shuffle_map(item, transforms, n_out: int, part_fn, block_idx: int):
+    """Map phase of an exchange: apply fused chain, split rows into n_out
+    partitions (returned as n_out separate objects via num_returns)."""
+    block = apply_chain(item, transforms)
+    parts: List[Block] = [[] for _ in range(n_out)]
+    for i, row in enumerate(block):
+        parts[part_fn(row, i, block_idx) % n_out].append(row)
+    return parts
+
+
+@ray_tpu.remote
+def _shuffle_reduce(reduce_fn, reducer_idx: int, *parts: Block) -> Block:
+    rows = [r for p in parts for r in p]
+    if reduce_fn is not None:
+        rows = reduce_fn(rows, reducer_idx)
+    return rows
+
+
+class _MapWorker:
+    """Actor applying a fused chain (reference ``actor_pool_map_operator``'s
+    ``_MapWorker``); holds user state (e.g. a loaded model) across blocks."""
+
+    def __init__(self, transforms: List[Transform]):
+        self._transforms = transforms
+
+    def apply(self, item) -> Block:
+        return apply_chain(item, self._transforms)
+
+
+class ActorPoolStrategy:
+    """``map_batches(..., compute=ActorPoolStrategy(size=4))`` (reference
+    ``python/ray/data/_internal/compute.py``)."""
+
+    def __init__(
+        self,
+        size: int = 2,
+        max_tasks_in_flight_per_actor: int = 2,
+        num_tpus: float = 0,
+        num_cpus: Optional[float] = None,
+    ):
+        self.size = size
+        self.max_tasks_in_flight_per_actor = max_tasks_in_flight_per_actor
+        self.num_tpus = num_tpus
+        self.num_cpus = num_cpus
+
+
+# ------------------------------------------------------------------- stages
+class OpStats:
+    def __init__(self, name: str):
+        self.name = name
+        self.num_tasks = 0
+        self.wall_s = 0.0
+
+    def __repr__(self):
+        return f"{self.name}: {self.num_tasks} tasks, {self.wall_s:.3f}s"
+
+
+class MapStage:
+    """Fused narrow transforms executed by tasks (or an actor pool)."""
+
+    def __init__(
+        self,
+        transforms: List[Transform],
+        names: Optional[List[str]] = None,
+        compute: Optional[ActorPoolStrategy] = None,
+    ):
+        self.transforms = list(transforms)
+        self.names = list(names or [])
+        self.compute = compute
+
+    @property
+    def name(self) -> str:
+        return "+".join(self.names) if self.names else "Map"
+
+    def fuse(self, other: "MapStage") -> Optional["MapStage"]:
+        """Adjacent task-compute map stages fuse into one."""
+        if self.compute is not None or other.compute is not None:
+            return None
+        return MapStage(
+            self.transforms + other.transforms, self.names + other.names
+        )
+
+    def run(self, upstream: Iterator, stats: List[OpStats]) -> Iterator:
+        st = OpStats(self.name)
+        stats.append(st)
+        if self.compute is None:
+            yield from self._run_tasks(upstream, st)
+        else:
+            yield from self._run_actor_pool(upstream, st)
+
+    def _run_tasks(self, upstream, st):
+        t0 = time.perf_counter()
+        cap = GlobalConfig.data_max_tasks_per_op
+        pending: deque = deque()
+        exhausted = False
+        while True:
+            while not exhausted and len(pending) < cap:
+                item = next(upstream, _SENTINEL)
+                if item is _SENTINEL:
+                    exhausted = True
+                    break
+                st.num_tasks += 1
+                pending.append(_run_item.remote(item, self.transforms))
+            if not pending:
+                break
+            st.wall_s = time.perf_counter() - t0
+            yield pending.popleft()
+        st.wall_s = time.perf_counter() - t0
+
+    def _run_actor_pool(self, upstream, st):
+        t0 = time.perf_counter()
+        strat = self.compute
+        worker_cls = ray_tpu.remote(_MapWorker).options(
+            num_cpus=strat.num_cpus if strat.num_cpus is not None else 1,
+            num_tpus=strat.num_tpus or None,
+        )
+        actors = [worker_cls.remote(self.transforms) for _ in range(strat.size)]
+        cap = strat.size * strat.max_tasks_in_flight_per_actor
+        pending: deque = deque()
+        exhausted = False
+        rr = 0
+        try:
+            while True:
+                while not exhausted and len(pending) < cap:
+                    item = next(upstream, _SENTINEL)
+                    if item is _SENTINEL:
+                        exhausted = True
+                        break
+                    actor = actors[rr % len(actors)]
+                    rr += 1
+                    st.num_tasks += 1
+                    pending.append(actor.apply.remote(item))
+                if not pending:
+                    break
+                head = pending.popleft()
+                # Ensure completion before exposing the ref: the pool is
+                # destroyed when the stage drains, which must not race
+                # in-flight calls.
+                ray_tpu.wait([head], num_returns=1)
+                st.wall_s = time.perf_counter() - t0
+                yield head
+        finally:
+            for a in actors:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:
+                    pass
+        st.wall_s = time.perf_counter() - t0
+
+
+class AllToAllStage:
+    """Internal-barrier exchange: consumes every upstream ref, emits
+    reducer outputs (hash shuffle substrate for shuffle/sort/groupby/
+    repartition)."""
+
+    def __init__(
+        self,
+        name: str,
+        n_out: Optional[int],
+        part_fn: Callable,
+        reduce_fn: Optional[Callable] = None,
+        prepare: Optional[Callable[[List], dict]] = None,
+        fused_transforms: Optional[List[Transform]] = None,
+        reverse_out: bool = False,
+    ):
+        self.name = name
+        self.n_out = n_out
+        self.part_fn = part_fn
+        self.reduce_fn = reduce_fn
+        # Optional driver-side hook run on the materialized input refs
+        # before the exchange (e.g. sort boundary sampling); returns extra
+        # kwargs threaded into part_fn via functools.partial.
+        self.prepare = prepare
+        self.fused_transforms = list(fused_transforms or [])
+        # Emit reducer outputs in reverse index order (descending sort).
+        self.reverse_out = reverse_out
+
+    def with_fused(self, transforms: List[Transform]) -> "AllToAllStage":
+        """Copy with a fused upstream chain — stages are shared between
+        derived Datasets, so fusion must never mutate in place."""
+        return AllToAllStage(
+            self.name,
+            self.n_out,
+            self.part_fn,
+            self.reduce_fn,
+            self.prepare,
+            transforms,
+            self.reverse_out,
+        )
+
+    def run(self, upstream: Iterator, stats: List[OpStats]) -> Iterator:
+        st = OpStats(self.name)
+        stats.append(st)
+        t0 = time.perf_counter()
+        items = list(upstream)  # barrier
+        n_out = self.n_out or max(1, len(items))
+        part_fn = self.part_fn
+        if self.prepare is not None:
+            # Materialize inputs for sampling (refs only; sampling getter
+            # decides what to fetch).
+            refs = _ensure_refs(items, self.fused_transforms)
+            items = refs
+            extra = self.prepare(refs)
+            if extra:
+                import functools
+
+                part_fn = functools.partial(part_fn, **extra)
+            fused: List[Transform] = []
+        else:
+            fused = self.fused_transforms
+        map_out = []
+        for idx, item in enumerate(items):
+            st.num_tasks += 1
+            refs = _shuffle_map.options(num_returns=n_out).remote(
+                item, fused, n_out, part_fn, idx
+            )
+            if n_out == 1:
+                refs = [refs]
+            map_out.append(refs)
+        order = range(n_out - 1, -1, -1) if self.reverse_out else range(n_out)
+        for j in order:
+            st.num_tasks += 1
+            parts_j = [map_out[i][j] for i in range(len(map_out))]
+            st.wall_s = time.perf_counter() - t0
+            yield _shuffle_reduce.remote(self.reduce_fn, j, *parts_j)
+        st.wall_s = time.perf_counter() - t0
+
+
+class LimitStage:
+    """Global row limit.  Driver-side trim: the pull-based executor means
+    upstream work stops as soon as n rows have been emitted, so only
+    ~in-flight-cap extra blocks are ever computed."""
+
+    def __init__(self, n: int):
+        self.n = n
+
+    @property
+    def name(self) -> str:
+        return f"Limit[{self.n}]"
+
+    def run(self, upstream: Iterator, stats: List[OpStats]) -> Iterator:
+        st = OpStats(self.name)
+        stats.append(st)
+        t0 = time.perf_counter()
+        remaining = self.n
+        for item in upstream:
+            if remaining <= 0:
+                break
+            block = (
+                ray_tpu.get(item, timeout=600)
+                if isinstance(item, ray_tpu.ObjectRef)
+                else apply_chain(item, [])
+            )
+            out = block[:remaining]
+            remaining -= len(out)
+            st.num_tasks += 1
+            st.wall_s = time.perf_counter() - t0
+            yield ray_tpu.put(out)
+        st.wall_s = time.perf_counter() - t0
+
+
+_SENTINEL = object()
+
+
+def _ensure_refs(items: List[Any], transforms: List[Transform]) -> List:
+    """Convert any ReadTasks/plain items into block refs (applying a fused
+    chain remotely)."""
+    out = []
+    for item in items:
+        if isinstance(item, ray_tpu.ObjectRef) and not transforms:
+            out.append(item)
+        else:
+            out.append(_run_item.remote(item, transforms))
+    return out
+
+
+class StreamingExecutor:
+    """Composes stage generators into one pull-based stream of block refs."""
+
+    def __init__(self, inputs: List[Any], stages: List[Any]):
+        self.inputs = list(inputs)
+        self.stages = list(stages)
+        self.stats: List[OpStats] = []
+
+    def run(self) -> Iterator:
+        stages = _optimize(self.inputs, self.stages)
+        stream: Iterator = iter(self.inputs)
+        for stage in stages:
+            stream = stage.run(stream, self.stats)
+        return stream
+
+
+def _optimize(inputs: List[Any], stages: List[Any]) -> List[Any]:
+    """Fusion rules (reference ``data/_internal/logical/rules/``):
+    (1) adjacent task-compute MapStages fuse; (2) a MapStage directly
+    before an AllToAllStage fuses into its map phase; (3) a leading
+    non-map stage over ReadTasks gets a normalization MapStage."""
+    fused: List[Any] = []
+    for stage in stages:
+        if fused and isinstance(stage, MapStage) and isinstance(fused[-1], MapStage):
+            merged = fused[-1].fuse(stage)
+            if merged is not None:
+                fused[-1] = merged
+                continue
+        if (
+            fused
+            and isinstance(stage, AllToAllStage)
+            and isinstance(fused[-1], MapStage)
+            and fused[-1].compute is None
+            and not stage.fused_transforms
+        ):
+            # Copy, never mutate: the stage object is shared by every
+            # Dataset derived from the same plan.
+            fused.append(stage.with_fused(fused.pop().transforms))
+            continue
+        fused.append(stage)
+    needs_norm = any(isinstance(i, ReadTask) for i in inputs)
+    if needs_norm and not (fused and isinstance(fused[0], (MapStage, AllToAllStage))):
+        fused.insert(0, MapStage([], ["Read"]))
+    return fused

@@ -199,10 +199,11 @@ def cmd_list(args) -> int:
         cols = ["pg_id", "state", "strategy", "bundles"]
     else:
         raise SystemExit(f"unknown entity {args.kind!r}")
+    rows = rows[: args.limit]
     if args.format == "json":
         print(json.dumps(rows, default=str, indent=2))
     else:
-        print(_fmt_table(rows[: args.limit], cols))
+        print(_fmt_table(rows, cols))
     return 0
 
 

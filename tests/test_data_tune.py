@@ -32,7 +32,7 @@ class TestData:
             lambda b: [sum(b)]
         )
         m = ds.materialize()
-        assert m._transforms == []
+        assert m._stages == []
         assert sorted(m.take_all()) == sorted(
             [sum(range(i * 4, (i + 1) * 4)) for i in range(4)]
         )
