@@ -1,0 +1,117 @@
+"""The autoscaler reconciler loop.
+
+Reference: ray ``python/ray/autoscaler/v2/autoscaler.py:50`` +
+``monitor.py`` — each round: poll the control plane's load state, compute a
+scaling decision, drive the provider.  Runs in any process that can reach
+the control plane (typically the head node, via ``Autoscaler.run``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+import time
+from typing import Optional
+
+from .config import AutoscalingConfig
+from .provider import NodeProvider
+from .scheduler import ScalingDecision, compute_scaling_decision
+
+logger = logging.getLogger(__name__)
+
+
+class Autoscaler:
+    def __init__(
+        self,
+        config: AutoscalingConfig,
+        provider: NodeProvider,
+        cp_address: str,
+    ):
+        self.config = config
+        self.provider = provider
+        self.cp_address = cp_address
+        self._stop = threading.Event()
+        self.last_decision: Optional[ScalingDecision] = None
+
+    # ------------------------------------------------------------- one round
+    def _get_load_state(self) -> dict:
+        from ..core.core_worker import try_global_worker
+        from ..core.rpc import RpcClient
+
+        worker = try_global_worker()
+        if worker is not None and worker.cp_address == self.cp_address:
+            return worker._run_sync(worker.cp.call("get_load_state"))
+
+        async def run():
+            client = RpcClient(self.cp_address)
+            await client.connect()
+            try:
+                return await client.call("get_load_state")
+            finally:
+                await client.close()
+
+        return asyncio.run(run())
+
+    def update(self) -> ScalingDecision:
+        """One reconcile round; returns the decision it acted on."""
+        state = self._get_load_state()
+        decision = compute_scaling_decision(
+            state, self.config, self.provider.non_terminated_nodes()
+        )
+        for tname, count in decision.to_launch.items():
+            node_type = self.config.node_types[tname]
+            for _ in range(count):
+                try:
+                    pid = self.provider.create_node(node_type)
+                    logger.info("launched %s (%s)", pid, tname)
+                except Exception as e:  # noqa: BLE001
+                    logger.warning("launch of %s failed: %s", tname, e)
+        for pid in decision.to_terminate:
+            try:
+                self.provider.terminate_node(pid)
+                logger.info("terminated %s", pid)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("terminate of %s failed: %s", pid, e)
+        if decision.infeasible:
+            logger.warning(
+                "infeasible resource demands (no node type fits): %s",
+                decision.infeasible[:5],
+            )
+        self.last_decision = decision
+        return decision
+
+    # ------------------------------------------------------------------ loop
+    def run(self, period_s: float = 5.0) -> None:
+        """Blocking reconcile loop (``ray_tpu.autoscaler.monitor`` analog)."""
+        while not self._stop.is_set():
+            try:
+                self.update()
+            except Exception as e:  # noqa: BLE001
+                logger.warning("autoscaler round failed: %s", e)
+            self._stop.wait(period_s)
+
+    def start_background(self, period_s: float = 5.0) -> threading.Thread:
+        thread = threading.Thread(
+            target=self.run, args=(period_s,), daemon=True,
+            name="rtpu-autoscaler",
+        )
+        thread.start()
+        return thread
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def wait_for_nodes(n: int, cp_address: str, timeout: float = 60.0) -> None:
+    """Test/ops helper: block until n nodes are alive."""
+    from ..util.state.api import StateApiClient
+
+    client = StateApiClient(cp_address)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        nodes = client.get_state()["nodes"]
+        if sum(1 for v in nodes.values() if v["alive"]) >= n:
+            return
+        time.sleep(0.3)
+    raise TimeoutError(f"cluster did not reach {n} nodes in {timeout}s")

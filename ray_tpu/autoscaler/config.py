@@ -1,0 +1,49 @@
+"""Autoscaling configuration (reference: the node-types section of the
+cluster YAML, ray ``python/ray/autoscaler/ray-schema.json``)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+@dataclass
+class NodeTypeConfig:
+    """One launchable node shape."""
+
+    name: str
+    resources: Dict[str, float]
+    min_workers: int = 0
+    max_workers: int = 10
+    labels: Dict[str, str] = field(default_factory=dict)
+    # Provider-specific knobs (e.g. GKE machine type / TPU topology).
+    node_config: Dict[str, object] = field(default_factory=dict)
+
+
+@dataclass
+class AutoscalingConfig:
+    node_types: Dict[str, NodeTypeConfig]
+    idle_timeout_s: float = 60.0
+    max_launch_batch: int = 8
+    # Global cap across all worker types (None = sum of per-type maxes).
+    max_workers: Optional[int] = None
+
+    @staticmethod
+    def from_dict(d: dict) -> "AutoscalingConfig":
+        types = {
+            name: NodeTypeConfig(
+                name=name,
+                resources=dict(t.get("resources", {})),
+                min_workers=t.get("min_workers", 0),
+                max_workers=t.get("max_workers", 10),
+                labels=dict(t.get("labels", {})),
+                node_config=dict(t.get("node_config", {})),
+            )
+            for name, t in d.get("node_types", {}).items()
+        }
+        return AutoscalingConfig(
+            node_types=types,
+            idle_timeout_s=d.get("idle_timeout_s", 60.0),
+            max_launch_batch=d.get("max_launch_batch", 8),
+            max_workers=d.get("max_workers"),
+        )

@@ -1,0 +1,146 @@
+"""Node providers: how the autoscaler launches and terminates machines.
+
+Reference: ray ``python/ray/autoscaler/node_provider.py`` (v1 ABC) and the
+``FakeMultiNodeProvider`` testing trick
+(``autoscaler/_private/fake_multi_node/node_provider.py:237``): fake nodes
+are real node-agent processes on this machine, each believing it is a
+distinct node — so autoscaler end-to-end tests run without a cloud.
+
+Every launched node carries two labels the autoscaler uses to reconcile
+provider state against the control plane's node table:
+``rtpu-node-type`` and ``rtpu-provider-id``.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Dict, List, Optional
+
+from .config import NodeTypeConfig
+
+NODE_TYPE_LABEL = "rtpu-node-type"
+PROVIDER_ID_LABEL = "rtpu-provider-id"
+
+
+class NodeProvider:
+    """ABC.  Implementations must be idempotent and tolerate repeated
+    terminate calls."""
+
+    def create_node(self, node_type: NodeTypeConfig) -> str:
+        """Launch one node of the given type; returns a provider id."""
+        raise NotImplementedError
+
+    def terminate_node(self, provider_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> Dict[str, str]:
+        """provider_id -> node_type name."""
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        for pid in list(self.non_terminated_nodes()):
+            self.terminate_node(pid)
+
+
+class FakeMultiNodeProvider(NodeProvider):
+    """Launches real local node-agent processes joined to an existing
+    cluster (the reference's fake-multinode analog)."""
+
+    def __init__(self, cp_address: str, session_id: str):
+        self._cp_address = cp_address
+        self._session_id = session_id
+        self._nodes: Dict[str, tuple] = {}  # provider_id -> (type_name, Node)
+
+    def create_node(self, node_type: NodeTypeConfig) -> str:
+        from ..core.node import Node
+
+        provider_id = f"fake-{uuid.uuid4().hex[:8]}"
+        labels = dict(node_type.labels)
+        labels[NODE_TYPE_LABEL] = node_type.name
+        labels[PROVIDER_ID_LABEL] = provider_id
+        resources = dict(node_type.resources)
+        node = Node(
+            head=False,
+            cp_address=self._cp_address,
+            resources=resources,
+            labels=labels,
+            session_id=self._session_id,
+            num_cpus=resources.get("CPU", 1),
+        )
+        node.start()
+        self._nodes[provider_id] = (node_type.name, node)
+        return provider_id
+
+    def terminate_node(self, provider_id: str) -> None:
+        entry = self._nodes.pop(provider_id, None)
+        if entry is not None:
+            _, node = entry
+            node.pg.kill_all()
+
+    def non_terminated_nodes(self) -> Dict[str, str]:
+        return {pid: tname for pid, (tname, _) in self._nodes.items()}
+
+
+class GKETPUProvider(NodeProvider):
+    """GKE/GCE TPU provider skeleton: shells out to ``gcloud`` to create and
+    delete TPU VM slices (reference precedent: the GCP node provider,
+    ``autoscaler/_private/gcp/``, and TPU pod metadata in
+    ``_private/accelerators/tpu.py:267-672``).  Requires ``gcloud`` on PATH
+    and is exercised only against a real project — tests use
+    ``FakeMultiNodeProvider``."""
+
+    def __init__(
+        self,
+        project: str,
+        zone: str,
+        cluster_name: str,
+        cp_address: str,
+        accelerator_type: str = "v5litepod-8",
+        runtime_version: str = "tpu-ubuntu2204-base",
+    ):
+        import shutil
+
+        if shutil.which("gcloud") is None:
+            raise RuntimeError("GKETPUProvider requires the gcloud CLI")
+        self._project = project
+        self._zone = zone
+        self._cluster = cluster_name
+        self._cp_address = cp_address
+        self._accel = accelerator_type
+        self._runtime = runtime_version
+        self._nodes: Dict[str, str] = {}
+
+    def _run(self, *args: str) -> str:
+        import subprocess
+
+        return subprocess.check_output(
+            ["gcloud", *args, f"--project={self._project}",
+             f"--zone={self._zone}", "--format=json"],
+            text=True,
+        )
+
+    def create_node(self, node_type: NodeTypeConfig) -> str:
+        provider_id = f"{self._cluster}-{node_type.name}-{uuid.uuid4().hex[:6]}"
+        accel = str(node_type.node_config.get("accelerator_type", self._accel))
+        startup = (
+            f"python -m ray_tpu start --address={self._cp_address} "
+            f"--labels '{{\"{NODE_TYPE_LABEL}\": \"{node_type.name}\", "
+            f"\"{PROVIDER_ID_LABEL}\": \"{provider_id}\"}}'"
+        )
+        self._run(
+            "compute", "tpus", "tpu-vm", "create", provider_id,
+            f"--accelerator-type={accel}",
+            f"--version={self._runtime}",
+            f"--metadata=startup-script={startup}",
+        )
+        self._nodes[provider_id] = node_type.name
+        return provider_id
+
+    def terminate_node(self, provider_id: str) -> None:
+        if provider_id in self._nodes:
+            self._run("compute", "tpus", "tpu-vm", "delete", provider_id,
+                      "--quiet")
+            self._nodes.pop(provider_id, None)
+
+    def non_terminated_nodes(self) -> Dict[str, str]:
+        return dict(self._nodes)

@@ -109,6 +109,8 @@ class ControlPlane:
         self._pending_pgs: List[PlacementGroupID] = []
         self._bg_tasks: List[asyncio.Task] = []
         self.task_event_store = TaskEventStore()
+        self._requested_resources: List[dict] = []
+        self._recent_unplaceable: List[tuple] = []  # (monotonic ts, resources)
 
     # ------------------------------------------------------------- lifecycle
     async def start(self) -> str:
@@ -302,10 +304,13 @@ class ControlPlane:
             node_id = self.scheduler.pick_node(
                 ResourceSet(spec.resources), spec.strategy
             )
-        except InfeasibleError as e:
-            entry.state = DEAD
-            entry.death_cause = str(e)
-            self._publish_actor(entry)
+        except InfeasibleError:
+            # No current node shape fits — keep pending rather than fail:
+            # the autoscaler may add a node that does (its load state
+            # includes this actor's demand), and the reference likewise
+            # queues infeasible actors indefinitely.
+            if spec.actor_id not in self._pending_actors:
+                self._pending_actors.append(spec.actor_id)
             return
         if node_id is None:
             if spec.actor_id not in self._pending_actors:
@@ -525,7 +530,10 @@ class ControlPlane:
 
     # -------------------------------------------------------------- lookups
     def handle_pick_node_for_lease(self, payload, conn):
-        """Spillback target selection for agents that can't fit a lease."""
+        """Spillback target selection for agents that can't fit a lease.
+        Unplaceable demands are remembered briefly so the autoscaler's load
+        state sees them (they live in no queue while the submitter backs
+        off and retries)."""
         try:
             node_id = self.scheduler.pick_node(
                 ResourceSet(payload["resources"]),
@@ -533,13 +541,74 @@ class ControlPlane:
                 preferred=payload.get("preferred"),
             )
         except InfeasibleError as e:
+            self._note_unplaceable(payload["resources"])
             return {"infeasible": True, "error": str(e)}
         if node_id is None:
+            self._note_unplaceable(payload["resources"])
             return {"node_id": None}
         return {
             "node_id": node_id,
             "agent_address": self.nodes[node_id].agent_address,
         }
+
+    # ------------------------------------------------------------- autoscaler
+    def handle_get_load_state(self, payload, conn):
+        """Cluster load snapshot for the autoscaler (reference:
+        ``GcsAutoscalerStateManager`` state consumed by
+        ``autoscaler/v2/autoscaler.py:50``)."""
+        pending_actors = []
+        for actor_id in self._pending_actors:
+            entry = self.actors.get(actor_id)
+            if entry is not None and entry.state in (PENDING_CREATION, RESTARTING):
+                pending_actors.append(dict(entry.spec.resources))
+        pending_pgs = []
+        for pg_id in self._pending_pgs:
+            entry = self.placement_groups.get(pg_id)
+            if entry is not None and entry.state == "PENDING":
+                pending_pgs.append(
+                    {
+                        "strategy": entry.strategy,
+                        "bundles": [dict(b) for b in entry.bundles],
+                    }
+                )
+        return {
+            "nodes": {
+                nid.hex(): {
+                    "alive": e.alive,
+                    "total": e.snapshot.get("total", {}),
+                    "available": e.snapshot.get("available", {}),
+                    "labels": e.snapshot.get("labels", {}),
+                    "pending_demands": e.snapshot.get("pending_demands", []),
+                    "idle_s": e.snapshot.get("idle_s", 0.0),
+                }
+                for nid, e in self.nodes.items()
+            },
+            "pending_actors": pending_actors,
+            "pending_pgs": pending_pgs,
+            "requested_resources": list(self._requested_resources),
+            "unplaceable_demands": [
+                dict(r)
+                for ts, r in self._recent_unplaceable
+                if time.monotonic() - ts < 5.0
+            ],
+        }
+
+    def _note_unplaceable(self, resources: dict, window_s: float = 5.0):
+        now = time.monotonic()
+        self._recent_unplaceable = [
+            (ts, r) for ts, r in self._recent_unplaceable
+            if now - ts < window_s
+        ]
+        self._recent_unplaceable.append((now, dict(resources)))
+
+    def handle_request_resources(self, payload, conn):
+        """Explicit autoscaling demand (``ray.autoscaler.sdk.
+        request_resources`` analog): a standing list of resource bundles the
+        cluster should be able to fit."""
+        self._requested_resources = [
+            dict(b) for b in payload.get("bundles", [])
+        ]
+        return True
 
     # ------------------------------------------------------------ task events
     def handle_task_events(self, payload, conn):

@@ -99,6 +99,7 @@ class NodeAgent:
         self._next_lease_id = 1
         self.bundles: Dict[Tuple[PlacementGroupID, int], BundlePool] = {}
         self._lease_queue: List[tuple] = []  # (payload, future)
+        self._idle_since = None  # monotonic ts when node went fully idle
         self._pull_futures: Dict[ObjectID, asyncio.Future] = {}
         self._bg: List[asyncio.Task] = []
 
@@ -131,10 +132,31 @@ class NodeAgent:
         await self.agent_clients.close_all()
 
     def _snapshot(self) -> dict:
+        # Idle tracking + queued lease demands feed the autoscaler's load
+        # state (reference: resource-demand fields in the raylet's resource
+        # report consumed by GcsAutoscalerStateManager).
+        pending = [
+            dict(payload.get("resources") or {})
+            for payload, fut in self._lease_queue
+            if not fut.done()
+        ]
+        busy = bool(pending) or (
+            self.resources.available.to_dict() != self.resources.total.to_dict()
+        )
+        if busy:
+            self._idle_since = None
+        elif self._idle_since is None:
+            self._idle_since = time.monotonic()
         return {
             "total": self.resources.total.to_dict(),
             "available": self.resources.available.to_dict(),
             "labels": dict(self.resources.labels),
+            "pending_demands": pending,
+            "idle_s": (
+                time.monotonic() - self._idle_since
+                if self._idle_since is not None
+                else 0.0
+            ),
         }
 
     async def _heartbeat_loop(self):
@@ -417,7 +439,11 @@ class NodeAgent:
             return
         if not fut.done():
             if reply.get("infeasible"):
-                fut.set_exception(ValueError(reply["error"]))
+                # Infeasible *now* — stay queued and retry (the reference
+                # queues infeasible work indefinitely; the autoscaler sees
+                # the demand via the control plane's unplaceable window and
+                # may add a node that fits).
+                fut.set_result({"granted": False, "retry": True})
             elif reply.get("node_id") is None:
                 fut.set_result({"granted": False, "retry": True})
             else:

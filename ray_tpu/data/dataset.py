@@ -107,6 +107,16 @@ class Dataset:
         runs the UDF in a pool of actors (stateful/expensive setup, e.g. a
         loaded model); a *class* UDF is constructed once per actor."""
         if isinstance(fn, type):
+            if compute is None:
+                # Task compute would silently reconstruct the instance per
+                # block (each task pickles the wrapper fresh) — the whole
+                # point of a class UDF is amortized setup, so require the
+                # pool (the reference raises here too).
+                raise ValueError(
+                    "map_batches with a callable class requires "
+                    "compute=ActorPoolStrategy(...) so the class is "
+                    "constructed once per actor"
+                )
             ctor_args = fn_constructor_args or ()
             cls = fn
 

@@ -51,6 +51,8 @@ class ItemsDatasource(Datasource):
         tasks = []
         for i in range(n):
             chunk = items[i * size : (i + 1) * size]
+            if not chunk and items:
+                continue  # ceil-division can leave empty trailing chunks
             tasks.append(
                 ReadTask(lambda c=chunk: list(c), {"num_rows": len(chunk)})
             )

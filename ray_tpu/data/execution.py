@@ -49,6 +49,20 @@ def _run_item(item, transforms: List[Transform]) -> Block:
     return apply_chain(item, transforms)
 
 
+def _run_item_ref(item):
+    return _run_item.remote(item, [])
+
+
+@ray_tpu.remote
+def _block_len(block: Block) -> int:
+    return len(block)
+
+
+@ray_tpu.remote
+def _trim_block(block: Block, n: int) -> Block:
+    return block[:n]
+
+
 @ray_tpu.remote
 def _shuffle_map(item, transforms, n_out: int, part_fn, block_idx: int):
     """Map phase of an exchange: apply fused chain, split rows into n_out
@@ -298,16 +312,22 @@ class LimitStage:
         for item in upstream:
             if remaining <= 0:
                 break
-            block = (
-                ray_tpu.get(item, timeout=600)
+            ref = (
+                item
                 if isinstance(item, ray_tpu.ObjectRef)
-                else apply_chain(item, [])
+                else _run_item_ref(item)
             )
-            out = block[:remaining]
-            remaining -= len(out)
+            # Only the row *count* comes back to the driver; whole blocks
+            # pass through by ref and at most one block is trimmed remotely.
+            n_rows = ray_tpu.get(_block_len.remote(ref), timeout=600)
             st.num_tasks += 1
             st.wall_s = time.perf_counter() - t0
-            yield ray_tpu.put(out)
+            if n_rows <= remaining:
+                remaining -= n_rows
+                yield ref
+            else:
+                yield _trim_block.remote(ref, remaining)
+                remaining = 0
         st.wall_s = time.perf_counter() - t0
 
 

@@ -1,0 +1,303 @@
+"""Autoscaler tests: pure scheduler decisions + end-to-end with the fake
+multi-node provider (reference model: ray
+``python/ray/tests/test_autoscaler_fake_multinode.py``)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (
+    Autoscaler,
+    AutoscalingConfig,
+    FakeMultiNodeProvider,
+    NodeTypeConfig,
+    request_resources,
+)
+from ray_tpu.autoscaler.provider import NODE_TYPE_LABEL, PROVIDER_ID_LABEL
+from ray_tpu.autoscaler.scheduler import compute_scaling_decision
+
+
+def _cfg(**kw):
+    defaults = dict(
+        node_types={
+            "cpu4": NodeTypeConfig("cpu4", {"CPU": 4.0}, max_workers=5),
+            "tpu8": NodeTypeConfig(
+                "tpu8", {"CPU": 8.0, "TPU": 8.0}, max_workers=2
+            ),
+        },
+        idle_timeout_s=60.0,
+    )
+    defaults.update(kw)
+    return AutoscalingConfig(**defaults)
+
+
+def _state(nodes=None, pending_actors=(), pending_pgs=(), requested=()):
+    return {
+        "nodes": nodes or {},
+        "pending_actors": list(pending_actors),
+        "pending_pgs": list(pending_pgs),
+        "requested_resources": list(requested),
+    }
+
+
+class TestSchedulerDecisions:
+    def test_launch_for_pending_actor(self):
+        d = compute_scaling_decision(
+            _state(pending_actors=[{"CPU": 2.0}]), _cfg(), {}
+        )
+        assert d.to_launch == {"cpu4": 1}
+        assert not d.infeasible
+
+    def test_tpu_demand_picks_tpu_type(self):
+        d = compute_scaling_decision(
+            _state(pending_actors=[{"CPU": 1.0, "TPU": 4.0}]), _cfg(), {}
+        )
+        assert d.to_launch == {"tpu8": 1}
+
+    def test_packs_multiple_demands_on_one_node(self):
+        d = compute_scaling_decision(
+            _state(pending_actors=[{"CPU": 2.0}, {"CPU": 2.0}]), _cfg(), {}
+        )
+        assert d.to_launch == {"cpu4": 1}
+
+    def test_existing_capacity_absorbs_demand(self):
+        nodes = {
+            "n1": {
+                "alive": True,
+                "total": {"CPU": 4.0},
+                "available": {"CPU": 4.0},
+                "labels": {},
+                "pending_demands": [],
+                "idle_s": 0.0,
+            }
+        }
+        d = compute_scaling_decision(
+            _state(nodes=nodes, pending_actors=[{"CPU": 3.0}]), _cfg(), {}
+        )
+        assert d.to_launch == {}
+
+    def test_infeasible_demand(self):
+        d = compute_scaling_decision(
+            _state(pending_actors=[{"GPU": 1.0}]), _cfg(), {}
+        )
+        assert d.infeasible == [{"GPU": 1.0}]
+        assert d.to_launch == {}
+
+    def test_max_workers_cap(self):
+        cfg = _cfg()
+        provider_nodes = {f"p{i}": "cpu4" for i in range(5)}
+        d = compute_scaling_decision(
+            _state(pending_actors=[{"CPU": 4.0}] * 3),
+            cfg,
+            provider_nodes,
+        )
+        assert d.to_launch.get("cpu4", 0) == 0  # at the per-type cap
+
+    def test_min_workers_floor(self):
+        cfg = _cfg(
+            node_types={
+                "cpu4": NodeTypeConfig(
+                    "cpu4", {"CPU": 4.0}, min_workers=2, max_workers=5
+                )
+            }
+        )
+        d = compute_scaling_decision(_state(), cfg, {})
+        assert d.to_launch == {"cpu4": 2}
+
+    def test_pg_bundles_counted(self):
+        d = compute_scaling_decision(
+            _state(
+                pending_pgs=[
+                    {"strategy": "PACK",
+                     "bundles": [{"CPU": 4.0}, {"CPU": 4.0}]}
+                ]
+            ),
+            _cfg(),
+            {},
+        )
+        assert d.to_launch == {"cpu4": 2}
+
+    def test_strict_pack_pg_is_atomic(self):
+        # Two 4-CPU bundles that must land on one node: only the 8-CPU
+        # (tpu8) type fits the merged demand.
+        d = compute_scaling_decision(
+            _state(
+                pending_pgs=[
+                    {"strategy": "STRICT_PACK",
+                     "bundles": [{"CPU": 4.0}, {"CPU": 4.0}]}
+                ]
+            ),
+            _cfg(),
+            {},
+        )
+        assert d.to_launch == {"tpu8": 1}
+
+    def test_strict_spread_needs_distinct_nodes(self):
+        d = compute_scaling_decision(
+            _state(
+                pending_pgs=[
+                    {"strategy": "STRICT_SPREAD",
+                     "bundles": [{"CPU": 1.0}, {"CPU": 1.0}]}
+                ]
+            ),
+            _cfg(),
+            {},
+        )
+        assert d.to_launch == {"cpu4": 2}
+
+    def test_requested_resources_check_totals_not_available(self):
+        # A busy node still satisfies a standing request — no launch loop.
+        nodes = {
+            "n1": {
+                "alive": True,
+                "total": {"CPU": 4.0},
+                "available": {"CPU": 0.0},
+                "labels": {},
+                "pending_demands": [],
+                "idle_s": 0.0,
+            }
+        }
+        d = compute_scaling_decision(
+            _state(nodes=nodes, requested=[{"CPU": 4.0}]), _cfg(), {}
+        )
+        assert d.to_launch == {}
+
+    def test_scale_down_not_blocked_by_infeasible_demand(self):
+        cfg = _cfg(idle_timeout_s=10.0)
+        nodes = {
+            "n0": {
+                "alive": True,
+                "total": {"CPU": 4.0},
+                "available": {"CPU": 4.0},
+                "labels": {NODE_TYPE_LABEL: "cpu4", PROVIDER_ID_LABEL: "p0"},
+                "pending_demands": [],
+                "idle_s": 100.0,
+            }
+        }
+        d = compute_scaling_decision(
+            _state(nodes=nodes, pending_actors=[{"GPU": 1.0}]),
+            cfg,
+            {"p0": "cpu4"},
+        )
+        assert d.infeasible == [{"GPU": 1.0}]
+        assert d.to_terminate == ["p0"]
+
+    def test_idle_terminate_respects_min_workers(self):
+        cfg = _cfg(
+            node_types={
+                "cpu4": NodeTypeConfig(
+                    "cpu4", {"CPU": 4.0}, min_workers=1, max_workers=5
+                )
+            },
+            idle_timeout_s=10.0,
+        )
+        nodes = {
+            f"n{i}": {
+                "alive": True,
+                "total": {"CPU": 4.0},
+                "available": {"CPU": 4.0},
+                "labels": {NODE_TYPE_LABEL: "cpu4", PROVIDER_ID_LABEL: f"p{i}"},
+                "pending_demands": [],
+                "idle_s": 100.0,
+            }
+            for i in range(3)
+        }
+        provider_nodes = {f"p{i}": "cpu4" for i in range(3)}
+        d = compute_scaling_decision(_state(nodes=nodes), cfg, provider_nodes)
+        assert len(d.to_terminate) == 2  # keep min_workers=1
+
+    def test_no_terminate_while_busy(self):
+        cfg = _cfg(idle_timeout_s=10.0)
+        nodes = {
+            "n0": {
+                "alive": True,
+                "total": {"CPU": 4.0},
+                "available": {"CPU": 4.0},
+                "labels": {NODE_TYPE_LABEL: "cpu4", PROVIDER_ID_LABEL: "p0"},
+                "pending_demands": [],
+                "idle_s": 100.0,
+            }
+        }
+        d = compute_scaling_decision(
+            _state(nodes=nodes, pending_actors=[{"CPU": 2.0}]),
+            cfg,
+            {"p0": "cpu4"},
+        )
+        assert d.to_terminate == []
+
+
+class TestAutoscalerE2E:
+    def test_scale_up_schedules_pending_actor_then_scales_down(self):
+        ctx = ray_tpu.init(num_cpus=1)
+        provider = None
+        try:
+            cp = ctx.address_info["cp_address"]
+            session = ctx.address_info["session_id"]
+            provider = FakeMultiNodeProvider(cp, session)
+            config = AutoscalingConfig(
+                node_types={
+                    "worker4": NodeTypeConfig(
+                        "worker4", {"CPU": 4.0}, max_workers=2
+                    )
+                },
+                idle_timeout_s=3.0,
+            )
+            scaler = Autoscaler(config, provider, cp)
+
+            @ray_tpu.remote(num_cpus=4)
+            class Big:
+                def ping(self):
+                    return "pong"
+
+            handle = Big.remote()  # cannot fit on the 1-CPU head
+            time.sleep(1.0)
+            decision = scaler.update()
+            assert decision.to_launch == {"worker4": 1}
+
+            # The pending actor must schedule once the node joins.
+            assert ray_tpu.get(handle.ping.remote(), timeout=60) == "pong"
+
+            # Scale down: kill the actor, wait past idle timeout.
+            ray_tpu.kill(handle)
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                time.sleep(1.0)
+                decision = scaler.update()
+                if decision.to_terminate:
+                    break
+            assert provider.non_terminated_nodes() == {}
+        finally:
+            if provider is not None:
+                provider.shutdown()
+            ray_tpu.shutdown()
+
+    def test_request_resources(self):
+        ctx = ray_tpu.init(num_cpus=1)
+        provider = None
+        try:
+            cp = ctx.address_info["cp_address"]
+            provider = FakeMultiNodeProvider(cp, ctx.address_info["session_id"])
+            config = AutoscalingConfig(
+                node_types={
+                    "worker4": NodeTypeConfig(
+                        "worker4", {"CPU": 4.0}, max_workers=2
+                    )
+                },
+            )
+            scaler = Autoscaler(config, provider, cp)
+            request_resources(bundles=[{"CPU": 4.0}])
+            decision = scaler.update()
+            assert decision.to_launch == {"worker4": 1}
+            # Standing request is satisfied once the node exists.
+            from ray_tpu.autoscaler.autoscaler import wait_for_nodes
+
+            wait_for_nodes(2, cp, timeout=30)
+            time.sleep(1.5)  # heartbeat refresh
+            decision = scaler.update()
+            assert decision.to_launch == {}
+            request_resources()  # clear
+        finally:
+            if provider is not None:
+                provider.shutdown()
+            ray_tpu.shutdown()

@@ -168,6 +168,14 @@ class TestActorPool:
         )
         assert sorted(ds.take_all()) == [x * 3 for x in range(24)]
 
+    def test_class_udf_requires_actor_pool(self, cluster):
+        class F:
+            def __call__(self, block):
+                return block
+
+        with pytest.raises(ValueError, match="ActorPoolStrategy"):
+            rdata.range_dataset(4).map_batches(F)
+
     def test_stateful_class_udf(self, cluster):
         class AddConst:
             def __init__(self, c):
@@ -230,6 +238,11 @@ class TestIO:
         ds2 = rdata.read_binary_files(str(p))
         row = ds2.take(1)[0]
         assert row["bytes"].startswith(b"alpha")
+
+    def test_from_items_ragged_no_empty_blocks(self, cluster):
+        ds = rdata.from_items(list(range(9)), parallelism=8)
+        assert all(b for b in ds.iter_blocks())
+        assert ds.count() == 9
 
     def test_count_metadata_fast_path(self, cluster):
         ds = rdata.range_dataset(1000, parallelism=4)
