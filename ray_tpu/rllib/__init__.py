@@ -1,2 +1,15 @@
+"""``ray_tpu.rllib`` — reinforcement learning on the core actor runtime.
+
+Reference: ray ``rllib/`` — Algorithm (a Tune Trainable) coordinating env
+runner actors for sampling and JAX learners for SGD; algorithms: PPO, DQN
+(double/PER), IMPALA/APPO (V-trace), BC/MARWIL (offline).
+"""
+
+from .actor_manager import FaultTolerantActorManager  # noqa: F401
+from .algorithm import Algorithm, AlgorithmConfig  # noqa: F401
+from .bc import BC, BCConfig, MARWIL, MARWILConfig  # noqa: F401
+from .dqn import DQN, DQNConfig  # noqa: F401
 from .env import CartPole  # noqa: F401
+from .impala import APPO, APPOConfig, IMPALA, IMPALAConfig  # noqa: F401
 from .ppo import PPO, PPOConfig  # noqa: F401
+from .replay import PrioritizedReplayBuffer, ReplayBuffer  # noqa: F401

@@ -1,0 +1,82 @@
+"""Fault-tolerant management of a homogeneous group of worker actors.
+
+Reference: ``FaultTolerantActorManager`` (ray
+``rllib/utils/actor_manager.py``): issue calls to all actors, harvest
+results with a timeout, mark/replace the dead so one lost sampler never
+stalls training.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, List, Optional, Tuple
+
+import ray_tpu
+
+logger = logging.getLogger(__name__)
+
+
+class FaultTolerantActorManager:
+    def __init__(
+        self,
+        make_actor: Callable[[int], Any],
+        num_actors: int,
+        restore: bool = True,
+    ):
+        """``make_actor(index) -> ActorHandle``; ``restore`` controls whether
+        dead actors are transparently replaced at harvest time."""
+        self._make_actor = make_actor
+        self._restore = restore
+        self.actors: List[Any] = [make_actor(i) for i in range(num_actors)]
+        self.num_replacements = 0
+
+    def __len__(self) -> int:
+        return len(self.actors)
+
+    def foreach(
+        self,
+        method: str,
+        *args,
+        timeout: float = 300.0,
+        **kwargs,
+    ) -> List[Tuple[int, Any]]:
+        """Call ``method`` on every actor; returns [(index, result)] for the
+        healthy ones.  ``timeout`` bounds the whole round (a shared
+        deadline, not per-actor).  Dead/stalled actors are killed and
+        replaced."""
+        refs = [
+            (i, getattr(actor, method).remote(*args, **kwargs))
+            for i, actor in enumerate(self.actors)
+        ]
+        return self._harvest(refs, timeout)
+
+    def _harvest(self, refs, timeout: float) -> List[Tuple[int, Any]]:
+        import time
+
+        deadline = time.monotonic() + timeout
+        out: List[Tuple[int, Any]] = []
+        for i, ref in refs:
+            remaining = max(0.1, deadline - time.monotonic())
+            try:
+                out.append((i, ray_tpu.get(ref, timeout=remaining)))
+            except Exception as e:  # noqa: BLE001
+                logger.warning("actor %d failed (%s)%s", i, e,
+                               "; replacing" if self._restore else "")
+                if self._restore:
+                    # Kill the old handle first: a stalled (not dead) actor
+                    # would otherwise leak its process + resource slot.
+                    try:
+                        ray_tpu.kill(self.actors[i])
+                    except Exception:
+                        pass
+                    self.actors[i] = self._make_actor(i)
+                    self.num_replacements += 1
+        return out
+
+    def kill_all(self) -> None:
+        for actor in self.actors:
+            try:
+                ray_tpu.kill(actor)
+            except Exception:
+                pass
+        self.actors = []

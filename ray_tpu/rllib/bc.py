@@ -1,0 +1,133 @@
+"""Behavior Cloning — offline RL from a dataset of (obs, action) pairs.
+
+Reference: ray ``rllib/algorithms/bc/`` (+ ``rllib/offline/``): supervised
+cross-entropy on logged actions, reading batches through the Data layer.
+MARWIL reduces to this when advantages are all-ones (``beta=0``); passing
+``beta>0`` weights the loss by exponentiated advantages, giving the MARWIL
+objective.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .algorithm import Algorithm, AlgorithmConfig, init_mlp, mlp_forward
+
+_N_LAYERS = 2
+
+
+class BCConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.hidden = 64
+        self.train_batch_size = 256
+        self.num_sgd_steps = 16
+        self.beta = 0.0  # >0 → MARWIL advantage weighting
+        self.offline_data = None  # ray_tpu.data.Dataset or dict of arrays
+
+    def offline(self, data) -> "BCConfig":
+        self.offline_data = data
+        return self
+
+
+class BC(Algorithm):
+    def setup(self, config: BCConfig) -> None:
+        import jax
+        import optax
+
+        data = config.offline_data
+        if data is None:
+            raise ValueError("BC requires .offline(data)")
+        if not isinstance(data, dict):  # a Dataset of {"obs","actions",...}
+            rows = data.take_all()
+            data = {
+                k: np.asarray([r[k] for r in rows])
+                for k in rows[0].keys()
+            }
+        self.data = {
+            "obs": np.asarray(data["obs"], np.float32),
+            "actions": np.asarray(data["actions"], np.int64),
+            "advantages": np.asarray(
+                data.get(
+                    "advantages", np.ones(len(data["actions"]), np.float32)
+                ),
+                np.float32,
+            ),
+        }
+        obs_size = self.data["obs"].shape[1]
+        num_actions = int(self.data["actions"].max()) + 1
+        self.num_actions = num_actions
+
+        key = jax.random.PRNGKey(config.seed)
+        self.params = init_mlp(key, [obs_size, config.hidden, num_actions])
+        self.tx = optax.adam(config.lr)
+        self.opt_state = self.tx.init(self.params)
+        self._rng = np.random.default_rng(config.seed)
+        beta = config.beta
+        tx = self.tx
+
+        def update(params, opt_state, batch):
+            import jax.numpy as jnp
+
+            def loss_fn(p):
+                logits = mlp_forward(p, batch["obs"], _N_LAYERS)
+                logp_all = jax.nn.log_softmax(logits)
+                logp = jnp.take_along_axis(
+                    logp_all, batch["actions"][:, None], axis=1
+                )[:, 0]
+                weight = (
+                    jnp.exp(beta * batch["advantages"]) if beta > 0 else 1.0
+                )
+                return -jnp.mean(weight * logp)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            import optax as _optax
+
+            return _optax.apply_updates(params, updates), opt_state, loss
+
+        self._update = jax.jit(update)
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        cfg = self.config
+        n = len(self.data["actions"])
+        loss = None
+        for _ in range(cfg.num_sgd_steps):
+            idx = self._rng.integers(0, n, size=min(cfg.train_batch_size, n))
+            batch = {k: jnp.asarray(v[idx]) for k, v in self.data.items()}
+            self.params, self.opt_state, loss = self._update(
+                self.params, self.opt_state, batch
+            )
+        return {"loss": float(loss)}
+
+    def compute_action(self, obs: np.ndarray) -> int:
+        import jax.numpy as jnp
+
+        logits = mlp_forward(self.params, jnp.asarray(obs), _N_LAYERS)
+        return int(np.argmax(np.asarray(logits)))
+
+    def get_state(self) -> Dict[str, Any]:
+        return {"params": {k: np.asarray(v) for k, v in self.params.items()}}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.params = state["params"]
+        self.opt_state = self.tx.init(self.params)
+
+
+class MARWILConfig(BCConfig):
+    def __init__(self):
+        super().__init__()
+        self.beta = 1.0
+
+
+class MARWIL(BC):
+    pass
+
+
+BCConfig.ALGO_CLS = BC
+MARWILConfig.ALGO_CLS = MARWIL

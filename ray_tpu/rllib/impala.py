@@ -1,0 +1,249 @@
+"""IMPALA — asynchronous actor-learner with V-trace off-policy correction.
+
+Reference: ray ``rllib/algorithms/impala/`` (decoupled sampling and
+learning: EnvRunners produce trajectories under a stale behavior policy;
+the learner corrects with V-trace importance weights).  APPO is this plus a
+PPO-style clipped surrogate on the corrected advantages — exposed here via
+``APPOConfig`` (``use_appo_clip``).
+
+Async shape: each runner has one in-flight sample at all times; the learner
+harvests whichever finishes first, updates, and resubmits that runner with
+fresh params — sampling never barriers on the slowest runner.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.core.serialization import dumps_function
+
+from .algorithm import Algorithm, AlgorithmConfig, init_mlp, mlp_forward
+from .ppo import EnvRunner  # same on-policy sampler (returns logp_old)
+
+logger = logging.getLogger(__name__)
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 3e-3
+        self.hidden = 32
+        self.rollout_steps = 128
+        self.vtrace_clip_rho = 1.0
+        self.vtrace_clip_c = 1.0
+        self.entropy_coeff = 0.01
+        self.value_coeff = 0.5
+        self.batches_per_step = 4  # learner updates per train() call
+        self.use_appo_clip = False
+        self.appo_clip_eps = 0.2
+
+
+class APPOConfig(IMPALAConfig):
+    def __init__(self):
+        super().__init__()
+        self.use_appo_clip = True
+
+
+class IMPALA(Algorithm):
+    def setup(self, config: IMPALAConfig) -> None:
+        import jax
+        import optax
+
+        from .env import CartPole
+        from .ppo import _init_policy
+
+        maker = config.env_maker or (lambda: CartPole())
+        self._maker_payload = dumps_function(maker)
+        probe = maker()
+        self.obs_size = probe.observation_size
+        self.num_actions = probe.num_actions
+
+        key = jax.random.PRNGKey(config.seed)
+        self.params = _init_policy(
+            key, self.obs_size, self.num_actions, config.hidden
+        )
+        self.tx = optax.adam(config.lr)
+        self.opt_state = self.tx.init(self.params)
+
+        gamma = config.gamma
+        rho_bar = config.vtrace_clip_rho
+        c_bar = config.vtrace_clip_c
+        vf, ent = config.value_coeff, config.entropy_coeff
+        use_clip, clip_eps = config.use_appo_clip, config.appo_clip_eps
+        tx = self.tx
+
+        def vtrace_update(params, opt_state, batch):
+            """One V-trace update over a single trajectory (time-major)."""
+            import jax.numpy as jnp
+
+            from .ppo import _policy_forward
+
+            def loss_fn(p):
+                logits, values = _policy_forward(p, batch["obs"])
+                logp_all = jax.nn.log_softmax(logits)
+                logp = jnp.take_along_axis(
+                    logp_all, batch["actions"][:, None], axis=1
+                )[:, 0]
+                # Importance ratios target/behavior.
+                rhos = jnp.exp(logp - batch["logp_old"])
+                clipped_rho = jnp.minimum(rho_bar, rhos)
+                clipped_c = jnp.minimum(c_bar, rhos)
+                discounts = gamma * (1.0 - batch["dones"])
+                values_next = jnp.concatenate(
+                    [values[1:], batch["last_value"][None]]
+                )
+                deltas = clipped_rho * (
+                    batch["rewards"] + discounts * values_next - values
+                )
+
+                def scan_fn(acc, xs):
+                    delta, discount, c = xs
+                    acc = delta + discount * c * acc
+                    return acc, acc
+
+                _, vs_minus_v = jax.lax.scan(
+                    scan_fn,
+                    jnp.zeros(()),
+                    (deltas, discounts, clipped_c),
+                    reverse=True,
+                )
+                vs = jax.lax.stop_gradient(vs_minus_v + values)
+                vs_next = jnp.concatenate([vs[1:], batch["last_value"][None]])
+                pg_adv = jax.lax.stop_gradient(
+                    clipped_rho
+                    * (batch["rewards"] + discounts * vs_next - values)
+                )
+                if use_clip:  # APPO: clipped surrogate on vtrace advantages
+                    surrogate = jnp.minimum(
+                        rhos * pg_adv,
+                        jnp.clip(rhos, 1 - clip_eps, 1 + clip_eps) * pg_adv,
+                    )
+                    pg_loss = -jnp.mean(surrogate)
+                else:
+                    pg_loss = -jnp.mean(logp * pg_adv)
+                value_loss = jnp.mean((values - vs) ** 2)
+                entropy = -jnp.mean(
+                    jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1)
+                )
+                loss = pg_loss + vf * value_loss - ent * entropy
+                return loss, (pg_loss, value_loss, entropy)
+
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params
+            )
+            updates, opt_state = tx.update(grads, opt_state, params)
+            import optax as _optax
+
+            params = _optax.apply_updates(params, updates)
+            return params, opt_state, loss, aux
+
+        self._vtrace_update = jax.jit(vtrace_update)
+
+        self.runners = [
+            EnvRunner.remote(self._maker_payload, config.seed + i)
+            for i in range(config.num_env_runners)
+        ]
+        # One in-flight sample per runner at all times (the async core).
+        self._inflight: Dict[int, Any] = {}
+        np_params = self._np_params()
+        for i, r in enumerate(self.runners):
+            self._inflight[i] = r.sample.remote(
+                np_params, config.rollout_steps
+            )
+
+    def _np_params(self):
+        return {k: np.asarray(v) for k, v in self.params.items()}
+
+    def _make_runner(self, i: int):
+        return EnvRunner.remote(self._maker_payload, self.config.seed + i)
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        cfg = self.config
+        episode_returns: List[float] = []
+        steps = 0
+        loss = None
+        processed = 0
+        failures = 0
+        while processed < cfg.batches_per_step:
+            # Harvest whichever runner finishes first.
+            refs = list(self._inflight.values())
+            idx_by_ref = {ref: i for i, ref in self._inflight.items()}
+            ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=300)
+            if not ready:
+                raise TimeoutError("no env runner produced a batch in 300s")
+            ref = ready[0]
+            i = idx_by_ref[ref]
+            try:
+                traj = ray_tpu.get(ref, timeout=60)
+            except Exception as e:  # noqa: BLE001 — replace dead runner
+                failures += 1
+                if failures > 2 * len(self.runners) + 4:
+                    # A deterministic failure (e.g. env_maker unimportable
+                    # in workers) would otherwise respawn runners forever.
+                    raise RuntimeError(
+                        f"env runners keep failing ({failures} in one "
+                        f"step); last error: {e}"
+                    ) from e
+                logger.warning("runner %d failed (%s); replacing", i, e)
+                try:
+                    ray_tpu.kill(self.runners[i])
+                except Exception:
+                    pass
+                self.runners[i] = self._make_runner(i)
+                self._inflight[i] = self.runners[i].sample.remote(
+                    self._np_params(), cfg.rollout_steps
+                )
+                continue
+            batch = {
+                "obs": jnp.asarray(traj["obs"]),
+                "actions": jnp.asarray(traj["actions"]),
+                "rewards": jnp.asarray(traj["rewards"]),
+                "dones": jnp.asarray(traj["dones"], np.float32),
+                "logp_old": jnp.asarray(traj["logp_old"]),
+                "last_value": jnp.asarray(traj["last_value"], np.float32),
+            }
+            self.params, self.opt_state, loss, _aux = self._vtrace_update(
+                self.params, self.opt_state, batch
+            )
+            episode_returns.extend(traj["episode_returns"])
+            steps += len(traj["obs"])
+            processed += 1
+            # Resubmit with fresh params — only this runner, no barrier.
+            self._inflight[i] = self.runners[i].sample.remote(
+                self._np_params(), cfg.rollout_steps
+            )
+        return {
+            "episode_return_mean": (
+                float(np.mean(episode_returns)) if episode_returns else None
+            ),
+            "num_env_steps_sampled": steps,
+            "loss": float(loss) if loss is not None else None,
+        }
+
+    def get_state(self) -> Dict[str, Any]:
+        return {"params": self._np_params()}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.params = state["params"]
+        self.opt_state = self.tx.init(self.params)
+
+    def cleanup(self) -> None:
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+
+
+class APPO(IMPALA):
+    pass
+
+
+IMPALAConfig.ALGO_CLS = IMPALA
+APPOConfig.ALGO_CLS = APPO
