@@ -27,11 +27,14 @@ class ReplayBuffer:
                 k: np.zeros((self.capacity,) + v.shape[1:], v.dtype)
                 for k, v in batch.items()
             }
-        for i in range(n):
-            for k, v in batch.items():
-                self._storage[k][self._next] = v[i]
-            self._next = (self._next + 1) % self.capacity
-            self._size = min(self._size + 1, self.capacity)
+        if n >= self.capacity:  # keep only the newest capacity rows
+            batch = {k: v[n - self.capacity :] for k, v in batch.items()}
+            n = self.capacity
+        idx = (self._next + np.arange(n)) % self.capacity
+        for k, v in batch.items():
+            self._storage[k][idx] = v
+        self._next = int((self._next + n) % self.capacity)
+        self._size = min(self._size + n, self.capacity)
 
     def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
         idx = self._rng.integers(0, self._size, size=batch_size)
@@ -51,11 +54,11 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         self._max_priority = 1.0
 
     def add_batch(self, batch: Dict[str, np.ndarray]) -> None:
-        n = len(next(iter(batch.values())))
+        n = min(len(next(iter(batch.values()))), self.capacity)
         start = self._next
         super().add_batch(batch)
-        for i in range(n):
-            self._priorities[(start + i) % self.capacity] = self._max_priority
+        idx = (start + np.arange(n)) % self.capacity
+        self._priorities[idx] = self._max_priority
 
     def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
         prios = self._priorities[: self._size] ** self.alpha

@@ -277,9 +277,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-o", "--output", default=None)
     p.set_defaults(fn=cmd_timeline)
 
-    from . import job_cli
+    from . import job_cli, serve_cli
 
     job_cli.register(sub)
+    serve_cli.register(sub)
     return parser
 
 

@@ -1,5 +1,6 @@
 from .api import (  # noqa: F401
     delete,
+    deploy_config,
     get_handle,
     run,
     shutdown,
@@ -8,4 +9,5 @@ from .api import (  # noqa: F401
 )
 from .batching import batch  # noqa: F401
 from .deployment import Application, Deployment, deployment  # noqa: F401
-from .handle import DeploymentHandle  # noqa: F401
+from .handle import DeploymentHandle, DeploymentResponse  # noqa: F401
+from .multiplex import get_multiplexed_model_id, multiplexed  # noqa: F401

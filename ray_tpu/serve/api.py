@@ -33,29 +33,85 @@ def _get_or_create_controller():
 
 
 def run(app, name: str = "", route_prefix: Optional[str] = None) -> DeploymentHandle:
-    """Deploy an Application (or bare Deployment) and return its handle."""
+    """Deploy an Application (or bare Deployment) and return its handle.
+
+    Composition: ``.bind()`` arguments may themselves be bound applications
+    (``Pipeline.bind(model=Model.bind())``) — children deploy first and
+    arrive in the parent's constructor as ``DeploymentHandle``s (reference:
+    the deployment-graph build in ray ``serve/_private/build_app.py``).
+    """
     if isinstance(app, Deployment):
         app = Application(app)
     if not isinstance(app, Application):
         raise TypeError("serve.run expects an Application or Deployment")
-    d = app.deployment
     controller = _get_or_create_controller()
+    return _deploy_app(app, controller, route_prefix)
+
+
+def _deploy_app(
+    app: Application, controller, route_prefix: Optional[str] = None
+) -> DeploymentHandle:
+    def convert(v):
+        if isinstance(v, Deployment):
+            v = Application(v)
+        if isinstance(v, Application):
+            return _deploy_app(v, controller)
+        return v
+
+    init_args = tuple(convert(a) for a in app.init_args)
+    init_kwargs = {k: convert(v) for k, v in app.init_kwargs.items()}
+    d = app.deployment
     payload = dumps_function(d.func_or_class)
     ray_tpu.get(
         controller.deploy.remote(
             d.name,
             payload,
-            app.init_args,
-            app.init_kwargs,
+            init_args,
+            init_kwargs,
             d.num_replicas,
             d.ray_actor_options,
             d.version,
             d.max_ongoing_requests,
             route_prefix or d.route_prefix,
+            d.autoscaling_config,
         ),
         timeout=120,
     )
     return DeploymentHandle(d.name, controller)
+
+
+def deploy_config(config: Dict[str, Any]) -> Dict[str, DeploymentHandle]:
+    """Declarative multi-application deploy (reference: the REST config
+    schema, ray ``serve/schema.py`` / ``serve deploy``).  Schema::
+
+        {"applications": [
+            {"import_path": "pkg.mod:app",   # Application or Deployment
+             "route_prefix": "/x",           # optional
+             "deployment_overrides": {"num_replicas": 2, ...}}  # optional
+        ]}
+    """
+    import importlib
+
+    handles: Dict[str, DeploymentHandle] = {}
+    for spec in config.get("applications", []):
+        mod_name, _, attr = spec["import_path"].partition(":")
+        obj = getattr(importlib.import_module(mod_name), attr)
+        if isinstance(obj, Deployment):
+            obj = Application(obj)
+        if not isinstance(obj, Application):
+            raise TypeError(
+                f"{spec['import_path']} is not an Application/Deployment"
+            )
+        overrides = spec.get("deployment_overrides")
+        if overrides:
+            obj = Application(
+                obj.deployment.options(**overrides),
+                obj.init_args,
+                obj.init_kwargs,
+            )
+        handle = run(obj, route_prefix=spec.get("route_prefix"))
+        handles[obj.deployment.name] = handle
+    return handles
 
 
 def get_handle(name: str) -> DeploymentHandle:

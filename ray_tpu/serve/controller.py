@@ -1,29 +1,43 @@
 """Serve control plane: controller + replica actors.
 
 Reference architecture (ray ``python/ray/serve/_private/controller.py:107``,
-``deployment_state.py``, ``replica.py``): a singleton controller actor owns
-deployment state and reconciles target vs. actual replica actors (versioned
-in-place updates); replicas wrap the user callable and report queue depth
-used by the router's power-of-two-choices.
+``deployment_state.py``, ``replica.py``, ``autoscaling_state.py``): a
+singleton controller actor owns deployment state and runs a reconcile loop
+that (a) replaces dead replicas and (b) autoscales replica counts from
+queue metrics; replicas wrap the user callable and report queue depth used
+by the router's power-of-two-choices.
 """
 
 from __future__ import annotations
 
 import asyncio
+import logging
 import threading
-from typing import Any, Dict, List
+import time
+from typing import Any, Dict, List, Optional
 
 import ray_tpu
-from ray_tpu.core.serialization import dumps_function, loads_function
+from ray_tpu.core.serialization import loads_function
+
+logger = logging.getLogger(__name__)
 
 CONTROLLER_NAME = "_serve_controller"
+
+_AUTOSCALE_DEFAULTS = {
+    "min_replicas": 1,
+    "max_replicas": 4,
+    "target_ongoing_requests": 2.0,
+    "upscale_delay_s": 0.5,
+    "downscale_delay_s": 5.0,
+}
 
 
 @ray_tpu.remote
 class Replica:
     """Hosts one copy of the user callable."""
 
-    def __init__(self, payload: bytes, init_args, init_kwargs):
+    def __init__(self, payload: bytes, init_args, init_kwargs,
+                 max_ongoing_requests: int = 16):
         obj = loads_function(payload)
         if isinstance(obj, type):
             self.callable = obj(*init_args, **init_kwargs)
@@ -34,6 +48,11 @@ class Replica:
         self._ongoing = 0
         self._lock = threading.Lock()
         self._total = 0
+        # User-request concurrency is gated HERE, not by actor-level
+        # max_concurrency: system calls (queue_len / health_check) must
+        # bypass the user queue or a saturated replica looks dead and its
+        # metrics go dark (reference: replica system vs user concurrency).
+        self._user_sem = asyncio.Semaphore(max(1, max_ongoing_requests))
 
     def queue_len(self) -> int:
         return self._ongoing
@@ -41,20 +60,45 @@ class Replica:
     def stats(self) -> Dict[str, Any]:
         return {"ongoing": self._ongoing, "total": self._total}
 
-    async def handle_request(self, method: str, args, kwargs):
+    async def handle_request(self, method: str, args, kwargs,
+                             metadata: Optional[dict] = None):
+        from . import multiplex
+
         with self._lock:
+            # Counts queued + executing — the backlog signal autoscaling
+            # and pow-2 routing want.
             self._ongoing += 1
             self._total += 1
+        token = None
+        if metadata and metadata.get("multiplexed_model_id") is not None:
+            token = multiplex._model_id_var.set(
+                metadata["multiplexed_model_id"]
+            )
+        await self._user_sem.acquire()
         try:
             if self._is_class:
                 target = getattr(self.callable, method or "__call__")
             else:
                 target = self.callable
-            result = target(*args, **kwargs)
+            if asyncio.iscoroutinefunction(target):
+                result = target(*args, **kwargs)
+            else:
+                # Sync callables must NOT run on the replica's event loop: a
+                # blocking call (e.g. composing another deployment handle's
+                # .result()) would deadlock the loop and trip the worker
+                # watchdog.
+                loop = asyncio.get_running_loop()
+                ctx = __import__("contextvars").copy_context()
+                result = await loop.run_in_executor(
+                    None, lambda: ctx.run(target, *args, **kwargs)
+                )
             if asyncio.iscoroutine(result):
                 result = await result
             return result
         finally:
+            self._user_sem.release()
+            if token is not None:
+                multiplex._model_id_var.reset(token)
             with self._lock:
                 self._ongoing -= 1
 
@@ -73,52 +117,193 @@ class Replica:
 class ServeController:
     """Singleton named actor owning all deployment state."""
 
-    def __init__(self):
-        # name -> {"spec": dict, "replicas": [handles], "version": str}
-        self.deployments: Dict[str, dict] = {}
+    RECONCILE_PERIOD_S = 0.5
 
+    def __init__(self):
+        # name -> {"spec": {...}, "replicas": [handles], "version": str, ...}
+        self.deployments: Dict[str, dict] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._reconciler = threading.Thread(
+            target=self._reconcile_loop, daemon=True, name="serve-reconcile"
+        )
+        self._reconciler.start()
+
+    # ------------------------------------------------------------- deploy API
     def deploy(self, name: str, payload: bytes, init_args, init_kwargs,
                num_replicas: int, ray_actor_options: dict, version: str,
-               max_ongoing_requests: int, route_prefix):
-        import ray_tpu as rt
-
-        entry = self.deployments.get(name)
-        if entry is not None and entry["version"] != version:
-            # Versioned update: replace replicas in place.
-            for h in entry["replicas"]:
-                try:
-                    rt.kill(h)
-                except Exception:
-                    pass
-            entry = None
-        if entry is None:
-            entry = {"replicas": [], "version": version}
-        opts = dict(ray_actor_options or {})
-        opts.setdefault("max_concurrency", max(2, max_ongoing_requests))
-        current = len(entry["replicas"])
-        if num_replicas > current:
-            for _ in range(num_replicas - current):
-                entry["replicas"].append(
-                    Replica.options(**opts).remote(payload, init_args, init_kwargs)
+               max_ongoing_requests: int, route_prefix,
+               autoscaling_config: Optional[dict] = None):
+        with self._lock:
+            entry = self.deployments.get(name)
+            if entry is not None and entry["version"] != version:
+                # Versioned update: replace replicas in place.
+                for h in entry["replicas"]:
+                    self._kill(h)
+                entry = None
+            if entry is None:
+                entry = {"replicas": [], "version": version}
+            opts = dict(ray_actor_options or {})
+            # Actor-level concurrency must never be the user-request gate:
+            # queued handle_request coroutines waiting on _user_sem hold
+            # actor slots, and system calls (queue_len/health_check) need a
+            # slot immediately even when the replica is saturated.  So the
+            # actor runs effectively unbounded and _user_sem alone caps
+            # concurrent user work.
+            opts.setdefault("max_concurrency", 1000)
+            entry["spec"] = {
+                "payload": payload,
+                "init_args": init_args,
+                "init_kwargs": init_kwargs,
+                "opts": opts,
+                "max_ongoing_requests": max_ongoing_requests,
+            }
+            entry["version"] = version
+            entry["route_prefix"] = route_prefix or f"/{name}"
+            entry["max_ongoing_requests"] = max_ongoing_requests
+            if autoscaling_config is not None:
+                entry["autoscaling"] = dict(
+                    _AUTOSCALE_DEFAULTS, **autoscaling_config
                 )
-        elif num_replicas < current:
-            for h in entry["replicas"][num_replicas:]:
-                try:
-                    rt.kill(h)
-                except Exception:
-                    pass
-            entry["replicas"] = entry["replicas"][:num_replicas]
-        entry["version"] = version
-        entry["route_prefix"] = route_prefix or f"/{name}"
-        entry["max_ongoing_requests"] = max_ongoing_requests
-        self.deployments[name] = entry
-        return {"name": name, "num_replicas": len(entry["replicas"])}
+                num_replicas = max(
+                    entry["autoscaling"]["min_replicas"],
+                    min(num_replicas, entry["autoscaling"]["max_replicas"]),
+                )
+            else:
+                entry.pop("autoscaling", None)
+            entry["last_scale_ts"] = time.monotonic()
+            entry["scale_pressure_since"] = None
+            self._set_replica_count(entry, num_replicas)
+            self.deployments[name] = entry
+            return {"name": name, "num_replicas": len(entry["replicas"])}
 
+    def _spawn_replica(self, entry: dict):
+        spec = entry["spec"]
+        return Replica.options(**spec["opts"]).remote(
+            spec["payload"],
+            spec["init_args"],
+            spec["init_kwargs"],
+            spec.get("max_ongoing_requests", 16),
+        )
+
+    def _set_replica_count(self, entry: dict, n: int) -> None:
+        current = len(entry["replicas"])
+        if n > current:
+            for _ in range(n - current):
+                entry["replicas"].append(self._spawn_replica(entry))
+        elif n < current:
+            for h in entry["replicas"][n:]:
+                self._kill(h)
+            entry["replicas"] = entry["replicas"][:n]
+
+    @staticmethod
+    def _kill(handle) -> None:
+        try:
+            ray_tpu.kill(handle)
+        except Exception:
+            pass
+
+    # --------------------------------------------------------- reconcile loop
+    def _reconcile_loop(self):
+        while not self._stop.wait(self.RECONCILE_PERIOD_S):
+            try:
+                self._reconcile_once()
+            except Exception as e:  # noqa: BLE001
+                logger.warning("serve reconcile round failed: %s", e)
+
+    def _reconcile_once(self):
+        with self._lock:
+            entries = list(self.deployments.items())
+        for name, entry in entries:
+            self._replace_dead_replicas(name, entry)
+            if "autoscaling" in entry:
+                self._autoscale(name, entry)
+
+    def _replace_dead_replicas(self, name: str, entry: dict):
+        """Health check every replica; respawn the dead (reference:
+        DeploymentState reconciling target vs. actual).  Checks are issued
+        concurrently with one shared deadline, and respawn revalidates the
+        entry under the lock — deploy()/delete() may have replaced it
+        while the (slow) checks ran."""
+        import time as _time
+
+        replicas = list(entry["replicas"])
+        refs = [(h, h.health_check.remote()) for h in replicas]
+        deadline = _time.monotonic() + 10
+        dead = []
+        for h, ref in refs:
+            remaining = max(0.1, deadline - _time.monotonic())
+            try:
+                ray_tpu.get(ref, timeout=remaining)
+            except Exception:  # noqa: BLE001
+                dead.append(h)
+        if not dead:
+            return
+        with self._lock:
+            if self.deployments.get(name) is not entry:
+                return  # entry was redeployed/deleted while we checked
+            for h in dead:
+                try:
+                    idx = entry["replicas"].index(h)
+                except ValueError:
+                    continue  # already scaled away
+                logger.warning(
+                    "deployment %s replica %d unhealthy; replacing", name, idx
+                )
+                self._kill(h)
+                entry["replicas"][idx] = self._spawn_replica(entry)
+
+    def _autoscale(self, name: str, entry: dict):
+        cfg = entry["autoscaling"]
+        replicas = entry["replicas"]
+        if not replicas:
+            return
+        try:
+            queue_lens = ray_tpu.get(
+                [h.queue_len.remote() for h in replicas], timeout=5
+            )
+        except Exception:  # noqa: BLE001 — dead replicas handled above
+            return
+        per_replica = sum(queue_lens) / len(replicas)
+        target = cfg["target_ongoing_requests"]
+        now = time.monotonic()
+        desired = None
+        if per_replica > target and len(replicas) < cfg["max_replicas"]:
+            if entry["scale_pressure_since"] is None:
+                entry["scale_pressure_since"] = now
+            if now - entry["scale_pressure_since"] >= cfg["upscale_delay_s"]:
+                desired = min(
+                    cfg["max_replicas"],
+                    max(
+                        len(replicas) + 1,
+                        int(len(replicas) * per_replica / target),
+                    ),
+                )
+        elif per_replica < target * 0.5 and len(replicas) > cfg["min_replicas"]:
+            if entry["scale_pressure_since"] is None:
+                entry["scale_pressure_since"] = now
+            if now - entry["scale_pressure_since"] >= cfg["downscale_delay_s"]:
+                desired = max(cfg["min_replicas"], len(replicas) - 1)
+        else:
+            entry["scale_pressure_since"] = None
+        if desired is not None and desired != len(replicas):
+            logger.info(
+                "autoscaling %s: %d -> %d (avg ongoing %.2f, target %.2f)",
+                name, len(replicas), desired, per_replica, target,
+            )
+            with self._lock:
+                if self.deployments.get(name) is not entry:
+                    return
+                self._set_replica_count(entry, desired)
+                entry["scale_pressure_since"] = None
+                entry["last_scale_ts"] = now
+
+    # -------------------------------------------------------------- query API
     def get_replicas(self, name: str) -> List:
         entry = self.deployments.get(name)
         if entry is None:
             raise KeyError(f"deployment {name!r} not found")
-        return entry["replicas"]
+        return list(entry["replicas"])
 
     def get_routes(self) -> Dict[str, str]:
         return {
@@ -126,17 +311,13 @@ class ServeController:
         }
 
     def delete_deployment(self, name: str) -> bool:
-        import ray_tpu as rt
-
-        entry = self.deployments.pop(name, None)
-        if entry is None:
-            return False
-        for h in entry["replicas"]:
-            try:
-                rt.kill(h)
-            except Exception:
-                pass
-        return True
+        with self._lock:
+            entry = self.deployments.pop(name, None)
+            if entry is None:
+                return False
+            for h in entry["replicas"]:
+                self._kill(h)
+            return True
 
     def status(self) -> Dict[str, Any]:
         return {
@@ -144,6 +325,7 @@ class ServeController:
                 "num_replicas": len(e["replicas"]),
                 "version": e["version"],
                 "route_prefix": e["route_prefix"],
+                "autoscaling": e.get("autoscaling"),
             }
             for name, e in self.deployments.items()
         }

@@ -22,6 +22,10 @@ class Deployment:
     max_ongoing_requests: int = 16
     version: str = "1"
     route_prefix: Optional[str] = None
+    # {"min_replicas", "max_replicas", "target_ongoing_requests",
+    #  "upscale_delay_s", "downscale_delay_s"} — reference
+    # ``serve/autoscaling_policy.py`` defaults.
+    autoscaling_config: Optional[Dict[str, Any]] = None
 
     def options(self, **kwargs) -> "Deployment":
         merged = dataclasses.asdict(self)
@@ -45,7 +49,8 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
                ray_actor_options: Optional[Dict[str, Any]] = None,
                max_ongoing_requests: int = 16,
                version: str = "1",
-               route_prefix: Optional[str] = None):
+               route_prefix: Optional[str] = None,
+               autoscaling_config: Optional[Dict[str, Any]] = None):
     """``@serve.deployment`` decorator."""
 
     def wrap(obj) -> Deployment:
@@ -57,6 +62,7 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
             max_ongoing_requests=max_ongoing_requests,
             version=version,
             route_prefix=route_prefix,
+            autoscaling_config=autoscaling_config,
         )
 
     if _func_or_class is not None:

@@ -42,12 +42,28 @@ class _MethodCaller:
 
 
 class DeploymentHandle:
-    def __init__(self, deployment_name: str, controller=None):
+    def __init__(self, deployment_name: str, controller=None,
+                 multiplexed_model_id: Optional[str] = None):
         self.deployment_name = deployment_name
         self._controller = controller
         self._replicas: List = []
         self._refreshed = 0.0
         self._rr = 0
+        self._multiplexed_model_id = multiplexed_model_id
+        # model_id -> actor id of the replica that last served it (session
+        # affinity — the reference's multiplex-aware router prefers replicas
+        # already holding the model).
+        self._model_affinity: dict = {}
+
+    def options(self, *, multiplexed_model_id: Optional[str] = None
+                ) -> "DeploymentHandle":
+        clone = DeploymentHandle(
+            self.deployment_name, self._controller, multiplexed_model_id
+        )
+        clone._replicas = self._replicas
+        clone._refreshed = self._refreshed
+        clone._model_affinity = self._model_affinity
+        return clone
 
     def _get_controller(self):
         if self._controller is None:
@@ -83,9 +99,32 @@ class DeploymentHandle:
         return a if qa <= qb else b
 
     def _invoke(self, method: str, args, kwargs) -> DeploymentResponse:
-        replica = self._pick_replica()
+        model_id = self._multiplexed_model_id
+        replica = None
+        if model_id is not None:
+            # Session affinity: route back to the replica that has the model.
+            sticky = self._model_affinity.get(model_id)
+            self._refresh()
+            for r in self._replicas:
+                if r._actor_id == sticky:
+                    replica = r
+                    break
+            if replica is not None:
+                try:  # liveness probe — the cached list may be stale
+                    ray_tpu.get(replica.queue_len.remote(), timeout=3)
+                except Exception:  # noqa: BLE001
+                    self._model_affinity.pop(model_id, None)
+                    self._refresh(force=True)
+                    replica = None
+        if replica is None:
+            replica = self._pick_replica()
+            if model_id is not None:
+                self._model_affinity[model_id] = replica._actor_id
         self._rr += 1
-        ref = replica.handle_request.remote(method, args, kwargs)
+        metadata = (
+            {"multiplexed_model_id": model_id} if model_id is not None else None
+        )
+        ref = replica.handle_request.remote(method, args, kwargs, metadata)
         return DeploymentResponse(ref)
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:

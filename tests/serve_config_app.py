@@ -1,0 +1,12 @@
+"""Importable app for the declarative-config deploy test."""
+
+import ray_tpu.serve as serve
+
+
+@serve.deployment(name="ConfigEcho", ray_actor_options={"num_cpus": 0})
+class ConfigEcho:
+    def __call__(self, x):
+        return f"echo:{x}"
+
+
+app = ConfigEcho.bind()

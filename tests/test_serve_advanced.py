@@ -1,0 +1,173 @@
+"""Serve autoscaling, composition, multiplexing, replica FT, and config
+deploy (reference test model: ray ``python/ray/serve/tests/``)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+import ray_tpu.serve as serve
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ctx = ray_tpu.init(num_cpus=8)
+    yield ctx
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _wait_for(pred, timeout=30, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.3)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def test_composition_handle_in_handle(cluster):
+    @serve.deployment(ray_actor_options={"num_cpus": 0})
+    class Adder:
+        def __init__(self, delta):
+            self.delta = delta
+
+        def __call__(self, x):
+            return x + self.delta
+
+    @serve.deployment(ray_actor_options={"num_cpus": 0})
+    class Pipeline:
+        def __init__(self, adder):
+            self.adder = adder
+
+        def __call__(self, x):
+            partial = self.adder.remote(x).result(timeout=30)
+            return partial * 10
+
+    handle = serve.run(Pipeline.bind(Adder.bind(5)))
+    assert handle.remote(2).result(timeout=60) == 70
+    serve.delete("Pipeline")
+    serve.delete("Adder")
+
+
+def test_autoscaling_up_and_down(cluster):
+    @serve.deployment(
+        ray_actor_options={"num_cpus": 0},
+        max_ongoing_requests=2,
+        autoscaling_config={
+            "min_replicas": 1,
+            "max_replicas": 3,
+            "target_ongoing_requests": 1.0,
+            "upscale_delay_s": 0.2,
+            "downscale_delay_s": 1.0,
+        },
+    )
+    class Slow:
+        async def __call__(self):
+            import asyncio
+
+            await asyncio.sleep(0.4)
+            return "ok"
+
+    handle = serve.run(Slow.bind())
+    assert serve.status()["Slow"]["num_replicas"] == 1
+    # Sustained pressure: many concurrent requests.
+    responses = [handle.remote() for _ in range(40)]
+    _wait_for(
+        lambda: serve.status()["Slow"]["num_replicas"] >= 2,
+        timeout=30,
+        msg="scale up",
+    )
+    for r in responses:
+        assert r.result(timeout=60) == "ok"
+    _wait_for(
+        lambda: serve.status()["Slow"]["num_replicas"] == 1,
+        timeout=30,
+        msg="scale down",
+    )
+    serve.delete("Slow")
+
+
+def test_dead_replica_replaced(cluster):
+    @serve.deployment(ray_actor_options={"num_cpus": 0})
+    class Fragile:
+        def __call__(self):
+            return "alive"
+
+        def crash(self):
+            import os
+
+            os._exit(1)
+
+    handle = serve.run(Fragile.bind())
+    assert handle.remote().result(timeout=60) == "alive"
+    try:
+        handle.crash.remote().result(timeout=10)
+    except Exception:
+        pass
+    # Reconciler replaces the dead replica; requests succeed again.
+    def works():
+        try:
+            fresh = serve.get_handle("Fragile")
+            return fresh.remote().result(timeout=10) == "alive"
+        except Exception:
+            return False
+
+    _wait_for(works, timeout=40, msg="replica replacement")
+    serve.delete("Fragile")
+
+
+def test_multiplexed_models(cluster):
+    @serve.deployment(ray_actor_options={"num_cpus": 0})
+    class MultiModel:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id: str):
+            self.loads.append(model_id)
+            return {"id": model_id, "weights": model_id * 2}
+
+        async def __call__(self, x):
+            model_id = serve.get_multiplexed_model_id()
+            model = await self.get_model(model_id)
+            return f"{model['id']}:{x}"
+
+        def load_count(self):
+            return len(self.loads)
+
+    handle = serve.run(MultiModel.bind())
+    h_a = handle.options(multiplexed_model_id="ma")
+    h_b = handle.options(multiplexed_model_id="mb")
+    assert h_a.remote(1).result(timeout=60) == "ma:1"
+    assert h_b.remote(2).result(timeout=60) == "mb:2"
+    assert h_a.remote(3).result(timeout=60) == "ma:3"
+    # LRU: 2 distinct models → exactly 2 loads despite 3 calls.
+    loads = serve.get_handle("MultiModel").load_count.remote().result(timeout=30)
+    assert loads == 2
+    serve.delete("MultiModel")
+
+
+def test_deploy_config_and_cli_status(cluster, tmp_path, capsys):
+    import json
+
+    config = {
+        "applications": [
+            {
+                "import_path": "tests.serve_config_app:app",
+                "route_prefix": "/echo2",
+                "deployment_overrides": {"num_replicas": 2},
+            }
+        ]
+    }
+    handles = serve.deploy_config(config)
+    assert "ConfigEcho" in handles
+    assert handles["ConfigEcho"].remote("hi").result(timeout=60) == "echo:hi"
+    assert serve.status()["ConfigEcho"]["num_replicas"] == 2
+
+    from ray_tpu.scripts.cli import main
+
+    assert main(["serve", "status"]) == 0
+    out = capsys.readouterr().out
+    assert "ConfigEcho" in out
+    serve.delete("ConfigEcho")
