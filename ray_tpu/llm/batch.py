@@ -1,0 +1,62 @@
+"""Batch LLM inference over the Data layer.
+
+Reference: ray ``python/ray/llm/_internal/batch/`` (the ``Processor``
+pipeline applying a vLLM stage to a Dataset via actor pools).  Here the
+stage is a stateful UDF holding a ``JaxLLMEngine``, executed by
+``map_batches(compute=ActorPoolStrategy(...))`` so the engine loads once
+per actor and blocks stream through.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from .engine import EngineConfig, JaxLLMEngine, SamplingParams
+
+
+class _LLMStage:
+    """Callable-class UDF: one engine per data-actor."""
+
+    def __init__(self, engine_cfg: Optional[EngineConfig],
+                 sampling: Optional[SamplingParams],
+                 input_column: str, output_column: str):
+        self.engine = JaxLLMEngine(engine_cfg or EngineConfig())
+        self.sampling = sampling or SamplingParams()
+        self.input_column = input_column
+        self.output_column = output_column
+
+    def __call__(self, block):
+        prompts = [row[self.input_column] for row in block]
+        outputs = self.engine.generate(prompts, self.sampling)
+        return [
+            {**row, self.output_column: out["text"]}
+            for row, out in zip(block, outputs)
+        ]
+
+
+def build_llm_processor(
+    engine_cfg: Optional[EngineConfig] = None,
+    sampling: Optional[SamplingParams] = None,
+    *,
+    input_column: str = "prompt",
+    output_column: str = "generated",
+    concurrency: int = 1,
+    num_tpus: float = 0,
+):
+    """Returns ``fn(Dataset) -> Dataset`` adding ``output_column``."""
+    from ..data import ActorPoolStrategy
+
+    def process(dataset):
+        return dataset.map_batches(
+            _LLMStage,
+            fn_constructor_args=(
+                engine_cfg, sampling, input_column, output_column
+            ),
+            compute=ActorPoolStrategy(
+                size=concurrency,
+                num_tpus=num_tpus,
+                num_cpus=1 if not num_tpus else 0,
+            ),
+        )
+
+    return process

@@ -1,0 +1,263 @@
+"""JAX LLM engine: slot-based continuous batching over a KV cache.
+
+Role-equivalent of the reference's vLLM engine wrapper (ray
+``python/ray/llm/_internal/serve/engines/vllm/``) — but the engine IS the
+TPU program: a fixed pool of batch slots shares one jitted decode step, so
+requests join and leave the batch at token granularity (continuous
+batching) and the chip never waits for the longest request in a batch.
+
+Shapes are static (max_batch_size × max_seq_len) so XLA compiles exactly
+two programs: prefill and decode.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..models.gpt2 import GPT2Config, gpt2_init
+from ..models.gpt2_decode import (
+    gpt2_decode_step,
+    gpt2_init_cache,
+    gpt2_prefill,
+    sample_logits,
+)
+from .tokenizer import ByteTokenizer
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    max_tokens: int = 64
+    temperature: float = 0.0  # 0 → greedy
+    top_k: int = 0
+    top_p: float = 1.0
+    stop_token: Optional[int] = None  # default: tokenizer EOS
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    model: GPT2Config = dataclasses.field(
+        default_factory=lambda: GPT2Config.tiny(vocab_size=384)
+    )
+    max_batch_size: int = 8
+    max_seq_len: int = 128
+    seed: int = 0
+    # Optional: callable returning trained params (checkpoint load); default
+    # random init (tests / smoke).
+    param_loader: Optional[Callable[[], Any]] = None
+
+
+@dataclasses.dataclass
+class _Slot:
+    request_id: int
+    prompt_len: int
+    pos: int  # position of the last written token
+    generated: List[int]
+    params: SamplingParams
+    done: bool = False
+
+
+class JaxLLMEngine:
+    def __init__(self, cfg: EngineConfig, tokenizer=None):
+        import jax
+
+        self.cfg = cfg
+        self.tokenizer = tokenizer or ByteTokenizer()
+        mcfg = cfg.model
+        if cfg.param_loader is not None:
+            self.params = cfg.param_loader()
+        else:
+            self.params = gpt2_init(jax.random.PRNGKey(cfg.seed), mcfg)
+        self._key = jax.random.PRNGKey(cfg.seed + 1)
+        self.cache = gpt2_init_cache(mcfg, cfg.max_batch_size, cfg.max_seq_len)
+        # Per-slot state; None = free.
+        self.slots: List[Optional[_Slot]] = [None] * cfg.max_batch_size
+        self._next_id = itertools.count()
+        self._waiting: List[tuple] = []  # (request_id, token_ids, params)
+        self._finished: Dict[int, dict] = {}
+
+        def prefill_one(params, cache, tokens, length, slot_idx):
+            """Prefill a single request into batch row ``slot_idx``."""
+            import jax.numpy as jnp
+
+            one_cache = gpt2_init_cache(mcfg, 1, cfg.max_seq_len)
+            logits, one_cache = gpt2_prefill(
+                params, tokens[None], jnp.asarray([length]), one_cache, mcfg
+            )
+            cache = {
+                "k": jax.lax.dynamic_update_slice(
+                    cache["k"], one_cache["k"], (0, slot_idx, 0, 0, 0)
+                ),
+                "v": jax.lax.dynamic_update_slice(
+                    cache["v"], one_cache["v"], (0, slot_idx, 0, 0, 0)
+                ),
+            }
+            return logits[0], cache
+
+        self._prefill_one = jax.jit(prefill_one, donate_argnums=(1,))
+        self._decode = jax.jit(
+            lambda params, cache, tokens, pos: gpt2_decode_step(
+                params, tokens, pos, cache, mcfg
+            ),
+            donate_argnums=(1,),
+        )
+        # Sampling params are static: Python branches inside sample_logits;
+        # one small compile per distinct SamplingParams config.
+        self._sample = jax.jit(
+            sample_logits,
+            static_argnames=("temperature", "top_k", "top_p"),
+        )
+
+    # ----------------------------------------------------------------- queue
+    def add_request(
+        self, prompt: str, params: Optional[SamplingParams] = None
+    ) -> int:
+        params = params or SamplingParams()
+        token_ids = self.tokenizer.encode(prompt)
+        max_prompt = self.cfg.max_seq_len - 1
+        token_ids = token_ids[-max_prompt:]
+        request_id = next(self._next_id)
+        self._waiting.append((request_id, token_ids, params))
+        return request_id
+
+    def _free_slot(self) -> Optional[int]:
+        for i, s in enumerate(self.slots):
+            if s is None:
+                return i
+        return None
+
+    def _admit(self):
+        import jax.numpy as jnp
+
+        while self._waiting:
+            idx = self._free_slot()
+            if idx is None:
+                return
+            request_id, token_ids, params = self._waiting.pop(0)
+            tokens = np.zeros(self.cfg.max_seq_len, np.int32)
+            tokens[: len(token_ids)] = token_ids
+            logits, self.cache = self._prefill_one(
+                self.params,
+                self.cache,
+                jnp.asarray(tokens),
+                len(token_ids),
+                idx,
+            )
+            first = self._sample_one(logits[None], params)[0]
+            slot = _Slot(
+                request_id=request_id,
+                prompt_len=len(token_ids),
+                pos=len(token_ids) - 1,
+                generated=[int(first)],
+                params=params,
+            )
+            self.slots[idx] = slot
+            self._check_done(slot, int(first))
+
+    def _sample_one(self, logits, params: SamplingParams):
+        import jax
+
+        self._key, sub = jax.random.split(self._key)
+        return np.asarray(
+            self._sample(
+                logits,
+                sub,
+                temperature=params.temperature,
+                top_k=params.top_k,
+                top_p=params.top_p,
+            )
+        )
+
+    def _check_done(self, slot: _Slot, token: int):
+        stop = (
+            slot.params.stop_token
+            if slot.params.stop_token is not None
+            else getattr(self.tokenizer, "EOS", None)
+        )
+        total_len = slot.prompt_len + len(slot.generated)
+        if (
+            (stop is not None and token == stop)
+            or len(slot.generated) >= slot.params.max_tokens
+            or total_len >= self.cfg.max_seq_len - 1
+        ):
+            slot.done = True
+
+    # ------------------------------------------------------------------ step
+    def step(self) -> List[dict]:
+        """Admit waiting requests, run ONE decode step for all active slots,
+        retire finished requests.  Returns newly finished outputs."""
+        import jax.numpy as jnp
+
+        self._admit()
+        self._retire()
+        active = [
+            (i, s) for i, s in enumerate(self.slots)
+            if s is not None and not s.done
+        ]
+        if active:
+            tokens = np.zeros(self.cfg.max_batch_size, np.int32)
+            pos = np.zeros(self.cfg.max_batch_size, np.int32)
+            for i, s in active:
+                tokens[i] = s.generated[-1]
+                pos[i] = s.prompt_len + len(s.generated) - 1
+            logits, self.cache = self._decode(
+                self.params, self.cache, jnp.asarray(tokens), jnp.asarray(pos)
+            )
+            logits_np = logits  # stays on device for sampling
+            for i, s in active:
+                token = int(
+                    self._sample_one(logits_np[i : i + 1], s.params)[0]
+                )
+                s.generated.append(token)
+                s.pos += 1
+                self._check_done(s, token)
+        return self._retire()
+
+    def _retire(self) -> List[dict]:
+        out = []
+        for i, s in enumerate(self.slots):
+            if s is not None and s.done:
+                gen = s.generated
+                stop = (
+                    s.params.stop_token
+                    if s.params.stop_token is not None
+                    else getattr(self.tokenizer, "EOS", None)
+                )
+                if stop is not None and gen and gen[-1] == stop:
+                    gen = gen[:-1]
+                result = {
+                    "request_id": s.request_id,
+                    "token_ids": gen,
+                    "text": self.tokenizer.decode(gen),
+                    "num_generated": len(s.generated),
+                }
+                self._finished[s.request_id] = result
+                out.append(result)
+                self.slots[i] = None
+        return out
+
+    def has_unfinished(self) -> bool:
+        return bool(self._waiting) or any(
+            s is not None for s in self.slots
+        )
+
+    # ------------------------------------------------------------- generate
+    def generate(
+        self,
+        prompts: List[str],
+        params: Optional[SamplingParams] = None,
+        timeout_s: float = 300.0,
+    ) -> List[dict]:
+        """Blocking batch generation (requests stream through the slot pool
+        regardless of len(prompts) vs max_batch_size)."""
+        ids = [self.add_request(p, params) for p in prompts]
+        deadline = time.monotonic() + timeout_s
+        while self.has_unfinished():
+            if time.monotonic() > deadline:
+                raise TimeoutError("generation exceeded timeout")
+            self.step()
+        return [self._finished.pop(i) for i in ids]

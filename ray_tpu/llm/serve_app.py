@@ -1,0 +1,123 @@
+"""OpenAI-compatible LLM serving on top of ``ray_tpu.serve``.
+
+Reference: ray ``python/ray/llm/_internal/serve/core/server/`` (the
+OpenAI-compatible router over vLLM deployments) and ``serve/llm``'s
+``build_openai_app``.  The deployment holds one ``JaxLLMEngine`` per
+replica (one chip each via ``num_tpus=1``); ``@serve.batch`` coalesces
+concurrent single-prompt calls so they enter the engine's continuous batch
+together.  Endpoints: ``/v1/completions`` and ``/v1/chat/completions``
+via the serve HTTP proxy (the raw JSON body arrives as the call's single
+argument).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from .. import serve
+from .engine import EngineConfig, JaxLLMEngine, SamplingParams
+
+
+def _sampling_from_request(body: Dict[str, Any]) -> SamplingParams:
+    return SamplingParams(
+        max_tokens=int(body.get("max_tokens", 64)),
+        temperature=float(body.get("temperature", 0.0)),
+        top_p=float(body.get("top_p", 1.0)),
+    )
+
+
+@serve.deployment(name="LLMServer", ray_actor_options={"num_cpus": 1})
+class LLMServer:
+    """One engine per replica; requests batch dynamically."""
+
+    def __init__(self, engine_cfg: Optional[EngineConfig] = None,
+                 model_name: str = "ray-tpu-gpt2"):
+        self.engine = JaxLLMEngine(engine_cfg or EngineConfig())
+        self.model_name = model_name
+
+    @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.02)
+    async def _generate_batch(self, requests: List[tuple]):
+        """requests: [(prompt, SamplingParams)] — one engine pass serves
+        them all (the engine's slot pool IS the batch)."""
+        ids = [
+            self.engine.add_request(prompt, params)
+            for prompt, params in requests
+        ]
+        while self.engine.has_unfinished():
+            self.engine.step()
+        return [self.engine._finished.pop(i) for i in ids]
+
+    async def __call__(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        """OpenAI completions-ish: dispatch on request shape."""
+        if "messages" in body:
+            return await self.chat(body)
+        return await self.completions(body)
+
+    async def completions(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        prompt = body.get("prompt", "")
+        out = await self._generate_batch((prompt, _sampling_from_request(body)))
+        return {
+            "id": f"cmpl-{uuid.uuid4().hex[:12]}",
+            "object": "text_completion",
+            "created": int(time.time()),
+            "model": body.get("model", self.model_name),
+            "choices": [
+                {
+                    "index": 0,
+                    "text": out["text"],
+                    "finish_reason": "stop",
+                }
+            ],
+            "usage": {
+                "completion_tokens": out["num_generated"],
+                "prompt_tokens": len(prompt),
+                "total_tokens": len(prompt) + out["num_generated"],
+            },
+        }
+
+    async def chat(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        messages = body.get("messages", [])
+        prompt = "\n".join(
+            f"{m.get('role', 'user')}: {m.get('content', '')}"
+            for m in messages
+        ) + "\nassistant:"
+        out = await self._generate_batch((prompt, _sampling_from_request(body)))
+        return {
+            "id": f"chatcmpl-{uuid.uuid4().hex[:12]}",
+            "object": "chat.completion",
+            "created": int(time.time()),
+            "model": body.get("model", self.model_name),
+            "choices": [
+                {
+                    "index": 0,
+                    "message": {
+                        "role": "assistant",
+                        "content": out["text"],
+                    },
+                    "finish_reason": "stop",
+                }
+            ],
+            "usage": {"completion_tokens": out["num_generated"]},
+        }
+
+
+def build_openai_app(
+    engine_cfg: Optional[EngineConfig] = None,
+    model_name: str = "ray-tpu-gpt2",
+    num_replicas: int = 1,
+    num_tpus: float = 0,
+):
+    """Build the Serve application; run with ``serve.run(app)`` and expose
+    via ``serve.start_http_proxy()`` — then POST to ``/v1/completions`` or
+    ``/v1/chat/completions``."""
+    opts: Dict[str, Any] = {"num_cpus": 1}
+    if num_tpus:
+        opts = {"num_cpus": 0, "num_tpus": num_tpus}
+    d = LLMServer.options(
+        num_replicas=num_replicas,
+        ray_actor_options=opts,
+        route_prefix="/v1",
+    )
+    return d.bind(engine_cfg, model_name)

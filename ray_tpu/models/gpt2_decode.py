@@ -1,0 +1,154 @@
+"""KV-cache inference path for GPT-2: prefill + single-token decode.
+
+The serving analog of the training forward in ``gpt2.py`` (reference role:
+the model runner inside the vLLM engine the reference wraps, ray
+``python/ray/llm/_internal/serve/engines/vllm/``).  TPU-first decisions:
+  - the KV cache is a pair of layer-stacked arrays ``[L, B, S_max, H, D]``
+    living in HBM across steps; decode updates them with
+    ``dynamic_update_slice`` (XLA keeps the update in place under jit
+    donation);
+  - both phases scan over the layer axis (one compile regardless of depth);
+  - per-slot positions make the batch *ragged*: each sequence attends only
+    to its own ``[0, pos]`` prefix, so one jitted decode step serves a
+    continuous batch of requests at different generation offsets.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .gpt2 import GPT2Config, _layernorm
+
+
+def gpt2_init_cache(cfg: GPT2Config, batch: int, max_len: int):
+    shape = (cfg.n_layer, batch, max_len, cfg.n_head, cfg.head_dim)
+    dt = jnp.dtype(cfg.dtype)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def _qkv(x, layer):
+    qkv = jnp.einsum("bse,ethd->bsthd", x, layer["wqkv"]) + layer["bqkv"]
+    return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+
+def _masked_attention(q, k, v, mask):
+    """q [B,S,H,D] over k/v [B,T,H,D] with additive bool mask [B,S,T]."""
+    scores = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32)
+    scores = scores / (q.shape[-1] ** 0.5)
+    scores = jnp.where(mask[:, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhst,bthd->bshd", probs, v)
+
+
+def gpt2_prefill(
+    params, tokens, lengths, cache, cfg: GPT2Config
+) -> Tuple[jnp.ndarray, dict]:
+    """Run the prompt through the model, filling the cache.
+
+    tokens: [B, S] right-padded prompts; lengths: [B] true lengths.
+    Returns (last_logits [B, V], cache with positions [0, S) written).
+    """
+    b, s = tokens.shape
+    x = params["wte"][tokens] + params["wpe"][:s][None]
+    x = x.astype(jnp.dtype(cfg.dtype))
+    causal = jnp.tril(jnp.ones((s, s), bool))[None]  # [1, S, S]
+
+    def body(x, layer):
+        y = _layernorm(x, layer["ln1_g"], layer["ln1_b"])
+        q, k, v = _qkv(y, layer)
+        o = _masked_attention(q, k, v, causal)
+        x = x + (
+            jnp.einsum("bshd,hde->bse", o, layer["wo"]) + layer["bo"]
+        ).astype(x.dtype)
+        y = _layernorm(x, layer["ln2_g"], layer["ln2_b"])
+        h = jax.nn.gelu(jnp.einsum("bse,ef->bsf", y, layer["wi"]) + layer["bi"])
+        x = x + (
+            jnp.einsum("bsf,fe->bse", h, layer["wo2"]) + layer["bo2"]
+        ).astype(x.dtype)
+        return x, (k, v)
+
+    x, (ks, vs) = jax.lax.scan(body, x, params["blocks"])
+    cache = {
+        "k": jax.lax.dynamic_update_slice(
+            cache["k"], ks.astype(cache["k"].dtype), (0, 0, 0, 0, 0)
+        ),
+        "v": jax.lax.dynamic_update_slice(
+            cache["v"], vs.astype(cache["v"].dtype), (0, 0, 0, 0, 0)
+        ),
+    }
+    x = _layernorm(x, params["lnf_g"], params["lnf_b"])
+    last = jnp.take_along_axis(
+        x, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1
+    )[:, 0]
+    logits = jnp.einsum("be,ve->bv", last, params["wte"])
+    return logits.astype(jnp.float32), cache
+
+
+def gpt2_decode_step(
+    params, tokens, pos, cache, cfg: GPT2Config
+) -> Tuple[jnp.ndarray, dict]:
+    """One generation step for a ragged batch.
+
+    tokens: [B] the most recent token per slot; pos: [B] its position.
+    Writes k/v at ``pos`` and attends each slot to its own ``[0, pos]``.
+    Returns (logits [B, V], updated cache).
+    """
+    b = tokens.shape[0]
+    t_max = cache["k"].shape[2]
+    x = params["wte"][tokens] + params["wpe"][pos]
+    x = x.astype(jnp.dtype(cfg.dtype))[:, None]  # [B, 1, E]
+    # [B, 1, T] — slot b attends to cache positions <= pos[b].
+    mask = (jnp.arange(t_max)[None] <= pos[:, None])[:, None]
+    batch_idx = jnp.arange(b)
+
+    def body(x, inputs):
+        layer, k_l, v_l = inputs
+        y = _layernorm(x, layer["ln1_g"], layer["ln1_b"])
+        q, k, v = _qkv(y, layer)  # [B, 1, H, D]
+        k_l = k_l.at[batch_idx, pos].set(k[:, 0].astype(k_l.dtype))
+        v_l = v_l.at[batch_idx, pos].set(v[:, 0].astype(v_l.dtype))
+        o = _masked_attention(q, k_l, v_l, mask)
+        x = x + (
+            jnp.einsum("bshd,hde->bse", o, layer["wo"]) + layer["bo"]
+        ).astype(x.dtype)
+        y = _layernorm(x, layer["ln2_g"], layer["ln2_b"])
+        h = jax.nn.gelu(jnp.einsum("bse,ef->bsf", y, layer["wi"]) + layer["bi"])
+        x = x + (
+            jnp.einsum("bsf,fe->bse", h, layer["wo2"]) + layer["bo2"]
+        ).astype(x.dtype)
+        return x, (k_l, v_l)
+
+    x, (ks, vs) = jax.lax.scan(
+        body, x, (params["blocks"], cache["k"], cache["v"])
+    )
+    cache = {"k": ks, "v": vs}
+    x = _layernorm(x[:, 0], params["lnf_g"], params["lnf_b"])
+    logits = jnp.einsum("be,ve->bv", x, params["wte"])
+    return logits.astype(jnp.float32), cache
+
+
+def sample_logits(logits, key, temperature, top_k: int = 0, top_p: float = 1.0):
+    """Temperature / top-k / top-p sampling on [B, V] logits (greedy when
+    temperature == 0)."""
+    greedy = jnp.argmax(logits, axis=-1)
+    temp = jnp.maximum(temperature, 1e-6)
+    scaled = logits / temp
+    if top_k > 0:
+        kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
+        scaled = jnp.where(scaled < kth, -1e30, scaled)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # Smallest set with cumulative prob >= top_p; find the cutoff logit.
+        cutoff_idx = jnp.argmax(cum >= top_p, axis=-1)
+        cutoff = jnp.take_along_axis(
+            sorted_logits, cutoff_idx[:, None], axis=-1
+        )
+        scaled = jnp.where(scaled < cutoff, -1e30, scaled)
+    sampled = jax.random.categorical(key, scaled, axis=-1)
+    return jnp.where(temperature <= 0.0, greedy, sampled)

@@ -6,6 +6,7 @@ from .api import (  # noqa: F401
     shutdown,
     start_http_proxy,
     status,
+    stop_http_proxy,
 )
 from .batching import batch  # noqa: F401
 from .deployment import Application, Deployment, deployment  # noqa: F401

@@ -150,10 +150,42 @@ def start_http_proxy(host: str = "127.0.0.1", port: int = 8000) -> str:
 
     controller = _get_or_create_controller()
     handles: Dict[str, DeploymentHandle] = {}
+    # Route-table cache: the controller must stay OUT of the request hot
+    # path (reference: routes push to proxies via long-poll; a short TTL
+    # pull approximates that).
+    route_cache: Dict[str, Any] = {"routes": {}, "ts": 0.0}
+
+    def get_routes_cached():
+        import time as _time
+
+        now = _time.monotonic()
+        if now - route_cache["ts"] > 2.0:
+            route_cache["routes"] = ray_tpu.get(
+                controller.get_routes.remote(), timeout=30
+            )
+            route_cache["ts"] = now
+        return route_cache["routes"]
+
+    def match_route(path: str, routes: Dict[str, str]):
+        # Longest-prefix match (reference route_prefix semantics): a
+        # deployment at /v1 serves /v1/completions and /v1/chat/completions.
+        name = routes.get(path)
+        if name is None:
+            candidates = [
+                (prefix, n)
+                for prefix, n in routes.items()
+                if path.startswith(prefix.rstrip("/") + "/")
+            ]
+            if candidates:
+                name = max(candidates, key=lambda c: len(c[0]))[1]
+        return name
 
     async def handle_request(request: "web.Request"):
-        routes = ray_tpu.get(controller.get_routes.remote(), timeout=30)
-        name = routes.get(request.path)
+        name = match_route(request.path, get_routes_cached())
+        if name is None:
+            # Maybe the route is newer than the cache — refresh once.
+            route_cache["ts"] = 0.0
+            name = match_route(request.path, get_routes_cached())
         if name is None:
             return web.json_response(
                 {"error": f"no deployment at {request.path}"}, status=404

@@ -159,7 +159,10 @@ class ServeController:
                 "max_ongoing_requests": max_ongoing_requests,
             }
             entry["version"] = version
-            entry["route_prefix"] = route_prefix or f"/{name}"
+            # Normalize once at registration ('/v1/' == '/v1'); the proxy
+            # does prefix matching against these keys.
+            prefix = route_prefix or f"/{name}"
+            entry["route_prefix"] = "/" + prefix.strip("/")
             entry["max_ongoing_requests"] = max_ongoing_requests
             if autoscaling_config is not None:
                 entry["autoscaling"] = dict(
