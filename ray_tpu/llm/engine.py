@@ -55,10 +55,14 @@ class EngineConfig:
 class _Slot:
     request_id: int
     prompt_len: int
-    pos: int  # position of the last written token
     generated: List[int]
     params: SamplingParams
     done: bool = False
+
+    @property
+    def last_pos(self) -> int:
+        """Cache position of the most recent token."""
+        return self.prompt_len + len(self.generated) - 1
 
 
 class JaxLLMEngine:
@@ -151,7 +155,6 @@ class JaxLLMEngine:
             slot = _Slot(
                 request_id=request_id,
                 prompt_len=len(token_ids),
-                pos=len(token_ids) - 1,
                 generated=[int(first)],
                 params=params,
             )
@@ -193,7 +196,7 @@ class JaxLLMEngine:
         import jax.numpy as jnp
 
         self._admit()
-        self._retire()
+        finished = self._retire()  # requests that finished at admission
         active = [
             (i, s) for i, s in enumerate(self.slots)
             if s is not None and not s.done
@@ -203,7 +206,7 @@ class JaxLLMEngine:
             pos = np.zeros(self.cfg.max_batch_size, np.int32)
             for i, s in active:
                 tokens[i] = s.generated[-1]
-                pos[i] = s.prompt_len + len(s.generated) - 1
+                pos[i] = s.last_pos
             logits, self.cache = self._decode(
                 self.params, self.cache, jnp.asarray(tokens), jnp.asarray(pos)
             )
@@ -213,9 +216,9 @@ class JaxLLMEngine:
                     self._sample_one(logits_np[i : i + 1], s.params)[0]
                 )
                 s.generated.append(token)
-                s.pos += 1
                 self._check_done(s, token)
-        return self._retire()
+        finished.extend(self._retire())
+        return finished
 
     def _retire(self) -> List[dict]:
         out = []

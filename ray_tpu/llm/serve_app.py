@@ -72,8 +72,11 @@ class LLMServer:
             ],
             "usage": {
                 "completion_tokens": out["num_generated"],
-                "prompt_tokens": len(prompt),
-                "total_tokens": len(prompt) + out["num_generated"],
+                "prompt_tokens": len(self.engine.tokenizer.encode(prompt)),
+                "total_tokens": (
+                    len(self.engine.tokenizer.encode(prompt))
+                    + out["num_generated"]
+                ),
             },
         }
 

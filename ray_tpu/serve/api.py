@@ -181,11 +181,18 @@ def start_http_proxy(host: str = "127.0.0.1", port: int = 8000) -> str:
         return name
 
     async def handle_request(request: "web.Request"):
+        import time as _time
+
         name = match_route(request.path, get_routes_cached())
         if name is None:
-            # Maybe the route is newer than the cache — refresh once.
-            route_cache["ts"] = 0.0
-            name = match_route(request.path, get_routes_cached())
+            # Maybe the route is newer than the cache — refresh, but at
+            # most once per second: a stream of 404s (scanners, health
+            # probes) must not put the controller back in the hot path.
+            now = _time.monotonic()
+            if now - route_cache.get("miss_ts", 0.0) > 1.0:
+                route_cache["miss_ts"] = now
+                route_cache["ts"] = 0.0
+                name = match_route(request.path, get_routes_cached())
         if name is None:
             return web.json_response(
                 {"error": f"no deployment at {request.path}"}, status=404

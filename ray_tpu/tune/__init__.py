@@ -1,3 +1,9 @@
 from .search import choice, grid_search, loguniform, randint, uniform  # noqa: F401
-from .schedulers import ASHAScheduler, FIFOScheduler  # noqa: F401
+from .schedulers import (  # noqa: F401
+    ASHAScheduler,
+    FIFOScheduler,
+    HyperBandScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+)
 from .tuner import ResultGrid, TuneConfig, Tuner, TrialResult  # noqa: F401

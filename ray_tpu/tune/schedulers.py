@@ -1,13 +1,21 @@
 """Trial schedulers (reference: ray ``python/ray/tune/schedulers/`` —
-FIFO and ASHA/async-hyperband early stopping)."""
+FIFO, ASHA/async-hyperband, HyperBand brackets, median stopping, and
+population-based training).
+
+Protocol: ``on_result(trial_id, metrics, **info) -> "CONTINUE" | "STOP"``;
+``info`` may carry ``config`` and ``checkpoint``.  A scheduler that clones
+trials (PBT) also implements ``pop_clones() -> [(config, checkpoint)]``,
+which the Tuner drains into new trials.
+"""
 
 from __future__ import annotations
 
-from typing import Dict, List
+import random
+from typing import Any, Dict, List, Optional, Tuple
 
 
 class FIFOScheduler:
-    def on_result(self, trial_id: str, metrics: Dict) -> str:
+    def on_result(self, trial_id: str, metrics: Dict, **info) -> str:
         return "CONTINUE"
 
 
@@ -40,7 +48,7 @@ class ASHAScheduler:
             self._rung_levels.append(r)
             r *= reduction_factor
 
-    def on_result(self, trial_id: str, metrics: Dict) -> str:
+    def on_result(self, trial_id: str, metrics: Dict, **info) -> str:
         t = metrics.get(self.time_attr)
         value = metrics.get(self.metric)
         if t is None or value is None:
@@ -63,3 +71,175 @@ class ASHAScheduler:
                 )
                 return "CONTINUE" if good else "STOP"
         return "CONTINUE"
+
+
+class MedianStoppingRule:
+    """Stop a trial whose best result so far is worse than the median of
+    other trials' running averages at the same step (reference:
+    ``tune/schedulers/median_stopping_rule.py``)."""
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 grace_period: int = 1,
+                 time_attr: str = "training_iteration",
+                 min_samples_required: int = 3):
+        assert mode in ("min", "max")
+        self.metric = metric
+        self.mode = mode
+        self.grace_period = grace_period
+        self.time_attr = time_attr
+        self.min_samples = min_samples_required
+        self._history: Dict[str, List[float]] = {}
+
+    def on_result(self, trial_id: str, metrics: Dict, **info) -> str:
+        t = metrics.get(self.time_attr)
+        value = metrics.get(self.metric)
+        if t is None or value is None:
+            return "CONTINUE"
+        self._history.setdefault(trial_id, []).append(float(value))
+        if t < self.grace_period:
+            return "CONTINUE"
+        other_avgs = [
+            sum(v) / len(v)
+            for tid, v in self._history.items()
+            if tid != trial_id and v
+        ]
+        if len(other_avgs) < self.min_samples:
+            return "CONTINUE"
+        other_avgs.sort()
+        median = other_avgs[len(other_avgs) // 2]
+        mine = self._history[trial_id]
+        best = max(mine) if self.mode == "max" else min(mine)
+        bad = best < median if self.mode == "max" else best > median
+        return "STOP" if bad else "CONTINUE"
+
+
+class HyperBandScheduler:
+    """HyperBand as a set of ASHA brackets with staggered grace periods
+    (reference: ``tune/schedulers/hyperband.py``; the async-bracket framing
+    follows the ASHA paper's recommendation)."""
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 max_t: int = 81, reduction_factor: int = 3,
+                 time_attr: str = "training_iteration"):
+        self.brackets: List[ASHAScheduler] = []
+        grace = 1
+        while grace < max_t:
+            self.brackets.append(
+                ASHAScheduler(
+                    metric=metric, mode=mode, max_t=max_t,
+                    grace_period=grace, reduction_factor=reduction_factor,
+                    time_attr=time_attr,
+                )
+            )
+            grace *= reduction_factor
+        self._assignment: Dict[str, int] = {}
+        self._next = 0
+
+    def on_result(self, trial_id: str, metrics: Dict, **info) -> str:
+        idx = self._assignment.get(trial_id)
+        if idx is None:
+            idx = self._next % len(self.brackets)
+            self._assignment[trial_id] = idx
+            self._next += 1
+        return self.brackets[idx].on_result(trial_id, metrics, **info)
+
+
+class PopulationBasedTraining:
+    """PBT (reference: ``tune/schedulers/pbt.py``): at each perturbation
+    interval, trials in the bottom quantile stop and are replaced by clones
+    of a top-quantile trial — config mutated, training state restored from
+    the donor's last reported checkpoint."""
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 perturbation_interval: int = 4,
+                 quantile_fraction: float = 0.25,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 time_attr: str = "training_iteration",
+                 seed: int = 0):
+        assert mode in ("min", "max")
+        assert 0 < quantile_fraction <= 0.5
+        self.metric = metric
+        self.mode = mode
+        self.interval = perturbation_interval
+        self.quantile = quantile_fraction
+        self.mutations = hyperparam_mutations or {}
+        self.time_attr = time_attr
+        self._rng = random.Random(seed)
+        # trial_id -> {"score", "config", "checkpoint"}
+        self._state: Dict[str, dict] = {}
+        self._clones: List[Tuple[dict, Any]] = []
+        self.num_perturbations = 0
+
+    def _mutate(self, config: dict) -> dict:
+        out = dict(config)
+        for key, spec in self.mutations.items():
+            if callable(getattr(spec, "sample", None)):
+                out[key] = spec.sample(self._rng)
+            elif isinstance(spec, (list, tuple)):
+                out[key] = self._rng.choice(list(spec))
+            elif callable(spec):
+                out[key] = spec()
+            elif isinstance(out.get(key), (int, float)):
+                # resample-by-perturbation: ×0.8 or ×1.2 (reference default)
+                factor = self._rng.choice([0.8, 1.2])
+                val = out[key] * factor
+                if isinstance(out[key], int):
+                    # round, and force at least ±1 so small ints (1, 2)
+                    # don't truncate to 0 or get stuck forever
+                    val = round(val)
+                    if val == out[key]:
+                        val = out[key] + (1 if factor > 1 else -1)
+                    out[key] = max(1, int(val))
+                else:
+                    out[key] = float(val)
+        return out
+
+    def on_result(self, trial_id: str, metrics: Dict, **info) -> str:
+        t = metrics.get(self.time_attr)
+        value = metrics.get(self.metric)
+        if value is None:
+            return "CONTINUE"
+        self._state[trial_id] = {
+            "score": float(value),
+            "config": info.get("config", {}),
+            "checkpoint": info.get("checkpoint"),
+        }
+        if info.get("terminal"):
+            # Trial is ending via stop criteria: its score stays as a donor
+            # comparator, but it must never be exploited (a clone per
+            # finished trial would keep the experiment alive forever).
+            return "CONTINUE"
+        if t is None or t % self.interval != 0:
+            return "CONTINUE"
+        scores = sorted(
+            (s["score"] for s in self._state.values()),
+            reverse=(self.mode == "max"),
+        )
+        if len(scores) < 3:
+            return "CONTINUE"
+        k = max(1, int(len(scores) * self.quantile))
+        top_cut, bottom_cut = scores[k - 1], scores[-k]
+        mine = self._state[trial_id]["score"]
+        in_bottom = (
+            mine <= bottom_cut if self.mode == "max" else mine >= bottom_cut
+        )
+        if not in_bottom:
+            return "CONTINUE"
+        donors = [
+            s for s in self._state.values()
+            if (s["score"] >= top_cut if self.mode == "max"
+                else s["score"] <= top_cut)
+        ]
+        if not donors:
+            return "CONTINUE"
+        donor = self._rng.choice(donors)
+        self._clones.append(
+            (self._mutate(donor["config"]), donor["checkpoint"])
+        )
+        self.num_perturbations += 1
+        self._state.pop(trial_id, None)
+        return "STOP"
+
+    def pop_clones(self) -> List[Tuple[dict, Any]]:
+        clones, self._clones = self._clones, []
+        return clones

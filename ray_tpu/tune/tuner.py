@@ -31,6 +31,9 @@ class TuneConfig:
     mode: str = "min"
     scheduler: Any = None
     seed: Optional[int] = None
+    # Stop criteria applied to every trial's metrics, e.g.
+    # {"training_iteration": 20} (reference: RunConfig(stop=...)).
+    stop: Optional[Dict[str, float]] = None
 
 
 @dataclasses.dataclass
@@ -77,25 +80,35 @@ class _TrialActor:
         self._queue: List[Dict[str, Any]] = []
         self._stop = False
 
-    def run(self, fn_payload: bytes, config: Dict[str, Any]):
+    def run(self, fn_payload: bytes, config: Dict[str, Any],
+            start_checkpoint=None, stop_criteria=None):
         from ray_tpu.train.session import TrainContext, _clear_session, _set_session
 
         fn = loads_function(fn_payload)
         iteration = [0]
 
+        ctx = TrainContext(
+            world_rank=0, world_size=1, local_rank=0, node_rank=0,
+            trial_name=self.trial_id, _report_fn=None,
+            latest_checkpoint=start_checkpoint,
+        )
+
         def report_fn(metrics, checkpoint):
             iteration[0] += 1
             metrics = dict(metrics)
             metrics.setdefault("training_iteration", iteration[0])
+            if checkpoint is not None:
+                ctx.latest_checkpoint = checkpoint
             with self._lock:
-                self._queue.append(metrics)
+                self._queue.append((metrics, checkpoint))
+            # Stop criteria apply synchronously (a fast trainable would
+            # otherwise race past the controller's asynchronous poll).
+            if _met_stop_criteria(metrics, stop_criteria):
+                raise _EarlyStop()
             if self._stop:
                 raise _EarlyStop()
 
-        ctx = TrainContext(
-            world_rank=0, world_size=1, local_rank=0, node_rank=0,
-            trial_name=self.trial_id, _report_fn=report_fn,
-        )
+        ctx._report_fn = report_fn
         _set_session(ctx)
         try:
             fn(config)
@@ -119,6 +132,55 @@ class _EarlyStop(BaseException):
     pass
 
 
+def _met_stop_criteria(metrics: Dict[str, Any],
+                       stop: Optional[Dict[str, float]]) -> bool:
+    return bool(stop) and any(
+        metrics.get(k) is not None and metrics[k] >= v
+        for k, v in stop.items()
+    )
+
+
+def _all_subclasses(cls):
+    for sub in cls.__subclasses__():
+        yield sub
+        yield from _all_subclasses(sub)
+
+
+def _as_function_trainable(trainable):
+    """Accept both function trainables (``fn(config)``) and class
+    trainables exposing the Algorithm lifecycle (``setup/train/stop`` —
+    e.g. an rllib Algorithm class or AlgorithmConfig).  Class trainables
+    wrap into a report loop (reference: class Trainable adaptation)."""
+    from ..rllib.algorithm import Algorithm, AlgorithmConfig
+
+    if isinstance(trainable, type) and issubclass(trainable, Algorithm):
+        algo_cls = trainable
+
+        def run_algo(config):
+            from ray_tpu.train import session as train_session
+
+            cfg_cls = None
+            for sub in _all_subclasses(AlgorithmConfig):
+                if sub.ALGO_CLS is algo_cls:
+                    cfg_cls = sub
+                    break
+            algo_cfg = (cfg_cls or AlgorithmConfig)()
+            if config:
+                algo_cfg.training(**config)
+            algo = algo_cls(algo_cfg)
+            try:
+                while True:
+                    result = algo.train()
+                    train_session.report(result)
+            finally:
+                algo.stop()
+
+        return run_algo
+    if not callable(trainable):
+        raise TypeError(f"trainable must be callable, got {trainable!r}")
+    return trainable
+
+
 class Tuner:
     def __init__(
         self,
@@ -127,7 +189,7 @@ class Tuner:
         param_space: Optional[Dict[str, Any]] = None,
         tune_config: Optional[TuneConfig] = None,
     ):
-        self.trainable = trainable
+        self.trainable = _as_function_trainable(trainable)
         self.param_space = param_space or {}
         self.tune_config = tune_config or TuneConfig()
 
@@ -139,31 +201,42 @@ class Tuner:
         )
         payload = dumps_function(self.trainable)
         pending = [
-            (f"trial_{i:04d}", variant) for i, variant in enumerate(variants)
+            (f"trial_{i:04d}", variant, None)
+            for i, variant in enumerate(variants)
         ]
+        next_trial = len(pending)
         running: Dict[str, dict] = {}
         results: List[TrialResult] = []
 
         while pending or running:
             while pending and len(running) < cfg.max_concurrent_trials:
-                trial_id, variant = pending.pop(0)
+                trial_id, variant, start_ckpt = pending.pop(0)
                 # max_concurrency: poll()/request_stop() must stay responsive
                 # while run() executes the trainable.
                 actor = _TrialActor.options(max_concurrency=4).remote(trial_id)
                 running[trial_id] = {
                     "actor": actor,
                     "config": variant,
-                    "ref": actor.run.remote(payload, variant),
+                    "ref": actor.run.remote(
+                        payload, variant, start_ckpt, cfg.stop
+                    ),
                     "history": [],
                     "stopped": False,
                 }
             time.sleep(0.05)
             for trial_id, st in list(running.items()):
-                for metrics in ray_tpu.get(
+                for metrics, checkpoint in ray_tpu.get(
                     st["actor"].poll.remote(), timeout=60
                 ):
                     st["history"].append(metrics)
-                    decision = scheduler.on_result(trial_id, metrics)
+                    terminal = _met_stop_criteria(metrics, cfg.stop)
+                    decision = scheduler.on_result(
+                        trial_id, metrics,
+                        config=st["config"], checkpoint=checkpoint,
+                        terminal=terminal,
+                    )
+                    if decision != "STOP" and terminal:
+                        decision = "STOP"
                     if decision == "STOP" and not st["stopped"]:
                         st["stopped"] = True
                         st["actor"].request_stop.remote()
@@ -176,12 +249,22 @@ class Tuner:
                         stopped = stopped or out.get("stopped", False)
                     except Exception as e:  # noqa: BLE001
                         error = str(e)
-                    # Final drain after completion.
+                    # Final drain after completion — a fast trial may have
+                    # reported everything before the first poll, so these
+                    # results must still reach the scheduler (PBT clone
+                    # decisions depend on them).
                     try:
-                        for metrics in ray_tpu.get(
+                        for metrics, ckpt in ray_tpu.get(
                             st["actor"].poll.remote(), timeout=30
                         ):
                             st["history"].append(metrics)
+                            scheduler.on_result(
+                                trial_id, metrics,
+                                config=st["config"], checkpoint=ckpt,
+                                terminal=_met_stop_criteria(
+                                    metrics, cfg.stop
+                                ),
+                            )
                     except Exception:
                         pass
                     results.append(
@@ -199,4 +282,12 @@ class Tuner:
                     except Exception:
                         pass
                     del running[trial_id]
+            # PBT-style clones: enqueue replacements for exploited trials
+            # (checked after draining so end-of-trial decisions count).
+            if hasattr(scheduler, "pop_clones"):
+                for clone_cfg, clone_ckpt in scheduler.pop_clones():
+                    pending.append(
+                        (f"trial_{next_trial:04d}", clone_cfg, clone_ckpt)
+                    )
+                    next_trial += 1
         return ResultGrid(results, cfg.metric, cfg.mode)

@@ -1,0 +1,175 @@
+"""Scheduler + Tuner feature tests: median stopping, HyperBand, PBT with
+checkpoint cloning, stop criteria, class trainables."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+import ray_tpu.tune as tune
+from ray_tpu.tune.schedulers import (
+    HyperBandScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ctx = ray_tpu.init(num_cpus=8)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+class TestMedianStopping:
+    def test_stops_below_median(self):
+        rule = MedianStoppingRule(metric="score", mode="max", grace_period=2)
+        # Three good trials establish the median.
+        for t in (1, 2, 3):
+            for tid, v in (("a", 10), ("b", 9), ("c", 11)):
+                assert rule.on_result(
+                    tid, {"score": v, "training_iteration": t}
+                ) == "CONTINUE"
+        # A much worse trial gets cut after grace.
+        assert rule.on_result(
+            "d", {"score": 1, "training_iteration": 1}
+        ) == "CONTINUE"  # within grace
+        assert rule.on_result(
+            "d", {"score": 1, "training_iteration": 2}
+        ) == "STOP"
+
+
+class TestHyperBand:
+    def test_brackets_assigned_round_robin(self):
+        hb = HyperBandScheduler(metric="loss", mode="min", max_t=9,
+                                reduction_factor=3)
+        assert len(hb.brackets) == 2
+        hb.on_result("t0", {"loss": 1.0, "training_iteration": 1})
+        hb.on_result("t1", {"loss": 1.0, "training_iteration": 1})
+        hb.on_result("t2", {"loss": 1.0, "training_iteration": 1})
+        assert hb._assignment["t0"] == 0
+        assert hb._assignment["t1"] == 1
+        assert hb._assignment["t2"] == 0
+
+    def test_stop_at_max_t(self):
+        hb = HyperBandScheduler(metric="loss", mode="min", max_t=9)
+        assert hb.on_result(
+            "t", {"loss": 1.0, "training_iteration": 9}
+        ) == "STOP"
+
+
+class TestPBT:
+    def test_exploit_bottom_clones_top(self):
+        pbt = PopulationBasedTraining(
+            metric="score", mode="max", perturbation_interval=2,
+            quantile_fraction=0.34,
+            hyperparam_mutations={"lr": [0.1, 0.01]},
+        )
+        # Three trials report at the interval; the worst must be exploited.
+        assert pbt.on_result(
+            "good", {"score": 10, "training_iteration": 2},
+            config={"lr": 1.0}, checkpoint={"w": "good"},
+        ) == "CONTINUE"
+        assert pbt.on_result(
+            "mid", {"score": 5, "training_iteration": 2},
+            config={"lr": 0.5}, checkpoint=None,
+        ) == "CONTINUE"
+        assert pbt.on_result(
+            "bad", {"score": 1, "training_iteration": 2},
+            config={"lr": 0.001}, checkpoint=None,
+        ) == "STOP"
+        clones = pbt.pop_clones()
+        assert len(clones) == 1
+        clone_cfg, clone_ckpt = clones[0]
+        assert clone_ckpt == {"w": "good"}  # donor's checkpoint
+        assert clone_cfg["lr"] in (0.1, 0.01)  # mutated
+        assert pbt.num_perturbations == 1
+
+    def test_off_interval_no_exploit(self):
+        pbt = PopulationBasedTraining(
+            metric="score", mode="max", perturbation_interval=4
+        )
+        for tid, v in (("a", 10), ("b", 5), ("c", 1)):
+            assert pbt.on_result(
+                tid, {"score": v, "training_iteration": 3}, config={}
+            ) == "CONTINUE"
+        assert pbt.pop_clones() == []
+
+
+class TestTunerIntegration:
+    def test_stop_criteria(self, cluster):
+        def trainable(config):
+            import ray_tpu.train as train
+
+            for i in range(100):
+                train.report({"loss": 1.0 / (i + 1)})
+
+        grid = tune.Tuner(
+            trainable,
+            param_space={},
+            tune_config=tune.TuneConfig(
+                num_samples=1, stop={"training_iteration": 5}
+            ),
+        ).fit()
+        best = grid.get_best_result()
+        assert best.metrics["training_iteration"] <= 6
+        assert best.stopped_early
+
+    def test_pbt_end_to_end_clone_restores_checkpoint(self, cluster):
+        def trainable(config):
+            import time as _time
+
+            import ray_tpu.train as train
+
+            ckpt = train.get_checkpoint()
+            start = ckpt["step"] if ckpt else 0
+            base = config["base"]
+            for i in range(start, start + 12):
+                _time.sleep(0.1)  # interleave trials so PBT sees the cohort
+                train.report(
+                    {"score": base + i}, checkpoint={"step": i + 1}
+                )
+
+        pbt = PopulationBasedTraining(
+            metric="score", mode="max", perturbation_interval=3,
+            quantile_fraction=0.34,
+            hyperparam_mutations={"base": [50, 60]},
+        )
+        grid = tune.Tuner(
+            trainable,
+            param_space={"base": tune.grid_search([0, 20, 40])},
+            tune_config=tune.TuneConfig(
+                metric="score", mode="max", scheduler=pbt,
+                max_concurrent_trials=3, stop={"training_iteration": 12},
+            ),
+        ).fit()
+        assert pbt.num_perturbations >= 1
+        # A clone ran (trial ids beyond the initial 3).
+        assert len(grid.results) >= 4
+        best = grid.get_best_result()
+        assert best.metrics["score"] >= 40
+
+    def test_class_trainable_algorithm(self, cluster):
+        from ray_tpu.rllib import BC, BCConfig
+
+        # BC needs offline data — provide via a tiny closure-configured
+        # subclass-style param.  Use DQN-free path: wrap BCConfig directly.
+        rng = np.random.default_rng(0)
+        data = {
+            "obs": rng.normal(size=(64, 4)).astype(np.float32),
+            "actions": (rng.random(64) > 0.5).astype(np.int64),
+        }
+
+        def trainable(config):
+            import ray_tpu.train as train
+
+            algo = BCConfig().offline(data).training(**config).build()
+            for _ in range(3):
+                train.report(algo.train())
+
+        grid = tune.Tuner(
+            trainable,
+            param_space={"lr": tune.grid_search([1e-3, 1e-2])},
+            tune_config=tune.TuneConfig(metric="loss", mode="min"),
+        ).fit()
+        assert len(grid.results) == 2
+        assert grid.get_best_result().metrics["loss"] > 0
