@@ -34,16 +34,36 @@ def _build() -> bool:
 
         with open(os.path.join(os.path.dirname(_LIB_PATH), ".build.lock"), "w") as lk:
             fcntl.flock(lk, fcntl.LOCK_EX)
-            if not os.path.exists(_LIB_PATH):
+            if _stale():
+                # Build to a temp path and rename atomically: overwriting
+                # the .so in place would truncate a library other live
+                # processes have dlopen'd (SIGBUS on their next page fault).
+                tmp = _LIB_PATH + f".tmp.{os.getpid()}"
                 subprocess.run(
-                    ["make", "-C", _SRC_DIR],
+                    ["make", "-C", _SRC_DIR, f"TARGET={tmp}"],
                     check=True,
                     capture_output=True,
                     timeout=120,
                 )
+                os.replace(tmp, _LIB_PATH)
         return os.path.exists(_LIB_PATH)
     except Exception:
         return False
+
+
+def _stale() -> bool:
+    """True if the .so is missing or older than any native source file."""
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    try:
+        for name in os.listdir(_SRC_DIR):
+            if name.endswith((".cc", ".h")):
+                if os.path.getmtime(os.path.join(_SRC_DIR, name)) > lib_mtime:
+                    return True
+    except OSError:
+        pass
+    return False
 
 
 def _declare(lib):
@@ -78,6 +98,20 @@ def _declare(lib):
         "rtpu_chan_read_end": (ctypes.c_int, [p]),
         "rtpu_chan_set_closed": (None, [p]),
         "rtpu_chan_is_closed": (ctypes.c_int, [p]),
+        "rtpu_sched_create": (p, []),
+        "rtpu_sched_destroy": (None, [p]),
+        "rtpu_sched_update_node": (
+            None,
+            [p, u8p, ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(i64),
+             ctypes.POINTER(i64), ctypes.c_int32],
+        ),
+        "rtpu_sched_remove_node": (None, [p, u8p]),
+        "rtpu_sched_num_nodes": (ctypes.c_int32, [p]),
+        "rtpu_sched_pick_node": (
+            ctypes.c_int32,
+            [p, ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(i64),
+             ctypes.c_int32, i64, i64, u8p, u64, u8p],
+        ),
     }
     for name, (res, args) in sigs.items():
         fn = getattr(lib, name)
@@ -95,7 +129,10 @@ def get_lib():
     with _lib_lock:
         if _lib is not None or _load_failed:
             return _lib
-        if not os.path.exists(_LIB_PATH) and not _build():
+        if _stale() and not _build() and not os.path.exists(_LIB_PATH):
+            # Rebuild failed AND there is nothing to load.  (A stale .so
+            # with a missing toolchain still loads — better old symbols
+            # than silently disabling the native plane.)
             _load_failed = True
             return None
         try:
@@ -384,3 +421,101 @@ class NativeChannel:
 
 class ChannelClosedError(RuntimeError):
     pass
+
+
+class NativeScheduler:
+    """ctypes wrapper over the native scheduling core (src/native/
+    rtpu_sched.cc — fixed-point resource table + hybrid policy).  Resource
+    kind names are interned to int32 ids here (the analog of the
+    reference's ResourceID interning)."""
+
+    def __init__(self, lib):
+        from .resources import PRECISION
+
+        # rtpu_sched.cc's kPrecision is compiled to 10000; the Python side
+        # must agree or the two resource views silently diverge.
+        assert PRECISION == 10000, "resources.PRECISION changed; update rtpu_sched.cc"
+        self.PRECISION = PRECISION
+        self._lib = lib
+        self._handle = lib.rtpu_sched_create()
+        self._kind_ids = {}
+
+    def _kind(self, name: str) -> int:
+        kid = self._kind_ids.get(name)
+        if kid is None:
+            kid = len(self._kind_ids)
+            self._kind_ids[name] = kid
+        return kid
+
+    def _vectors(self, amounts: dict):
+        n = len(amounts)
+        kinds = (ctypes.c_int32 * n)()
+        vals = (ctypes.c_int64 * n)()
+        for i, (k, v) in enumerate(amounts.items()):
+            kinds[i] = self._kind(k)
+            vals[i] = int(round(v * self.PRECISION))
+        return kinds, vals, n
+
+    def update_node(self, node_id_bytes: bytes, total: dict, available: dict):
+        keys = set(total) | set(available)
+        n = len(keys)
+        kinds = (ctypes.c_int32 * n)()
+        totals = (ctypes.c_int64 * n)()
+        avails = (ctypes.c_int64 * n)()
+        for i, k in enumerate(keys):
+            kinds[i] = self._kind(k)
+            totals[i] = int(round(total.get(k, 0.0) * self.PRECISION))
+            avails[i] = int(round(available.get(k, 0.0) * self.PRECISION))
+        buf = (ctypes.c_uint8 * 16).from_buffer_copy(node_id_bytes)
+        self._lib.rtpu_sched_update_node(
+            self._handle, buf, kinds, totals, avails, n
+        )
+
+    def remove_node(self, node_id_bytes: bytes):
+        buf = (ctypes.c_uint8 * 16).from_buffer_copy(node_id_bytes)
+        self._lib.rtpu_sched_remove_node(self._handle, buf)
+
+    def num_nodes(self) -> int:
+        return self._lib.rtpu_sched_num_nodes(self._handle)
+
+    def pick_node(
+        self,
+        request: dict,
+        spread_threshold: float,
+        top_k_fraction: float,
+        preferred: bytes = None,
+        seed: int = 0,
+    ):
+        """Returns (status, node_id_bytes): status 1 picked, 0 retry later,
+        -1 infeasible forever, -2 empty cluster."""
+        kinds, vals, n = self._vectors(request)
+        out = (ctypes.c_uint8 * 16)()
+        pref = (
+            (ctypes.c_uint8 * 16).from_buffer_copy(preferred)
+            if preferred is not None
+            else None
+        )
+        status = self._lib.rtpu_sched_pick_node(
+            self._handle,
+            kinds,
+            vals,
+            n,
+            int(spread_threshold * self.PRECISION),
+            int(top_k_fraction * self.PRECISION),
+            pref,
+            seed,
+            out,
+        )
+        return status, bytes(out) if status == 1 else None
+
+    def __del__(self):
+        try:
+            self._lib.rtpu_sched_destroy(self._handle)
+        except Exception:
+            pass
+
+
+def make_scheduler():
+    """NativeScheduler if the library is available, else None."""
+    lib = get_lib()
+    return NativeScheduler(lib) if lib is not None else None

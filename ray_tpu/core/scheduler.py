@@ -68,11 +68,25 @@ def _feasible(
 
 
 class ClusterScheduler:
-    """Holds the cluster resource view; pure policy, no IO."""
+    """Holds the cluster resource view; pure policy, no IO.
 
-    def __init__(self):
+    The default (hybrid) pick path runs in the native scheduling core when
+    the C++ library is available (``src/native/rtpu_sched.cc`` — interned
+    resource ids + fixed-point arithmetic; reference
+    ``raylet/scheduling/policy/hybrid_scheduling_policy.h``); label/affinity
+    strategies and placement-group bundles stay in Python."""
+
+    def __init__(self, use_native: bool = True):
         self.nodes: Dict[NodeID, NodeResources] = {}
         self._spread_rr = 0
+        self._native = None
+        if use_native:
+            try:
+                from .native import make_scheduler
+
+                self._native = make_scheduler()
+            except Exception:  # noqa: BLE001 — toolchain missing
+                self._native = None
 
     def update_node(self, node_id: NodeID, snapshot: dict):
         nr = self.nodes.get(node_id)
@@ -82,9 +96,15 @@ class ClusterScheduler:
         nr.total = ResourceSet(snapshot["total"])
         nr.available = ResourceSet(snapshot["available"])
         nr.labels = snapshot.get("labels", {})
+        if self._native is not None:
+            self._native.update_node(
+                node_id.binary(), snapshot["total"], snapshot["available"]
+            )
 
     def remove_node(self, node_id: NodeID):
         self.nodes.pop(node_id, None)
+        if self._native is not None:
+            self._native.remove_node(node_id.binary())
 
     # ------------------------------------------------------------------ tasks
     def pick_node(
@@ -103,6 +123,27 @@ class ClusterScheduler:
             if not strategy.soft:
                 return None
             strategy = None  # soft: fall through to hybrid
+        if (
+            self._native is not None
+            and (strategy is None or isinstance(strategy, DefaultStrategy))
+        ):
+            status, picked = self._native.pick_node(
+                request.to_dict(),
+                GlobalConfig.scheduler_spread_threshold,
+                GlobalConfig.scheduler_top_k_fraction,
+                preferred=preferred.binary() if preferred else None,
+                seed=random.getrandbits(63),
+            )
+            if status == 1:
+                return NodeID(picked)
+            if status == 0:
+                return None
+            if status == -1:
+                raise InfeasibleError(
+                    f"no node can ever satisfy {request.to_dict()} "
+                    f"(strategy=default)"
+                )
+            return None  # -2: empty cluster
         candidates = self.nodes
         if isinstance(strategy, NodeLabelStrategy):
             candidates = {

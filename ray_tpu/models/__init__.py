@@ -1,4 +1,17 @@
 from .gpt2 import GPT2Config, gpt2_apply, gpt2_init, gpt2_loss, gpt2_param_axes  # noqa: F401
+from .gpt2_decode import (  # noqa: F401
+    gpt2_decode_step,
+    gpt2_init_cache,
+    gpt2_prefill,
+    sample_logits,
+)
+from .llama import (  # noqa: F401
+    LlamaConfig,
+    llama_apply,
+    llama_init,
+    llama_loss,
+    llama_param_axes,
+)
 from .mlp import mlp_apply, mlp_init  # noqa: F401
 from .moe import (  # noqa: F401
     MoEConfig,

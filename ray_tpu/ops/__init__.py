@@ -1,1 +1,5 @@
 from .attention import flash_attention, reference_attention  # noqa: F401
+from .decode_attention import (  # noqa: F401
+    decode_attention,
+    reference_decode_attention,
+)

@@ -1,0 +1,105 @@
+"""Single-token decode attention over a KV cache — the serving hot op.
+
+During generation each sequence attends one query token against its own
+``[0, pos]`` cache prefix.  This is HBM-bandwidth-bound (the whole cache
+streams through once per token), so the Pallas kernel's job is to keep the
+streaming tiled in VMEM with f32 accumulation and the ragged-position mask
+applied on the fly — the TPU analog of the paged/decode attention kernels
+the reference gets from vLLM's CUDA side (SURVEY.md §2.3: the reference has
+no kernels of its own).
+
+Layouts: q [B, H, D]; k/v cache [B, T, H, D]; pos [B] (last valid index).
+Returns [B, H, D].  ``kernel=False`` (or non-TPU) uses the XLA reference.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def reference_decode_attention(q, k_cache, v_cache, pos):
+    """Ground truth in plain XLA."""
+    t = k_cache.shape[1]
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bhd,bthd->bht", q, k_cache).astype(jnp.float32)
+    scores = scores * scale
+    mask = jnp.arange(t)[None, None, :] <= pos[:, None, None]
+    scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bht,bthd->bhd", probs.astype(v_cache.dtype), v_cache)
+
+
+def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, block_t: int,
+                   t_total: int, scale: float):
+    """Grid: (B*H,).  Tiles (leading dim squeezed): pos [1], q [D],
+    k/v [T, D]; online softmax over T in blocks of block_t."""
+    import jax.experimental.pallas as pl
+
+    pos = pos_ref[0]
+    q = q_ref[...].astype(jnp.float32) * scale  # [D]
+
+    n_blocks = t_total // block_t
+
+    def body(i, carry):
+        m_prev, l_prev, acc = carry
+        start = i * block_t
+        k_blk = k_ref[pl.dslice(start, block_t), :].astype(jnp.float32)
+        v_blk = v_ref[pl.dslice(start, block_t), :].astype(jnp.float32)
+        s = k_blk @ q  # [block_t]
+        idx = start + jax.lax.broadcasted_iota(jnp.int32, (block_t,), 0)
+        s = jnp.where(idx <= pos, s, NEG_INF)
+        m_cur = jnp.maximum(m_prev, s.max())
+        correction = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur)  # [block_t]
+        l_cur = l_prev * correction + p.sum()
+        acc = acc * correction + p @ v_blk  # [D]
+        return m_cur, l_cur, acc
+
+    d = q_ref.shape[-1]
+    m0 = jnp.float32(NEG_INF)
+    l0 = jnp.float32(0.0)
+    acc0 = jnp.zeros((d,), jnp.float32)
+    _m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
+    o_ref[...] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_t", "kernel", "interpret"))
+def decode_attention(q, k_cache, v_cache, pos, *, block_t: int = 128,
+                     kernel: bool = True, interpret: bool = False):
+    """q [B,H,D], k/v [B,T,H,D], pos [B] → [B,H,D]."""
+    if not kernel:
+        return reference_decode_attention(q, k_cache, v_cache, pos)
+    import jax.experimental.pallas as pl
+
+    b, t, h, d = k_cache.shape
+    block_t = min(block_t, t)
+    if t % block_t != 0:  # ragged tail: XLA path (caches are sized in
+        return reference_decode_attention(q, k_cache, v_cache, pos)  # blocks)
+    scale = d ** -0.5
+    # Fold batch and heads into the grid axis (same convention as the
+    # flash kernel above).
+    qf = q.reshape(b * h, d)
+    kf = k_cache.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    vf = v_cache.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    posf = jnp.repeat(pos.astype(jnp.int32), h).reshape(b * h, 1)
+    out = pl.pallas_call(
+        functools.partial(
+            _decode_kernel, block_t=block_t, t_total=t, scale=scale
+        ),
+        grid=(b * h,),
+        in_specs=[
+            pl.BlockSpec((None, 1), lambda bh: (bh, 0)),  # pos
+            pl.BlockSpec((None, d), lambda bh: (bh, 0)),  # q
+            pl.BlockSpec((None, t, d), lambda bh: (bh, 0, 0)),  # k
+            pl.BlockSpec((None, t, d), lambda bh: (bh, 0, 0)),  # v
+        ],
+        out_specs=pl.BlockSpec((None, d), lambda bh: (bh, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, d), q.dtype),
+        interpret=interpret,
+    )(posf, qf, kf, vf)
+    return out.reshape(b, h, d)

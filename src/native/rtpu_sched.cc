@@ -1,0 +1,196 @@
+// rtpu_sched.cc — native cluster-scheduling core.
+//
+// Native equivalent of the reference's scheduling data model + hybrid
+// policy (ray src/ray/common/scheduling/fixed_point.h, resource_set.h,
+// cluster_resource_data.h and
+// src/ray/raylet/scheduling/policy/hybrid_scheduling_policy.h): fixed-point
+// resource vectors (1e-4 resolution, matching the Python layer's
+// PRECISION=10000), a per-node available/total table, and the
+// pack-until-threshold-then-spread policy with top-k random tie-breaking.
+//
+// Resource kinds are interned to int32 ids by the Python caller (the analog
+// of the reference's ResourceID interning in scheduling_ids.h), so the hot
+// pick path is pure integer arithmetic over flat arrays.
+//
+// Exposed as a flat C ABI consumed via ctypes (ray_tpu/core/native.py).
+
+#include <algorithm>
+#include <array>
+#include <cstdint>
+#include <cstring>
+#include <unordered_map>
+#include <vector>
+
+#define RTPU_API extern "C" __attribute__((visibility("default")))
+
+namespace {
+
+constexpr int64_t kPrecision = 10000;  // matches resources.py PRECISION
+
+struct NodeIdKey {
+  std::array<uint8_t, 16> bytes;
+  bool operator==(const NodeIdKey& o) const { return bytes == o.bytes; }
+};
+
+struct NodeIdHash {
+  size_t operator()(const NodeIdKey& k) const {
+    uint64_t h;
+    std::memcpy(&h, k.bytes.data(), 8);
+    uint64_t l;
+    std::memcpy(&l, k.bytes.data() + 8, 8);
+    return static_cast<size_t>(h ^ (l * 0x9e3779b97f4a7c15ULL));
+  }
+};
+
+struct Node {
+  // kind id -> fixed-point amount; vectors indexed by position after a
+  // lookup table keeps this simple (kinds per node are few).
+  std::unordered_map<int32_t, int64_t> total;
+  std::unordered_map<int32_t, int64_t> avail;
+
+  bool Fits(const int32_t* kinds, const int64_t* vals, int32_t n,
+            bool against_total) const {
+    const auto& pool = against_total ? total : avail;
+    for (int32_t i = 0; i < n; ++i) {
+      if (vals[i] <= 0) continue;
+      auto it = pool.find(kinds[i]);
+      if (it == pool.end() || it->second < vals[i]) return false;
+    }
+    return true;
+  }
+
+  // Max utilization across kinds (the reference's critical-resource
+  // utilization driving the hybrid policy).
+  double Utilization() const {
+    double best = 0.0;
+    for (const auto& [kind, tot] : total) {
+      if (tot <= 0) continue;
+      auto it = avail.find(kind);
+      int64_t av = it == avail.end() ? 0 : it->second;
+      double u = static_cast<double>(tot - av) / static_cast<double>(tot);
+      if (u > best) best = u;
+    }
+    return best;
+  }
+};
+
+struct Sched {
+  std::unordered_map<NodeIdKey, Node, NodeIdHash> nodes;
+};
+
+// xorshift64* — deterministic tie-breaking from a caller seed.
+inline uint64_t NextRand(uint64_t* state) {
+  uint64_t x = *state ? *state : 0x2545F4914F6CDD1DULL;
+  x ^= x >> 12;
+  x ^= x << 25;
+  x ^= x >> 27;
+  *state = x;
+  return x * 0x2545F4914F6CDD1DULL;
+}
+
+}  // namespace
+
+RTPU_API void* rtpu_sched_create() { return new Sched(); }
+
+RTPU_API void rtpu_sched_destroy(void* h) { delete static_cast<Sched*>(h); }
+
+RTPU_API void rtpu_sched_update_node(void* h, const uint8_t* id,
+                                     const int32_t* kinds,
+                                     const int64_t* totals,
+                                     const int64_t* avails, int32_t n) {
+  auto* sched = static_cast<Sched*>(h);
+  NodeIdKey key;
+  std::memcpy(key.bytes.data(), id, 16);
+  Node& node = sched->nodes[key];
+  node.total.clear();
+  node.avail.clear();
+  for (int32_t i = 0; i < n; ++i) {
+    node.total[kinds[i]] = totals[i];
+    node.avail[kinds[i]] = avails[i];
+  }
+}
+
+RTPU_API void rtpu_sched_remove_node(void* h, const uint8_t* id) {
+  auto* sched = static_cast<Sched*>(h);
+  NodeIdKey key;
+  std::memcpy(key.bytes.data(), id, 16);
+  sched->nodes.erase(key);
+}
+
+RTPU_API int32_t rtpu_sched_num_nodes(void* h) {
+  return static_cast<int32_t>(static_cast<Sched*>(h)->nodes.size());
+}
+
+// Returns 1 = picked (out_id filled); 0 = feasible on totals but not now;
+// -1 = infeasible forever; -2 = no nodes registered.
+RTPU_API int32_t rtpu_sched_pick_node(void* h, const int32_t* kinds,
+                                      const int64_t* vals, int32_t n,
+                                      int64_t spread_threshold_fp,
+                                      int64_t top_k_frac_fp,
+                                      const uint8_t* preferred_or_null,
+                                      uint64_t seed, uint8_t* out_id) {
+  auto* sched = static_cast<Sched*>(h);
+  if (sched->nodes.empty()) return -2;
+
+  struct Cand {
+    const NodeIdKey* id;
+    double util;
+  };
+  std::vector<Cand> feasible;
+  feasible.reserve(sched->nodes.size());
+  bool ever = false;
+  for (const auto& [id, node] : sched->nodes) {
+    if (node.Fits(kinds, vals, n, /*against_total=*/true)) {
+      ever = true;
+      if (node.Fits(kinds, vals, n, /*against_total=*/false)) {
+        feasible.push_back({&id, node.Utilization()});
+      }
+    }
+  }
+  if (feasible.empty()) return ever ? 0 : -1;
+
+  const double threshold =
+      static_cast<double>(spread_threshold_fp) / kPrecision;
+
+  // Preferred (local) node wins while under the pack threshold.
+  if (preferred_or_null != nullptr) {
+    NodeIdKey pref;
+    std::memcpy(pref.bytes.data(), preferred_or_null, 16);
+    for (const auto& c : feasible) {
+      if (*c.id == pref && c.util < threshold) {
+        std::memcpy(out_id, c.id->bytes.data(), 16);
+        return 1;
+      }
+    }
+  }
+
+  std::vector<Cand> below;
+  for (const auto& c : feasible) {
+    if (c.util < threshold) below.push_back(c);
+  }
+  if (!below.empty()) {
+    // Pack: fill the most-utilized under-threshold nodes first; break ties
+    // top-k random to avoid herding (scheduler_top_k_fraction).
+    std::sort(below.begin(), below.end(), [](const Cand& a, const Cand& b) {
+      if (a.util != b.util) return a.util > b.util;
+      return a.id->bytes < b.id->bytes;  // stable across processes
+    });
+    const double frac = static_cast<double>(top_k_frac_fp) / kPrecision;
+    size_t k = std::max<size_t>(
+        1, static_cast<size_t>(below.size() * frac));
+    uint64_t rng = seed;
+    const Cand& pick = below[NextRand(&rng) % k];
+    std::memcpy(out_id, pick.id->bytes.data(), 16);
+    return 1;
+  }
+  // Everyone above threshold: spread to least utilized.
+  const Cand* best = &feasible[0];
+  for (const auto& c : feasible) {
+    if (c.util < best->util ||
+        (c.util == best->util && c.id->bytes < best->id->bytes)) {
+      best = &c;
+    }
+  }
+  std::memcpy(out_id, best->id->bytes.data(), 16);
+  return 1;
+}

@@ -210,3 +210,92 @@ class TestChannel:
         assert r2.read(timeout=5)[0] == b"b"
         for c in (w, r1, r2):
             c.detach()
+
+
+class TestNativeScheduler:
+    """Native scheduling core (src/native/rtpu_sched.cc)."""
+
+    def _sched(self):
+        from ray_tpu.core.native import make_scheduler
+
+        s = make_scheduler()
+        assert s is not None, "native toolchain must exist in this image"
+        return s
+
+    def test_pick_statuses(self):
+        s = self._sched()
+        a, b = b"A" * 16, b"B" * 16
+        s.update_node(a, {"CPU": 4.0}, {"CPU": 4.0})
+        s.update_node(b, {"CPU": 4.0, "TPU": 8.0}, {"CPU": 1.0, "TPU": 8.0})
+        assert s.num_nodes() == 2
+        assert s.pick_node({"CPU": 2.0}, 0.5, 0.2)[0] == 1
+        status, picked = s.pick_node({"TPU": 4.0}, 0.5, 0.2)
+        assert (status, picked) == (1, b)
+        assert s.pick_node({"GPU": 1.0}, 0.5, 0.2) == (-1, None)
+        assert s.pick_node({"CPU": 3.0, "TPU": 1.0}, 0.5, 0.2) == (0, None)
+        s.remove_node(b)
+        assert s.num_nodes() == 1
+        assert s.pick_node({"TPU": 1.0}, 0.5, 0.2) == (-1, None)
+
+    def test_pack_then_spread(self):
+        s = self._sched()
+        # Node A half full (under 0.5 threshold? exactly 0.5 → spread side),
+        # node B empty: packing fills the most-utilized under-threshold node.
+        s.update_node(b"A" * 16, {"CPU": 10.0}, {"CPU": 6.0})  # util 0.4
+        s.update_node(b"B" * 16, {"CPU": 10.0}, {"CPU": 10.0})  # util 0.0
+        status, picked = s.pick_node({"CPU": 1.0}, 0.5, 0.01)
+        assert status == 1 and picked == b"A" * 16  # pack (top-1 of below)
+        # Both above threshold: spread to the least utilized.
+        s.update_node(b"A" * 16, {"CPU": 10.0}, {"CPU": 2.0})  # util 0.8
+        s.update_node(b"B" * 16, {"CPU": 10.0}, {"CPU": 4.0})  # util 0.6
+        status, picked = s.pick_node({"CPU": 1.0}, 0.5, 0.01)
+        assert status == 1 and picked == b"B" * 16
+
+    def test_preferred_under_threshold_wins(self):
+        s = self._sched()
+        s.update_node(b"A" * 16, {"CPU": 10.0}, {"CPU": 9.0})
+        s.update_node(b"B" * 16, {"CPU": 10.0}, {"CPU": 5.0})
+        status, picked = s.pick_node(
+            {"CPU": 1.0}, 0.5, 0.2, preferred=b"A" * 16
+        )
+        assert status == 1 and picked == b"A" * 16
+
+    def test_fractional_fixed_point(self):
+        s = self._sched()
+        s.update_node(b"A" * 16, {"CPU": 1.0}, {"CPU": 0.5001})
+        assert s.pick_node({"CPU": 0.5}, 0.5, 0.2)[0] == 1
+        assert s.pick_node({"CPU": 0.5002}, 0.5, 0.2)[0] == 0
+
+    def test_matches_python_policy_semantics(self):
+        """Native and Python ClusterScheduler agree on feasibility and the
+        pack-vs-spread side for random clusters."""
+        import random
+
+        from ray_tpu.core.ids import NodeID
+        from ray_tpu.core.resources import ResourceSet
+        from ray_tpu.core.scheduler import ClusterScheduler, InfeasibleError
+
+        rng = random.Random(0)
+        for trial in range(20):
+            nat = ClusterScheduler(use_native=True)
+            py = ClusterScheduler(use_native=False)
+            assert nat._native is not None
+            for i in range(rng.randint(1, 5)):
+                nid = NodeID.from_random()
+                total = {"CPU": float(rng.randint(1, 8))}
+                avail = {"CPU": rng.randint(0, int(total["CPU"]))* 1.0}
+                snap = {"total": total, "available": avail, "labels": {}}
+                nat.update_node(nid, snap)
+                py.update_node(nid, snap)
+            req = ResourceSet({"CPU": float(rng.randint(1, 6))})
+            try:
+                a = nat.pick_node(req)
+                a_kind = "picked" if a is not None else "retry"
+            except InfeasibleError:
+                a_kind = "infeasible"
+            try:
+                b = py.pick_node(req)
+                b_kind = "picked" if b is not None else "retry"
+            except InfeasibleError:
+                b_kind = "infeasible"
+            assert a_kind == b_kind, f"trial {trial}: {a_kind} vs {b_kind}"
