@@ -52,6 +52,12 @@ _KNOBS: Dict[str, tuple] = {
     "num_workers_soft_limit": (int, 0, "0 = num_cpus"),
     "worker_niceness": (int, 0, "Nice level for spawned workers"),
     "prestart_workers": (int, 0, "Workers to pre-start per node"),
+    # -- OOM defense --
+    "memory_monitor_period_s": (float, 1.0, "0 disables the memory monitor"),
+    "memory_monitor_threshold": (float, 0.95, "Kill workers above this usage"),
+    "memory_monitor_fake_usage_file": (
+        str, "", "Testing: read usage fraction from this file instead of /proc"
+    ),
     # -- fault tolerance --
     "task_max_retries_default": (int, 3, "Default retries for idempotent tasks"),
     "actor_max_restarts_default": (int, 0, "Default actor restarts"),

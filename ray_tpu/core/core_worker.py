@@ -167,6 +167,9 @@ class _LeasePool:
                 "placement_group_id": self.template.placement_group_id,
                 "bundle_index": self.template.bundle_index,
                 "env_vars": self.template.env_vars,
+                # OOM-defense policy input: only leases whose tasks can be
+                # resubmitted should be preferred kill victims.
+                "retriable": self.template.max_retries > 0,
             }
             while True:
                 reply = await agent.call(

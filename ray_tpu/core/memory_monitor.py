@@ -1,0 +1,92 @@
+"""Node OOM defense: memory monitor + worker-killing policy.
+
+Reference: ``MemoryMonitor`` (ray ``src/ray/common/memory_monitor.h:52``)
+polls node memory; ``WorkerKillingPolicy`` (ray
+``raylet/worker_killing_policy.h:33``) picks a victim when usage crosses
+the threshold — retriable work first, newest first (so long-running work
+survives).  The killed task surfaces as a ``WorkerCrashedError`` and the
+submitter's ``max_retries`` machinery resubmits it, exactly like any other
+worker death.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+
+def system_memory_fraction() -> float:
+    """Used fraction of node memory from /proc/meminfo (cgroup limits are
+    the follow-up; the reference reads both)."""
+    total = available = None
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    total = int(line.split()[1])
+                elif line.startswith("MemAvailable:"):
+                    available = int(line.split()[1])
+                if total is not None and available is not None:
+                    break
+    except OSError:
+        return 0.0
+    if not total:
+        return 0.0
+    return 1.0 - (available or 0) / total
+
+
+def pick_worker_to_kill(
+    leases: List[dict],
+) -> Optional[Tuple[int, dict]]:
+    """Choose a victim among active leases.
+
+    Each lease dict needs: ``lease_id``, ``start_ts``, ``retriable`` (bool),
+    ``is_actor`` (bool).  Policy (reference group-by-owner/retriable-first,
+    simplified): retriable tasks before non-retriable before actors; newest
+    first within a class — the work closest to its start loses the least.
+    """
+    if not leases:
+        return None
+
+    def rank(lease):
+        if lease.get("is_actor"):
+            cls = 2
+        elif lease.get("retriable", True):
+            cls = 0
+        else:
+            cls = 1
+        return (cls, -lease.get("start_ts", 0.0))
+
+    ordered = sorted(leases, key=rank)
+    victim = ordered[0]
+    return victim["lease_id"], victim
+
+
+class MemoryMonitor:
+    """Periodically invoked by the node agent; kills one victim per breach
+    round (gradual back-off beats mass slaughter)."""
+
+    def __init__(
+        self,
+        threshold: float,
+        usage_reader: Callable[[], float] = system_memory_fraction,
+    ):
+        self.threshold = threshold
+        self.usage_reader = usage_reader
+        self.num_kills = 0
+
+    def check(self, leases: List[dict]) -> Optional[Tuple[int, dict]]:
+        """Returns (lease_id, lease) to kill, or None."""
+        usage = self.usage_reader()
+        if usage < self.threshold:
+            return None
+        victim = pick_worker_to_kill(leases)
+        if victim is not None:
+            self.num_kills += 1
+            logger.warning(
+                "memory usage %.1f%% >= %.1f%%: killing lease %s",
+                usage * 100, self.threshold * 100, victim[0],
+            )
+        return victim

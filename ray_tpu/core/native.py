@@ -139,7 +139,10 @@ def get_lib():
             lib = ctypes.CDLL(_LIB_PATH)
             _declare(lib)
             _lib = lib
-        except OSError:
+        except (OSError, AttributeError):
+            # AttributeError: a stale .so lacking newly added symbols (and
+            # no toolchain to rebuild) — degrade to the Python fallbacks
+            # rather than crash every native caller.
             _load_failed = True
     return _lib
 

@@ -52,13 +52,16 @@ class WorkerHandle:
 class Lease:
     def __init__(self, lease_id: int, worker: WorkerHandle, resources: ResourceSet,
                  instances: Dict[str, List[int]], pg_id: Optional[PlacementGroupID],
-                 bundle_index: int):
+                 bundle_index: int, is_actor: bool = False):
         self.lease_id = lease_id
         self.worker = worker
         self.resources = resources
         self.instances = instances
         self.pg_id = pg_id
         self.bundle_index = bundle_index
+        self.is_actor = is_actor
+        self.retriable = not is_actor  # refined from the lease request
+        self.start_ts = time.monotonic()
 
 
 class BundlePool:
@@ -118,8 +121,52 @@ class NodeAgent:
         loop = asyncio.get_running_loop()
         self._bg.append(loop.create_task(self._heartbeat_loop()))
         self._bg.append(loop.create_task(self._monitor_workers_loop()))
+        if GlobalConfig.memory_monitor_period_s > 0:
+            self._bg.append(loop.create_task(self._memory_monitor_loop()))
         logger.info("node agent %s on %s", self.node_id.hex()[:8], addr)
         return addr
+
+    async def _memory_monitor_loop(self):
+        """OOM defense (reference: MemoryMonitor + WorkerKillingPolicy):
+        when node memory crosses the threshold, kill the newest retriable
+        lease's worker — the submitter's retry machinery resubmits it."""
+        from .memory_monitor import MemoryMonitor, system_memory_fraction
+
+        fake_file = GlobalConfig.memory_monitor_fake_usage_file
+
+        def usage_reader() -> float:
+            if fake_file:  # chaos/testing hook
+                try:
+                    with open(fake_file) as f:
+                        return float(f.read().strip())
+                except (OSError, ValueError):
+                    return 0.0
+            return system_memory_fraction()
+
+        monitor = MemoryMonitor(
+            GlobalConfig.memory_monitor_threshold, usage_reader
+        )
+        self.memory_monitor = monitor
+        period = GlobalConfig.memory_monitor_period_s
+        while True:
+            await asyncio.sleep(period)
+            try:
+                victims = [
+                    {
+                        "lease_id": lid,
+                        "start_ts": lease.start_ts,
+                        "retriable": lease.retriable and not lease.is_actor,
+                        "is_actor": lease.is_actor,
+                    }
+                    for lid, lease in self.leases.items()
+                ]
+                picked = monitor.check(victims)
+                if picked is not None:
+                    lease = self.leases.get(picked[0])
+                    if lease is not None:
+                        self._kill_worker_proc(lease.worker)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("memory monitor round failed: %s", e)
 
     async def stop(self):
         for t in self._bg:
@@ -409,9 +456,11 @@ class NodeAgent:
             return
         lease_id = self._next_lease_id
         self._next_lease_id += 1
-        self.leases[lease_id] = Lease(
+        lease = Lease(
             lease_id, worker, resources, instances, pg_id, bundle_index
         )
+        lease.retriable = payload.get("retriable", True)
+        self.leases[lease_id] = lease
         if not fut.done():
             fut.set_result(
                 {
@@ -551,6 +600,7 @@ class NodeAgent:
             instances,
             spec.placement_group_id,
             spec.bundle_index,
+            is_actor=True,
         )
         return {"worker_address": worker.address, "worker_id": worker.worker_id}
 

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+import tempfile
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -63,12 +64,92 @@ def drop_arena(session_id: str):
 
 
 def delete_from_tiers(session_id: str, object_id: ObjectID):
-    """Remove an object from whichever shm tier holds it (arena delete is
-    deferred past live reader pins by the native layer)."""
+    """Remove an object from whichever tier holds it — shm arena, tmpfs
+    segment, or disk spill file (arena delete is deferred past live reader
+    pins by the native layer)."""
     arena = get_arena(session_id)
     if arena is not None:
         arena.delete(object_id.binary())
     shm.unlink_by_name(shm.segment_name(session_id, object_id.hex()))
+    try:
+        os.unlink(spill_path(session_id, object_id))
+    except OSError:
+        pass
+
+
+# --------------------------------------------------------------------------
+# Disk spill tier (reference: plasma spilling to external storage;
+# ``object_spilling_config`` in the reference).  Objects evicted from shm
+# under memory pressure land here and remain directly readable — no lineage
+# re-execution needed for spilled-but-wanted objects.
+# --------------------------------------------------------------------------
+
+def spill_dir(session_id: str, create: bool = False) -> str:
+    d = os.path.join(
+        tempfile.gettempdir(), "ray_tpu", f"session_{session_id}", "spill"
+    )
+    if create:
+        os.makedirs(d, exist_ok=True)
+    return d
+
+
+def spill_path(session_id: str, object_id: ObjectID) -> str:
+    return os.path.join(spill_dir(session_id), object_id.hex() + ".bin")
+
+
+def spill_object(session_id: str, object_id: ObjectID, payload) -> int:
+    spill_dir(session_id, create=True)
+    path = spill_path(session_id, object_id)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+    os.replace(tmp, path)
+    return len(payload)
+
+
+def read_spilled(session_id: str, object_id: ObjectID):
+    try:
+        with open(spill_path(session_id, object_id), "rb") as f:
+            return f.read()
+    except OSError:
+        return None
+
+
+def read_from_tiers(session_id: str, object_id: ObjectID):
+    """Raw payload bytes from any tier, or None."""
+    arena = get_arena(session_id)
+    if arena is not None:
+        mv = arena.acquire(object_id.binary())
+        if mv is not None:
+            data = bytes(mv)
+            del mv
+            return data
+    try:
+        seg = shm.ShmSegment.attach(
+            shm.segment_name(session_id, object_id.hex())
+        )
+        data = bytes(seg.view())
+        seg.close()
+        return data
+    except FileNotFoundError:
+        pass
+    return read_spilled(session_id, object_id)
+
+
+class _SpilledBlob:
+    """In-memory copy of a spilled object, quacking like a ShmSegment so it
+    can live in ``ShmObjectStore._attached``."""
+
+    __slots__ = ("_data",)
+
+    def __init__(self, data: bytes):
+        self._data = data
+
+    def view(self) -> memoryview:
+        return memoryview(self._data)
+
+    def close(self):
+        self._data = b""
 
 
 class _Entry:
@@ -178,7 +259,7 @@ class ShmObjectStore:
             )
             return True
         except FileNotFoundError:
-            return False
+            return os.path.exists(spill_path(self.session_id, object_id))
 
     def get(self, object_id: ObjectID) -> Any:
         return deserialize_from_bytes(self.raw_bytes(object_id))
@@ -192,9 +273,19 @@ class ShmObjectStore:
                 return mv
         seg = self._attached.get(object_id)
         if seg is None:
-            seg = shm.ShmSegment.attach(
-                shm.segment_name(self.session_id, object_id.hex())
-            )
+            try:
+                seg = shm.ShmSegment.attach(
+                    shm.segment_name(self.session_id, object_id.hex())
+                )
+            except FileNotFoundError:
+                # Last tier: the object was spilled to disk under pressure.
+                # Cache the blob in _attached — chunked remote pulls call
+                # raw_bytes once per chunk and must not re-read the whole
+                # file every time.
+                data = read_spilled(self.session_id, object_id)
+                if data is None:
+                    raise
+                seg = _SpilledBlob(data)
             self._attached[object_id] = seg
         return seg.view()
 
@@ -219,6 +310,16 @@ class NodeObjectDirectory:
         self.used = 0
         self._objects: Dict[ObjectID, Tuple[int, float]] = {}  # size, seal_ts
         self._pinned: Dict[ObjectID, int] = {}
+        self.spilled_bytes = 0
+        self.num_spilled = 0
+        self._spilled: Dict[ObjectID, int] = {}  # oid -> size (disk tier)
+        # Spill file IO runs off the agent's event loop; one worker keeps
+        # spills ordered.  _spilling tracks sizes of in-flight victims (the
+        # object is still in shm until its spill completes) and _freed
+        # records frees that raced an in-flight spill.
+        self._spill_pool = None
+        self._spilling: Dict[ObjectID, int] = {}
+        self._freed_while_spilling: set = set()
 
     def seal(self, object_id: ObjectID, size: int):
         if object_id not in self._objects:
@@ -228,11 +329,17 @@ class NodeObjectDirectory:
                 self._evict()
 
     def contains(self, object_id: ObjectID) -> bool:
-        return object_id in self._objects
+        return (
+            object_id in self._objects
+            or object_id in self._spilled
+            or object_id in self._spilling
+        )
 
     def size_of(self, object_id: ObjectID) -> Optional[int]:
         entry = self._objects.get(object_id)
-        return entry[0] if entry else None
+        if entry is not None:
+            return entry[0]
+        return self._spilled.get(object_id) or self._spilling.get(object_id)
 
     def pin(self, object_id: ObjectID):
         self._pinned[object_id] = self._pinned.get(object_id, 0) + 1
@@ -248,10 +355,19 @@ class NodeObjectDirectory:
         entry = self._objects.pop(object_id, None)
         if entry is not None:
             self.used -= entry[0]
+        spilled = self._spilled.pop(object_id, None)
+        if object_id in self._spilling:
+            self._freed_while_spilling.add(object_id)
+        if entry is not None or spilled is not None:
             delete_from_tiers(self.session_id, object_id)
 
     def _evict(self):
-        """LRU-evict unpinned sealed objects until under capacity."""
+        """LRU-evict unpinned sealed objects until under capacity,
+        *spilling* each victim to the disk tier first (reference: plasma
+        object spilling) so consumers read it back without lineage
+        re-execution.  Accounting updates happen here (on the caller's
+        loop); the file IO + shm removal run on a spill thread so large
+        disk writes never stall the node agent."""
         victims = sorted(
             (oid for oid in self._objects if oid not in self._pinned),
             key=lambda oid: self._objects[oid][1],
@@ -259,11 +375,49 @@ class NodeObjectDirectory:
         for oid in victims:
             if self.used <= self.capacity:
                 break
-            self.free(oid)
+            entry = self._objects.pop(oid, None)
+            if entry is None:
+                continue
+            self.used -= entry[0]
+            self._spilling[oid] = entry[0]
+            if self._spill_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._spill_pool = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="rtpu-spill"
+                )
+            self._spill_pool.submit(self._spill_one, oid)
+
+    def _spill_one(self, oid: ObjectID):
+        """Runs on the spill thread.  Order matters: write the spill file
+        BEFORE removing the shm copy so readers always find the object in
+        at least one tier."""
+        try:
+            payload = read_from_tiers(self.session_id, oid)
+            if payload is not None:
+                spill_object(self.session_id, oid, payload)
+                self.spilled_bytes += len(payload)
+                self.num_spilled += 1
+                self._spilled[oid] = len(payload)
+            arena = get_arena(self.session_id)
+            if arena is not None:
+                arena.delete(oid.binary())
+            shm.unlink_by_name(shm.segment_name(self.session_id, oid.hex()))
+        finally:
+            self._spilling.pop(oid, None)
+            if oid in self._freed_while_spilling:
+                self._freed_while_spilling.discard(oid)
+                self._spilled.pop(oid, None)
+                delete_from_tiers(self.session_id, oid)
 
     def object_ids(self) -> List[ObjectID]:
         return list(self._objects)
 
     def cleanup(self):
+        if self._spill_pool is not None:
+            self._spill_pool.shutdown(wait=True)
+            self._spill_pool = None
         for oid in list(self._objects):
+            self.free(oid)
+        for oid in list(self._spilled):
             self.free(oid)

@@ -1,0 +1,166 @@
+"""Dashboard-lite: cluster state + metrics over HTTP (JSON, no UI).
+
+Role-equivalent of the reference dashboard's API surface (ray
+``python/ray/dashboard/``: the head process aggregating state + the
+metrics pipeline to Prometheus) without the TypeScript frontend — SURVEY.md
+§7 scopes round 1 to "serve JSON; UI later".  Endpoints:
+
+    GET /                    endpoint index
+    GET /api/cluster         resource + actor/job summary
+    GET /api/nodes|actors|tasks|jobs|placement_groups
+    GET /api/timeline        Chrome-trace events
+    GET /metrics             Prometheus exposition (ray.util.metrics analog)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Any, Dict, Optional
+
+_state: Dict[str, Any] = {}
+
+
+def start_dashboard(
+    host: str = "127.0.0.1", port: int = 8265, address: Optional[str] = None
+) -> str:
+    """Start the dashboard HTTP server (connects a driver if needed)."""
+    import ray_tpu
+    from aiohttp import web
+
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(address=address or "auto")
+
+    from .util.state import api as state_api
+    from .util.state.api import StateApiClient, chrome_trace_events
+
+    client = StateApiClient()
+
+    def _json(data, status=200):
+        return web.json_response(
+            json.loads(json.dumps(data, default=str)), status=status
+        )
+
+    async def run_sync(fn, *args, **kw):
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, lambda: fn(*args, **kw))
+
+    async def index(request):
+        return _json(
+            {
+                "endpoints": [
+                    "/api/cluster", "/api/nodes", "/api/actors",
+                    "/api/tasks", "/api/jobs", "/api/placement_groups",
+                    "/api/timeline", "/metrics",
+                ]
+            }
+        )
+
+    async def cluster(request):
+        state = await run_sync(client.get_state)
+        alive = [n for n in state["nodes"].values() if n["alive"]]
+        total: Dict[str, float] = {}
+        avail: Dict[str, float] = {}
+        for info in alive:
+            for k, v in info["snapshot"]["total"].items():
+                total[k] = total.get(k, 0) + v
+            for k, v in info["snapshot"]["available"].items():
+                avail[k] = avail.get(k, 0) + v
+        actors: Dict[str, int] = {}
+        for a in state["actors"]:
+            actors[a["state"]] = actors.get(a["state"], 0) + 1
+        return _json(
+            {
+                "nodes_alive": len(alive),
+                "nodes_total": len(state["nodes"]),
+                "resources_total": total,
+                "resources_available": avail,
+                "actors_by_state": actors,
+                "jobs_running": sum(
+                    1 for j in state["jobs"].values()
+                    if j["state"] == "RUNNING"
+                ),
+            }
+        )
+
+    async def nodes(request):
+        return _json(await run_sync(state_api.list_nodes))
+
+    async def actors(request):
+        return _json(await run_sync(state_api.list_actors))
+
+    async def tasks(request):
+        limit = int(request.query.get("limit", "1000"))
+        filters = None
+        if "name" in request.query:
+            filters = {"name": request.query["name"]}
+        return _json(
+            await run_sync(state_api.list_tasks, None, filters, limit)
+        )
+
+    async def jobs(request):
+        return _json(await run_sync(state_api.list_jobs))
+
+    async def pgs(request):
+        return _json(await run_sync(state_api.list_placement_groups))
+
+    async def timeline(request):
+        reply = await run_sync(client.list_task_events, None, 100000)
+        return _json(chrome_trace_events(reply))
+
+    async def metrics(request):
+        from .util import metrics as metrics_mod
+
+        text = await run_sync(metrics_mod.prometheus_text)
+        return web.Response(text=text, content_type="text/plain")
+
+    app = web.Application()
+    app.router.add_get("/", index)
+    app.router.add_get("/api/cluster", cluster)
+    app.router.add_get("/api/nodes", nodes)
+    app.router.add_get("/api/actors", actors)
+    app.router.add_get("/api/tasks", tasks)
+    app.router.add_get("/api/jobs", jobs)
+    app.router.add_get("/api/placement_groups", pgs)
+    app.router.add_get("/api/timeline", timeline)
+    app.router.add_get("/metrics", metrics)
+
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    runner_box: Dict[str, Any] = {}
+
+    def serve_forever():
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(app)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, host, port)
+        loop.run_until_complete(site.start())
+        runner_box["runner"] = runner
+        started.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=serve_forever, daemon=True,
+                         name="rtpu-dashboard")
+    t.start()
+    if not started.wait(timeout=10):
+        raise RuntimeError("dashboard failed to start")
+    _state.update(loop=loop, thread=t, runner=runner_box.get("runner"))
+    return f"http://{host}:{port}"
+
+
+def stop_dashboard() -> None:
+    loop = _state.get("loop")
+    runner = _state.get("runner")
+    if loop is None:
+        return
+    if runner is not None:
+        # Release the listening socket before stopping the loop, else a
+        # restart on the same port hits address-in-use until GC.
+        fut = asyncio.run_coroutine_threadsafe(runner.cleanup(), loop)
+        try:
+            fut.result(timeout=5)
+        except Exception:
+            pass
+    loop.call_soon_threadsafe(loop.stop)
+    _state.clear()

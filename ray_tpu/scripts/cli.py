@@ -232,6 +232,20 @@ def cmd_timeline(args) -> int:
     return 0
 
 
+def cmd_dashboard(args) -> int:
+    from ..dashboard import start_dashboard
+
+    url = start_dashboard(
+        host=args.host, port=args.port, address=args.address
+    )
+    print(f"dashboard at {url} (endpoints at {url}/)")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="ray-tpu", description="ray_tpu cluster CLI"
@@ -276,6 +290,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--address", default=None)
     p.add_argument("-o", "--output", default=None)
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("dashboard", help="serve cluster state + metrics over HTTP")
+    p.add_argument("--address", default=None)
+    p.add_argument("--port", type=int, default=8265)
+    p.add_argument("--host", default="127.0.0.1")
+    p.set_defaults(fn=cmd_dashboard)
 
     from . import job_cli, serve_cli
 

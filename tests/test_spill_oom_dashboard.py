@@ -1,0 +1,171 @@
+"""Object spilling, OOM defense, and dashboard-lite tests."""
+
+import json
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+class TestSpilling:
+    def test_objects_survive_eviction_via_spill(self):
+        # Tiny store: 3 × 300KB objects exceed the 700KB budget; early ones
+        # spill to disk and must still be gettable.
+        ctx = ray_tpu.init(
+            num_cpus=2,
+            _system_config={"object_store_memory_bytes": 700 * 1024},
+        )
+        try:
+            arrays = [
+                np.full(300 * 1024 // 8, float(i)) for i in range(3)
+            ]
+            refs = [ray_tpu.put(a) for a in arrays]
+            time.sleep(0.3)
+            for i, ref in enumerate(refs):
+                out = ray_tpu.get(ref, timeout=60)
+                np.testing.assert_array_equal(out, arrays[i])
+            # At least one object must have hit the disk tier.
+            from ray_tpu.core.object_store import spill_dir
+
+            session = ctx.address_info["session_id"]
+            spilled = os.listdir(spill_dir(session))
+            assert len(spilled) >= 1
+        finally:
+            ray_tpu.shutdown()
+
+    def test_remote_task_reads_spilled_object(self):
+        ctx = ray_tpu.init(
+            num_cpus=2,
+            _system_config={"object_store_memory_bytes": 700 * 1024},
+        )
+        try:
+            big = [ray_tpu.put(np.full(300 * 1024 // 8, float(i)))
+                   for i in range(3)]
+
+            @ray_tpu.remote
+            def total(x):
+                return float(x.sum())
+
+            results = ray_tpu.get(
+                [total.remote(r) for r in big], timeout=120
+            )
+            expected = [float(np.full(300 * 1024 // 8, float(i)).sum())
+                        for i in range(3)]
+            assert results == expected
+        finally:
+            ray_tpu.shutdown()
+
+
+class TestMemoryMonitor:
+    def test_victim_policy_order(self):
+        from ray_tpu.core.memory_monitor import pick_worker_to_kill
+
+        leases = [
+            {"lease_id": 1, "start_ts": 10.0, "retriable": True,
+             "is_actor": False},
+            {"lease_id": 2, "start_ts": 20.0, "retriable": True,
+             "is_actor": False},
+            {"lease_id": 3, "start_ts": 30.0, "retriable": False,
+             "is_actor": False},
+            {"lease_id": 4, "start_ts": 5.0, "retriable": False,
+             "is_actor": True},
+        ]
+        # Newest retriable task first.
+        assert pick_worker_to_kill(leases)[0] == 2
+        # Without retriable tasks: non-retriable before actors.
+        assert pick_worker_to_kill(leases[2:])[0] == 3
+        # Actors only as a last resort.
+        assert pick_worker_to_kill(leases[3:])[0] == 4
+        assert pick_worker_to_kill([]) is None
+
+    def test_monitor_triggers_on_threshold(self):
+        from ray_tpu.core.memory_monitor import MemoryMonitor
+
+        usage = {"v": 0.5}
+        monitor = MemoryMonitor(0.9, usage_reader=lambda: usage["v"])
+        leases = [{"lease_id": 7, "start_ts": 1.0, "retriable": True,
+                   "is_actor": False}]
+        assert monitor.check(leases) is None
+        usage["v"] = 0.96
+        assert monitor.check(leases)[0] == 7
+        assert monitor.num_kills == 1
+
+    def test_oom_kill_retries_task(self, tmp_path):
+        """End-to-end: the monitor kills the worker of a running task under
+        (fake) memory pressure; once pressure clears, the retry succeeds."""
+        usage_file = tmp_path / "usage.txt"
+        usage_file.write_text("0.1")
+        ray_tpu.init(
+            num_cpus=2,
+            _system_config={
+                "memory_monitor_period_s": 0.2,
+                "memory_monitor_threshold": 0.9,
+                "memory_monitor_fake_usage_file": str(usage_file),
+            },
+        )
+        try:
+            @ray_tpu.remote(max_retries=3)
+            def slow():
+                import time as _t
+
+                _t.sleep(2.0)
+                return "done"
+
+            start = time.monotonic()
+            ref = slow.remote()
+            time.sleep(0.7)  # task is running on its lease
+            usage_file.write_text("0.99")  # breach: kill the worker
+            time.sleep(0.8)
+            usage_file.write_text("0.1")  # pressure clears; retry succeeds
+            assert ray_tpu.get(ref, timeout=90) == "done"
+            # The first attempt was killed ~1.5s in, so the successful
+            # retry pushes total time past a single 2s run.
+            assert time.monotonic() - start > 3.0
+        finally:
+            ray_tpu.shutdown()
+
+
+class TestDashboard:
+    def test_endpoints(self):
+        ray_tpu.init(num_cpus=4)
+        from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+        try:
+            @ray_tpu.remote
+            def tick():
+                return 1
+
+            ray_tpu.get([tick.remote() for _ in range(3)], timeout=60)
+            from ray_tpu.util.metrics import Counter
+
+            c = Counter("dash_test_total", tag_keys=())
+            c.inc(5)
+
+            url = start_dashboard(port=8266)
+
+            def fetch(path):
+                return json.loads(
+                    urllib.request.urlopen(url + path, timeout=30).read()
+                )
+
+            index = fetch("/")
+            assert "/api/cluster" in index["endpoints"]
+            cluster = fetch("/api/cluster")
+            assert cluster["nodes_alive"] == 1
+            assert cluster["resources_total"]["CPU"] == 4.0
+            nodes = fetch("/api/nodes")
+            assert len(nodes) == 1
+            time.sleep(1.2)  # task event flush
+            tasks = fetch("/api/tasks?name=tick")
+            assert len(tasks) == 3
+            timeline = fetch("/api/timeline")
+            assert isinstance(timeline, list)
+            text = urllib.request.urlopen(url + "/metrics", timeout=30).read()
+            assert b"dash_test_total" in text
+        finally:
+            stop_dashboard()
+            ray_tpu.shutdown()
