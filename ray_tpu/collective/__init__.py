@@ -11,7 +11,9 @@ from .collective import (  # noqa: F401
     init_collective_group,
     init_local_group,
     is_group_initialized,
+    recv,
     reducescatter,
+    send,
 )
 from .device_objects import DeviceObjectStore, DeviceRef, device_object_store  # noqa: F401
 from .types import Backend, GroupInfo, ReduceOp  # noqa: F401

@@ -36,6 +36,10 @@ class GroupManager:
             from .xla_group import XlaGroup
 
             group = XlaGroup(group_name, world_size, rank, **kwargs)
+        # The caller-declared rank identifies THIS member for p2p edges
+        # even when the group object itself is rank-less (LOCAL backend is
+        # single-controller, so its own rank is always 0).
+        group.declared_rank = rank
         self._groups[group_name] = group
         return group
 
@@ -88,6 +92,7 @@ def is_group_initialized(group_name: str = "default") -> bool:
 
 
 def destroy_collective_group(group_name: str = "default"):
+    _destroy_p2p_edges(group_name)
     _manager.destroy(group_name)
 
 
@@ -127,3 +132,61 @@ def alltoall(tensor, group_name: str = "default"):
 
 def barrier(group_name: str = "default"):
     return _manager.get(group_name).barrier()
+
+
+def send(tensor, dst_rank: int, group_name: str = "default"):
+    """Point-to-point send (reference: ``ray.util.collective.send``,
+    NCCL p2p).  TPU-native path: the tensor rides the object plane —
+    host-staged through a named per-edge queue actor, so it works across
+    any pair of group members without a matching collective on the others.
+    For device-resident bulk transfer inside a jitted step, use
+    ``jax.lax.ppermute`` over the mesh instead."""
+    import numpy as np
+
+    group = _manager.get(group_name)
+    src = getattr(group, "declared_rank", get_rank(group_name))
+    queue = _p2p_queue(group_name, src, dst_rank)
+    queue.put(np.asarray(tensor))
+
+
+def recv(src_rank: int, group_name: str = "default", timeout: float = 60.0):
+    """Blocking receive of the next tensor sent by ``src_rank``."""
+    group = _manager.get(group_name)
+    dst = getattr(group, "declared_rank", get_rank(group_name))
+    queue = _p2p_queue(group_name, src_rank, dst)
+    return queue.get(timeout=timeout)
+
+
+# (group, src, dst) -> Queue; handles are cached so the hot p2p path pays
+# the named-actor rendezvous once per edge, not per message.
+_p2p_cache: Dict[tuple, object] = {}
+
+
+def _p2p_queue(group_name: str, src: int, dst: int):
+    """Named queue actor for the (group, src→dst) edge, created on first
+    use by either end (get_if_exists rendezvous)."""
+    from ..util.queue import Queue
+
+    key = (group_name, src, dst)
+    queue = _p2p_cache.get(key)
+    if queue is None:
+        queue = Queue(
+            maxsize=64,
+            name=f"_rtpu_p2p:{group_name}:{src}->{dst}",
+            get_if_exists=True,
+        )
+        _p2p_cache[key] = queue
+    return queue
+
+
+def _destroy_p2p_edges(group_name: str):
+    """Kill this process's p2p queue actors for a group — a later group
+    reusing the name must not receive stale tensors."""
+    import ray_tpu
+
+    for key in [k for k in _p2p_cache if k[0] == group_name]:
+        queue = _p2p_cache.pop(key)
+        try:
+            ray_tpu.kill(queue.actor)
+        except Exception:  # noqa: BLE001
+            pass

@@ -221,6 +221,8 @@ class ShmObjectStore:
         # returned to user code borrow the mapping.
         self._attached: Dict[ObjectID, shm.ShmSegment] = {}
         self._arena = get_arena(session_id)
+        # Single-slot cache for spilled-object reads (see raw_bytes).
+        self._spill_cache: Optional[Tuple[ObjectID, "_SpilledBlob"]] = None
 
     # -- write path ---------------------------------------------------------
     def create(self, object_id: ObjectID, value: Any) -> int:
@@ -279,13 +281,18 @@ class ShmObjectStore:
                 )
             except FileNotFoundError:
                 # Last tier: the object was spilled to disk under pressure.
-                # Cache the blob in _attached — chunked remote pulls call
-                # raw_bytes once per chunk and must not re-read the whole
-                # file every time.
+                # Single-slot cache (chunked pulls read one object's chunks
+                # back-to-back): caching every blob in _attached would
+                # re-accumulate in heap exactly what spilling evicted.
+                cached = self._spill_cache
+                if cached is not None and cached[0] == object_id:
+                    return cached[1].view()
                 data = read_spilled(self.session_id, object_id)
                 if data is None:
                     raise
-                seg = _SpilledBlob(data)
+                blob = _SpilledBlob(data)
+                self._spill_cache = (object_id, blob)
+                return blob.view()
             self._attached[object_id] = seg
         return seg.view()
 
@@ -391,14 +398,27 @@ class NodeObjectDirectory:
     def _spill_one(self, oid: ObjectID):
         """Runs on the spill thread.  Order matters: write the spill file
         BEFORE removing the shm copy so readers always find the object in
-        at least one tier."""
+        at least one tier.  A failed spill (e.g. disk full) restores the
+        object to the tracked set — losing track of a live shm copy would
+        corrupt capacity accounting."""
+        import logging
+
         try:
-            payload = read_from_tiers(self.session_id, oid)
-            if payload is not None:
-                spill_object(self.session_id, oid, payload)
-                self.spilled_bytes += len(payload)
-                self.num_spilled += 1
-                self._spilled[oid] = len(payload)
+            try:
+                payload = read_from_tiers(self.session_id, oid)
+                if payload is not None:
+                    spill_object(self.session_id, oid, payload)
+                    self.spilled_bytes += len(payload)
+                    self.num_spilled += 1
+                    self._spilled[oid] = len(payload)
+            except Exception as e:  # noqa: BLE001 — e.g. ENOSPC
+                logging.getLogger(__name__).warning(
+                    "spill of %s failed (%s); keeping shm copy", oid.hex(), e
+                )
+                size = self._spilling.get(oid, 0)
+                self._objects[oid] = (size, time.monotonic())
+                self.used += size
+                return
             arena = get_arena(self.session_id)
             if arena is not None:
                 arena.delete(oid.binary())

@@ -29,6 +29,10 @@ def start_dashboard(
     import ray_tpu
     from aiohttp import web
 
+    if _state:
+        # Only one dashboard per process; replace the previous instance
+        # instead of orphaning its loop/thread/socket.
+        stop_dashboard()
     if not ray_tpu.is_initialized():
         ray_tpu.init(address=address or "auto")
 

@@ -6,5 +6,10 @@ from .config import (  # noqa: F401
     RunConfig,
     ScalingConfig,
 )
-from .session import get_checkpoint, get_context, report  # noqa: F401
+from .session import (  # noqa: F401
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    report,
+)
 from .trainer import DataParallelTrainer, JaxTrainer  # noqa: F401

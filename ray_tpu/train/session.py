@@ -25,6 +25,9 @@ class TrainContext:
     node_rank: int
     trial_name: str = ""
     latest_checkpoint: Optional[Checkpoint] = None
+    # Per-worker dataset shards (reference: the DatasetsCallback's
+    # streaming_split delivery; ray ``train/v2``).
+    dataset_shards: Optional[dict] = None
     # filled by the worker actor:
     _report_fn: Any = None
 
@@ -54,3 +57,16 @@ def report(metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):
 
 def get_checkpoint() -> Optional[Checkpoint]:
     return get_context().latest_checkpoint
+
+
+def get_dataset_shard(name: str = "train"):
+    """This worker's shard of a dataset passed to the trainer via
+    ``datasets={name: ds}`` (reference: ``ray.train.get_dataset_shard``;
+    the shard is a ``DataIterator`` whose transforms run worker-side)."""
+    ctx = get_context()
+    shards = ctx.dataset_shards or {}
+    if name not in shards:
+        raise KeyError(
+            f"no dataset shard {name!r}; trainer datasets: {sorted(shards)}"
+        )
+    return shards[name]

@@ -46,6 +46,7 @@ class DataParallelTrainer:
         run_config: Optional[RunConfig] = None,
         backend: Optional[Backend] = None,
         resume_from_checkpoint: Optional[Checkpoint] = None,
+        datasets: Optional[Dict[str, Any]] = None,
     ):
         self.train_loop = train_loop_per_worker
         self.train_loop_config = train_loop_config
@@ -53,6 +54,10 @@ class DataParallelTrainer:
         self.run_config = run_config or RunConfig()
         self.backend = backend or self.backend_cls()
         self.resume_from_checkpoint = resume_from_checkpoint
+        # Data ingest (reference: the DatasetsCallback + streaming_split):
+        # each dataset splits into one lazy shard per worker, read in the
+        # worker via ray_tpu.train.get_dataset_shard(name).
+        self.datasets = datasets or {}
 
     def fit(self) -> Result:
         storage = self.run_config.storage_path or tempfile.mkdtemp(
@@ -79,9 +84,20 @@ class DataParallelTrainer:
             )
             try:
                 self.backend.on_start(group)
+                shards_per_worker = None
+                if self.datasets:
+                    n = self.scaling_config.num_workers
+                    split = {
+                        name: ds.streaming_split(n)
+                        for name, ds in self.datasets.items()
+                    }
+                    shards_per_worker = [
+                        {name: split[name][i] for name in split}
+                        for i in range(n)
+                    ]
                 run_refs = group.run_async(
                     payload, self.train_loop_config, ckpt_mgr.latest(),
-                    ckpt_mgr.run_dir,
+                    ckpt_mgr.run_dir, shards_per_worker,
                 )
                 result = self._poll_until_done(group, run_refs, ckpt_mgr,
                                                metrics_history)

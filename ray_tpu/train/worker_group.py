@@ -71,7 +71,8 @@ class TrainWorker:
 
     # -------------------------------------------------------------- run/poll
     def run(self, train_fn_payload: bytes, config: Optional[dict],
-            latest_checkpoint, run_dir: Optional[str] = None) -> bool:
+            latest_checkpoint, run_dir: Optional[str] = None,
+            dataset_shards: Optional[dict] = None) -> bool:
         """Execute the user loop to completion (blocking this call slot)."""
         from ray_tpu.core.serialization import loads_function
 
@@ -96,6 +97,7 @@ class TrainWorker:
             local_rank=0,
             node_rank=self.rank,
             latest_checkpoint=latest_checkpoint,
+            dataset_shards=dataset_shards,
             _report_fn=report_fn,
         )
         _set_session(ctx)
@@ -140,10 +142,15 @@ class WorkerGroup:
         ]
 
     def run_async(self, train_fn_payload: bytes, config, latest_checkpoint,
-                  run_dir=None):
+                  run_dir=None, dataset_shards_per_worker=None):
         return [
-            w.run.remote(train_fn_payload, config, latest_checkpoint, run_dir)
-            for w in self.workers
+            w.run.remote(
+                train_fn_payload, config, latest_checkpoint, run_dir,
+                dataset_shards_per_worker[i]
+                if dataset_shards_per_worker
+                else None,
+            )
+            for i, w in enumerate(self.workers)
         ]
 
     def poll(self):

@@ -69,9 +69,17 @@ _POLL_MAX_S = 0.2
 
 
 class Queue:
-    def __init__(self, maxsize: int = 0, *, actor_options: Optional[dict] = None):
+    def __init__(self, maxsize: int = 0, *,
+                 actor_options: Optional[dict] = None,
+                 name: Optional[str] = None,
+                 get_if_exists: bool = False):
         opts = dict(actor_options or {})
         opts.setdefault("num_cpus", 0)
+        if name is not None:
+            # Named queues rendezvous across processes (collective p2p edges
+            # use this); get_if_exists makes creation race-free.
+            opts["name"] = name
+            opts["get_if_exists"] = get_if_exists
         self.maxsize = maxsize
         self.actor = remote(**opts)(_QueueActor).remote(maxsize)
 
