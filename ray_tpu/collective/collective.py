@@ -180,13 +180,24 @@ def _p2p_queue(group_name: str, src: int, dst: int):
 
 
 def _destroy_p2p_edges(group_name: str):
-    """Kill this process's p2p queue actors for a group — a later group
-    reusing the name must not receive stale tensors."""
+    """Kill ALL p2p queue actors for a group (cluster-wide, by name) — a
+    later group reusing the name must not receive stale tensors, including
+    on edges only a peer process ever touched.  Peers still holding handles
+    see a dead-actor error on their next send/recv (loud, not stale)."""
     import ray_tpu
 
     for key in [k for k in _p2p_cache if k[0] == group_name]:
-        queue = _p2p_cache.pop(key)
-        try:
-            ray_tpu.kill(queue.actor)
-        except Exception:  # noqa: BLE001
-            pass
+        _p2p_cache.pop(key)
+    prefix = f"_rtpu_p2p:{group_name}:"
+    try:
+        from ..util.state import list_actors
+
+        for row in list_actors():
+            name = row.get("name")
+            if name and name.startswith(prefix) and row["state"] != "DEAD":
+                try:
+                    ray_tpu.kill(ray_tpu.get_actor(name))
+                except Exception:  # noqa: BLE001
+                    pass
+    except Exception:  # noqa: BLE001 — best effort without a driver
+        pass

@@ -221,8 +221,10 @@ class ShmObjectStore:
         # returned to user code borrow the mapping.
         self._attached: Dict[ObjectID, shm.ShmSegment] = {}
         self._arena = get_arena(session_id)
-        # Single-slot cache for spilled-object reads (see raw_bytes).
-        self._spill_cache: Optional[Tuple[ObjectID, "_SpilledBlob"]] = None
+        # Bounded LRU cache for spilled-object reads (see raw_bytes).
+        from collections import OrderedDict
+
+        self._spill_cache: "OrderedDict[ObjectID, _SpilledBlob]" = OrderedDict()
 
     # -- write path ---------------------------------------------------------
     def create(self, object_id: ObjectID, value: Any) -> int:
@@ -281,17 +283,22 @@ class ShmObjectStore:
                 )
             except FileNotFoundError:
                 # Last tier: the object was spilled to disk under pressure.
-                # Single-slot cache (chunked pulls read one object's chunks
-                # back-to-back): caching every blob in _attached would
+                # Small bounded LRU (chunked pulls read an object's chunks
+                # back-to-back, possibly interleaved across a couple of
+                # concurrent pulls): caching every blob in _attached would
                 # re-accumulate in heap exactly what spilling evicted.
-                cached = self._spill_cache
-                if cached is not None and cached[0] == object_id:
-                    return cached[1].view()
-                data = read_spilled(self.session_id, object_id)
-                if data is None:
-                    raise
-                blob = _SpilledBlob(data)
-                self._spill_cache = (object_id, blob)
+                blob = self._spill_cache.get(object_id)
+                if blob is None:
+                    data = read_spilled(self.session_id, object_id)
+                    if data is None:
+                        raise
+                    blob = _SpilledBlob(data)
+                    self._spill_cache[object_id] = blob
+                    while len(self._spill_cache) > 2:
+                        _, old = self._spill_cache.popitem(last=False)
+                        old.close()
+                else:
+                    self._spill_cache.move_to_end(object_id)
                 return blob.view()
             self._attached[object_id] = seg
         return seg.view()
@@ -412,6 +419,10 @@ class NodeObjectDirectory:
                     self.num_spilled += 1
                     self._spilled[oid] = len(payload)
             except Exception as e:  # noqa: BLE001 — e.g. ENOSPC
+                if oid in self._freed_while_spilling:
+                    # Freed during the spill: nothing to restore — the
+                    # finally block deletes whatever remains.
+                    return
                 logging.getLogger(__name__).warning(
                     "spill of %s failed (%s); keeping shm copy", oid.hex(), e
                 )

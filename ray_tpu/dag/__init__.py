@@ -6,6 +6,7 @@ ordinary task/actor submissions (``dag.execute()``) or compiled into
 channel-driven per-actor loops (``dag.experimental_compile()``).
 """
 
+from .collective_ops import CollectiveOpNode, allreduce_bind
 from .compiled import CompiledDAG, CompiledDAGRef, DAGError
 from .nodes import (
     ClassMethodNode,
@@ -17,6 +18,7 @@ from .nodes import (
 )
 
 __all__ = [
+    "CollectiveOpNode",
     "CompiledDAG",
     "CompiledDAGRef",
     "DAGError",
@@ -26,4 +28,5 @@ __all__ = [
     "InputAttributeNode",
     "InputNode",
     "MultiOutputNode",
+    "allreduce_bind",
 ]

@@ -1,0 +1,103 @@
+"""In-graph collectives: allreduce across a set of actors' DAG nodes.
+
+Reference: ray ``python/ray/dag/collective_node.py:23,252`` — binding an
+allreduce over per-actor computation nodes so the exchange happens inside
+the compiled graph, overlapping with the pipeline.  Here the exchange rides
+the same shm channels as every other compiled edge: each participant reads
+the other participants' values and reduces locally (host tensors; on-chip
+tensors inside one jitted step should use ``jax.lax.psum`` instead — the
+channel path is for cross-actor orchestration).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+from .nodes import ClassMethodNode, DAGNode
+
+RESERVED_COLLECTIVE_METHOD = "__rtpu_dag_collective__"
+
+_OPS = ("sum", "mean", "max", "min", "product")
+
+
+def apply_collective(op: str, tensors: Sequence) -> np.ndarray:
+    arrays = [np.asarray(t) for t in tensors]
+    stacked = np.stack(arrays)
+    if op == "sum":
+        return stacked.sum(axis=0)
+    if op == "mean":
+        return stacked.mean(axis=0)
+    if op == "max":
+        return stacked.max(axis=0)
+    if op == "min":
+        return stacked.min(axis=0)
+    if op == "product":
+        return stacked.prod(axis=0)
+    raise ValueError(f"unknown collective op {op!r} (one of {_OPS})")
+
+
+class CollectiveOpNode(ClassMethodNode):
+    """One participant's view of an in-graph allreduce: consumes every
+    participant's upstream value, emits the reduced tensor on this
+    participant's actor."""
+
+    def __init__(self, actor_handle, participants: Sequence[DAGNode], op: str):
+        super().__init__(
+            actor_handle,
+            RESERVED_COLLECTIVE_METHOD,
+            tuple(participants),
+            {"_op": op},
+        )
+
+    def _execute_impl(self, cache, input_args, input_kwargs):
+        # Classic (uncompiled) path: gather the participant refs and reduce
+        # driver-side (the compiled path reduces inside each actor's loop).
+        # Sibling outputs of the same allreduce share ONE reduction via the
+        # per-execute cache — N outputs must not mean N gathers.
+        import ray_tpu
+
+        group_key = (
+            "__rtpu_allreduce__",
+            tuple(id(a) for a in self._bound_args),
+            self._bound_kwargs["_op"],
+        )
+        if group_key in cache:
+            return cache[group_key]
+        refs = [
+            self._resolve_arg(a, cache, input_args, input_kwargs)
+            for a in self._bound_args
+        ]
+        values = [
+            ray_tpu.get(r, timeout=300)
+            if isinstance(r, ray_tpu.ObjectRef)
+            else r
+            for r in refs
+        ]
+        result = ray_tpu.put(
+            apply_collective(self._bound_kwargs["_op"], values)
+        )
+        cache[group_key] = result
+        return result
+
+
+def allreduce_bind(
+    nodes: Sequence[ClassMethodNode], op: str = "sum"
+) -> List[CollectiveOpNode]:
+    """Bind an allreduce across per-actor nodes; returns one output node per
+    participant (reference: ``ray.experimental.collective.allreduce.bind``).
+
+        with InputNode() as inp:
+            partials = [w.compute.bind(inp) for w in workers]
+            reduced = allreduce_bind(partials)
+            dag = MultiOutputNode(reduced)
+    """
+    if op not in _OPS:
+        raise ValueError(f"unknown collective op {op!r} (one of {_OPS})")
+    if len(nodes) < 2:
+        raise ValueError("allreduce requires at least 2 participants")
+    actor_ids = {n._actor._actor_id for n in nodes}
+    if len(actor_ids) != len(nodes):
+        raise ValueError("each participant must live on a distinct actor")
+    return [CollectiveOpNode(n._actor, nodes, op) for n in nodes]

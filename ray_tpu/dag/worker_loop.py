@@ -104,7 +104,16 @@ def dag_exec_loop(instance, plan: Dict[str, Any]) -> str:
                     result: Any = upstream_err
                 else:
                     try:
-                        result = getattr(instance, op["method"])(*args, **kwargs)
+                        if op["method"] == "__rtpu_dag_collective__":
+                            # In-graph allreduce: args are every
+                            # participant's value; reduce locally.
+                            from .collective_ops import apply_collective
+
+                            result = apply_collective(kwargs["_op"], args)
+                        else:
+                            result = getattr(instance, op["method"])(
+                                *args, **kwargs
+                            )
                     except BaseException as e:  # noqa: BLE001 — becomes a pipeline error
                         result = _Err(serialize_to_bytes(e))
                 local_vals[op["idx"]] = result

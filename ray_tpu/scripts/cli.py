@@ -232,6 +232,43 @@ def cmd_timeline(args) -> int:
     return 0
 
 
+def cmd_logs(args) -> int:
+    """List or tail system-process logs from the newest session directory
+    (reference: ``ray logs``)."""
+    import glob as _glob
+    import tempfile
+
+    base = os.path.join(tempfile.gettempdir(), "ray_tpu")
+    sessions = sorted(
+        _glob.glob(os.path.join(base, "session_*")),
+        key=os.path.getmtime,
+        reverse=True,
+    )
+    if not sessions:
+        print("no sessions found")
+        return 1
+    session = sessions[0]
+    logs = sorted(_glob.glob(os.path.join(session, "*.log")))
+    if not args.component:
+        print(f"session: {session}")
+        for path in logs:
+            print(f"  {os.path.basename(path)}  "
+                  f"({os.path.getsize(path)} bytes)")
+        return 0
+    matches = [p for p in logs if args.component in os.path.basename(p)]
+    if not matches:
+        print(f"no log matching {args.component!r}")
+        return 1
+    for path in matches:
+        print(f"==> {os.path.basename(path)} <==")
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - args.tail_bytes))
+            sys.stdout.write(f.read().decode(errors="replace"))
+    return 0
+
+
 def cmd_dashboard(args) -> int:
     from ..dashboard import start_dashboard
 
@@ -290,6 +327,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--address", default=None)
     p.add_argument("-o", "--output", default=None)
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("logs", help="list/tail system logs of the newest session")
+    p.add_argument("component", nargs="?", default=None,
+                   help="substring of the log file name (e.g. control_plane)")
+    p.add_argument("--tail-bytes", type=int, default=1 << 16)
+    p.set_defaults(fn=cmd_logs)
 
     p = sub.add_parser("dashboard", help="serve cluster state + metrics over HTTP")
     p.add_argument("--address", default=None)

@@ -222,3 +222,81 @@ class TestCompiledDAG:
         cdag.teardown()
         # After teardown the actor serves ordinary calls again.
         assert ray_tpu.get(a.add.remote(2), timeout=90) == 7
+
+
+class TestInDagCollectives:
+    """In-graph allreduce (reference: ray dag/collective_node.py)."""
+
+    def _workers(self, n=2):
+        import ray_tpu
+
+        @ray_tpu.remote(max_concurrency=2)
+        class W:
+            def __init__(self, rank):
+                self.rank = rank
+
+            def compute(self, x):
+                import numpy as np
+
+                return np.full(4, float(x * (self.rank + 1)))
+
+            def scale(self, t):
+                return t * 10
+
+        return [W.remote(i) for i in range(n)]
+
+    def test_classic_execute_allreduce(self, ray_start_regular):
+        import numpy as np
+
+        import ray_tpu
+        from ray_tpu.dag import InputNode, MultiOutputNode, allreduce_bind
+
+        workers = self._workers(2)
+        with InputNode() as inp:
+            partials = [w.compute.bind(inp) for w in workers]
+            reduced = allreduce_bind(partials, op="sum")
+            dag = MultiOutputNode(reduced)
+        refs = dag.execute(3)
+        out = [ray_tpu.get(r, timeout=60) for r in refs]
+        # sum over ranks: 3*(1) + 3*(2) = 9 in every slot, on both outputs.
+        for o in out:
+            np.testing.assert_allclose(np.asarray(o), np.full(4, 9.0))
+
+    def test_compiled_allreduce_with_downstream(self, ray_start_regular):
+        import numpy as np
+
+        import ray_tpu
+        from ray_tpu.dag import InputNode, MultiOutputNode, allreduce_bind
+
+        workers = self._workers(2)
+        with InputNode() as inp:
+            partials = [w.compute.bind(inp) for w in workers]
+            reduced = allreduce_bind(partials, op="sum")
+            # Downstream op consumes the reduced value on worker 0.
+            scaled = workers[0].scale.bind(reduced[0])
+            dag = MultiOutputNode([scaled, reduced[1]])
+        compiled = dag.experimental_compile()
+        try:
+            for x in (1, 2):
+                a, b = compiled.execute(x).get(timeout=60)
+                np.testing.assert_allclose(
+                    np.asarray(a), np.full(4, 3.0 * x * 10)
+                )
+                np.testing.assert_allclose(
+                    np.asarray(b), np.full(4, 3.0 * x)
+                )
+        finally:
+            compiled.teardown()
+
+    def test_allreduce_validation(self, ray_start_regular):
+        from ray_tpu.dag import allreduce_bind
+
+        workers = self._workers(1)
+        with __import__("pytest").raises(ValueError):
+            allreduce_bind([], op="sum")
+        from ray_tpu.dag import InputNode
+
+        with InputNode() as inp:
+            node = workers[0].compute.bind(inp)
+        with __import__("pytest").raises(ValueError):
+            allreduce_bind([node, node])  # same actor twice
