@@ -1,0 +1,36 @@
+"""OpenAI-compatible LLM serving on the JAX KV-cache engine.
+
+Run: JAX_PLATFORMS=cpu python examples/llm_serving.py
+(random weights — the machinery, not the prose, is the point)
+"""
+
+import json
+import urllib.request
+
+import ray_tpu
+import ray_tpu.serve as serve
+from ray_tpu.llm import EngineConfig, build_openai_app
+from ray_tpu.models.gpt2 import GPT2Config
+
+
+def main():
+    ray_tpu.init(num_cpus=4)
+    cfg = EngineConfig(
+        model=GPT2Config.tiny(vocab_size=384, max_seq=64, dtype="float32"),
+        max_batch_size=4,
+        max_seq_len=64,
+    )
+    serve.run(build_openai_app(cfg))
+    url = serve.start_http_proxy(port=8000)
+    req = urllib.request.Request(
+        f"{url}/v1/completions",
+        data=json.dumps({"prompt": "TPUs are", "max_tokens": 8}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    print(json.loads(urllib.request.urlopen(req, timeout=120).read()))
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+"""Train DQN on CartPole with distributed env runners.
+
+Run: JAX_PLATFORMS=cpu python examples/rllib_dqn.py
+"""
+
+import ray_tpu
+from ray_tpu.rllib import DQNConfig
+
+
+def main():
+    ray_tpu.init(num_cpus=4)
+    algo = (
+        DQNConfig()
+        .env_runners(2, rollout_steps=128)
+        .training(lr=1e-3, num_learn_steps=32, epsilon_decay_iters=15)
+        .build()
+    )
+    for i in range(10):
+        result = algo.train()
+        print(
+            f"iter {i}: return={result['episode_return_mean']} "
+            f"eps={result['epsilon']:.2f}"
+        )
+    algo.stop()
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""Serve a model with autoscaling + HTTP, then query it.
+
+Run: JAX_PLATFORMS=cpu python examples/serve_model.py
+"""
+
+import json
+import urllib.request
+
+import ray_tpu
+import ray_tpu.serve as serve
+
+
+@serve.deployment(
+    ray_actor_options={"num_cpus": 0},
+    autoscaling_config={"min_replicas": 1, "max_replicas": 3,
+                        "target_ongoing_requests": 2.0},
+)
+class Doubler:
+    def __call__(self, x):
+        return {"doubled": x * 2}
+
+
+def main():
+    ray_tpu.init(num_cpus=4)
+    serve.run(Doubler.bind(), route_prefix="/double")
+    url = serve.start_http_proxy(port=8000)
+    req = urllib.request.Request(
+        f"{url}/double",
+        data=json.dumps({"args": [21]}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    print(json.loads(urllib.request.urlopen(req, timeout=30).read()))
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

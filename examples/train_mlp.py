@@ -1,0 +1,64 @@
+"""Data-parallel MLP training with dataset ingest (JaxTrainer).
+
+Run: JAX_PLATFORMS=cpu python examples/train_mlp.py
+"""
+
+import numpy as np
+
+import ray_tpu
+import ray_tpu.data as rdata
+import ray_tpu.train as train
+
+
+def train_loop(config):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models import mlp_apply, mlp_init
+
+    rng = jax.random.PRNGKey(train.get_context().world_rank)
+    params = mlp_init(rng, [4, 32, 2])
+    tx = optax.adam(config["lr"])
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, x, y):
+        def loss_fn(p):
+            logits = mlp_apply(p, x)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, y
+            ).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    shard = train.get_dataset_shard("train")
+    for epoch in range(config["epochs"]):
+        for batch in shard.iter_batches(batch_size=32, batch_format="numpy"):
+            x = jnp.asarray(batch["x"])
+            y = jnp.asarray(batch["y"])
+            params, opt_state, loss = step(params, opt_state, x, y)
+        train.report({"epoch": epoch, "loss": float(loss)})
+
+
+def main():
+    ray_tpu.init(num_cpus=4)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(512, 4)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int64)
+    ds = rdata.read_numpy({"x": x, "y": y}, parallelism=8)
+
+    result = train.JaxTrainer(
+        train_loop,
+        train_loop_config={"lr": 1e-2, "epochs": 3},
+        scaling_config=train.ScalingConfig(num_workers=2),
+        datasets={"train": ds},
+    ).fit()
+    print("final:", result.metrics)
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

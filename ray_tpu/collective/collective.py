@@ -186,15 +186,28 @@ def _destroy_p2p_edges(group_name: str):
     see a dead-actor error on their next send/recv (loud, not stale)."""
     import ray_tpu
 
+    # Cached handles die unconditionally (no state-API dependency)...
     for key in [k for k in _p2p_cache if k[0] == group_name]:
-        _p2p_cache.pop(key)
-    prefix = f"_rtpu_p2p:{group_name}:"
+        queue = _p2p_cache.pop(key)
+        try:
+            ray_tpu.kill(queue.actor)
+        except Exception:  # noqa: BLE001
+            pass
+    # ...and a best-effort cluster-wide sweep catches edges only peer
+    # processes ever touched.  Edge names end with "src->dst" and contain
+    # no further ':' after the group name, so "train" never matches
+    # "train:eval" edges.
+    import re
+
+    edge_re = re.compile(
+        re.escape(f"_rtpu_p2p:{group_name}:") + r"\d+->\d+$"
+    )
     try:
         from ..util.state import list_actors
 
         for row in list_actors():
             name = row.get("name")
-            if name and name.startswith(prefix) and row["state"] != "DEAD":
+            if name and edge_re.fullmatch(name) and row["state"] != "DEAD":
                 try:
                     ray_tpu.kill(ray_tpu.get_actor(name))
                 except Exception:  # noqa: BLE001

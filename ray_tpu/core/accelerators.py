@@ -1,0 +1,131 @@
+"""Accelerator plugin system — one manager per vendor.
+
+Reference: the ``AcceleratorManager`` ABC (ray
+``python/ray/_private/accelerators/accelerator.py:18``) with per-vendor
+implementations; here TPU is the first-class citizen
+(``TPUAcceleratorManager``, reference ``accelerators/tpu.py:267``) and CPU
+is the trivial fallback.  The node agent uses the active manager for
+resource detection and per-lease chip isolation; new vendors plug in by
+registering a manager.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+from . import tpu_detect
+
+
+class AcceleratorManager:
+    """ABC (reference interface, ray ``accelerator.py:43-111``)."""
+
+    # Resource string, e.g. "TPU".
+    resource_name: str = ""
+
+    def get_current_node_num_accelerators(self) -> int:
+        raise NotImplementedError
+
+    def get_current_node_accelerator_type(self) -> Optional[str]:
+        raise NotImplementedError
+
+    def get_current_node_additional_resources(self) -> Dict[str, float]:
+        return {}
+
+    def get_current_node_labels(self) -> Dict[str, str]:
+        return {}
+
+    def validate_resource_request_quantity(
+        self, quantity: float
+    ) -> Tuple[bool, Optional[str]]:
+        return True, None
+
+    def get_visible_accelerator_ids_env_var(self) -> Optional[str]:
+        return None
+
+    def get_current_process_visible_accelerator_ids(
+        self,
+    ) -> Optional[List[str]]:
+        var = self.get_visible_accelerator_ids_env_var()
+        if var is None:
+            return None
+        raw = os.environ.get(var)
+        if raw is None:
+            return None
+        return [v for v in raw.split(",") if v != ""]
+
+    def set_current_process_visible_accelerator_ids(
+        self, ids: List[str]
+    ) -> None:
+        var = self.get_visible_accelerator_ids_env_var()
+        if var is not None:
+            os.environ[var] = ",".join(str(i) for i in ids)
+
+
+class TPUAcceleratorManager(AcceleratorManager):
+    """TPU chips + slice topology (reference ``accelerators/tpu.py``)."""
+
+    resource_name = "TPU"
+
+    def get_current_node_num_accelerators(self) -> int:
+        return tpu_detect.num_local_chips()
+
+    def get_current_node_accelerator_type(self) -> Optional[str]:
+        return tpu_detect.accelerator_type() or None
+
+    def get_current_node_additional_resources(self) -> Dict[str, float]:
+        res, _labels = tpu_detect.detect_resources_and_labels()
+        return {k: v for k, v in res.items() if k != "TPU"}
+
+    def get_current_node_labels(self) -> Dict[str, str]:
+        _res, labels = tpu_detect.detect_resources_and_labels()
+        return labels
+
+    def validate_resource_request_quantity(
+        self, quantity: float
+    ) -> Tuple[bool, Optional[str]]:
+        # Reference rule (tpu.py:92-105): fractional chips are not
+        # schedulable, and multi-chip requests must be 1, 2, 4, or a
+        # multiple of 4 (ICI connectivity).
+        if quantity != int(quantity):
+            return False, "TPU requests must be whole chips"
+        q = int(quantity)
+        if q in (1, 2, 4) or (q > 4 and q % 4 == 0):
+            return True, None
+        return False, (
+            f"invalid TPU chip count {q}: must be 1, 2, 4, or a multiple "
+            f"of 4"
+        )
+
+    def get_visible_accelerator_ids_env_var(self) -> str:
+        from .config import GlobalConfig
+
+        return GlobalConfig.tpu_visible_chips_env  # TPU_VISIBLE_CHIPS
+
+
+class CPUAcceleratorManager(AcceleratorManager):
+    resource_name = "CPU"
+
+    def get_current_node_num_accelerators(self) -> int:
+        return os.cpu_count() or 1
+
+    def get_current_node_accelerator_type(self) -> Optional[str]:
+        return None
+
+
+_REGISTRY: Dict[str, AcceleratorManager] = {
+    "TPU": TPUAcceleratorManager(),
+    "CPU": CPUAcceleratorManager(),
+}
+
+
+def register_accelerator_manager(mgr: AcceleratorManager) -> None:
+    _REGISTRY[mgr.resource_name] = mgr
+
+
+def get_accelerator_manager(resource_name: str) -> Optional[AcceleratorManager]:
+    return _REGISTRY.get(resource_name)
+
+
+def all_accelerator_managers() -> List[AcceleratorManager]:
+    return list(_REGISTRY.values())

@@ -35,6 +35,19 @@ def _normalize_options(opts: Dict[str, Any]) -> Dict[str, Any]:
     # Tasks and actors both default to one CPU slot (actors hold it for
     # their lifetime; declare num_cpus=0 for pure-TPU actors).
     resources.setdefault("CPU", 1.0)
+    # Any resource kind with a registered accelerator manager validates its
+    # requested quantity (reference: per-vendor validate_resource_request_
+    # quantity).
+    from .accelerators import get_accelerator_manager
+
+    for kind, quantity in resources.items():
+        if kind == "CPU" or not quantity:
+            continue
+        mgr = get_accelerator_manager(kind)
+        if mgr is not None:
+            ok, reason = mgr.validate_resource_request_quantity(quantity)
+            if not ok:
+                raise ValueError(reason)
     strategy = opts.get("scheduling_strategy")
     pg_id = None
     bundle_index = -1

@@ -300,6 +300,35 @@ class ControlPlane:
 
     async def _try_schedule_actor(self, entry: ActorEntry):
         spec = entry.spec
+        if spec.placement_group_id is not None:
+            # PG-bound actor: its resources come from the bundle, which was
+            # already carved OUT of the node's main pool — consulting
+            # pick_node would wrongly demand the capacity twice (and fail
+            # on a saturated node).  Target the bundle's node directly.
+            pg = self.placement_groups.get(spec.placement_group_id)
+            if pg is None or pg.state == "REMOVED":
+                # Terminal: an actor bound to a gone PG can never schedule.
+                entry.state = DEAD
+                entry.death_cause = (
+                    f"placement group {spec.placement_group_id} was removed"
+                )
+                self._publish_actor(entry)
+                return
+            if pg.state != "CREATED" or not pg.bundle_nodes:
+                if spec.actor_id not in self._pending_actors:
+                    self._pending_actors.append(spec.actor_id)
+                return
+            idx = spec.bundle_index if spec.bundle_index >= 0 else 0
+            if idx >= len(pg.bundle_nodes):
+                entry.state = DEAD
+                entry.death_cause = (
+                    f"bundle_index {idx} out of range for placement group "
+                    f"with {len(pg.bundle_nodes)} bundles"
+                )
+                self._publish_actor(entry)
+                return
+            await self._create_actor_on_node(entry, pg.bundle_nodes[idx])
+            return
         try:
             node_id = self.scheduler.pick_node(
                 ResourceSet(spec.resources), spec.strategy
@@ -316,7 +345,15 @@ class ControlPlane:
             if spec.actor_id not in self._pending_actors:
                 self._pending_actors.append(spec.actor_id)
             return
-        node = self.nodes[node_id]
+        await self._create_actor_on_node(entry, node_id)
+
+    async def _create_actor_on_node(self, entry: ActorEntry, node_id: NodeID):
+        spec = entry.spec
+        node = self.nodes.get(node_id)
+        if node is None or not node.alive:
+            if spec.actor_id not in self._pending_actors:
+                self._pending_actors.append(spec.actor_id)
+            return
         client = self.agent_clients.get(node.agent_address)
         try:
             # The agent's handler may wait for a worker spawn AND an
@@ -534,6 +571,36 @@ class ControlPlane:
         Unplaceable demands are remembered briefly so the autoscaler's load
         state sees them (they live in no queue while the submitter backs
         off and retries)."""
+        pg_id = payload.get("placement_group_id")
+        if pg_id is not None:
+            # PG-bound lease: the only valid target is the bundle's node
+            # (its resources live in that node's bundle pool).
+            entry = self.placement_groups.get(pg_id)
+            if entry is None or entry.state == "REMOVED":
+                # Fatal (not retry-until-autoscaled): the PG is gone.
+                return {
+                    "infeasible": True,
+                    "fatal": True,
+                    "error": f"placement group {pg_id} was removed",
+                }
+            if entry.state != "CREATED" or not entry.bundle_nodes:
+                return {"node_id": None}  # PG pending; submitter retries
+            idx = payload.get("bundle_index", -1)
+            idx = idx if idx >= 0 else 0
+            if idx >= len(entry.bundle_nodes):
+                return {
+                    "infeasible": True,
+                    "fatal": True,
+                    "error": (
+                        f"bundle_index {idx} out of range for placement "
+                        f"group with {len(entry.bundle_nodes)} bundles"
+                    ),
+                }
+            node_id = entry.bundle_nodes[idx]
+            node = self.nodes.get(node_id)
+            if node is None or not node.alive:
+                return {"node_id": None}
+            return {"node_id": node_id, "agent_address": node.agent_address}
         try:
             node_id = self.scheduler.pick_node(
                 ResourceSet(payload["resources"]),
@@ -627,6 +694,28 @@ class ControlPlane:
             "profile_events": self.task_event_store.profile_events(),
             "num_dropped": self.task_event_store.num_dropped,
         }
+
+    async def handle_list_objects(self, payload, conn):
+        """Cluster-wide sealed-object listing: concurrent fan-out to every
+        alive agent's directory (``ray list objects`` analog) — one wedged
+        agent must not serialize the whole sweep."""
+
+        async def one(address):
+            try:
+                return await self.agent_clients.get(address).call(
+                    "list_objects", {}, timeout=10, retries=1
+                )
+            except Exception:  # noqa: BLE001 — agent racing shutdown
+                return []
+
+        replies = await asyncio.gather(
+            *(
+                one(entry.agent_address)
+                for entry in list(self.nodes.values())
+                if entry.alive
+            )
+        )
+        return [row for reply in replies for row in reply]
 
     def handle_ping(self, payload, conn):
         return "pong"

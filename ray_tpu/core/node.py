@@ -21,7 +21,7 @@ import tempfile
 import time
 from typing import Dict, List, Optional
 
-from . import shm, tpu_detect
+from . import shm
 from .config import GlobalConfig
 from .rpc import RpcClient, find_free_port
 
@@ -110,7 +110,20 @@ class Node:
         self.cp_address = cp_address
         self.agent_address: Optional[str] = None
 
-        detected_res, detected_labels = tpu_detect.detect_resources_and_labels()
+        # Detection runs through the accelerator plugin registry (TPU is
+        # built in; other vendors contribute by registering a manager).
+        from .accelerators import all_accelerator_managers
+
+        detected_res: Dict[str, float] = {}
+        detected_labels: Dict[str, str] = {}
+        for mgr in all_accelerator_managers():
+            if mgr.resource_name == "CPU":
+                continue  # CPU count is handled below (num_cpus override)
+            n = mgr.get_current_node_num_accelerators()
+            if n > 0:
+                detected_res[mgr.resource_name] = float(n)
+            detected_res.update(mgr.get_current_node_additional_resources())
+            detected_labels.update(mgr.get_current_node_labels())
         res: Dict[str, float] = {
             "CPU": float(num_cpus if num_cpus is not None else (os.cpu_count() or 1)),
         }

@@ -374,8 +374,11 @@ class NodeAgent:
         bundle = self._resource_pool(pg_id, bundle_index, resources)
         if pg_id is not None:
             if bundle is None:
-                fut.set_exception(
-                    ValueError(f"placement group {pg_id} has no bundle on this node")
+                # The bundle lives on another node (or the PG is still
+                # pending): ask the control plane for the bundle's node and
+                # spill the lease there instead of failing the task.
+                asyncio.get_running_loop().create_task(
+                    self._spillback(payload, fut, resources)
                 )
                 return True
             if not resources.is_subset_of(bundle.available):
@@ -480,6 +483,8 @@ class NodeAgent:
                     "resources": resources.to_dict(),
                     "strategy": payload.get("strategy"),
                     "preferred": None,
+                    "placement_group_id": payload.get("placement_group_id"),
+                    "bundle_index": payload.get("bundle_index", -1),
                 },
             )
         except Exception as e:  # noqa: BLE001
@@ -488,6 +493,11 @@ class NodeAgent:
             return
         if not fut.done():
             if reply.get("infeasible"):
+                if reply.get("fatal"):
+                    # No amount of scaling fixes this (e.g. removed PG,
+                    # bad bundle index): surface the error now.
+                    fut.set_exception(ValueError(reply["error"]))
+                    return
                 # Infeasible *now* — stay queued and retry (the reference
                 # queues infeasible work indefinitely; the autoscaler sees
                 # the demand via the control plane's unplaceable window and
@@ -495,6 +505,16 @@ class NodeAgent:
                 fut.set_result({"granted": False, "retry": True})
             elif reply.get("node_id") is None:
                 fut.set_result({"granted": False, "retry": True})
+            elif reply["agent_address"] == self.server.address:
+                # The control plane pointed back at THIS node (e.g. a PG
+                # bundle recorded here that _try_grant couldn't find) —
+                # spilling to ourselves would loop forever.
+                fut.set_exception(
+                    ValueError(
+                        "lease unroutable: target node is this node but "
+                        "the local grant failed"
+                    )
+                )
             else:
                 fut.set_result(
                     {"granted": False, "spillback": reply["agent_address"]}
@@ -648,6 +668,15 @@ class NodeAgent:
         for oid in payload["object_ids"]:
             self.directory.free(oid)
         return True
+
+    def handle_list_objects(self, payload, conn):
+        """Local sealed-object inventory for the state API (snapshot taken
+        under the directory's tier lock — the spill thread mutates tiers
+        concurrently)."""
+        out = self.directory.inventory()
+        for row in out:
+            row["node_id"] = self.node_id.hex()
+        return out
 
     def handle_object_info(self, payload, conn):
         size = self.directory.size_of(payload["object_id"])

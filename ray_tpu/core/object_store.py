@@ -330,10 +330,15 @@ class NodeObjectDirectory:
         # Spill file IO runs off the agent's event loop; one worker keeps
         # spills ordered.  _spilling tracks sizes of in-flight victims (the
         # object is still in shm until its spill completes) and _freed
-        # records frees that raced an in-flight spill.
+        # records frees that raced an in-flight spill.  _tier_lock guards
+        # the spill-tier dicts against event-loop readers racing the spill
+        # thread's mutations.
+        import threading as _threading
+
         self._spill_pool = None
         self._spilling: Dict[ObjectID, int] = {}
         self._freed_while_spilling: set = set()
+        self._tier_lock = _threading.Lock()
 
     def seal(self, object_id: ObjectID, size: int):
         if object_id not in self._objects:
@@ -369,9 +374,10 @@ class NodeObjectDirectory:
         entry = self._objects.pop(object_id, None)
         if entry is not None:
             self.used -= entry[0]
-        spilled = self._spilled.pop(object_id, None)
-        if object_id in self._spilling:
-            self._freed_while_spilling.add(object_id)
+        with self._tier_lock:
+            spilled = self._spilled.pop(object_id, None)
+            if object_id in self._spilling:
+                self._freed_while_spilling.add(object_id)
         if entry is not None or spilled is not None:
             delete_from_tiers(self.session_id, object_id)
 
@@ -417,32 +423,59 @@ class NodeObjectDirectory:
                     spill_object(self.session_id, oid, payload)
                     self.spilled_bytes += len(payload)
                     self.num_spilled += 1
-                    self._spilled[oid] = len(payload)
+                    with self._tier_lock:
+                        self._spilled[oid] = len(payload)
             except Exception as e:  # noqa: BLE001 — e.g. ENOSPC
-                if oid in self._freed_while_spilling:
-                    # Freed during the spill: nothing to restore — the
-                    # finally block deletes whatever remains.
-                    return
+                with self._tier_lock:
+                    if oid in self._freed_while_spilling:
+                        # Freed during the spill: nothing to restore — the
+                        # finally block deletes whatever remains.
+                        return
+                    size = self._spilling.get(oid, 0)
+                    self._objects[oid] = (size, time.monotonic())
+                    self.used += size
                 logging.getLogger(__name__).warning(
                     "spill of %s failed (%s); keeping shm copy", oid.hex(), e
                 )
-                size = self._spilling.get(oid, 0)
-                self._objects[oid] = (size, time.monotonic())
-                self.used += size
                 return
             arena = get_arena(self.session_id)
             if arena is not None:
                 arena.delete(oid.binary())
             shm.unlink_by_name(shm.segment_name(self.session_id, oid.hex()))
         finally:
-            self._spilling.pop(oid, None)
-            if oid in self._freed_while_spilling:
-                self._freed_while_spilling.discard(oid)
-                self._spilled.pop(oid, None)
+            with self._tier_lock:
+                self._spilling.pop(oid, None)
+                freed = oid in self._freed_while_spilling
+                if freed:
+                    self._freed_while_spilling.discard(oid)
+                    self._spilled.pop(oid, None)
+            if freed:
                 delete_from_tiers(self.session_id, oid)
 
     def object_ids(self) -> List[ObjectID]:
         return list(self._objects)
+
+    def inventory(self) -> List[dict]:
+        """Snapshot of every tracked object across tiers (state API); the
+        lock also covers _objects, which the spill thread's failure path
+        mutates."""
+        with self._tier_lock:
+            objects = list(self._objects.items())
+            spilled = list(self._spilled.items())
+            spilling = list(self._spilling.items())
+        out = [
+            {"object_id": oid.hex(), "size": entry[0], "tier": "shm"}
+            for oid, entry in objects
+        ]
+        out.extend(
+            {"object_id": oid.hex(), "size": size, "tier": "spilled"}
+            for oid, size in spilled
+        )
+        out.extend(
+            {"object_id": oid.hex(), "size": size, "tier": "spilling"}
+            for oid, size in spilling
+        )
+        return out
 
     def cleanup(self):
         if self._spill_pool is not None:

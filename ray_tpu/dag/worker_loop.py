@@ -104,11 +104,14 @@ def dag_exec_loop(instance, plan: Dict[str, Any]) -> str:
                     result: Any = upstream_err
                 else:
                     try:
-                        if op["method"] == "__rtpu_dag_collective__":
+                        from .collective_ops import (
+                            RESERVED_COLLECTIVE_METHOD,
+                            apply_collective,
+                        )
+
+                        if op["method"] == RESERVED_COLLECTIVE_METHOD:
                             # In-graph allreduce: args are every
                             # participant's value; reduce locally.
-                            from .collective_ops import apply_collective
-
                             result = apply_collective(kwargs["_op"], args)
                         else:
                             result = getattr(instance, op["method"])(

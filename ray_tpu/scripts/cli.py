@@ -197,6 +197,9 @@ def cmd_list(args) -> int:
     elif kind in ("placement_groups", "pgs"):
         rows = state_api.list_placement_groups(args.address)
         cols = ["pg_id", "state", "strategy", "bundles"]
+    elif kind == "objects":
+        rows = state_api.list_objects(args.address)
+        cols = ["object_id", "size", "tier", "node_id"]
     else:
         raise SystemExit(f"unknown entity {args.kind!r}")
     rows = rows[: args.limit]
@@ -310,7 +313,8 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("list", help="list cluster entities")
     p.add_argument(
         "kind",
-        choices=["nodes", "actors", "tasks", "jobs", "placement-groups", "pgs"],
+        choices=["nodes", "actors", "tasks", "jobs", "placement-groups",
+                 "pgs", "objects"],
     )
     p.add_argument("--address", default=None)
     p.add_argument("--filter", action="append", help="key=value (repeatable)")

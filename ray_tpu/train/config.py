@@ -11,6 +11,10 @@ from typing import Any, Dict, Optional
 @dataclasses.dataclass
 class ScalingConfig:
     num_workers: int = 1
+    # Elastic lower bound (reference: Train v2 ScalingPolicy): when the
+    # cluster cannot gang-schedule num_workers, the trainer retries with
+    # fewer, down to min_workers.  None = fixed-size gang.
+    min_workers: Optional[int] = None
     use_tpu: bool = False
     resources_per_worker: Optional[Dict[str, float]] = None
     # TPU gang options: chips per worker host; reserve the slice as one

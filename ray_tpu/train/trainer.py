@@ -77,16 +77,12 @@ class DataParallelTrainer:
         last_error: Optional[BaseException] = None
 
         while attempts <= max(0, failure_cfg.max_failures):
-            group = WorkerGroup(
-                self.scaling_config.num_workers,
-                self.scaling_config.worker_resources(),
-                self.scaling_config.placement_strategy,
-            )
+            group = self._create_group_elastic()
             try:
                 self.backend.on_start(group)
                 shards_per_worker = None
                 if self.datasets:
-                    n = self.scaling_config.num_workers
+                    n = group.num_workers
                     split = {
                         name: ds.streaming_split(n)
                         for name, ds in self.datasets.items()
@@ -126,6 +122,47 @@ class DataParallelTrainer:
             error=last_error,
             metrics_history=metrics_history,
         )
+
+    def _create_group_elastic(self) -> WorkerGroup:
+        """Gang-create the worker group; if elastic (min_workers set) and
+        the full gang cannot be placed, retry with fewer workers — the
+        reference's ScalingPolicy resize-on-recovery semantic."""
+        cfg = self.scaling_config
+        if cfg.min_workers is None or cfg.min_workers >= cfg.num_workers:
+            return WorkerGroup(
+                cfg.num_workers, cfg.worker_resources(),
+                cfg.placement_strategy,
+            )
+        # Elastic: size the gang to what the cluster can fit right now
+        # (cheap feasibility probe against the resource view — no 2-minute
+        # PG timeout per candidate size), floored at min_workers.
+        res = cfg.worker_resources()
+        floor = max(1, cfg.min_workers)
+
+        def probe() -> int:
+            avail = ray_tpu.available_resources()
+            n = cfg.num_workers
+            while n > floor and any(
+                avail.get(k, 0.0) < v * n for k, v in res.items()
+            ):
+                n -= 1
+            return n
+
+        n = probe()
+        if n < cfg.num_workers:
+            # The view may be stale — a just-torn-down gang's resources are
+            # still charged until the next heartbeat.  Re-probe after one
+            # heartbeat period before committing to a smaller gang.
+            from ray_tpu.core.config import GlobalConfig
+
+            time.sleep(GlobalConfig.health_check_period_s * 1.5)
+            n = max(n, probe())
+        if n < cfg.num_workers:
+            logger.warning(
+                "elastic downscale: gang of %d (wanted %d) based on "
+                "available resources", n, cfg.num_workers,
+            )
+        return WorkerGroup(n, res, cfg.placement_strategy)
 
     def _poll_until_done(self, group, run_refs, ckpt_mgr, metrics_history):
         pending = list(run_refs)

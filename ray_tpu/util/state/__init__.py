@@ -15,6 +15,7 @@ from .api import (  # noqa: F401
     list_actors,
     list_jobs,
     list_nodes,
+    list_objects,
     list_placement_groups,
     list_tasks,
     summarize_actors,

@@ -117,6 +117,12 @@ def list_tasks(
     return StateApiClient(address).list_task_events(filters, limit)["tasks"]
 
 
+def list_objects(address: Optional[str] = None) -> List[dict]:
+    """Sealed shm/spilled objects across all nodes (``ray list objects``
+    analog; in-process memory-store values are owner-local and not listed)."""
+    return StateApiClient(address)._call("list_objects")
+
+
 # -------------------------------------------------------------------- getters
 def get_node(node_id: str, address: Optional[str] = None) -> Optional[dict]:
     for row in list_nodes(address):

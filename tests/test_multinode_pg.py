@@ -1,0 +1,42 @@
+"""Cross-node placement-group routing on an in-process multi-node cluster
+(the reference's `Cluster` testing trick)."""
+
+import pytest
+
+import ray_tpu
+
+
+def test_pg_task_and_actor_route_to_bundle_node(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=1)  # head
+    cluster.add_node(num_cpus=2)  # worker with the capacity
+    ray_tpu.init(address=cluster.cp_address, num_cpus=0)
+
+    pg = ray_tpu.placement_group([{"CPU": 2}], strategy="PACK")
+    assert pg.ready(timeout=60)
+
+    # Task lease: submitted to the driver's 0-CPU local agent, which has no
+    # bundle — must spill to the bundle's node, not error.
+    @ray_tpu.remote(num_cpus=2)
+    def where():
+        import os
+
+        return os.getpid()
+
+    ref = where.options(
+        scheduling_strategy=ray_tpu.placement_group_strategy(pg, 0)
+    ).remote()
+    assert isinstance(ray_tpu.get(ref, timeout=90), int)
+
+    # Gang actor on the saturated bundle node.
+    @ray_tpu.remote(num_cpus=2)
+    class Member:
+        def ping(self):
+            return "pong"
+
+    m = Member.options(
+        scheduling_strategy=ray_tpu.placement_group_strategy(pg, 0)
+    ).remote()
+    assert ray_tpu.get(m.ping.remote(), timeout=90) == "pong"
+    ray_tpu.kill(m)
+    ray_tpu.remove_placement_group(pg)

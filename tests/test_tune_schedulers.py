@@ -118,13 +118,24 @@ class TestTunerIntegration:
         def trainable(config):
             import time as _time
 
+            import ray_tpu
             import ray_tpu.train as train
+            from ray_tpu.util.queue import Queue
+
+            # Start barrier: PBT decisions need the whole cohort's scores,
+            # so no trial may finish before all three have started (under
+            # CPU contention trials would otherwise run serially).
+            barrier = Queue(name="pbt_test_barrier", get_if_exists=True)
+            barrier.put(1)
+            deadline = _time.monotonic() + 60
+            while barrier.qsize() < 3 and _time.monotonic() < deadline:
+                _time.sleep(0.05)
 
             ckpt = train.get_checkpoint()
             start = ckpt["step"] if ckpt else 0
             base = config["base"]
             for i in range(start, start + 12):
-                _time.sleep(0.1)  # interleave trials so PBT sees the cohort
+                _time.sleep(0.1)  # interleave so the controller polls often
                 train.report(
                     {"score": base + i}, checkpoint={"step": i + 1}
                 )
