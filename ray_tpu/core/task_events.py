@@ -57,20 +57,11 @@ class TaskEventBuffer:
     ) -> None:
         if not GlobalConfig.enable_task_events:
             return
+        # Hot path (3 records per task): append a flat tuple; the dict
+        # shape the control plane expects is built at flush time.
         self._events.append(
-            {
-                "task_id": task_id_hex,
-                "attempt": attempt,
-                "name": name,
-                "state": state,
-                "ts": time.time(),
-                "job_id": job_id_hex,
-                "actor_id": actor_id_hex,
-                "node_id": self._node,
-                "worker_id": self._worker,
-                "error": error,
-                "resources": resources,
-            }
+            (task_id_hex, attempt, name, state, time.time(), job_id_hex,
+             actor_id_hex, error, resources)
         )
         if len(self._events) > GlobalConfig.task_events_max_buffer:
             # Shed oldest half under backpressure.
@@ -113,8 +104,24 @@ class TaskEventBuffer:
     async def flush(self) -> None:
         if not self._events and not self._profile_events:
             return
-        events, self._events = self._events, []
+        raw, self._events = self._events, []
         profiles, self._profile_events = self._profile_events, []
+        events = [
+            {
+                "task_id": t[0],
+                "attempt": t[1],
+                "name": t[2],
+                "state": t[3],
+                "ts": t[4],
+                "job_id": t[5],
+                "actor_id": t[6],
+                "node_id": self._node,
+                "worker_id": self._worker,
+                "error": t[7],
+                "resources": t[8],
+            }
+            for t in raw
+        ]
         try:
             await self._cp.call(
                 "task_events",
