@@ -37,7 +37,9 @@ class TaskEventBuffer:
         self._cp = cp_client
         self._node = node_id_hex
         self._worker = worker_id_hex
-        self._events: List[dict] = []
+        # Flat tuples on the hot path (see record()); dicts are built at
+        # flush time.
+        self._events: List[tuple] = []
         self._profile_events: List[dict] = []
         self._task: Optional[asyncio.Task] = None
         self._stopped = False
