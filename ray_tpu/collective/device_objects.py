@@ -30,6 +30,10 @@ class DeviceRef:
     dtype: str
     owner_rank: int = 0
     group_name: str = "default"
+    # RPC address of the owning worker process (set automatically when the
+    # owner runs inside a core worker) — enables point-to-point fetch
+    # without a collective group.
+    owner_address: str = ""
 
 
 class DeviceObjectStore:
@@ -39,12 +43,27 @@ class DeviceObjectStore:
         self._objects: Dict[ObjectID, object] = {}
         self._lock = threading.Lock()
 
+    # Residency cap: without distributed ref counting, an unbounded store
+    # would leak HBM across a long-lived actor's lifetime.  Oldest entries
+    # evict (consumers then pay a re-fetch failure — loud, not a leak).
+    MAX_OBJECTS = 256
+
     def put(self, array, group_name: str = "default", rank: int = 0) -> DeviceRef:
         oid = ObjectID.from_random()
         with self._lock:
             self._objects[oid] = array
+            while len(self._objects) > self.MAX_OBJECTS:
+                evicted = next(iter(self._objects))
+                del self._objects[evicted]
+        owner_address = ""
+        from ray_tpu.core.core_worker import try_global_worker
+
+        worker = try_global_worker()
+        if worker is not None:
+            owner_address = worker.address
         return DeviceRef(
-            oid, tuple(array.shape), str(array.dtype), rank, group_name
+            oid, tuple(array.shape), str(array.dtype), rank, group_name,
+            owner_address,
         )
 
     def get_local(self, ref: DeviceRef):
@@ -58,25 +77,58 @@ class DeviceObjectStore:
         with self._lock:
             return ref.object_id in self._objects
 
-    def free(self, ref: DeviceRef):
-        with self._lock:
-            self._objects.pop(ref.object_id, None)
-
     def fetch(self, ref: DeviceRef):
-        """Resolve a DeviceRef: local hit returns the resident array; remote
-        owner → the owning rank broadcasts over the collective group (all
-        members must call fetch() collectively, like the reference's NCCL
-        transport)."""
+        """Resolve a DeviceRef.  Resolution order:
+
+        1. local hit → the resident array, zero movement;
+        2. owner_address set → point-to-point RPC to the owning worker
+           (one host hop; works anywhere in the cluster);
+        3. fall back to a collective broadcast from the owner rank — all
+           group members must call fetch() collectively (the reference's
+           NCCL-transport shape; pair with ``serve_fetch`` on the owner).
+        """
         if self.contains(ref):
             return self.get_local(ref)
+        if ref.owner_address:
+            return self._fetch_rpc(ref)
         from .collective import get_group
 
         group = get_group(ref.group_name)
-        import numpy as np
         import jax.numpy as jnp
 
         placeholder = jnp.zeros(ref.shape, dtype=ref.dtype)
         return group.broadcast(placeholder, src_rank=ref.owner_rank)
+
+    def _fetch_rpc(self, ref: DeviceRef):
+        from ray_tpu.core.core_worker import global_worker
+
+        worker = global_worker()
+        client = worker.worker_clients.get(ref.owner_address)
+        reply = worker._run_sync(
+            client.call("device_fetch", {"object_id": ref.object_id})
+        )
+        return array_from_fetch_reply(ref, reply)
+
+    def free(self, ref: DeviceRef) -> bool:
+        """Release locally; if remote-owned, ask the owner to release."""
+        with self._lock:
+            if self._objects.pop(ref.object_id, None) is not None:
+                return True
+        if ref.owner_address:
+            from ray_tpu.core.core_worker import try_global_worker
+
+            worker = try_global_worker()
+            if worker is not None:
+                try:
+                    client = worker.worker_clients.get(ref.owner_address)
+                    return worker._run_sync(
+                        client.call(
+                            "device_free", {"object_id": ref.object_id}
+                        )
+                    )
+                except Exception:  # noqa: BLE001 — owner gone = freed
+                    return False
+        return False
 
     def serve_fetch(self, ref: DeviceRef):
         """Owner side of a collective fetch."""
@@ -87,6 +139,22 @@ class DeviceObjectStore:
 
     def __len__(self):
         return len(self._objects)
+
+
+def array_from_fetch_reply(ref: DeviceRef, reply: dict):
+    """Decode a ``device_fetch`` RPC reply into a device array."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    if not reply.get("found"):
+        raise KeyError(
+            f"device object {ref.object_id} no longer resident at "
+            f"{ref.owner_address} (evicted or actor restarted)"
+        )
+    arr = np.frombuffer(
+        reply["data"], dtype=np.dtype(ref.dtype)
+    ).reshape(ref.shape)
+    return jnp.asarray(arr)
 
 
 _store: Optional[DeviceObjectStore] = None

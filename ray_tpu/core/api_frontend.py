@@ -61,6 +61,18 @@ def _normalize_options(opts: Dict[str, Any]) -> Dict[str, Any]:
         strategy = SpreadStrategy()
     elif strategy == "DEFAULT" or strategy is None:
         strategy = None
+    transport = opts.get("tensor_transport", "")
+    if transport not in ("", "device"):
+        # "nccl" (the reference's value) or typos must not silently no-op.
+        raise ValueError(
+            f"unknown tensor_transport {transport!r}: the TPU-native "
+            f"transport is 'device'"
+        )
+    if transport and not opts.get("_actor"):
+        raise ValueError(
+            "tensor_transport is an actor option (device objects live in "
+            "the owning actor's HBM)"
+        )
     out = {
         "resources": resources,
         "strategy": strategy,
@@ -210,6 +222,7 @@ class ActorClass:
             env_vars=norm["env_vars"],
             detached=opts.get("lifetime") == "detached",
             get_if_exists=opts.get("get_if_exists", False),
+            tensor_transport=opts.get("tensor_transport", ""),
         )
         return ActorHandle(actor_id)
 

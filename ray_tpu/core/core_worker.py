@@ -928,6 +928,7 @@ class CoreWorker:
         env_vars=None,
         detached=False,
         get_if_exists=False,
+        tensor_transport="",
     ) -> Tuple[ActorID, ActorSpec]:
         class_id = self._export_function(cls, prefix="cls")
         payload, held = self._prepare_args(args, kwargs)
@@ -949,6 +950,7 @@ class CoreWorker:
             env_vars=env_vars or {},
             detached=detached,
             owner_address=self.address,
+            tensor_transport=tensor_transport,
         )
 
         async def register():
@@ -1165,6 +1167,58 @@ class CoreWorker:
                 out.append(("shm", self.agent_address, len(payload)))
         return out
 
+    def _device_transport_active(self) -> bool:
+        return bool(
+            self.actor_spec is not None
+            and getattr(self.actor_spec, "tensor_transport", "") == "device"
+        )
+
+    async def _device_unwrap(self, value):
+        """DeviceRefs anywhere in the arg pytree resolve to their resident
+        jax.Arrays (RDT analog).  Runs ON the worker event loop, so remote
+        fetches await the RPC directly — blocking here would deadlock the
+        loop."""
+        import jax
+
+        from ..collective.device_objects import (
+            DeviceRef,
+            array_from_fetch_reply,
+            device_object_store,
+        )
+
+        store = device_object_store()
+        is_ref = lambda v: isinstance(v, DeviceRef)  # noqa: E731
+        leaves, treedef = jax.tree.flatten(value, is_leaf=is_ref)
+        out = []
+        for v in leaves:
+            if not is_ref(v):
+                out.append(v)
+            elif store.contains(v):
+                out.append(store.get_local(v))
+            elif v.owner_address:
+                client = self.worker_clients.get(v.owner_address)
+                reply = await client.call(
+                    "device_fetch", {"object_id": v.object_id}
+                )
+                out.append(array_from_fetch_reply(v, reply))
+            else:  # collective-group fallback (owner must serve_fetch)
+                out.append(store.fetch(v))
+        return jax.tree.unflatten(treedef, out)
+
+    @staticmethod
+    def _device_wrap(value):
+        """jax.Arrays anywhere in the return pytree stay in HBM; DeviceRefs
+        travel instead."""
+        import jax
+
+        from ..collective.device_objects import device_object_store
+
+        store = device_object_store()
+        return jax.tree.map(
+            lambda v: store.put(v) if isinstance(v, jax.Array) else v,
+            value,
+        )
+
     async def _execute(self, spec: TaskSpec, fn) -> dict:
         ev_kw = {
             "job_id_hex": spec.job_id.hex(),
@@ -1173,6 +1227,9 @@ class CoreWorker:
         self.task_events.record(spec.task_id.hex(), spec.name, "RUNNING", **ev_kw)
         try:
             args, kwargs = await self._resolve_args(spec.args_payload)
+            if self._device_transport_active():
+                args = await self._device_unwrap(list(args))
+                kwargs = await self._device_unwrap(kwargs)
             self._current_task_name = spec.name
             loop = asyncio.get_running_loop()
             if asyncio.iscoroutinefunction(fn):
@@ -1181,6 +1238,8 @@ class CoreWorker:
                 result = await loop.run_in_executor(
                     self._task_executor, lambda: fn(*args, **kwargs)
                 )
+            if self._device_transport_active():
+                result = self._device_wrap(result)
             returns = await self._package_returns(spec, result)
             self.task_events.record(
                 spec.task_id.hex(), spec.name, "FINISHED", **ev_kw
@@ -1275,6 +1334,26 @@ class CoreWorker:
                     "error": _ser(TaskError.from_exception(e, spec.name))}
         finally:
             advance()
+
+    def handle_device_fetch(self, payload, conn):
+        """Point-to-point DeviceRef resolution (RDT analog): serialize the
+        resident array to the requester (one host hop)."""
+        import numpy as np
+
+        from ..collective.device_objects import device_object_store
+
+        store = device_object_store()
+        arr = store._objects.get(payload["object_id"])
+        if arr is None:
+            return {"found": False}
+        return {"found": True, "data": np.asarray(arr).tobytes()}
+
+    def handle_device_free(self, payload, conn):
+        from ..collective.device_objects import device_object_store
+
+        store = device_object_store()
+        with store._lock:
+            return store._objects.pop(payload["object_id"], None) is not None
 
     def handle_ping(self, payload, conn):
         return "pong"

@@ -78,6 +78,10 @@ class ActorSpec:
     env_vars: Dict[str, str] = field(default_factory=dict)
     detached: bool = False
     owner_address: str = ""
+    # "" = plain object plane; "device" keeps jax.Array returns resident in
+    # HBM and hands out DeviceRefs (the reference's tensor_transport="nccl"
+    # RDT analog; ray ``experimental/gpu_object_manager``).
+    tensor_transport: str = ""
 
 
 class ObjectRef:
