@@ -1,0 +1,62 @@
+"""Opt-out usage reporting — cluster-local only.
+
+Reference: ray ``python/ray/_private/usage/`` + the dashboard usage-stats
+module.  Privacy-first differences: nothing ever leaves the cluster — the
+head aggregates an anonymous feature-usage blob in the control-plane KV,
+inspectable via ``usage_report()`` and exported nowhere.  Disable entirely
+with ``RAY_TPU_usage_stats_enabled=false``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+_KV_NS = "_usage"
+
+
+def _enabled() -> bool:
+    import os
+
+    return os.environ.get(
+        "RAY_TPU_usage_stats_enabled", "true"
+    ).lower() not in ("0", "false", "no")
+
+
+def record_library_usage(library: str) -> None:
+    """Called by library entry points (train/tune/serve/...); best-effort.
+    Each process writes its OWN key so concurrent recorders never clobber
+    each other (no atomic KV increment needed); ``usage_report`` sums."""
+    if not _enabled():
+        return
+    try:
+        from .core_worker import try_global_worker
+
+        worker = try_global_worker()
+        if worker is None:
+            return
+        key = f"lib:{library}:{worker.worker_id.hex()}"
+        current = worker.kv_get(_KV_NS, key) or {"count": 0}
+        current["count"] += 1
+        current["last_used"] = time.time()
+        worker.kv_put(_KV_NS, key, current)
+    except Exception:  # noqa: BLE001 — usage stats must never break apps
+        pass
+
+
+def usage_report() -> Dict[str, dict]:
+    """The head-local usage blob, summed per library (never exported
+    off-cluster)."""
+    from .core_worker import global_worker
+
+    worker = global_worker()
+    out: Dict[str, dict] = {}
+    for key in worker.kv_keys(_KV_NS):
+        entry = worker.kv_get(_KV_NS, key)
+        if entry is None:
+            continue
+        lib = key.rsplit(":", 1)[0]  # "lib:train:<worker>" -> "lib:train"
+        agg = out.setdefault(lib, {"count": 0, "last_used": 0.0})
+        agg["count"] += entry.get("count", 0)
+        agg["last_used"] = max(agg["last_used"], entry.get("last_used", 0.0))
+    return out

@@ -66,6 +66,9 @@ class Algorithm:
     ``lambda config: algo.train()`` style loops, or use directly)."""
 
     def __init__(self, config: AlgorithmConfig):
+        from ray_tpu.core.usage import record_library_usage
+
+        record_library_usage("rllib")
         self.config = config
         self.iteration = 0
         self.setup(config)

@@ -44,6 +44,9 @@ def run(app, name: str = "", route_prefix: Optional[str] = None) -> DeploymentHa
         app = Application(app)
     if not isinstance(app, Application):
         raise TypeError("serve.run expects an Application or Deployment")
+    from ray_tpu.core.usage import record_library_usage
+
+    record_library_usage("serve")
     controller = _get_or_create_controller()
     return _deploy_app(app, controller, route_prefix)
 

@@ -60,6 +60,9 @@ class DataParallelTrainer:
         self.datasets = datasets or {}
 
     def fit(self) -> Result:
+        from ray_tpu.core.usage import record_library_usage
+
+        record_library_usage("train")
         storage = self.run_config.storage_path or tempfile.mkdtemp(
             prefix="rtpu_train_"
         )

@@ -194,6 +194,9 @@ class Tuner:
         self.tune_config = tune_config or TuneConfig()
 
     def fit(self) -> ResultGrid:
+        from ray_tpu.core.usage import record_library_usage
+
+        record_library_usage("tune")
         cfg = self.tune_config
         scheduler = cfg.scheduler or FIFOScheduler()
         variants = generate_variants(

@@ -188,3 +188,15 @@ def test_job_cli_list(ray_start_regular, capsys):
     out = capsys.readouterr().out
     assert sid in out
     assert main(["job", "status", sid]) == 0
+
+
+def test_usage_report(ray_start_regular, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_usage_stats_enabled", "true")
+    from ray_tpu.core.usage import record_library_usage, usage_report
+
+    record_library_usage("train")
+    record_library_usage("train")
+    record_library_usage("serve")
+    report = usage_report()
+    assert report["lib:train"]["count"] == 2
+    assert report["lib:serve"]["count"] == 1
