@@ -65,6 +65,8 @@ _KNOBS: Dict[str, tuple] = {
     "tpu_visible_chips_env": (str, "TPU_VISIBLE_CHIPS", "Env var used for chip isolation"),
     # -- data --
     "data_max_tasks_per_op": (int, 8, "Streaming executor in-flight cap per op"),
+    # -- usage stats --
+    "usage_stats_enabled": (bool, True, "Cluster-local usage recording"),
     # -- task events / observability --
     "enable_task_events": (bool, True, "Record task lifecycle events"),
     "task_events_flush_period_s": (float, 0.5, "Worker buffer flush period"),

@@ -16,17 +16,16 @@ _KV_NS = "_usage"
 
 
 def _enabled() -> bool:
-    import os
+    from .config import GlobalConfig
 
-    return os.environ.get(
-        "RAY_TPU_usage_stats_enabled", "true"
-    ).lower() not in ("0", "false", "no")
+    return GlobalConfig.usage_stats_enabled
 
 
 def record_library_usage(library: str) -> None:
     """Called by library entry points (train/tune/serve/...); best-effort.
-    Each process writes its OWN key so concurrent recorders never clobber
-    each other (no atomic KV increment needed); ``usage_report`` sums."""
+    Each process writes its OWN key so concurrent *processes* never clobber
+    each other; ``usage_report`` sums.  Same-process concurrent threads can
+    still lose an increment (acceptable for an approximate counter)."""
     if not _enabled():
         return
     try:

@@ -12,4 +12,8 @@ from .session import (  # noqa: F401
     get_dataset_shard,
     report,
 )
-from .trainer import DataParallelTrainer, JaxTrainer  # noqa: F401
+from .trainer import (  # noqa: F401
+    DataParallelTrainer,
+    JaxTrainer,
+    TorchTrainer,
+)

@@ -193,6 +193,18 @@ class DataParallelTrainer:
         return Result(metrics=latest_metrics, checkpoint=ckpt_mgr.latest())
 
 
+class TorchTrainer(DataParallelTrainer):
+    """DataParallelTrainer with the torch.distributed (gloo) backend
+    (reference: ray ``train/v2/torch/torch_trainer.py:18``) — CPU-torch
+    parity for workloads not yet ported to JAX."""
+
+    def __init__(self, *args, **kwargs):
+        from .backend import TorchBackend
+
+        kwargs.setdefault("backend", TorchBackend())
+        super().__init__(*args, **kwargs)
+
+
 class JaxTrainer(DataParallelTrainer):
     """DataParallelTrainer with the Jax backend as default (reference:
     ray ``train/v2/jax/jax_trainer.py:19``).  For TPU slice jobs set
