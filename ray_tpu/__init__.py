@@ -24,6 +24,7 @@ from .api import (  # noqa: F401
     ActorHandle,
     ClientContext,
     ObjectRef,
+    ObjectRefGenerator,
     PlacementGroup,
     RemoteFunction,
     SlicePlacementGroup,

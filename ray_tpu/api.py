@@ -13,7 +13,13 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 from .core import node as node_mod
 from .core.api_frontend import ActorClass, ActorHandle, RemoteFunction, remote  # noqa: F401
 from .core.config import GlobalConfig
-from .core.core_worker import CoreWorker, global_worker, set_global_worker, try_global_worker
+from .core.core_worker import (
+    CoreWorker,
+    ObjectRefGenerator,
+    global_worker,
+    set_global_worker,
+    try_global_worker,
+)
 from .core.exceptions import *  # noqa: F401,F403
 from .core.ids import JobID, NodeID
 from .core.placement import (  # noqa: F401

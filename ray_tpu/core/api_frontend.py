@@ -104,6 +104,8 @@ class RemoteFunction:
         return rf
 
     def remote(self, *args, **kwargs):
+        import inspect
+
         worker = global_worker()
         cached_worker, function_id = self._export_cache
         if cached_worker is not worker:
@@ -112,12 +114,21 @@ class RemoteFunction:
         if self._norm_cache is None:
             self._norm_cache = _normalize_options(self._opts)
         norm = self._norm_cache
+        num_returns = self._opts.get("num_returns", 1)
+        if "num_returns" not in self._opts and (
+            inspect.isgeneratorfunction(self._fn)
+            or inspect.isasyncgenfunction(self._fn)
+        ):
+            # Generator tasks stream their yields (reference: streaming
+            # generator returns).  An EXPLICIT num_returns=N keeps the old
+            # materialize-N-values behavior.
+            num_returns = "streaming"
         refs = worker.submit_task(
             self._fn,
             args,
             kwargs,
             name=self._opts.get("name") or self._fn.__name__,
-            num_returns=self._opts.get("num_returns", 1),
+            num_returns=num_returns,
             resources=norm["resources"],
             strategy=norm["strategy"],
             max_retries=self._opts.get(
@@ -128,6 +139,8 @@ class RemoteFunction:
             env_vars=norm["env_vars"],
             function_id=function_id,
         )
+        if num_returns == "streaming":
+            return refs  # an ObjectRefGenerator
         if self._opts.get("num_returns", 1) == 1:
             return refs[0]
         return refs
@@ -163,6 +176,8 @@ class ActorMethod:
             kwargs,
             num_returns=self._num_returns,
         )
+        if self._num_returns == "streaming":
+            return refs  # an ObjectRefGenerator
         return refs[0] if self._num_returns == 1 else refs
 
     def bind(self, *args, **kwargs):

@@ -24,6 +24,7 @@ owned objects while user code blocks.
 from __future__ import annotations
 
 import asyncio
+import inspect
 import logging
 import os
 import threading
@@ -211,7 +212,7 @@ class _LeasePool:
         try:
             reply = await lease["client"].call(
                 "push_task",
-                {"spec": spec},
+                {"spec": spec, "attempt": attempt},
                 timeout=86400.0,  # tasks may run arbitrarily long
                 retries=1,
             )
@@ -229,6 +230,10 @@ class _LeasePool:
                 logger.warning(
                     "task %s attempt %d failed (%s); retrying", spec.name, attempt, e
                 )
+                if spec.streaming:
+                    # A retried generator replays from scratch; drop the
+                    # dead attempt's undelivered items + stragglers.
+                    self.worker._reset_stream_for_retry(spec.task_id)
                 self.submit(spec, attempt + 1)
             else:
                 self.worker._fail_task_returns(
@@ -269,6 +274,44 @@ class _LeasePool:
             )
         except Exception:
             pass
+
+
+class ObjectRefGenerator:
+    """Iterator over a streaming-generator task's yields (reference:
+    ``ObjectRefGenerator``/streaming generator returns).  Each ``next()``
+    blocks until the executor pushes the next item and yields an ObjectRef
+    whose ``get`` returns the value."""
+
+    def __init__(self, task_id: TaskID, worker: "CoreWorker"):
+        self._task_id = task_id
+        self._worker = worker
+
+    def __iter__(self) -> "ObjectRefGenerator":
+        return self
+
+    def __next__(self):
+        kind, value = self._worker._run_sync(
+            self._worker._stream_next(self._task_id)
+        )
+        if kind == "item":
+            return value
+        self._closed = True
+        if kind == "err":
+            raise value
+        raise StopIteration
+
+    def close(self):
+        """Drop the stream (abandoned consumers must not leak the queue
+        and undelivered item refs for the process lifetime)."""
+        if not getattr(self, "_closed", False):
+            self._closed = True
+            try:
+                self._worker.cancel_stream(self._task_id)
+            except Exception:  # noqa: BLE001 — shutdown races
+                pass
+
+    def __del__(self):
+        self.close()
 
 
 class CoreWorker:
@@ -323,6 +366,11 @@ class CoreWorker:
         self._current_task_name = ""
         self._shutdown = False
         self.task_events = None  # TaskEventBuffer, created on the loop
+        # Streaming-generator returns: task_id -> stream state.  The item
+        # queue holds ("item", ref) | ("end", None) | ("err", exc); "end"
+        # enqueues only after ALL `expected` items arrived (stream notifies
+        # and the task reply travel on different sockets and may reorder).
+        self._streams: Dict[TaskID, dict] = {}
 
     # ------------------------------------------------------------- lifecycle
     async def async_start(self):
@@ -647,6 +695,87 @@ class CoreWorker:
         except Exception:
             pass
 
+    # ------------------------------------------------- streaming (owner side)
+    def _new_stream(self, task_id: TaskID):
+        self._streams[task_id] = {
+            "queue": asyncio.Queue(),
+            "received": 0,
+            "expected": None,  # set by the task reply ("streamed": n)
+            "attempt": 0,
+        }
+
+    def _reset_stream_for_retry(self, task_id: TaskID):
+        """A retried streaming task replays from scratch: drop undelivered
+        items from the dead attempt and ignore its stragglers.  The queue
+        object is drained IN PLACE — a consumer may be blocked awaiting it."""
+        state = self._streams.get(task_id)
+        if state is not None:
+            state["attempt"] += 1
+            state["received"] = 0
+            state["expected"] = None
+            queue = state["queue"]
+            while not queue.empty():
+                try:
+                    queue.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
+
+    def handle_stream_item(self, payload, conn):
+        """Oneway push from the executing worker: one yielded item."""
+        state = self._streams.get(payload["task_id"])
+        if state is None:
+            return  # stream finished/cancelled; drop
+        if payload.get("attempt", 0) != state["attempt"]:
+            return  # straggler from a dead attempt
+        oid = ObjectID.for_task_return(payload["task_id"], payload["index"])
+        obj = self.owned.get(oid)
+        if obj is None:
+            obj = self._new_owned(oid)
+            obj.local_refs += 1
+        ret = payload["ret"]
+        if ret[0] == "inline":
+            obj.inline_payload = ret[1]
+            obj.size = len(ret[1])
+        else:  # ("shm", agent_addr, size)
+            obj.locations.add(ret[1])
+            obj.size = ret[2]
+        obj.state = READY
+        obj.event.set()
+        ref = ObjectRef.__new__(ObjectRef)
+        ref.id = oid
+        ref.owner_address = self.address
+        ref._worker = self
+        state["received"] += 1
+        state["queue"].put_nowait(("item", ref))
+        if state["expected"] is not None and state["received"] >= state["expected"]:
+            state["queue"].put_nowait(("end", None))
+
+    def _finish_stream(self, task_id: TaskID, streamed: Optional[int] = None,
+                       error=None):
+        state = self._streams.get(task_id)
+        if state is None:
+            return
+        if error is not None:
+            state["queue"].put_nowait(("err", error))
+            return
+        state["expected"] = streamed if streamed is not None else state["received"]
+        if state["received"] >= state["expected"]:
+            state["queue"].put_nowait(("end", None))
+
+    async def _stream_next(self, task_id: TaskID):
+        state = self._streams.get(task_id)
+        if state is None:
+            return ("end", None)
+        kind, value = await state["queue"].get()
+        if kind != "item":
+            self._streams.pop(task_id, None)
+        return (kind, value)
+
+    def cancel_stream(self, task_id: TaskID):
+        """Abandoned-generator cleanup (called from ObjectRefGenerator)."""
+        if self.loop is not None and not self.loop.is_closed():
+            self.loop.call_soon_threadsafe(self._streams.pop, task_id, None)
+
     def handle_incref(self, payload, conn):
         obj = self.owned.get(payload["object_id"])
         if obj is not None:
@@ -831,6 +960,7 @@ class CoreWorker:
         env_vars: Optional[Dict[str, str]] = None,
         function_id: Optional[str] = None,
     ) -> List[ObjectRef]:
+        streaming = num_returns == "streaming"
         function_id = function_id or self._export_function(fn)
         payload, held = self._prepare_args(args, kwargs)
         spec = TaskSpec(
@@ -839,7 +969,8 @@ class CoreWorker:
             function_id=function_id,
             name=name or getattr(fn, "__name__", "task"),
             args_payload=payload,
-            num_returns=num_returns,
+            num_returns=0 if streaming else num_returns,
+            streaming=streaming,
             resources=resources or {"CPU": 1},
             strategy=strategy,
             max_retries=max_retries,
@@ -861,6 +992,8 @@ class CoreWorker:
                 job_id_hex=spec.job_id.hex(),
                 resources=spec.resources,
             )
+            if streaming:
+                self._new_stream(spec.task_id)
             for oid in return_ids:
                 obj = self._new_owned(oid, lineage=spec)
                 obj.local_refs += 1
@@ -871,6 +1004,8 @@ class CoreWorker:
             pool.submit(spec)
 
         self.loop.call_soon_threadsafe(setup)
+        if streaming:
+            return ObjectRefGenerator(spec.task_id, self)
         for oid in return_ids:
             ref = ObjectRef.__new__(ObjectRef)
             ref.id = oid
@@ -884,6 +1019,9 @@ class CoreWorker:
         if reply.get("error") is not None:
             exc = deserialize_from_bytes(reply["error"])
             self._fail_task_returns(spec, exc)
+            return
+        if reply.get("streamed") is not None:
+            self._finish_stream(spec.task_id, streamed=reply["streamed"])
             return
         for oid, ret in zip(spec.return_ids(), reply["returns"]):
             obj = self.owned.get(oid)
@@ -901,6 +1039,8 @@ class CoreWorker:
 
     def _fail_task_returns(self, spec: TaskSpec, exc: BaseException):
         self._release_args(spec)
+        if spec.task_id in self._streams:
+            self._finish_stream(spec.task_id, error=exc)
         for oid in spec.return_ids():
             obj = self.owned.get(oid)
             if obj is None:
@@ -1018,6 +1158,7 @@ class CoreWorker:
         num_returns: int = 1,
         name: str = "",
     ) -> List[ObjectRef]:
+        streaming = num_returns == "streaming"
         payload, held = self._prepare_args(args, kwargs)
         spec = TaskSpec(
             task_id=new_task_id(),
@@ -1025,7 +1166,8 @@ class CoreWorker:
             function_id="",  # actor methods dispatch by name
             name=name or method_name,
             args_payload=payload,
-            num_returns=num_returns,
+            num_returns=0 if streaming else num_returns,
+            streaming=streaming,
             owner_address=self.address,
             actor_id=actor_id,
         )
@@ -1042,12 +1184,16 @@ class CoreWorker:
                 job_id_hex=spec.job_id.hex(),
                 actor_id_hex=spec.actor_id.hex(),
             )
+            if streaming:
+                self._new_stream(spec.task_id)
             for oid in return_ids:
                 obj = self._new_owned(oid)
                 obj.local_refs += 1
             asyncio.get_running_loop().create_task(self._submit_actor_task(spec))
 
         self.loop.call_soon_threadsafe(setup)
+        if streaming:
+            return ObjectRefGenerator(spec.task_id, self)
         refs = []
         for oid in return_ids:
             ref = ObjectRef.__new__(ObjectRef)
@@ -1140,6 +1286,76 @@ class CoreWorker:
         kwargs = {k: await resolve(v) for k, v in kwargs.items()}
         return args, kwargs
 
+    async def _package_value(self, spec: TaskSpec, value, index: int) -> tuple:
+        """Package one return/stream value: inline if small, else sealed
+        into the shm arena."""
+        payload = serialize_to_bytes(value)
+        if len(payload) <= GlobalConfig.max_inline_object_bytes:
+            return ("inline", payload)
+        oid = ObjectID.for_task_return(spec.task_id, index)
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(
+            None, self.shm_store.create_from_bytes, oid, payload
+        )
+        await self.agent.call(
+            "seal_object", {"object_id": oid, "size": len(payload)}
+        )
+        return ("shm", self.agent_address, len(payload))
+
+    # ------------------------------------------------- streaming generators
+    async def _execute_streaming(self, spec: TaskSpec, fn, args, kwargs,
+                                 ev_kw) -> dict:
+        """Run a (sync or async) generator task, pushing each yielded item
+        to the owner as it is produced (reference: streaming-generator
+        returns, ray ``task_manager.h`` num_returns="streaming")."""
+        caller = self.worker_clients.get(spec.owner_address)
+        count = 0
+        try:
+            if inspect.isasyncgenfunction(fn):
+                agen = fn(*args, **kwargs)
+                async for item in agen:
+                    ret = await self._package_value(spec, item, count)
+                    await caller.notify(
+                        "stream_item",
+                        {"task_id": spec.task_id, "index": count,
+                         "ret": ret, "attempt": getattr(spec, "_attempt", 0)},
+                    )
+                    count += 1
+            else:
+                gen = fn(*args, **kwargs)
+                loop = asyncio.get_running_loop()
+                sentinel = object()
+                while True:
+                    item = await loop.run_in_executor(
+                        self._task_executor,
+                        lambda: next(gen, sentinel),
+                    )
+                    if item is sentinel:
+                        break
+                    ret = await self._package_value(spec, item, count)
+                    await caller.notify(
+                        "stream_item",
+                        {"task_id": spec.task_id, "index": count,
+                         "ret": ret, "attempt": getattr(spec, "_attempt", 0)},
+                    )
+                    count += 1
+            self.task_events.record(
+                spec.task_id.hex(), spec.name, "FINISHED", **ev_kw
+            )
+            return {"returns": [], "error": None, "streamed": count}
+        except BaseException as e:  # noqa: BLE001
+            import traceback as tb
+
+            self.task_events.record(
+                spec.task_id.hex(), spec.name, "FAILED", error=repr(e), **ev_kw
+            )
+            err = TaskError(e, tb.format_exc(), spec.name)
+            return {
+                "returns": None,
+                "error": serialize_to_bytes(err),
+                "streamed": count,
+            }
+
     async def _package_returns(self, spec: TaskSpec, result) -> List[tuple]:
         if spec.num_returns == 1:
             values = [result]
@@ -1150,22 +1366,10 @@ class CoreWorker:
                     f"task {spec.name} declared {spec.num_returns} returns "
                     f"but produced {len(values)}"
                 )
-        out = []
-        for i, value in enumerate(values):
-            payload = serialize_to_bytes(value)
-            if len(payload) <= GlobalConfig.max_inline_object_bytes:
-                out.append(("inline", payload))
-            else:
-                oid = ObjectID.for_task_return(spec.task_id, i)
-                loop = asyncio.get_running_loop()
-                await loop.run_in_executor(
-                    None, self.shm_store.create_from_bytes, oid, payload
-                )
-                await self.agent.call(
-                    "seal_object", {"object_id": oid, "size": len(payload)}
-                )
-                out.append(("shm", self.agent_address, len(payload)))
-        return out
+        return [
+            await self._package_value(spec, value, i)
+            for i, value in enumerate(values)
+        ]
 
     def _device_transport_active(self) -> bool:
         return bool(
@@ -1231,6 +1435,13 @@ class CoreWorker:
                 args = await self._device_unwrap(list(args))
                 kwargs = await self._device_unwrap(kwargs)
             self._current_task_name = spec.name
+            if spec.streaming and (
+                inspect.isgeneratorfunction(fn)
+                or inspect.isasyncgenfunction(fn)
+            ):
+                return await self._execute_streaming(
+                    spec, fn, args, kwargs, ev_kw
+                )
             loop = asyncio.get_running_loop()
             if asyncio.iscoroutinefunction(fn):
                 result = await fn(*args, **kwargs)
@@ -1256,6 +1467,7 @@ class CoreWorker:
 
     async def handle_push_task(self, payload, conn):
         spec: TaskSpec = payload["spec"]
+        spec._attempt = payload.get("attempt", 0)  # stream notify tagging
         fn = await self._get_function(spec.function_id)
         async with self._task_semaphore:
             return await self._execute(spec, fn)

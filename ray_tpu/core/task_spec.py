@@ -32,6 +32,11 @@ class TaskSpec:
     # _RefMarker sentinels during serialization (see core_worker).
     args_payload: bytes
     num_returns: int = 1
+    # Streaming-generator task: yields push to the owner as produced and
+    # num_returns is 0 (the executor streams ONLY when the owner opted in
+    # and registered a stream — a generator return without this flag is an
+    # ordinary value).
+    streaming: bool = False
     resources: Dict[str, float] = field(default_factory=dict)
     strategy: Optional[SchedulingStrategy] = None
     max_retries: int = 0
