@@ -32,6 +32,7 @@ from .core.placement import (  # noqa: F401
 from .core.task_spec import ObjectRef  # noqa: F401
 
 _local_node: Optional[node_mod.Node] = None
+_config_overrides_before: Optional[Dict[str, Any]] = None
 
 
 def is_initialized() -> bool:
@@ -52,10 +53,14 @@ def init(
     "host:port" → connect to that control plane (starts a local node agent
     for this machine if none is known).
     """
-    global _local_node
+    global _local_node, _config_overrides_before
     if is_initialized():
         return ClientContext(global_worker())
     if _system_config:
+        # _system_config is cluster-scoped (reference semantics): snapshot
+        # the prior overrides so shutdown() restores them — a test process
+        # init/shutdown cycle must not leak config into the next cluster.
+        _config_overrides_before = dict(GlobalConfig._overrides)
         GlobalConfig.override(**_system_config)
 
     if address in (None, "local"):
@@ -105,7 +110,7 @@ def init(
 
 
 def shutdown():
-    global _local_node
+    global _local_node, _config_overrides_before
     worker = try_global_worker()
     if worker is not None:
         worker.shutdown()
@@ -113,6 +118,9 @@ def shutdown():
     if _local_node is not None:
         _local_node.stop()
         _local_node = None
+    if _config_overrides_before is not None:
+        GlobalConfig._overrides = _config_overrides_before
+        _config_overrides_before = None
 
 
 class ClientContext:

@@ -85,8 +85,15 @@ def _normalize_options(opts: Dict[str, Any]) -> Dict[str, Any]:
 
 class RemoteFunction:
     def __init__(self, fn, default_opts: Optional[dict] = None):
+        import inspect
+
         self._fn = fn
         self._opts = default_opts or {}
+        # Memoized: the streaming decision is a constant per function and
+        # .remote() is the submission hot path.
+        self._is_generator = inspect.isgeneratorfunction(
+            fn
+        ) or inspect.isasyncgenfunction(fn)
         # Export cache keyed by the worker that exported it: a new
         # ray_tpu.init() means a fresh control-plane KV, so the function must
         # be re-exported there.
@@ -104,8 +111,6 @@ class RemoteFunction:
         return rf
 
     def remote(self, *args, **kwargs):
-        import inspect
-
         worker = global_worker()
         cached_worker, function_id = self._export_cache
         if cached_worker is not worker:
@@ -115,10 +120,7 @@ class RemoteFunction:
             self._norm_cache = _normalize_options(self._opts)
         norm = self._norm_cache
         num_returns = self._opts.get("num_returns", 1)
-        if "num_returns" not in self._opts and (
-            inspect.isgeneratorfunction(self._fn)
-            or inspect.isasyncgenfunction(self._fn)
-        ):
+        if "num_returns" not in self._opts and self._is_generator:
             # Generator tasks stream their yields (reference: streaming
             # generator returns).  An EXPLICIT num_returns=N keeps the old
             # materialize-N-values behavior.

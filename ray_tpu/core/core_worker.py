@@ -702,6 +702,7 @@ class CoreWorker:
             "received": 0,
             "expected": None,  # set by the task reply ("streamed": n)
             "attempt": 0,
+            "pending_error": None,  # delivered after in-flight items drain
         }
 
     def _reset_stream_for_retry(self, task_id: TaskID):
@@ -713,6 +714,7 @@ class CoreWorker:
             state["attempt"] += 1
             state["received"] = 0
             state["expected"] = None
+            state["pending_error"] = None
             queue = state["queue"]
             while not queue.empty():
                 try:
@@ -731,7 +733,10 @@ class CoreWorker:
         obj = self.owned.get(oid)
         if obj is None:
             obj = self._new_owned(oid)
-            obj.local_refs += 1
+        # EVERY ObjectRef handed to the consumer carries one local ref —
+        # a retry replay of an index the consumer still holds must not
+        # alias two refs onto a single count (premature free).
+        obj.local_refs += 1
         ret = payload["ret"]
         if ret[0] == "inline":
             obj.inline_payload = ret[1]
@@ -747,20 +752,33 @@ class CoreWorker:
         ref._worker = self
         state["received"] += 1
         state["queue"].put_nowait(("item", ref))
+        self._maybe_terminate_stream(state)
+
+    @staticmethod
+    def _maybe_terminate_stream(state: dict):
         if state["expected"] is not None and state["received"] >= state["expected"]:
-            state["queue"].put_nowait(("end", None))
+            err = state.get("pending_error")
+            state["queue"].put_nowait(
+                ("err", err) if err is not None else ("end", None)
+            )
 
     def _finish_stream(self, task_id: TaskID, streamed: Optional[int] = None,
                        error=None):
+        """Terminal signal from the task reply.  Both ends (success AND
+        error) wait for all ``streamed`` in-flight items first — the reply
+        and the item notifies ride different sockets and may reorder."""
         state = self._streams.get(task_id)
         if state is None:
             return
         if error is not None:
-            state["queue"].put_nowait(("err", error))
-            return
+            state["pending_error"] = error
+            if streamed is None:
+                # No count available (e.g. lease/connection failure):
+                # nothing more is coming — fail now.
+                state["queue"].put_nowait(("err", error))
+                return
         state["expected"] = streamed if streamed is not None else state["received"]
-        if state["received"] >= state["expected"]:
-            state["queue"].put_nowait(("end", None))
+        self._maybe_terminate_stream(state)
 
     async def _stream_next(self, task_id: TaskID):
         state = self._streams.get(task_id)
@@ -1018,6 +1036,13 @@ class CoreWorker:
         self._release_args(spec)
         if reply.get("error") is not None:
             exc = deserialize_from_bytes(reply["error"])
+            if reply.get("streamed") is not None:
+                # Mid-stream failure: deliver the items yielded before the
+                # error, THEN the error.
+                self._finish_stream(
+                    spec.task_id, streamed=reply["streamed"], error=exc
+                )
+                return
             self._fail_task_returns(spec, exc)
             return
         if reply.get("streamed") is not None:
@@ -1243,6 +1268,7 @@ class CoreWorker:
                     "caller": self.address,
                     "seq": seq,
                     "incarnation": incarnation,
+                    "attempt": attempt,
                 },
                 timeout=86400.0,
                 retries=1,
@@ -1256,6 +1282,10 @@ class CoreWorker:
             self.worker_clients.invalidate(state.address)
             if attempt < state.max_task_retries:
                 await asyncio.sleep(0.2)
+                if spec.streaming:
+                    # The restarted actor replays the generator from
+                    # scratch; drop the dead attempt's items/stragglers.
+                    self._reset_stream_for_retry(spec.task_id)
                 await self._submit_actor_task(spec, attempt + 1)
             else:
                 self._fail_task_returns(
@@ -1435,13 +1465,30 @@ class CoreWorker:
                 args = await self._device_unwrap(list(args))
                 kwargs = await self._device_unwrap(kwargs)
             self._current_task_name = spec.name
-            if spec.streaming and (
-                inspect.isgeneratorfunction(fn)
-                or inspect.isasyncgenfunction(fn)
-            ):
-                return await self._execute_streaming(
-                    spec, fn, args, kwargs, ev_kw
+            if spec.streaming:
+                if inspect.isgeneratorfunction(fn) or inspect.isasyncgenfunction(fn):
+                    return await self._execute_streaming(
+                        spec, fn, args, kwargs, ev_kw
+                    )
+                # Loud failure beats a consumer hung on a stream that no
+                # code path would ever terminate.
+                err = TaskError(
+                    TypeError(
+                        f"{spec.name!r} requested num_returns='streaming' "
+                        f"but is not a generator function"
+                    ),
+                    "",
+                    spec.name,
                 )
+                self.task_events.record(
+                    spec.task_id.hex(), spec.name, "FAILED",
+                    error="not a generator", **ev_kw,
+                )
+                return {
+                    "returns": None,
+                    "error": serialize_to_bytes(err),
+                    "streamed": 0,
+                }
             loop = asyncio.get_running_loop()
             if asyncio.iscoroutinefunction(fn):
                 result = await fn(*args, **kwargs)
@@ -1498,6 +1545,7 @@ class CoreWorker:
 
     async def handle_actor_push_task(self, payload, conn):
         spec: TaskSpec = payload["spec"]
+        spec._attempt = payload.get("attempt", 0)  # stream notify tagging
         caller = payload["caller"]
         seq = payload["seq"]
         key = (caller, payload.get("incarnation", 0))

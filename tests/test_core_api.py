@@ -389,6 +389,7 @@ class TestStreamingGenerators:
         assert [ray_tpu.get(r, timeout=60) for r in gen] == [100, 101, 102]
         # Plain methods on the same actor unaffected.
         assert ray_tpu.get(g.plain.remote(), timeout=60) == "ok"
+        ray_tpu.kill(g)
 
     def test_generator_without_streaming_flag_errors(self, cluster):
         @ray_tpu.remote(max_concurrency=2)
@@ -401,6 +402,7 @@ class TestStreamingGenerators:
         # serialize — surfaces as a task error, never a hang.
         with pytest.raises(Exception):
             ray_tpu.get(g.stream.remote(), timeout=60)
+        ray_tpu.kill(g)
 
     def test_explicit_num_returns_on_generator_fn(self, cluster):
         @ray_tpu.remote(num_returns=2)
@@ -435,3 +437,28 @@ class TestStreamingGenerators:
         # stream completes.
         assert values[-1] == 3
         assert values.count(1) >= 1 and values.count(2) >= 1
+
+    def test_streaming_flag_on_non_generator_errors(self, cluster):
+        @ray_tpu.remote(max_concurrency=2)
+        class A:
+            def plain(self):
+                return []
+
+        a = A.remote()
+        gen = a.plain.options(num_returns="streaming").remote()
+        with pytest.raises(Exception, match="not a generator"):
+            next(gen)
+        ray_tpu.kill(a)
+
+    def test_error_after_items_delivers_items_first(self, cluster):
+        @ray_tpu.remote
+        def partial():
+            yield "x"
+            raise ValueError("after one")
+
+        gen = partial.remote()
+        collected = []
+        with pytest.raises(Exception, match="after one"):
+            for ref in gen:
+                collected.append(ray_tpu.get(ref, timeout=60))
+        assert collected == ["x"]
