@@ -83,6 +83,13 @@ class JaxLLMEngine:
         self._next_id = itertools.count()
         self._waiting: List[tuple] = []  # (request_id, token_ids, params)
         self._finished: Dict[int, dict] = {}
+        # ALL engine-state mutation serializes on this lock: step() may be
+        # driven concurrently by batched calls (replica event loop) and by
+        # generate_stream callers (replica executor threads).  Reentrant:
+        # generate/generate_stream hold it across pop+step.
+        import threading
+
+        self._step_lock = threading.RLock()
 
         def prefill_one(params, cache, tokens, length, slot_idx):
             """Prefill a single request into batch row ``slot_idx``."""
@@ -192,9 +199,14 @@ class JaxLLMEngine:
     # ------------------------------------------------------------------ step
     def step(self) -> List[dict]:
         """Admit waiting requests, run ONE decode step for all active slots,
-        retire finished requests.  Returns newly finished outputs."""
+        retire finished requests.  Returns newly finished outputs.
+        Thread-safe (serialized on the engine lock)."""
         import jax.numpy as jnp
 
+        with self._step_lock:
+            return self._step_locked(jnp)
+
+    def _step_locked(self, jnp) -> List[dict]:
         self._admit()
         finished = self._retire()  # requests that finished at admission
         active = [
@@ -249,6 +261,62 @@ class JaxLLMEngine:
         )
 
     # ------------------------------------------------------------- generate
+    def cancel_request(self, request_id: int) -> None:
+        """Drop a request wherever it is (queue, slot, finished results) —
+        abandoned streams must not keep decoding or park results forever."""
+        with self._step_lock:
+            self._waiting = [
+                w for w in self._waiting if w[0] != request_id
+            ]
+            for i, slot in enumerate(self.slots):
+                if slot is not None and slot.request_id == request_id:
+                    self.slots[i] = None
+            self._finished.pop(request_id, None)
+
+    def generate_stream(self, prompt: str,
+                        params: Optional[SamplingParams] = None,
+                        timeout_s: float = 300.0):
+        """Incremental generation: yields the text delta after every decode
+        step for this request.  Concurrent streams (and batched generate
+        calls) share the slot pool — every state access holds the engine
+        lock; only the yields happen outside it."""
+        request_id = self.add_request(prompt, params)
+        emitted = 0
+        deadline = time.monotonic() + timeout_s
+        try:
+            while True:
+                if time.monotonic() > deadline:
+                    raise TimeoutError("generation exceeded timeout")
+                done = None
+                delta_tokens: list = []
+                with self._step_lock:
+                    done = self._finished.pop(request_id, None)
+                    if done is None:
+                        self.step()
+                        done = self._finished.pop(request_id, None)
+                    if done is None:
+                        slot = next(
+                            (s for s in self.slots
+                             if s is not None
+                             and s.request_id == request_id),
+                            None,
+                        )
+                        if slot is not None and len(slot.generated) > emitted:
+                            delta_tokens = list(slot.generated[emitted:])
+                            emitted += len(delta_tokens)
+                if done is not None:
+                    tail = self.tokenizer.decode(done["token_ids"][emitted:])
+                    if tail:
+                        yield tail
+                    return
+                if delta_tokens:
+                    text = self.tokenizer.decode(delta_tokens)
+                    if text:
+                        yield text
+        finally:
+            # Timeout or abandoned consumer: release the slot/queue entry.
+            self.cancel_request(request_id)
+
     def generate(
         self,
         prompts: List[str],

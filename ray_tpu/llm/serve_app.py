@@ -40,20 +40,59 @@ class LLMServer:
     @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.02)
     async def _generate_batch(self, requests: List[tuple]):
         """requests: [(prompt, SamplingParams)] — one engine pass serves
-        them all (the engine's slot pool IS the batch)."""
+        them all (the engine's slot pool IS the batch).  All engine-state
+        access holds the engine lock: SSE streams may be stepping the same
+        engine from replica threads concurrently."""
         ids = [
             self.engine.add_request(prompt, params)
             for prompt, params in requests
         ]
-        while self.engine.has_unfinished():
-            self.engine.step()
-        return [self.engine._finished.pop(i) for i in ids]
+        while True:
+            with self.engine._step_lock:
+                if all(i in self.engine._finished for i in ids):
+                    return [self.engine._finished.pop(i) for i in ids]
+                self.engine.step()
 
-    async def __call__(self, body: Dict[str, Any]) -> Dict[str, Any]:
-        """OpenAI completions-ish: dispatch on request shape."""
+    async def __call__(self, body: Dict[str, Any]):
+        """OpenAI completions-ish: dispatch on request shape.  With
+        ``"stream": true`` the proxy calls this through the streaming path
+        and SSE-frames each yielded chunk (OpenAI ``stream`` semantics)."""
+        if body.get("stream") is True:
+            return self.stream_chunks(body)
         if "messages" in body:
             return await self.chat(body)
         return await self.completions(body)
+
+    def stream_chunks(self, body: Dict[str, Any]):
+        """Sync generator of OpenAI-style streaming chunks (per decode
+        step).  Runs on a replica thread via handle_request_streaming."""
+        chat = "messages" in body
+        if chat:
+            prompt = "\n".join(
+                f"{m.get('role', 'user')}: {m.get('content', '')}"
+                for m in body.get("messages", [])
+            ) + "\nassistant:"
+        else:
+            prompt = body.get("prompt", "")
+        cid = f"{'chatcmpl' if chat else 'cmpl'}-{uuid.uuid4().hex[:12]}"
+        created = int(time.time())
+        for delta in self.engine.generate_stream(
+            prompt, _sampling_from_request(body)
+        ):
+            if chat:
+                choice = {"index": 0, "delta": {"content": delta},
+                          "finish_reason": None}
+                obj = "chat.completion.chunk"
+            else:
+                choice = {"index": 0, "text": delta, "finish_reason": None}
+                obj = "text_completion"
+            yield {
+                "id": cid,
+                "object": obj,
+                "created": created,
+                "model": body.get("model", self.model_name),
+                "choices": [choice],
+            }
 
     async def completions(self, body: Dict[str, Any]) -> Dict[str, Any]:
         prompt = body.get("prompt", "")

@@ -10,5 +10,9 @@ from .api import (  # noqa: F401
 )
 from .batching import batch  # noqa: F401
 from .deployment import Application, Deployment, deployment  # noqa: F401
-from .handle import DeploymentHandle, DeploymentResponse  # noqa: F401
+from .handle import (  # noqa: F401
+    DeploymentHandle,
+    DeploymentResponse,
+    DeploymentResponseGenerator,
+)
 from .multiplex import get_multiplexed_model_id, multiplexed  # noqa: F401

@@ -183,6 +183,42 @@ def start_http_proxy(host: str = "127.0.0.1", port: int = 8000) -> str:
                 name = max(candidates, key=lambda c: len(c[0]))[1]
         return name
 
+    async def stream_sse(request: "web.Request", handle, body):
+        import asyncio as _asyncio
+
+        resp = web.StreamResponse(
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+            }
+        )
+        await resp.prepare(request)
+        loop = _asyncio.get_running_loop()
+        # Routing does blocking control-plane/replica probes — keep it off
+        # the proxy loop (same as the non-stream path).
+        gen = await loop.run_in_executor(
+            None, lambda: handle.options(stream=True).remote(body)
+        )
+        sentinel = object()
+        try:
+            while True:
+                chunk = await loop.run_in_executor(
+                    None, lambda: next(gen, sentinel)
+                )
+                if chunk is sentinel:
+                    break
+                await resp.write(
+                    b"data: " + json.dumps(chunk, default=str).encode()
+                    + b"\n\n"
+                )
+        except Exception as e:  # noqa: BLE001 — surface mid-stream errors
+            await resp.write(
+                b"data: " + json.dumps({"error": str(e)}).encode() + b"\n\n"
+            )
+        await resp.write(b"data: [DONE]\n\n")
+        await resp.write_eof()
+        return resp
+
     async def handle_request(request: "web.Request"):
         import time as _time
 
@@ -205,6 +241,12 @@ def start_http_proxy(host: str = "127.0.0.1", port: int = 8000) -> str:
             body = await request.json()
         except Exception:
             body = None
+        if isinstance(body, dict) and body.get("stream") is True:
+            # Server-sent events: the deployment's method must be a
+            # generator; each chunk goes out as one `data:` frame
+            # (reference: serve HTTP response streaming / OpenAI
+            # `stream: true`).
+            return await stream_sse(request, handle, body)
         if isinstance(body, dict) and ("args" in body or "kwargs" in body):
             args = body.get("args", [])
             kwargs = body.get("kwargs", {})

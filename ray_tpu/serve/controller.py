@@ -102,6 +102,66 @@ class Replica:
             with self._lock:
                 self._ongoing -= 1
 
+    async def handle_request_streaming(self, method: str, args, kwargs,
+                                       metadata: Optional[dict] = None):
+        """Streaming twin of handle_request: the target must be a generator
+        (sync or async); each yielded chunk streams to the caller via the
+        core runtime's streaming actor-method path."""
+        from . import multiplex
+
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        token = None
+        if metadata and metadata.get("multiplexed_model_id") is not None:
+            token = multiplex._model_id_var.set(
+                metadata["multiplexed_model_id"]
+            )
+        await self._user_sem.acquire()
+        try:
+            if self._is_class:
+                target = getattr(self.callable, method or "__call__")
+            else:
+                target = self.callable
+            result = target(*args, **kwargs)
+            if asyncio.iscoroutine(result):
+                # e.g. an async __call__ that returns a generator when the
+                # request asked for streaming.
+                result = await result
+            if hasattr(result, "__aiter__"):
+                async for item in result:
+                    yield item
+            elif hasattr(result, "__iter__"):
+                # Sync generator: pull items on a thread so a blocking body
+                # can't stall the replica loop.  Copy the context so the
+                # multiplexed-model-id contextvar set above is visible
+                # inside the generator frames (run_in_executor does not
+                # propagate context by itself).
+                import contextvars
+
+                ctx = contextvars.copy_context()
+                loop = asyncio.get_running_loop()
+                sentinel = object()
+                it = iter(result)
+                while True:
+                    item = await loop.run_in_executor(
+                        None, lambda: ctx.run(next, it, sentinel)
+                    )
+                    if item is sentinel:
+                        break
+                    yield item
+            else:
+                raise TypeError(
+                    f"stream=True requires {method or '__call__'} to be a "
+                    f"generator; got {type(result).__name__}"
+                )
+        finally:
+            self._user_sem.release()
+            if token is not None:
+                multiplex._model_id_var.reset(token)
+            with self._lock:
+                self._ongoing -= 1
+
     def reconfigure(self, user_config):
         if hasattr(self.callable, "reconfigure"):
             self.callable.reconfigure(user_config)

@@ -32,6 +32,23 @@ class DeploymentResponse:
         return self._ref
 
 
+class DeploymentResponseGenerator:
+    """Iterator over a streaming deployment call's chunks (reference:
+    ``handle.options(stream=True)``); yields VALUES, one per chunk the
+    replica's generator produced."""
+
+    def __init__(self, ref_generator, timeout: Optional[float] = 120.0):
+        self._gen = ref_generator
+        self._timeout = timeout
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        ref = next(self._gen)
+        return ray_tpu.get(ref, timeout=self._timeout)
+
+
 class _MethodCaller:
     def __init__(self, handle: "DeploymentHandle", method: str):
         self._handle = handle
@@ -43,22 +60,34 @@ class _MethodCaller:
 
 class DeploymentHandle:
     def __init__(self, deployment_name: str, controller=None,
-                 multiplexed_model_id: Optional[str] = None):
+                 multiplexed_model_id: Optional[str] = None,
+                 stream: bool = False):
         self.deployment_name = deployment_name
         self._controller = controller
         self._replicas: List = []
         self._refreshed = 0.0
         self._rr = 0
         self._multiplexed_model_id = multiplexed_model_id
+        self._stream = stream
         # model_id -> actor id of the replica that last served it (session
         # affinity — the reference's multiplex-aware router prefers replicas
         # already holding the model).
         self._model_affinity: dict = {}
 
-    def options(self, *, multiplexed_model_id: Optional[str] = None
-                ) -> "DeploymentHandle":
+    _UNSET = object()
+
+    def options(self, *, multiplexed_model_id=_UNSET,
+                stream=_UNSET) -> "DeploymentHandle":
+        """Chaining-safe: options not passed keep their current values
+        (``h.options(multiplexed_model_id="m").options(stream=True)``
+        retains the model id)."""
         clone = DeploymentHandle(
-            self.deployment_name, self._controller, multiplexed_model_id
+            self.deployment_name,
+            self._controller,
+            self._multiplexed_model_id
+            if multiplexed_model_id is self._UNSET
+            else multiplexed_model_id,
+            self._stream if stream is self._UNSET else stream,
         )
         clone._replicas = self._replicas
         clone._refreshed = self._refreshed
@@ -124,6 +153,11 @@ class DeploymentHandle:
         metadata = (
             {"multiplexed_model_id": model_id} if model_id is not None else None
         )
+        if self._stream:
+            gen = replica.handle_request_streaming.options(
+                num_returns="streaming"
+            ).remote(method, args, kwargs, metadata)
+            return DeploymentResponseGenerator(gen)
         ref = replica.handle_request.remote(method, args, kwargs, metadata)
         return DeploymentResponse(ref)
 

@@ -209,3 +209,43 @@ class TestBatchInference:
         rows = processor(ds).take_all()
         assert len(rows) == 6
         assert all(isinstance(r["generated"], str) for r in rows)
+
+
+class TestTokenStreaming:
+    def test_engine_generate_stream(self):
+        engine = JaxLLMEngine(_tiny_cfg())
+        p = SamplingParams(max_tokens=6, temperature=0.0)
+        deltas = list(engine.generate_stream("hello", p))
+        assert len(deltas) >= 1
+        # Streamed deltas concatenate to the one-shot result.
+        full = JaxLLMEngine(_tiny_cfg()).generate(["hello"], p)[0]["text"]
+        assert "".join(deltas) == full
+
+    def test_openai_sse_streaming(self, cluster):
+        import json
+        import urllib.request
+
+        import ray_tpu.serve as serve
+
+        serve.run(build_openai_app(_tiny_cfg()))
+        url = serve.start_http_proxy(port=8173)
+        req = urllib.request.Request(
+            f"{url}/v1/completions",
+            data=json.dumps(
+                {"prompt": "hi", "max_tokens": 5, "stream": True}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        raw = urllib.request.urlopen(req, timeout=180).read().decode()
+        frames = [
+            l[len("data: "):]
+            for l in raw.splitlines()
+            if l.startswith("data: ")
+        ]
+        assert frames[-1] == "[DONE]"
+        chunks = [json.loads(f) for f in frames[:-1]]
+        assert len(chunks) >= 1
+        assert chunks[0]["object"] == "text_completion"
+        assert all("text" in c["choices"][0] for c in chunks)
+        serve.stop_http_proxy()
+        serve.delete("LLMServer")

@@ -171,3 +171,66 @@ def test_deploy_config_and_cli_status(cluster, tmp_path, capsys):
     out = capsys.readouterr().out
     assert "ConfigEcho" in out
     serve.delete("ConfigEcho")
+
+
+def test_handle_streaming(cluster):
+    @serve.deployment(ray_actor_options={"num_cpus": 0})
+    class Streamer:
+        def __call__(self, n):
+            for i in range(n):
+                yield {"chunk": i}
+
+    handle = serve.run(Streamer.bind())
+    chunks = list(handle.options(stream=True).remote(4))
+    assert chunks == [{"chunk": i} for i in range(4)]
+    # Non-generator via stream errors loudly.
+    @serve.deployment(name="NotGen", ray_actor_options={"num_cpus": 0})
+    class NotGen:
+        def __call__(self):
+            return 42
+
+    h2 = serve.run(NotGen.bind())
+    with pytest.raises(Exception, match="generator"):
+        list(h2.options(stream=True).remote())
+    serve.delete("Streamer")
+    serve.delete("NotGen")
+
+
+def test_http_sse_streaming(cluster):
+    import urllib.request
+
+    @serve.deployment(ray_actor_options={"num_cpus": 0})
+    class Ticker:
+        async def __call__(self, body):
+            if body.get("stream") is True:
+                def gen():
+                    for i in range(3):
+                        yield {"tick": i}
+
+                return gen()
+            return {"all": 3}
+
+    serve.run(Ticker.bind(), route_prefix="/tick")
+    url = serve.start_http_proxy(port=8171)
+    import json as _json
+
+    req = urllib.request.Request(
+        f"{url}/tick",
+        data=_json.dumps({"stream": True}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    raw = urllib.request.urlopen(req, timeout=120).read().decode()
+    frames = [l[len("data: "):] for l in raw.splitlines() if l.startswith("data: ")]
+    assert frames[-1] == "[DONE]"
+    ticks = [_json.loads(f)["tick"] for f in frames[:-1]]
+    assert ticks == [0, 1, 2]
+    # Non-stream body unaffected.
+    req = urllib.request.Request(
+        f"{url}/tick",
+        data=_json.dumps({}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    out = _json.loads(urllib.request.urlopen(req, timeout=60).read())
+    assert out["result"] == {"all": 3}
+    serve.stop_http_proxy()
+    serve.delete("Ticker")
