@@ -390,7 +390,9 @@ class CoreWorker:
                 "register_job",
                 {"job_id": self.job_id, "driver_address": self.address},
             )
-            self.loop.create_task(self._job_heartbeat_loop())
+            self._heartbeat_task = self.loop.create_task(
+                self._job_heartbeat_loop()
+            )
         return self.address
 
     async def _job_heartbeat_loop(self):
@@ -457,6 +459,16 @@ class CoreWorker:
 
     async def async_shutdown(self):
         self._shutdown = True
+        # Ordered teardown (reference: core_worker/shutdown_coordinator.h):
+        # cancel periodic loops first so nothing is left pending when the
+        # event loop stops.
+        hb = getattr(self, "_heartbeat_task", None)
+        if hb is not None and not hb.done():
+            hb.cancel()
+            try:
+                await hb
+            except (asyncio.CancelledError, Exception):
+                pass
         if self.task_events is not None:
             try:
                 await asyncio.wait_for(self.task_events.stop(), timeout=2)
@@ -488,17 +500,25 @@ class CoreWorker:
         return obj
 
     async def _put_async(self, value: Any) -> ObjectRef:
+        from .serialization import serialize, serialized_nbytes
+
         oid = ObjectID.from_random()
         obj = self._new_owned(oid)
         obj.local_refs += 1
-        payload = serialize_to_bytes(value)
-        obj.size = len(payload)
-        if len(payload) <= GlobalConfig.max_inline_object_bytes:
-            obj.inline_payload = payload
+        from .serialization import write_serialized
+
+        header, views = serialize(value)
+        size = serialized_nbytes(header, views)
+        obj.size = size
+        if size <= GlobalConfig.max_inline_object_bytes:
+            buf = bytearray(size)
+            write_serialized(header, views, buf)
+            obj.inline_payload = bytes(buf)
             self.memory_store.put(oid, value)
         else:
-            self.shm_store.create_from_bytes(oid, payload)
-            await self.agent.call("seal_object", {"object_id": oid, "size": len(payload)})
+            # Zero-copy: pickle-5 buffers memcpy straight into the arena.
+            self.shm_store.create_serialized(oid, header, views)
+            await self.agent.call("seal_object", {"object_id": oid, "size": size})
             obj.locations.add(self.agent_address)
             self.memory_store.put(oid, value)  # local cache for owner gets
         obj.state = READY
@@ -1318,19 +1338,24 @@ class CoreWorker:
 
     async def _package_value(self, spec: TaskSpec, value, index: int) -> tuple:
         """Package one return/stream value: inline if small, else sealed
-        into the shm arena."""
-        payload = serialize_to_bytes(value)
-        if len(payload) <= GlobalConfig.max_inline_object_bytes:
-            return ("inline", payload)
+        zero-copy into the shm arena."""
+        from .serialization import serialize, serialized_nbytes, write_serialized
+
+        header, views = serialize(value)
+        size = serialized_nbytes(header, views)
+        if size <= GlobalConfig.max_inline_object_bytes:
+            buf = bytearray(size)
+            write_serialized(header, views, buf)
+            return ("inline", bytes(buf))
         oid = ObjectID.for_task_return(spec.task_id, index)
         loop = asyncio.get_running_loop()
         await loop.run_in_executor(
-            None, self.shm_store.create_from_bytes, oid, payload
+            None, self.shm_store.create_serialized, oid, header, views
         )
         await self.agent.call(
-            "seal_object", {"object_id": oid, "size": len(payload)}
+            "seal_object", {"object_id": oid, "size": size}
         )
-        return ("shm", self.agent_address, len(payload))
+        return ("shm", self.agent_address, size)
 
     # ------------------------------------------------- streaming generators
     async def _execute_streaming(self, spec: TaskSpec, fn, args, kwargs,

@@ -97,6 +97,31 @@ def serialize_to_bytes(value: Any) -> bytes:
     return out.getvalue()
 
 
+def serialized_nbytes(header: bytes, views: List[memoryview]) -> int:
+    """Size of the flat encoding without materializing it."""
+    return 8 + len(header) + sum(8 + memoryview(v).nbytes for v in views)
+
+
+def write_serialized(header: bytes, views: List[memoryview], dest) -> int:
+    """Write the flat encoding straight into ``dest`` (e.g. an shm arena
+    block) — the zero-copy put path: one memcpy per buffer instead of the
+    bytes()/BytesIO/getvalue() triple copy of ``serialize_to_bytes``.
+    Returns bytes written."""
+    mv = memoryview(dest)
+    mv[0:4] = len(views).to_bytes(4, "little")
+    mv[4:8] = len(header).to_bytes(4, "little")
+    off = 8
+    mv[off : off + len(header)] = header
+    off += len(header)
+    for v in views:
+        b = memoryview(v).cast("B")
+        mv[off : off + 8] = b.nbytes.to_bytes(8, "little")
+        off += 8
+        mv[off : off + b.nbytes] = b
+        off += b.nbytes
+    return off
+
+
 def deserialize_from_bytes(data) -> Any:
     mv = memoryview(data)
     nbufs = int.from_bytes(mv[0:4], "little")

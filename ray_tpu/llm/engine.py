@@ -6,6 +6,11 @@ TPU program: a fixed pool of batch slots shares one jitted decode step, so
 requests join and leave the batch at token granularity (continuous
 batching) and the chip never waits for the longest request in a batch.
 
+Model-agnostic: any config type with a registered ``ModelFamily``
+(``ray_tpu.models.model_family`` — GPT-2 and Llama ship in-tree, mirroring
+the reference's vLLM model registry) plugs in; the engine only speaks
+init/init_cache/prefill/decode_step.
+
 Shapes are static (max_batch_size × max_seq_len) so XLA compiles exactly
 two programs: prefill and decode.
 """
@@ -19,13 +24,8 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
-from ..models.gpt2 import GPT2Config, gpt2_init
-from ..models.gpt2_decode import (
-    gpt2_decode_step,
-    gpt2_init_cache,
-    gpt2_prefill,
-    sample_logits,
-)
+from ..models import GPT2Config, model_family
+from ..models.gpt2_decode import sample_logits
 from .tokenizer import ByteTokenizer
 
 
@@ -40,7 +40,8 @@ class SamplingParams:
 
 @dataclasses.dataclass
 class EngineConfig:
-    model: GPT2Config = dataclasses.field(
+    # Any config with a registered ModelFamily (GPT2Config, LlamaConfig, …).
+    model: Any = dataclasses.field(
         default_factory=lambda: GPT2Config.tiny(vocab_size=384)
     )
     max_batch_size: int = 8
@@ -72,12 +73,14 @@ class JaxLLMEngine:
         self.cfg = cfg
         self.tokenizer = tokenizer or ByteTokenizer()
         mcfg = cfg.model
+        fam = model_family(mcfg)
+        self.family = fam
         if cfg.param_loader is not None:
             self.params = cfg.param_loader()
         else:
-            self.params = gpt2_init(jax.random.PRNGKey(cfg.seed), mcfg)
+            self.params = fam.init(jax.random.PRNGKey(cfg.seed), mcfg)
         self._key = jax.random.PRNGKey(cfg.seed + 1)
-        self.cache = gpt2_init_cache(mcfg, cfg.max_batch_size, cfg.max_seq_len)
+        self.cache = fam.init_cache(mcfg, cfg.max_batch_size, cfg.max_seq_len)
         # Per-slot state; None = free.
         self.slots: List[Optional[_Slot]] = [None] * cfg.max_batch_size
         self._next_id = itertools.count()
@@ -95,8 +98,8 @@ class JaxLLMEngine:
             """Prefill a single request into batch row ``slot_idx``."""
             import jax.numpy as jnp
 
-            one_cache = gpt2_init_cache(mcfg, 1, cfg.max_seq_len)
-            logits, one_cache = gpt2_prefill(
+            one_cache = fam.init_cache(mcfg, 1, cfg.max_seq_len)
+            logits, one_cache = fam.prefill(
                 params, tokens[None], jnp.asarray([length]), one_cache, mcfg
             )
             cache = {
@@ -111,7 +114,7 @@ class JaxLLMEngine:
 
         self._prefill_one = jax.jit(prefill_one, donate_argnums=(1,))
         self._decode = jax.jit(
-            lambda params, cache, tokens, pos: gpt2_decode_step(
+            lambda params, cache, tokens, pos: fam.decode_step(
                 params, tokens, pos, cache, mcfg
             ),
             donate_argnums=(1,),

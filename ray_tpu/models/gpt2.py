@@ -88,7 +88,13 @@ def gpt2_init(key, cfg: GPT2Config):
 def gpt2_param_axes():
     """Logical sharding axes per parameter (leading None = layer-stack axis)."""
     return {
-        "wte": P("vocab", "embed"),
+        # NOTE: the vocab axis of the embedding table is deliberately NOT
+        # sharded: ``wte[tokens]`` gathers along it, and a vocab-sharded
+        # table forces XLA SPMD into "involuntary full rematerialization"
+        # (replicate-then-repartition) on every step.  Sharding embed over
+        # fsdp keeps the ZeRO-3 memory win; the unembedding matmul still
+        # produces vocab(model)-sharded logits by slicing.
+        "wte": P(None, "embed"),
         "wpe": P(None, "embed"),
         "blocks": {
             "ln1_g": P(None, "norm"),
@@ -118,6 +124,17 @@ def _layernorm(x, g, b, eps=1e-5):
 
 
 def _attention(q, k, v, cfg: GPT2Config, mesh):
+    if cfg.attention == "dense_remat":
+        # Dense XLA attention (fastest at moderate S on this chip: the
+        # einsum-softmax fusion runs at the matmul roofline) with
+        # ``jax.checkpoint`` so the [B,H,S,S] probs are recomputed in
+        # backward instead of stored — flash-attention's memory profile at
+        # dense-attention speed.  Long S still wants the Pallas kernel.
+        from ..ops.attention import reference_attention
+
+        return jax.checkpoint(
+            lambda q, k, v: reference_attention(q, k, v, causal=True)
+        )(q, k, v)
     if cfg.attention == "flash":
         from ..ops.attention import flash_attention
 
@@ -157,12 +174,19 @@ def _block(x, layer, cfg: GPT2Config, mesh):
     return wlc(x, P("batch", "seq", "act_embed"), mesh)
 
 
-def gpt2_apply(params, tokens, cfg: GPT2Config, mesh=None):
-    """tokens: [B, S] int32 → logits [B, S, V]."""
+def gpt2_hidden(params, tokens, cfg: GPT2Config, mesh=None):
+    """tokens: [B, S] int32 → final layernormed hidden states [B, S, E]."""
     from ..parallel.sharding import with_logical_constraint as wlc
 
     b, s = tokens.shape
-    x = params["wte"][tokens] + params["wpe"][:s][None]
+    # Gather from an explicitly replicated view of the table: the ZeRO-3
+    # all-gather of wte happens as one clean collective, the token gather
+    # then has a replicated operand and output, and the batch/seq constraint
+    # below is a free slice.  Gathering from the fsdp-sharded table instead
+    # makes SPMD reshard the gather output embed→batch, which it can only do
+    # by full rematerialization (round-1 MULTICHIP finding).
+    wte = wlc(params["wte"], P(None, "act_embed"), mesh)
+    x = wte[tokens] + params["wpe"][:s][None]
     x = wlc(x, P("batch", "seq", "act_embed"), mesh)
 
     block = functools.partial(_block, cfg=cfg, mesh=mesh)
@@ -173,18 +197,69 @@ def gpt2_apply(params, tokens, cfg: GPT2Config, mesh=None):
         return block(x, layer), None
 
     x, _ = jax.lax.scan(scan_body, x, params["blocks"])
-    x = _layernorm(x, params["lnf_g"], params["lnf_b"])
+    return _layernorm(x, params["lnf_g"], params["lnf_b"])
+
+
+def gpt2_apply(params, tokens, cfg: GPT2Config, mesh=None):
+    """tokens: [B, S] int32 → logits [B, S, V]."""
+    from ..parallel.sharding import with_logical_constraint as wlc
+
+    x = gpt2_hidden(params, tokens, cfg, mesh)
     logits = jnp.einsum("bse,ve->bsv", x, params["wte"])
     return wlc(logits, P("batch", "seq", "vocab"), mesh)
 
 
-def gpt2_loss(params, tokens, cfg: GPT2Config, mesh=None, z_loss: float = 0.0):
-    """Next-token cross-entropy.  tokens: [B, S+1] (inputs = [:, :-1])."""
-    inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    logits = gpt2_apply(params, inputs, cfg, mesh).astype(jnp.float32)
-    logz = jax.nn.logsumexp(logits, axis=-1)
+def _ce_from_logits(logits, targets, z_loss: float):
+    """Summed (not mean) next-token NLL with f32 reduction arithmetic fused
+    into the bf16 logits (no f32 [.., V] materialization)."""
+    logz = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
     gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    nll = (logz - gold).mean()
+    nll = (logz - gold.astype(jnp.float32)).sum()
     if z_loss > 0:
-        nll = nll + z_loss * (logz ** 2).mean()
+        nll = nll + z_loss * (logz ** 2).sum()
     return nll
+
+
+def gpt2_loss(
+    params, tokens, cfg: GPT2Config, mesh=None, z_loss: float = 0.0,
+    ce_chunks: int = 0,
+):
+    """Next-token cross-entropy.  tokens: [B, S+1] (inputs = [:, :-1]).
+
+    ``ce_chunks > 0`` evaluates the unembedding + CE in that many
+    rematerialized sequence chunks: peak memory holds one [B, S/c, V]
+    logits block instead of [B, S, V] (the classic blockwise-CE recipe;
+    the unembed matmul is recomputed chunkwise in backward).  This is what
+    lets the single-chip train batch double on a 16G-HBM chip.
+    """
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    x = gpt2_hidden(params, inputs, cfg, mesh)
+    b, s, e = x.shape
+    if ce_chunks > 1 and s % ce_chunks != 0:
+        raise ValueError(
+            f"ce_chunks={ce_chunks} must divide the sequence length {s} "
+            "(silently falling back would materialize the full [B,S,V] "
+            "logits the caller asked to avoid)"
+        )
+    if ce_chunks <= 1:
+        logits = jnp.einsum("bse,ve->bsv", x, params["wte"])
+        from ..parallel.sharding import with_logical_constraint as wlc
+
+        logits = wlc(logits, P("batch", "seq", "vocab"), mesh)
+        return _ce_from_logits(logits, targets, z_loss) / (b * s)
+
+    c = s // ce_chunks
+    xs = x.reshape(b, ce_chunks, c, e).swapaxes(0, 1)  # [n, B, C, E]
+    ts = targets.reshape(b, ce_chunks, c).swapaxes(0, 1)
+
+    @jax.checkpoint
+    def chunk_nll(wte, x_c, t_c):
+        logits = jnp.einsum("bce,ve->bcv", x_c, wte)
+        return _ce_from_logits(logits, t_c, z_loss)
+
+    def body(acc, xt):
+        x_c, t_c = xt
+        return acc + chunk_nll(params["wte"], x_c, t_c), None
+
+    total, _ = jax.lax.scan(body, jnp.float32(0.0), (xs, ts))
+    return total / (b * s)

@@ -3,14 +3,23 @@
 The serving analog of the training forward in ``gpt2.py`` (reference role:
 the model runner inside the vLLM engine the reference wraps, ray
 ``python/ray/llm/_internal/serve/engines/vllm/``).  TPU-first decisions:
-  - the KV cache is a pair of layer-stacked arrays ``[L, B, S_max, H, D]``
-    living in HBM across steps; decode updates them with
-    ``dynamic_update_slice`` (XLA keeps the update in place under jit
-    donation);
-  - both phases scan over the layer axis (one compile regardless of depth);
+
+  - the KV cache is a pair of layer-stacked **head-major** arrays
+    ``[L, B, H, T_max, D]`` living in HBM across steps — this layout means
+    neither prefill writes, decode reads, nor the decode-attention kernel
+    ever transpose the cache on the hot path;
+  - cache writes are **deferred**: each layer's current-token k/v is merged
+    into attention analytically (``k_self``/``v_self`` in
+    ``ops/decode_attention.py``) and all 2L writes collapse into one
+    batched ``write_token_to_cache`` at the end of the step — TPU scatters
+    with multiple index dims lower pathologically (~1 ms each), so this is
+    worth ~20 ms/step at L=12 (round-1 design: 36 ms/step; this: 20.5 ms
+    at B=32, T=1024 on the v5e-lite part, whose effective HBM bandwidth of
+    ~40-60 GB/s — not compute — is the decode floor);
   - per-slot positions make the batch *ragged*: each sequence attends only
-    to its own ``[0, pos]`` prefix, so one jitted decode step serves a
-    continuous batch of requests at different generation offsets.
+    to its own ``[0, pos]`` prefix;
+  - the layer loop is a Python loop (static layer indices; L compile-time
+    bodies are fine for decoders).
 """
 
 from __future__ import annotations
@@ -25,7 +34,7 @@ from .gpt2 import GPT2Config, _layernorm
 
 
 def gpt2_init_cache(cfg: GPT2Config, batch: int, max_len: int):
-    shape = (cfg.n_layer, batch, max_len, cfg.n_head, cfg.head_dim)
+    shape = (cfg.n_layer, batch, cfg.n_head, max_len, cfg.head_dim)
     dt = jnp.dtype(cfg.dtype)
     return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
@@ -36,7 +45,7 @@ def _qkv(x, layer):
 
 
 def _masked_attention(q, k, v, mask):
-    """q [B,S,H,D] over k/v [B,T,H,D] with additive bool mask [B,S,T]."""
+    """q [B,S,H,D] over k/v [B,S,H,D] with bool mask [B,S,S]."""
     scores = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32)
     scores = scores / (q.shape[-1] ** 0.5)
     scores = jnp.where(mask[:, None], scores, -1e30)
@@ -72,13 +81,12 @@ def gpt2_prefill(
         return x, (k, v)
 
     x, (ks, vs) = jax.lax.scan(body, x, params["blocks"])
+    # ks/vs: [L, B, S, H, D] → head-major [L, B, H, S, D].
+    ks = ks.transpose(0, 1, 3, 2, 4).astype(cache["k"].dtype)
+    vs = vs.transpose(0, 1, 3, 2, 4).astype(cache["v"].dtype)
     cache = {
-        "k": jax.lax.dynamic_update_slice(
-            cache["k"], ks.astype(cache["k"].dtype), (0, 0, 0, 0, 0)
-        ),
-        "v": jax.lax.dynamic_update_slice(
-            cache["v"], vs.astype(cache["v"].dtype), (0, 0, 0, 0, 0)
-        ),
+        "k": jax.lax.dynamic_update_slice(cache["k"], ks, (0, 0, 0, 0, 0)),
+        "v": jax.lax.dynamic_update_slice(cache["v"], vs, (0, 0, 0, 0, 0)),
     }
     x = _layernorm(x, params["lnf_g"], params["lnf_b"])
     last = jnp.take_along_axis(
@@ -89,46 +97,59 @@ def gpt2_prefill(
 
 
 def gpt2_decode_step(
-    params, tokens, pos, cache, cfg: GPT2Config
+    params, tokens, pos, cache, cfg: GPT2Config, *, kernel: bool = False
 ) -> Tuple[jnp.ndarray, dict]:
     """One generation step for a ragged batch.
 
     tokens: [B] the most recent token per slot; pos: [B] its position.
     Writes k/v at ``pos`` and attends each slot to its own ``[0, pos]``.
     Returns (logits [B, V], updated cache).
-    """
-    b = tokens.shape[0]
-    t_max = cache["k"].shape[2]
-    x = params["wte"][tokens] + params["wpe"][pos]
-    x = x.astype(jnp.dtype(cfg.dtype))[:, None]  # [B, 1, E]
-    # [B, 1, T] — slot b attends to cache positions <= pos[b].
-    mask = (jnp.arange(t_max)[None] <= pos[:, None])[:, None]
-    batch_idx = jnp.arange(b)
 
-    def body(x, inputs):
-        layer, k_l, v_l = inputs
+    ``kernel=False`` (default) uses the XLA decode attention: on the
+    bandwidth-limited v5e-lite part the fused einsum path measures 20.5 ms
+    vs 29 ms for the Pallas kernel at B=32/T=1024 (the kernel's per-program
+    full-T block copies can't ride the ~40 GB/s effective HBM).  The kernel
+    remains the right call on full-bandwidth parts / long caches.
+    """
+    from ..ops.decode_attention import decode_attention
+
+    b = tokens.shape[0]
+    x = params["wte"][tokens] + params["wpe"][pos]
+    x = x.astype(jnp.dtype(cfg.dtype))  # [B, E]
+    ck, cv = cache["k"], cache["v"]
+    new_ks, new_vs = [], []
+
+    for l in range(cfg.n_layer):
+        layer = jax.tree.map(lambda a: a[l], params["blocks"])
         y = _layernorm(x, layer["ln1_g"], layer["ln1_b"])
-        q, k, v = _qkv(y, layer)  # [B, 1, H, D]
-        k_l = k_l.at[batch_idx, pos].set(k[:, 0].astype(k_l.dtype))
-        v_l = v_l.at[batch_idx, pos].set(v[:, 0].astype(v_l.dtype))
-        o = _masked_attention(q, k_l, v_l, mask)
+        qkv = jnp.einsum("be,ethd->bthd", y, layer["wqkv"]) + layer["bqkv"]
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # [B, H, D]
+        new_ks.append(k.astype(ck.dtype))
+        new_vs.append(v.astype(cv.dtype))
+        # Deferred-scatter protocol: the cache holds [0, pos-1]; the current
+        # token's k/v are merged in-kernel (one batched cache write below
+        # replaces 2L per-layer scatters — TPU scatters cost ~1 ms each).
+        o = decode_attention(
+            q, ck, cv, pos, l, k_self=new_ks[-1], v_self=new_vs[-1],
+            kernel=kernel,
+        )  # [B, H, D]
         x = x + (
-            jnp.einsum("bshd,hde->bse", o, layer["wo"]) + layer["bo"]
+            jnp.einsum("bhd,hde->be", o.astype(y.dtype), layer["wo"])
+            + layer["bo"]
         ).astype(x.dtype)
         y = _layernorm(x, layer["ln2_g"], layer["ln2_b"])
-        h = jax.nn.gelu(jnp.einsum("bse,ef->bsf", y, layer["wi"]) + layer["bi"])
+        h = jax.nn.gelu(jnp.einsum("be,ef->bf", y, layer["wi"]) + layer["bi"])
         x = x + (
-            jnp.einsum("bsf,fe->bse", h, layer["wo2"]) + layer["bo2"]
+            jnp.einsum("bf,fe->be", h, layer["wo2"]) + layer["bo2"]
         ).astype(x.dtype)
-        return x, (k_l, v_l)
 
-    x, (ks, vs) = jax.lax.scan(
-        body, x, (params["blocks"], cache["k"], cache["v"])
-    )
-    cache = {"k": ks, "v": vs}
-    x = _layernorm(x[:, 0], params["lnf_g"], params["lnf_b"])
+    from ..ops.decode_attention import write_token_to_cache
+
+    ck = write_token_to_cache(ck, jnp.stack(new_ks), pos)
+    cv = write_token_to_cache(cv, jnp.stack(new_vs), pos)
+    x = _layernorm(x, params["lnf_g"], params["lnf_b"])
     logits = jnp.einsum("be,ve->bv", x, params["wte"])
-    return logits.astype(jnp.float32), cache
+    return logits.astype(jnp.float32), {"k": ck, "v": cv}
 
 
 def sample_logits(logits, key, temperature, top_k: int = 0, top_p: float = 1.0):

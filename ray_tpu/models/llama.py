@@ -98,7 +98,11 @@ def llama_init(key, cfg: LlamaConfig):
 def llama_param_axes():
     """Logical sharding axes (leading None = layer-stack axis)."""
     return {
-        "wte": P("vocab", "embed"),
+        # vocab axis unsharded: the token gather along a vocab-sharded table
+        # forces SPMD full rematerialization (see gpt2.py:gpt2_param_axes).
+        # lm_head keeps its vocab sharding — it is only ever contracted over
+        # embed, producing vocab-sharded logits with no gather.
+        "wte": P(None, "embed"),
         "blocks": {
             "rms1": P(None, "norm"),
             "wq": P(None, "embed", "heads", "kv"),
@@ -188,7 +192,9 @@ def llama_apply(params, tokens, cfg: LlamaConfig, mesh=None):
     from ..parallel.sharding import with_logical_constraint as wlc
 
     b, s = tokens.shape
-    x = params["wte"][tokens].astype(jnp.dtype(cfg.dtype))
+    # Replicated-view gather — see gpt2.gpt2_apply for the SPMD rationale.
+    wte = wlc(params["wte"], P(None, "act_embed"), mesh)
+    x = wte[tokens].astype(jnp.dtype(cfg.dtype))
     x = wlc(x, P("batch", "seq", "act_embed"), mesh)
     positions = jnp.arange(s, dtype=jnp.int32)
 

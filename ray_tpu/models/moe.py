@@ -97,7 +97,9 @@ def moe_init(key, cfg: MoEConfig):
 
 def moe_param_axes():
     return {
-        "wte": P("vocab", "embed"),
+        # vocab axis unsharded — a vocab-sharded table under the token
+        # gather forces SPMD full rematerialization (see gpt2.py).
+        "wte": P(None, "embed"),
         "wpe": P(None, "embed"),
         "blocks": {
             "ln1_g": P(None, "norm"),
@@ -213,7 +215,9 @@ def moe_apply(params, tokens, cfg: MoEConfig, mesh=None):
     from ..parallel.sharding import with_logical_constraint as wlc
 
     b, s = tokens.shape
-    x = params["wte"][tokens] + params["wpe"][:s][None]
+    # Replicated-view gather — see gpt2.gpt2_apply for the SPMD rationale.
+    wte = wlc(params["wte"], P(None, "act_embed"), mesh)
+    x = wte[tokens] + params["wpe"][:s][None]
     x = wlc(x, P("batch", "seq", "act_embed"), mesh)
 
     block = functools.partial(_block, cfg=cfg, mesh=mesh)

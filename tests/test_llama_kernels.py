@@ -113,43 +113,87 @@ class TestLlama:
 
 
 class TestDecodeAttention:
-    def _data(self, b=3, t=64, h=4, d=16, dtype=jnp.float32):
-        keys = jax.random.split(jax.random.PRNGKey(0), 4)
+    def _data(self, b=3, t=64, h=4, hkv=None, d=16, layers=2,
+              dtype=jnp.float32):
+        hkv = hkv if hkv is not None else h
+        keys = jax.random.split(jax.random.PRNGKey(0), 6)
         q = jax.random.normal(keys[0], (b, h, d), dtype)
-        k = jax.random.normal(keys[1], (b, t, h, d), dtype)
-        v = jax.random.normal(keys[2], (b, t, h, d), dtype)
+        k = jax.random.normal(keys[1], (layers, b, hkv, t, d), dtype)
+        v = jax.random.normal(keys[2], (layers, b, hkv, t, d), dtype)
+        ks = jax.random.normal(keys[3], (b, hkv, d), dtype)
+        vs = jax.random.normal(keys[4], (b, hkv, d), dtype)
         pos = jnp.array([5, 31, 63], jnp.int32)[:b]
-        return q, k, v, pos
+        return q, k, v, ks, vs, pos
 
     def test_kernel_matches_reference(self):
-        q, k, v, pos = self._data()
-        ref = reference_decode_attention(q, k, v, pos)
+        q, k, v, ks, vs, pos = self._data()
+        for layer in (0, 1):
+            ref = reference_decode_attention(q, k, v, pos, layer, ks, vs)
+            out = decode_attention(
+                q, k, v, pos, layer, k_self=ks, v_self=vs, block_t=16,
+                kernel=True, interpret=True,
+            )
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       atol=1e-5, rtol=1e-5)
+
+    def test_gqa_grouped_heads(self):
+        q, k, v, ks, vs, pos = self._data(h=4, hkv=2)
+        ref = reference_decode_attention(q, k, v, pos, 0, ks, vs)
         out = decode_attention(
-            q, k, v, pos, block_t=16, kernel=True, interpret=True
+            q, k, v, pos, 0, k_self=ks, v_self=vs, block_t=16, kernel=True,
+            interpret=True,
         )
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=1e-5, rtol=1e-5)
 
+    def test_self_vs_prewritten_cache_agree(self):
+        """Deferred-scatter form == attending a cache with the current
+        token already written at pos."""
+        q, k, v, ks, vs, pos = self._data(b=3)
+        bidx = jnp.arange(3)
+        k_written = k.at[0, bidx, :, pos].set(ks)
+        v_written = v.at[0, bidx, :, pos].set(vs)
+        a = reference_decode_attention(q, k_written, v_written, pos, 0)
+        b_ = reference_decode_attention(q, k, v, pos, 0, ks, vs)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=1e-5)
+
     def test_ragged_positions_masked(self):
-        """Entries past pos must not affect the output."""
-        q, k, v, pos = self._data()
-        k_poisoned = k.at[:, 40:].set(1e4)
-        v_poisoned = v.at[:, 40:].set(1e4)
+        """Cache entries at or past pos must not affect the output."""
+        q, k, v, ks, vs, _ = self._data()
+        pos = jnp.array([5, 20, 39])
+        k_poisoned = k.at[:, :, :, 39:].set(1e4)
+        v_poisoned = v.at[:, :, :, 39:].set(1e4)
         out_a = decode_attention(
-            q, k, v, jnp.array([5, 20, 39]), block_t=16, interpret=True
+            q, k, v, pos, 0, k_self=ks, v_self=vs, block_t=16,
+            interpret=True,
         )
         out_b = decode_attention(
-            q, k_poisoned, v_poisoned, jnp.array([5, 20, 39]),
+            q, k_poisoned, v_poisoned, pos, 0, k_self=ks, v_self=vs,
             block_t=16, interpret=True,
         )
         np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_b),
                                    atol=1e-5)
 
-    def test_bf16_inputs(self):
-        q, k, v, pos = self._data(dtype=jnp.bfloat16)
-        ref = reference_decode_attention(q, k, v, pos)
+    def test_pos_zero_attends_only_self(self):
+        """Empty prefix: output is exactly v_self per head group."""
+        q, k, v, ks, vs, _ = self._data(b=3)
+        pos = jnp.zeros((3,), jnp.int32)
         out = decode_attention(
-            q, k, v, pos, block_t=32, kernel=True, interpret=True
+            q, k, v, pos, 0, k_self=ks, v_self=vs, block_t=16,
+            interpret=True,
+        )
+        expect = jnp.broadcast_to(
+            vs[:, :, None, :], (3, 4, 1, 16)
+        ).reshape(3, 4, 16)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                                   atol=1e-5)
+
+    def test_bf16_inputs(self):
+        q, k, v, ks, vs, pos = self._data(dtype=jnp.bfloat16)
+        ref = reference_decode_attention(q, k, v, pos, 0, ks, vs)
+        out = decode_attention(
+            q, k, v, pos, 0, k_self=ks, v_self=vs, block_t=32, kernel=True,
+            interpret=True,
         )
         np.testing.assert_allclose(
             np.asarray(out, np.float32), np.asarray(ref, np.float32),
@@ -157,9 +201,9 @@ class TestDecodeAttention:
         )
 
     def test_non_divisible_t_falls_back(self):
-        q, k, v, pos = self._data(t=60)
-        ref = reference_decode_attention(q, k, v, pos)
-        out = decode_attention(q, k, v, pos, block_t=16, kernel=True,
-                               interpret=True)
+        q, k, v, ks, vs, pos = self._data(t=60)
+        ref = reference_decode_attention(q, k, v, pos, 0, ks, vs)
+        out = decode_attention(q, k, v, pos, 0, k_self=ks, v_self=vs,
+                               block_t=16, kernel=True, interpret=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=1e-5)

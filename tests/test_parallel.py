@@ -93,6 +93,8 @@ class TestFlashAttention:
         rq, rk, rv = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
         np.testing.assert_allclose(np.asarray(gq), np.asarray(rq), rtol=1e-3,
                                    atol=1e-3)
+        np.testing.assert_allclose(np.asarray(gk), np.asarray(rk), rtol=1e-3,
+                                   atol=1e-3)
         np.testing.assert_allclose(np.asarray(gv), np.asarray(rv), rtol=1e-3,
                                    atol=1e-3)
 
