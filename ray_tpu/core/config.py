@@ -34,6 +34,7 @@ _KNOBS: Dict[str, tuple] = {
     "rpc_max_retries": (int, 8, "Retryable RPC attempts"),
     "testing_rpc_failure": (str, "", "Chaos spec: 'method:prob_req:prob_resp,…'"),
     # -- control plane --
+    "cp_persistence": (int, 1, "Durable sqlite control-plane tables (restart FT)"),
     "health_check_period_s": (float, 1.0, "Agent heartbeat period"),
     "health_check_timeout_s": (float, 10.0, "Mark node dead after this long"),
     "resource_sync_period_s": (float, 0.2, "Resource view gossip period"),
@@ -45,6 +46,8 @@ _KNOBS: Dict[str, tuple] = {
     "max_tasks_in_flight_per_worker": (int, 10, "Pipelined pushes per leased worker"),
     # -- object store --
     "max_inline_object_bytes": (int, 100 * 1024, "Inline small objects in RPCs"),
+    "lineage_pinning": (int, 1, "Pin task args while returns live (reconstruction)"),
+    "max_object_reconstructions": (int, 3, "Lineage re-execution attempts per get"),
     "object_store_memory_bytes": (int, 2 * 1024**3, "Per-node shm budget"),
     "object_chunk_bytes": (int, 5 * 1024 * 1024, "Chunk size for node-to-node transfer"),
     "memory_store_fallback_bytes": (int, 512 * 1024 * 1024, "In-process store budget"),

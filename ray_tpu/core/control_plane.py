@@ -12,8 +12,14 @@ One process per cluster (Ray ``src/ray/gcs/gcs_server.h``).  Owns:
   - the authoritative eventually-consistent resource view (ray_syncer analog:
     agents push snapshots on every heartbeat).
 
-Storage is in-memory (the reference's default); a Redis-backed StoreClient
-can be slotted behind ``_kv`` later for control-plane HA.
+Storage is pluggable (``store_client.py``, the reference's
+``gcs/store_client/`` hierarchy): in-memory, or an embedded sqlite journal
+under the session directory for restart fault tolerance.  With the durable
+store, the KV, actor, placement-group and job tables survive a
+control-plane crash: the restarted process reloads them, node agents
+re-register on their next heartbeat ("reregister" reply), drivers likewise,
+and pending actors/PGs resume scheduling — the
+``test_gcs_fault_tolerance.py`` story without the external Redis.
 """
 
 from __future__ import annotations
@@ -21,6 +27,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import logging
+import pickle
 import time
 from typing import Dict, List, Optional, Set
 
@@ -29,6 +36,7 @@ from .ids import ActorID, JobID, NodeID, PlacementGroupID
 from .resources import ResourceSet
 from .rpc import ClientPool, RpcServer, ServerConnection
 from .scheduler import ClusterScheduler, InfeasibleError
+from .store_client import make_store_client
 from .task_events import TaskEventStore
 from .task_spec import ActorSpec
 
@@ -92,7 +100,8 @@ class PlacementGroupEntry:
 
 
 class ControlPlane:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0, session_id: str = ""):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 session_id: str = "", store_path: Optional[str] = None):
         self.session_id = session_id
         self.server = RpcServer(self, host, port)
         self.scheduler = ClusterScheduler()
@@ -111,6 +120,130 @@ class ControlPlane:
         self.task_event_store = TaskEventStore()
         self._requested_resources: List[dict] = []
         self._recent_unplaceable: List[tuple] = []  # (monotonic ts, resources)
+        self.store = make_store_client(store_path)
+        self._recovered = self._recover()
+        # Grace window after a recovery: ALIVE actors whose node never
+        # re-registers are declared dead only after agents have had a full
+        # health-check timeout to reconnect.
+        self._recovery_deadline = (
+            time.monotonic() + GlobalConfig.health_check_timeout_s
+            if self._recovered
+            else None
+        )
+
+    # ----------------------------------------------------------- persistence
+    _KV_SEP = "\x00"
+
+    def _persist_kv(self, namespace: str, key: str, value,
+                    delete: bool = False) -> None:
+        if not self.store.durable:
+            return
+        # KV values are arbitrary picklable objects (the job SDK stores
+        # dicts), not only bytes — pickle for the blob store.
+        skey = namespace + self._KV_SEP + key
+        if delete:
+            self.store.delete("kv", skey)
+        else:
+            self.store.put("kv", skey, pickle.dumps(value))
+
+    def _persist_actor(self, entry: ActorEntry) -> None:
+        if not self.store.durable:
+            return
+        self.store.put(
+            "actors",
+            entry.spec.actor_id.hex(),
+            pickle.dumps(
+                {
+                    "spec": entry.spec,
+                    "state": entry.state,
+                    "address": entry.address,
+                    "node_id": entry.node_id,
+                    "num_restarts": entry.num_restarts,
+                    "incarnation": entry.incarnation,
+                    "death_cause": entry.death_cause,
+                }
+            ),
+        )
+
+    def _persist_pg(self, entry: PlacementGroupEntry) -> None:
+        if not self.store.durable:
+            return
+        self.store.put(
+            "pgs",
+            entry.pg_id.hex(),
+            pickle.dumps(
+                {
+                    "pg_id": entry.pg_id,
+                    "bundles": entry.bundles,
+                    "strategy": entry.strategy,
+                    "name": entry.name,
+                    "state": entry.state,
+                    "bundle_nodes": entry.bundle_nodes,
+                }
+            ),
+        )
+
+    def _persist_job(self, job_id: JobID) -> None:
+        if not self.store.durable:
+            return
+        job = self.jobs[job_id]
+        self.store.put(
+            "jobs",
+            job_id.hex(),
+            pickle.dumps(
+                {k: v for k, v in job.items() if k != "last_heartbeat"}
+            ),
+        )
+
+    def _recover(self) -> bool:
+        """Rebuild in-memory state from the durable store (no-op for the
+        in-memory backend).  Returns True if anything was loaded."""
+        loaded = False
+        for skey, value in self.store.scan("kv"):
+            ns, key = skey.split(self._KV_SEP, 1)
+            self._kv.setdefault(ns, {})[key] = pickle.loads(value)
+            loaded = True
+        for _key, blob in self.store.scan("actors"):
+            d = pickle.loads(blob)
+            entry = ActorEntry(d["spec"])
+            entry.state = d["state"]
+            entry.address = d["address"]
+            entry.node_id = d["node_id"]
+            entry.num_restarts = d["num_restarts"]
+            entry.incarnation = d["incarnation"]
+            entry.death_cause = d["death_cause"]
+            self.actors[entry.spec.actor_id] = entry
+            if entry.spec.name is not None and entry.state != DEAD:
+                self.named_actors[(entry.spec.namespace, entry.spec.name)] = (
+                    entry.spec.actor_id
+                )
+            if entry.state in (PENDING_CREATION, RESTARTING):
+                self._pending_actors.append(entry.spec.actor_id)
+            loaded = True
+        for _key, blob in self.store.scan("pgs"):
+            d = pickle.loads(blob)
+            entry = PlacementGroupEntry(
+                d["pg_id"], d["bundles"], d["strategy"], d["name"]
+            )
+            entry.state = d["state"]
+            entry.bundle_nodes = d["bundle_nodes"]
+            self.placement_groups[entry.pg_id] = entry
+            if entry.state == "PENDING":
+                self._pending_pgs.append(entry.pg_id)
+            loaded = True
+        now = time.monotonic()
+        for key, blob in self.store.scan("jobs"):
+            job = pickle.loads(blob)
+            job["last_heartbeat"] = now  # grace: drivers re-heartbeat soon
+            self.jobs[JobID.from_hex(key)] = job
+            loaded = True
+        if loaded:
+            logger.info(
+                "recovered state: %d actors, %d pgs, %d jobs, %d kv ns",
+                len(self.actors), len(self.placement_groups), len(self.jobs),
+                len(self._kv),
+            )
+        return loaded
 
     # ------------------------------------------------------------- lifecycle
     async def start(self) -> str:
@@ -125,6 +258,7 @@ class ControlPlane:
             t.cancel()
         await self.server.stop()
         await self.agent_clients.close_all()
+        self.store.close()
 
     # ---------------------------------------------------------------- pubsub
     def _publish(self, channel: str, message: dict):
@@ -209,12 +343,28 @@ class ControlPlane:
             for node_id, entry in list(self.nodes.items()):
                 if entry.alive and now - entry.last_heartbeat > timeout:
                     await self._on_node_dead(node_id)
+            if (
+                self._recovery_deadline is not None
+                and now > self._recovery_deadline
+            ):
+                # Post-recovery reconciliation: ALIVE actors whose node
+                # never re-registered are on lost nodes.
+                self._recovery_deadline = None
+                for actor_id, a in list(self.actors.items()):
+                    if a.state == ALIVE and (
+                        a.node_id not in self.nodes
+                        or not self.nodes[a.node_id].alive
+                    ):
+                        await self._on_actor_worker_died(
+                            actor_id, "node lost across control-plane restart"
+                        )
             for job_id, job in list(self.jobs.items()):
                 if (
                     job["state"] == "RUNNING"
                     and now - job.get("last_heartbeat", now) > timeout
                 ):
                     job["state"] = "FINISHED"
+                    self._persist_job(job_id)
                     logger.info("job %s lost its driver; cleaning up",
                                 job_id.hex())
                     await self._cleanup_job(job_id)
@@ -239,6 +389,9 @@ class ControlPlane:
         if not overwrite and payload["key"] in ns:
             return False
         ns[payload["key"]] = payload["value"]
+        self._persist_kv(
+            payload.get("namespace", ""), payload["key"], payload["value"]
+        )
         return True
 
     def handle_kv_get(self, payload, conn):
@@ -246,7 +399,12 @@ class ControlPlane:
 
     def handle_kv_del(self, payload, conn):
         ns = self._kv.get(payload.get("namespace", ""), {})
-        return ns.pop(payload["key"], None) is not None
+        existed = ns.pop(payload["key"], None) is not None
+        if existed:
+            self._persist_kv(
+                payload.get("namespace", ""), payload["key"], None, delete=True
+            )
+        return existed
 
     def handle_kv_keys(self, payload, conn):
         ns = self._kv.get(payload.get("namespace", ""), {})
@@ -266,6 +424,7 @@ class ControlPlane:
             "last_heartbeat": time.monotonic(),
         }
         conn.metadata["job_id"] = job_id
+        self._persist_job(job_id)
         return {"ok": True, "session_id": self.session_id}
 
     def handle_job_heartbeat(self, payload, conn):
@@ -295,6 +454,7 @@ class ControlPlane:
             self.named_actors[key] = spec.actor_id
         entry = ActorEntry(spec)
         self.actors[spec.actor_id] = entry
+        self._persist_actor(entry)
         await self._try_schedule_actor(entry)
         return entry.public_info()
 
@@ -381,6 +541,8 @@ class ControlPlane:
         self._publish_actor(entry)
 
     def _publish_actor(self, entry: ActorEntry):
+        # Every actor state transition publishes — persist at the same spot.
+        self._persist_actor(entry)
         self._publish("actor:" + entry.spec.actor_id.hex(), entry.public_info())
 
     def handle_get_actor_info(self, payload, conn):
@@ -470,6 +632,7 @@ class ControlPlane:
             pg_id, payload["bundles"], payload["strategy"], payload.get("name", "")
         )
         self.placement_groups[pg_id] = entry
+        self._persist_pg(entry)
         await self._try_schedule_pg(entry)
         return entry.public_info()
 
@@ -519,6 +682,7 @@ class ControlPlane:
             await client.call("commit_bundles", {"pg_id": entry.pg_id})
         entry.bundle_nodes = list(assignment)
         entry.state = "CREATED"
+        self._persist_pg(entry)
         self._publish("pg:" + entry.pg_id.hex(), entry.public_info())
 
     async def handle_remove_placement_group(self, payload, conn):
@@ -536,6 +700,7 @@ class ControlPlane:
                 except Exception:
                     pass
         entry.state = "REMOVED"
+        self._persist_pg(entry)
         if payload["pg_id"] in self._pending_pgs:
             self._pending_pgs.remove(payload["pg_id"])
         self._publish("pg:" + entry.pg_id.hex(), entry.public_info())
@@ -740,6 +905,7 @@ def main():
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, required=True)
     parser.add_argument("--session-id", required=True)
+    parser.add_argument("--store-path", default=None)
     args = parser.parse_args()
     logging.basicConfig(
         level=GlobalConfig.log_level,
@@ -747,7 +913,9 @@ def main():
     )
 
     async def run():
-        cp = ControlPlane(args.host, args.port, args.session_id)
+        cp = ControlPlane(
+            args.host, args.port, args.session_id, store_path=args.store_path
+        )
         await cp.start()
         await asyncio.Event().wait()  # serve forever
 

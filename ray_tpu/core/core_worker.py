@@ -127,6 +127,9 @@ class _LeasePool:
         self.leases: Dict[int, dict] = {}  # lease_id -> {addr, client, inflight}
         self.requesting = False
         self.idle_cancel: Dict[int, asyncio.TimerHandle] = {}
+        # Per-lease pipelining cap; None = the global knob.  Recovery pools
+        # pin it to 1 (see _resubmit_for_recovery).
+        self.max_inflight: Optional[int] = None
 
     def submit(self, spec: TaskSpec, attempt: int = 0):
         self.queue.put_nowait((spec, attempt))
@@ -134,7 +137,11 @@ class _LeasePool:
 
     def _pump(self):
         # Dispatch queued tasks onto leases with spare in-flight capacity.
-        max_inflight = GlobalConfig.max_tasks_in_flight_per_worker
+        max_inflight = (
+            self.max_inflight
+            if self.max_inflight is not None
+            else GlobalConfig.max_tasks_in_flight_per_worker
+        )
         while not self.queue.empty():
             lease = None
             for l in self.leases.values():
@@ -173,10 +180,21 @@ class _LeasePool:
                 "retriable": self.template.max_retries > 0,
             }
             while True:
-                reply = await agent.call(
-                    "request_lease", payload,
-                    timeout=GlobalConfig.worker_startup_timeout_s + 30,
-                )
+                try:
+                    reply = await agent.call(
+                        "request_lease", payload,
+                        timeout=GlobalConfig.worker_startup_timeout_s + 30,
+                    )
+                except RpcConnectionError:
+                    # A spillback target died before (or while) granting —
+                    # the control plane may not have noticed yet (health
+                    # timeout).  Fall back to the local agent, which will
+                    # re-pick a live node; only a dead LOCAL agent is fatal.
+                    if agent is self.worker.agent:
+                        raise
+                    agent = self.worker.agent
+                    await asyncio.sleep(0.2)
+                    continue
                 if reply.get("granted"):
                     lease = {
                         "lease_id": reply["lease_id"],
@@ -371,6 +389,11 @@ class CoreWorker:
         # enqueues only after ALL `expected` items arrived (stream notifies
         # and the task reply travel on different sockets and may reorder).
         self._streams: Dict[TaskID, dict] = {}
+        # In-flight lineage reconstructions, keyed by creating task id
+        # (reference: core_worker/object_recovery_manager.h:41 — concurrent
+        # gets of lost objects share one resubmission).
+        self._reconstructions: Dict[TaskID, asyncio.Future] = {}
+        self._recovery_waiters: Dict[TaskID, asyncio.Event] = {}
 
     # ------------------------------------------------------------- lifecycle
     async def async_start(self):
@@ -495,8 +518,10 @@ class CoreWorker:
     # ----------------------------------------------------------------- puts
     def _new_owned(self, object_id: ObjectID, lineage=None) -> OwnedObject:
         obj = OwnedObject()
-        obj.lineage = lineage
+        obj.lineage = None
         self.owned[object_id] = obj
+        if lineage is not None and GlobalConfig.lineage_pinning:
+            self._lineage_attach(obj, lineage)
         return obj
 
     async def _put_async(self, value: Any) -> ObjectRef:
@@ -557,24 +582,145 @@ class CoreWorker:
                 value = deserialize_from_bytes(obj.inline_payload)
                 self.memory_store.put(oid, value)
                 return value
-            return await self._fetch_from_locations(oid, sorted(obj.locations))
+            for attempt in range(GlobalConfig.max_object_reconstructions + 1):
+                try:
+                    return await self._fetch_from_locations(
+                        oid, sorted(obj.locations)
+                    )
+                except Exception as fetch_exc:  # noqa: BLE001 — loss shapes vary
+                    if (
+                        obj.lineage is None
+                        or attempt >= GlobalConfig.max_object_reconstructions
+                    ):
+                        if isinstance(fetch_exc, ObjectLostError):
+                            raise
+                        raise ObjectLostError(oid.hex(), str(fetch_exc))
+                    await self._reconstruct_object(oid, obj)
+                    if obj.state == ERROR:
+                        raise obj.error
+                    if obj.inline_payload is not None:
+                        value = deserialize_from_bytes(obj.inline_payload)
+                        self.memory_store.put(oid, value)
+                        return value
         # Borrowed object: resolve via the owner.
         if self.memory_store.contains(oid):
             return self.memory_store.peek(oid)
         owner = self.worker_clients.get(ref.owner_address)
-        # The owner's handler blocks until the producing task finishes, which
-        # can be arbitrarily long — don't let the default RPC deadline fire.
-        reply = await owner.call("get_object", {"object_id": oid}, timeout=86400.0)
-        kind = reply["kind"]
-        if kind == "inline":
-            value = deserialize_from_bytes(reply["payload"])
-            self.memory_store.put(oid, value)
-            return value
-        if kind == "error":
-            raise deserialize_from_bytes(reply["payload"])
-        # shm: fetch via local agent (zero-copy if already node-local)
-        value = await self._fetch_from_locations(oid, reply["locations"])
-        return value
+        lost: list = []
+        for attempt in range(GlobalConfig.max_object_reconstructions + 1):
+            # The owner's handler blocks until the producing task finishes
+            # (and reconstructs lost values) — don't let the default RPC
+            # deadline fire.
+            reply = await owner.call(
+                "get_object", {"object_id": oid, "lost_locations": lost},
+                timeout=86400.0,
+            )
+            kind = reply["kind"]
+            if kind == "inline":
+                value = deserialize_from_bytes(reply["payload"])
+                self.memory_store.put(oid, value)
+                return value
+            if kind == "error":
+                raise deserialize_from_bytes(reply["payload"])
+            try:
+                # shm: fetch via local agent (zero-copy if node-local)
+                return await self._fetch_from_locations(
+                    oid, reply["locations"]
+                )
+            except Exception as fetch_exc:  # noqa: BLE001
+                # Report the dead copies back to the owner, which prunes
+                # them and reconstructs via lineage (borrower-observed
+                # loss; reference: ownership_object_directory + recovery).
+                lost = reply["locations"]
+                if attempt >= GlobalConfig.max_object_reconstructions:
+                    raise ObjectLostError(oid.hex(), str(fetch_exc))
+        raise ObjectLostError(oid.hex(), "reconstruction attempts exhausted")
+
+    async def _reconstruct_object(self, oid: ObjectID, obj: "OwnedObject"):
+        """Re-run the creating task to rebuild a lost object (reference:
+        core_worker/object_recovery_manager.h:41 — all alternate copies are
+        gone, so resubmit via lineage).  Concurrent losses of sibling
+        return objects share one resubmission."""
+        spec = obj.lineage
+        fut = self._reconstructions.get(spec.task_id)
+        if fut is None:
+            fut = asyncio.ensure_future(self._resubmit_for_recovery(spec))
+            self._reconstructions[spec.task_id] = fut
+            fut.add_done_callback(
+                lambda _f: self._reconstructions.pop(spec.task_id, None)
+            )
+        await asyncio.shield(fut)
+        # The resubmission repopulated this object's record; wait for it.
+        target = self.owned.get(oid)
+        if target is not None:
+            await target.event.wait()
+
+    async def _resubmit_for_recovery(self, spec: TaskSpec):
+        logger.warning(
+            "reconstructing lost object(s) of task %s (%s) via lineage",
+            spec.task_id.hex()[:8], spec.name,
+        )
+        attempt = 0
+        if spec.streaming:
+            state = self._streams.get(spec.task_id)
+            if state is None:
+                self._new_stream(spec.task_id, spec)
+                state = self._streams[spec.task_id]
+                watermark = 10**12  # finished stream: every index is old
+            else:
+                watermark = state["received"]
+                self._reset_stream_for_retry(spec.task_id)
+            # Replay-for-recovery: indices the consumer already received
+            # ([0, watermark)) are recorded without new refs or enqueues;
+            # the live tail (>= watermark) streams to the consumer normally.
+            state["recovery_replay"] = True
+            state["replay_watermark"] = watermark
+            if watermark < 10**12:
+                state["received"] = watermark  # old items stay counted
+            attempt = state["attempt"]
+            # Reset every still-owned item record of this stream so getters
+            # wait for the replayed values instead of reading dead
+            # locations.
+            for robj in self.owned.values():
+                if robj.lineage is spec:
+                    robj.state = PENDING
+                    robj.error = None
+                    robj.inline_payload = None
+                    robj.locations = set()
+                    robj.event = asyncio.Event()
+        else:
+            for roid in spec.return_ids():
+                robj = self.owned.get(roid)
+                if robj is None:
+                    continue  # freed meanwhile; the task may still re-run
+                robj.state = PENDING
+                robj.error = None
+                robj.inline_payload = None
+                robj.locations = set()
+                robj.event = asyncio.Event()
+        self.task_events.record(
+            spec.task_id.hex(), spec.name, "PENDING_RECONSTRUCTION",
+            job_id_hex=spec.job_id.hex(), resources=spec.resources,
+        )
+        # Recovery submissions use a DEDICATED pool with one task per
+        # lease: a shared lease could pipeline the re-execution behind a
+        # task that is blocked waiting for this very object (observed
+        # deadlock: consume(x) holds the worker while x's producer queues
+        # behind it).  One-per-lease also keeps chained reconstructions
+        # (b needs a, a lost too) on separate workers.
+        sched_key = (spec.scheduling_class, "__recovery__")
+        pool = self.lease_pools.get(sched_key)
+        if pool is None:
+            pool = _LeasePool(self, sched_key, spec)
+            pool.max_inflight = 1
+            self.lease_pools[sched_key] = pool
+        done = asyncio.Event()
+        self._recovery_waiters[spec.task_id] = done
+        pool.submit(spec, attempt)
+        try:
+            await done.wait()
+        finally:
+            self._recovery_waiters.pop(spec.task_id, None)
 
     async def _fetch_from_locations(self, oid: ObjectID, locations: List[str]):
         if not locations:
@@ -702,6 +848,7 @@ class CoreWorker:
             if obj.state == PENDING:
                 return  # task still running; free after completion
             del self.owned[oid]
+            self._lineage_detach(obj)
             self.memory_store.free(oid)
             for agent_addr in obj.locations:
                 client = self.agent_clients.get(agent_addr)
@@ -716,13 +863,20 @@ class CoreWorker:
             pass
 
     # ------------------------------------------------- streaming (owner side)
-    def _new_stream(self, task_id: TaskID):
+    def _new_stream(self, task_id: TaskID, spec: "TaskSpec" = None):
+        if spec is not None and (
+            spec.actor_id is not None or spec.max_retries <= 0
+        ):
+            # Actor method items can't be rebuilt by a stateless re-run;
+            # non-retriable generators must not re-execute either.
+            spec = None
         self._streams[task_id] = {
             "queue": asyncio.Queue(),
             "received": 0,
             "expected": None,  # set by the task reply ("streamed": n)
             "attempt": 0,
             "pending_error": None,  # delivered after in-flight items drain
+            "spec": spec,  # lineage for reconstruction of item objects
         }
 
     def _reset_stream_for_retry(self, task_id: TaskID):
@@ -750,13 +904,34 @@ class CoreWorker:
         if payload.get("attempt", 0) != state["attempt"]:
             return  # straggler from a dead attempt
         oid = ObjectID.for_task_return(payload["task_id"], payload["index"])
+        replaying_old = (
+            state.get("recovery_replay")
+            and payload["index"] < state.get("replay_watermark", 0)
+        )
+        if replaying_old:
+            # Lineage-reconstruction replay of an index the consumer was
+            # already handed: repopulate the owned record in place — no new
+            # ref, nothing enqueued, ``received`` already counted it.  An
+            # index the consumer freed stays freed (the re-sealed shm copy
+            # is orphaned and falls to arena LRU eviction).
+            obj = self.owned.get(oid)
+            if obj is None:
+                return
+            ret = payload["ret"]
+            if ret[0] == "inline":
+                obj.inline_payload = ret[1]
+                obj.size = len(ret[1])
+            else:
+                obj.locations.add(ret[1])
+                obj.size = ret[2]
+            obj.state = READY
+            obj.error = None
+            obj.event.set()
+            self._maybe_terminate_stream(state)
+            return
         obj = self.owned.get(oid)
         if obj is None:
-            obj = self._new_owned(oid)
-        # EVERY ObjectRef handed to the consumer carries one local ref —
-        # a retry replay of an index the consumer still holds must not
-        # alias two refs onto a single count (premature free).
-        obj.local_refs += 1
+            obj = self._new_owned(oid, lineage=state.get("spec"))
         ret = payload["ret"]
         if ret[0] == "inline":
             obj.inline_payload = ret[1]
@@ -765,12 +940,17 @@ class CoreWorker:
             obj.locations.add(ret[1])
             obj.size = ret[2]
         obj.state = READY
+        obj.error = None
         obj.event.set()
+        state["received"] += 1
+        # EVERY ObjectRef handed to the consumer carries one local ref —
+        # a retry replay of an index the consumer still holds must not
+        # alias two refs onto a single count (premature free).
+        obj.local_refs += 1
         ref = ObjectRef.__new__(ObjectRef)
         ref.id = oid
         ref.owner_address = self.address
         ref._worker = self
-        state["received"] += 1
         state["queue"].put_nowait(("item", ref))
         self._maybe_terminate_stream(state)
 
@@ -842,6 +1022,28 @@ class CoreWorker:
                 ),
             }
         await obj.event.wait()
+        # Borrower-observed loss: prune the dead copies; reconstruct via
+        # lineage if no copy remains (the borrower side of
+        # object_recovery_manager.h recovery).
+        lost = payload.get("lost_locations") or ()
+        if lost:
+            obj.locations -= set(lost)
+            if (
+                not obj.locations
+                and obj.inline_payload is None
+                and obj.state == READY
+                and not self.memory_store.contains(oid)
+            ):
+                if obj.lineage is not None:
+                    try:
+                        await self._reconstruct_object(oid, obj)
+                    except Exception:  # noqa: BLE001 — surfaced below
+                        pass
+                else:
+                    obj.state = ERROR
+                    obj.error = ObjectLostError(
+                        oid.hex(), "all copies lost and no lineage"
+                    )
         if obj.state == ERROR:
             return {"kind": "error", "payload": serialize_to_bytes(obj.error)}
         if obj.inline_payload is not None:
@@ -975,12 +1177,40 @@ class CoreWorker:
                     obj.args_holds += 1
 
     def _release_args(self, spec: TaskSpec):
+        # Idempotent: the success path defers release to lineage GC while
+        # the failure path releases immediately — both may fire.
+        if getattr(spec, "_args_released", False):
+            return
+        spec._args_released = True  # type: ignore[attr-defined]
         for r in getattr(spec, "_held_refs", ()):  # type: ignore[attr-defined]
             if r.owner_address == self.address:
                 obj = self.owned.get(r.id)
                 if obj is not None:
                     obj.args_holds -= 1
                     self._maybe_free(r.id)
+
+    # ------------------------------------------------- lineage bookkeeping
+    # Lineage pinning (reference: task_manager.h:184 lineage pinning +
+    # reference_counter.cc lineage ref counting): while any return object
+    # of a task is still owned, the task's arg objects stay held so a
+    # reconstruction can re-run it.  When the last return object is freed,
+    # the args release — recursively freeing upstream lineage.
+
+    def _lineage_attach(self, obj: "OwnedObject", spec: TaskSpec):
+        obj.lineage = spec
+        spec._lineage_outstanding = (  # type: ignore[attr-defined]
+            getattr(spec, "_lineage_outstanding", 0) + 1
+        )
+
+    def _lineage_detach(self, obj: "OwnedObject"):
+        spec = obj.lineage
+        if spec is None:
+            return
+        obj.lineage = None
+        n = getattr(spec, "_lineage_outstanding", 1) - 1
+        spec._lineage_outstanding = n  # type: ignore[attr-defined]
+        if n <= 0:
+            self._release_args(spec)
 
     def submit_task(
         self,
@@ -1030,10 +1260,14 @@ class CoreWorker:
                 job_id_hex=spec.job_id.hex(),
                 resources=spec.resources,
             )
+            # Reconstruction eligibility matches the reference: only
+            # retriable tasks re-execute on object loss (a max_retries=0
+            # task may have non-idempotent side effects).
+            lineage = spec if spec.max_retries > 0 else None
             if streaming:
-                self._new_stream(spec.task_id)
+                self._new_stream(spec.task_id, lineage)
             for oid in return_ids:
-                obj = self._new_owned(oid, lineage=spec)
+                obj = self._new_owned(oid, lineage=lineage)
                 obj.local_refs += 1
             pool = self.lease_pools.get(spec.scheduling_class)
             if pool is None:
@@ -1053,7 +1287,16 @@ class CoreWorker:
         return refs
 
     def _handle_task_reply(self, spec: TaskSpec, reply: dict):
-        self._release_args(spec)
+        done = self._recovery_waiters.get(spec.task_id)
+        if done is not None:
+            done.set()
+        if (
+            not GlobalConfig.lineage_pinning
+            or getattr(spec, "_lineage_outstanding", 0) <= 0
+        ):
+            # No return object pinned this task's lineage (actor tasks,
+            # non-retriable tasks, zero-item streams): release args now.
+            self._release_args(spec)
         if reply.get("error") is not None:
             exc = deserialize_from_bytes(reply["error"])
             if reply.get("streamed") is not None:
@@ -1083,16 +1326,28 @@ class CoreWorker:
             self._maybe_free(oid)
 
     def _fail_task_returns(self, spec: TaskSpec, exc: BaseException):
-        self._release_args(spec)
+        done = self._recovery_waiters.get(spec.task_id)
+        if done is not None:
+            done.set()
         if spec.task_id in self._streams:
             self._finish_stream(spec.task_id, error=exc)
+        if spec.streaming:
+            # Item records reset by a failed reconstruction would otherwise
+            # stay PENDING forever and hang their getters.
+            for obj in self.owned.values():
+                if obj.lineage is spec and obj.state == PENDING:
+                    obj.state = ERROR
+                    obj.error = exc
+                    obj.event.set()
         for oid in spec.return_ids():
             obj = self.owned.get(oid)
             if obj is None:
                 obj = self._new_owned(oid)
+            self._lineage_detach(obj)  # an errored task is not re-runnable
             obj.state = ERROR
             obj.error = exc
             obj.event.set()
+        self._release_args(spec)
 
     # --------------------------------------------------------------- actors
     def create_actor(
@@ -1230,7 +1485,7 @@ class CoreWorker:
                 actor_id_hex=spec.actor_id.hex(),
             )
             if streaming:
-                self._new_stream(spec.task_id)
+                self._new_stream(spec.task_id, spec)
             for oid in return_ids:
                 obj = self._new_owned(oid)
                 obj.local_refs += 1

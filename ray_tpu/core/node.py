@@ -109,6 +109,9 @@ class Node:
         self.pg = ProcessGroup()
         self.cp_address = cp_address
         self.agent_address: Optional[str] = None
+        self._cp_argv: Optional[List[str]] = None
+        self._cp_log: Optional[str] = None
+        self._cp_env: Optional[dict] = None
 
         # Detection runs through the accelerator plugin registry (TPU is
         # built in; other vendors contribute by registering a manager).
@@ -141,15 +144,19 @@ class Node:
         if self.head:
             cp_port = self.port or find_free_port()
             self.cp_address = f"127.0.0.1:{cp_port}"
-            self.pg.spawn(
-                [
-                    sys.executable, "-m", "ray_tpu.core.control_plane",
-                    "--port", str(cp_port),
-                    "--session-id", self.session_id,
-                ],
-                os.path.join(self.log_dir, "control_plane.log"),
-                env,
-            )
+            self._cp_argv = [
+                sys.executable, "-m", "ray_tpu.core.control_plane",
+                "--port", str(cp_port),
+                "--session-id", self.session_id,
+            ]
+            if GlobalConfig.cp_persistence:
+                self._cp_argv += [
+                    "--store-path",
+                    os.path.join(self.log_dir, "control_plane.sqlite"),
+                ]
+            self._cp_log = os.path.join(self.log_dir, "control_plane.log")
+            self._cp_env = dict(env)
+            self.pg.spawn(self._cp_argv, self._cp_log, env)
             _wait_for_server(self.cp_address)
         assert self.cp_address
         agent_port = find_free_port()
@@ -174,6 +181,28 @@ class Node:
                     {"cp_address": self.cp_address, "session_id": self.session_id}, f
                 )
         return self
+
+    def kill_control_plane(self):
+        """Hard-kill the control-plane process (head nodes only) — the
+        GCS-crash half of the restart-FT test story."""
+        assert self.head, "control plane runs on the head node"
+        proc = self.pg.procs[0]
+        proc.kill()
+        proc.wait(timeout=10)
+
+    def restart_control_plane(self):
+        """Restart the control plane on the same port; with persistence on,
+        it reloads its tables and agents/drivers reconnect (reference:
+        python/ray/tests/test_gcs_fault_tolerance.py)."""
+        assert self.head and self._cp_argv is not None
+        proc = self.pg.procs[0]
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+        self.pg.spawn(self._cp_argv, self._cp_log, self._cp_env)
+        # The new process replaces slot 0 so kill ordering stays stable.
+        self.pg.procs[0] = self.pg.procs.pop()
+        _wait_for_server(self.cp_address)
 
     def stop(self):
         self.pg.kill_all()

@@ -21,7 +21,11 @@ _PREFIX = "rtpu"
 
 
 def segment_name(session_id: str, object_hex: str) -> str:
-    return f"{_PREFIX}_{session_id}_{object_hex[:24]}"
+    # FULL 32-char object hex: the id's last 4 bytes are the return-object
+    # index — truncating them collapses all return/stream objects of one
+    # task onto a single segment file (observed: stream-item replay wrote
+    # three items into one file, every read saw the last value).
+    return f"{_PREFIX}_{session_id}_{object_hex}"
 
 
 def _path(name: str) -> str:

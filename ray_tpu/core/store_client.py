@@ -1,0 +1,113 @@
+"""Pluggable control-plane metadata storage.
+
+Equivalent of the reference's GCS ``StoreClient`` hierarchy
+(ray ``src/ray/gcs/store_client/store_client.h``: in-memory default,
+``redis_store_client.h:126`` for HA) behind the same two-method surface the
+GCS table storage uses (``gcs/gcs_table_storage.h:200``).  TPU-native
+redesign: instead of an external Redis, the durable backend is an embedded
+sqlite journal under the session directory — one file, crash-atomic
+(WAL), zero extra processes to operate — which is the right trade for a
+single-control-plane cluster on a TPU pod (the reference needs Redis
+because its HA story is multi-GCS; ours is restart-with-reload, covered by
+every client's retrying reconnect + re-register protocol).
+
+Tables are string-named ("kv", "actors", "pgs", "jobs"); values are opaque
+bytes (callers pickle).  All methods are synchronous and fast (sqlite WAL
+commit ~100 µs) — they are called from the control plane's event loop on
+mutation paths only, never on reads (reads hit the in-memory state that
+``load_all`` rebuilt at startup).
+"""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+from typing import Dict, Iterator, Optional, Tuple
+
+
+class StoreClient:
+    """Interface: durable puts/deletes + full-table scan at recovery."""
+
+    durable = False
+
+    def put(self, table: str, key: str, value: bytes) -> None:
+        raise NotImplementedError
+
+    def delete(self, table: str, key: str) -> None:
+        raise NotImplementedError
+
+    def scan(self, table: str) -> Iterator[Tuple[str, bytes]]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class InMemoryStoreClient(StoreClient):
+    """The reference's default: no persistence, restart loses state.
+
+    Writes are NO-OPS: the control plane's live tables already hold the
+    state, and this store is only ever read back at startup recovery
+    (always empty for a non-durable backend) — buffering a pickled copy of
+    every mutation here would be pure overhead."""
+
+    def put(self, table: str, key: str, value: bytes) -> None:
+        pass
+
+    def delete(self, table: str, key: str) -> None:
+        pass
+
+    def scan(self, table: str):
+        return iter(())
+
+
+class SqliteStoreClient(StoreClient):
+    """Durable embedded store (the RedisStoreClient role).  WAL mode so a
+    control-plane crash mid-write never corrupts the file; synchronous=
+    NORMAL bounds the loss window to the last WAL checkpoint on an OS
+    crash, which matches the reference's Redis-async-replication window."""
+
+    durable = True
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self.path = path
+        self._db = sqlite3.connect(path)
+        self._db.execute("PRAGMA journal_mode=WAL")
+        self._db.execute("PRAGMA synchronous=NORMAL")
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS store ("
+            " tbl TEXT NOT NULL, key TEXT NOT NULL, value BLOB NOT NULL,"
+            " PRIMARY KEY (tbl, key))"
+        )
+        self._db.commit()
+
+    def put(self, table: str, key: str, value: bytes) -> None:
+        self._db.execute(
+            "INSERT OR REPLACE INTO store (tbl, key, value) VALUES (?, ?, ?)",
+            (table, key, sqlite3.Binary(value)),
+        )
+        self._db.commit()
+
+    def delete(self, table: str, key: str) -> None:
+        self._db.execute(
+            "DELETE FROM store WHERE tbl = ? AND key = ?", (table, key)
+        )
+        self._db.commit()
+
+    def scan(self, table: str):
+        cur = self._db.execute(
+            "SELECT key, value FROM store WHERE tbl = ?", (table,)
+        )
+        for key, value in cur:
+            yield key, bytes(value)
+
+    def close(self) -> None:
+        try:
+            self._db.close()
+        except Exception:
+            pass
+
+
+def make_store_client(path: Optional[str]) -> StoreClient:
+    return SqliteStoreClient(path) if path else InMemoryStoreClient()
