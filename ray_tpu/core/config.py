@@ -68,6 +68,9 @@ _KNOBS: Dict[str, tuple] = {
     "tpu_visible_chips_env": (str, "TPU_VISIBLE_CHIPS", "Env var used for chip isolation"),
     # -- data --
     "data_max_tasks_per_op": (int, 8, "Streaming executor in-flight cap per op"),
+    "data_memory_budget_per_op_bytes": (
+        int, 256 * 1024 * 1024, "Estimated in-flight output bytes cap per op"
+    ),
     # -- usage stats --
     "usage_stats_enabled": (bool, True, "Cluster-local usage recording"),
     # -- task events / observability --

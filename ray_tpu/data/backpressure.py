@@ -26,13 +26,17 @@ class OpResourceState:
         self.name = name
         self.outstanding = 0  # launched, not yet consumed downstream
         self.completed_tasks = 0
+        # The size average only counts outputs whose size was actually
+        # observed — unknown-size completions must not dilute it toward 0
+        # (which would disable the memory policy exactly when it matters).
+        self.sized_tasks = 0
         self.completed_bytes = 0
 
     @property
     def avg_output_bytes(self) -> float:
-        if self.completed_tasks == 0:
+        if self.sized_tasks == 0:
             return 0.0
-        return self.completed_bytes / self.completed_tasks
+        return self.completed_bytes / self.sized_tasks
 
     @property
     def estimated_inflight_bytes(self) -> float:
@@ -45,6 +49,7 @@ class OpResourceState:
         self.outstanding -= 1
         self.completed_tasks += 1
         if nbytes:
+            self.sized_tasks += 1
             self.completed_bytes += nbytes
 
 

@@ -186,6 +186,26 @@ class Dataset:
             AllToAllStage("RandomShuffle", None, part, reduce_fn)
         )
 
+    def join(
+        self,
+        other: "Dataset",
+        on: Union[str, Callable],
+        *,
+        right_on: Union[str, Callable, None] = None,
+        how: str = "inner",
+        num_partitions: Optional[int] = None,
+    ) -> "Dataset":
+        """Distributed hash join (reference
+        ``data/_internal/execution/operators/join.py``): both sides are
+        hash-partitioned on the key, one reduce task joins each partition
+        (build right, probe left).  ``how``: "inner" | "left".  Dict rows
+        merge columns (left wins clashes); other rows pair as tuples."""
+        from .joins import JoinStage
+
+        return self._with_stage(
+            JoinStage(other, on, right_on, how, num_partitions)
+        )
+
     def sort(self, key: Union[str, Callable, None] = None,
              descending: bool = False) -> "Dataset":
         """Distributed sample-partitioned sort (reference

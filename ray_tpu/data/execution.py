@@ -155,22 +155,32 @@ class MapStage:
             yield from self._run_actor_pool(upstream, st)
 
     def _run_tasks(self, upstream, st):
+        from .backpressure import (
+            OpResourceState, can_launch, default_policies, ref_size_if_known,
+        )
+
         t0 = time.perf_counter()
-        cap = GlobalConfig.data_max_tasks_per_op
+        policies = default_policies()
+        op = OpResourceState(self.name)
         pending: deque = deque()
         exhausted = False
         while True:
-            while not exhausted and len(pending) < cap:
+            while not exhausted and can_launch(op, policies):
                 item = next(upstream, _SENTINEL)
                 if item is _SENTINEL:
                     exhausted = True
                     break
                 st.num_tasks += 1
+                op.on_launch()
                 pending.append(_run_item.remote(item, self.transforms))
             if not pending:
                 break
             st.wall_s = time.perf_counter() - t0
-            yield pending.popleft()
+            head = pending.popleft()
+            yield head
+            # Downstream pulled the block: account its (now usually known)
+            # size into the op's memory model.
+            op.on_output_consumed(ref_size_if_known(head))
         st.wall_s = time.perf_counter() - t0
 
     def _run_actor_pool(self, upstream, st):
@@ -376,7 +386,7 @@ def _optimize(inputs: List[Any], stages: List[Any]) -> List[Any]:
                 continue
         if (
             fused
-            and isinstance(stage, AllToAllStage)
+            and hasattr(stage, "with_fused")
             and isinstance(fused[-1], MapStage)
             and fused[-1].compute is None
             and not stage.fused_transforms
@@ -387,6 +397,9 @@ def _optimize(inputs: List[Any], stages: List[Any]) -> List[Any]:
             continue
         fused.append(stage)
     needs_norm = any(isinstance(i, ReadTask) for i in inputs)
-    if needs_norm and not (fused and isinstance(fused[0], (MapStage, AllToAllStage))):
+    if needs_norm and not (
+        fused
+        and (isinstance(fused[0], MapStage) or hasattr(fused[0], "with_fused"))
+    ):
         fused.insert(0, MapStage([], ["Read"]))
     return fused

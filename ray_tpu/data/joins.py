@@ -16,7 +16,7 @@ from typing import List, Optional
 
 import ray_tpu
 
-from .block import Block, row_key
+from .block import Block, row_key, stable_hash
 
 
 @ray_tpu.remote
@@ -27,7 +27,9 @@ def _join_partition_map(item, transforms, n_out: int, key) -> List[Block]:
     block = apply_chain(item, transforms)
     parts: List[Block] = [[] for _ in range(n_out)]
     for row in block:
-        parts[hash(row_key(row, key)) % n_out].append(row)
+        # stable_hash, NOT builtin hash(): str hashing is seed-randomized
+        # per process, and the two sides partition in different workers.
+        parts[stable_hash(row_key(row, key)) % n_out].append(row)
     return parts
 
 

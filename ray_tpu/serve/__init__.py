@@ -10,6 +10,11 @@ from .api import (  # noqa: F401
 )
 from .batching import batch  # noqa: F401
 from .deployment import Application, Deployment, deployment  # noqa: F401
+from .request_router import (  # noqa: F401
+    PowerOfTwoChoicesRouter,
+    PrefixAwareRouter,
+    RequestRouter,
+)
 from .handle import (  # noqa: F401
     DeploymentHandle,
     DeploymentResponse,

@@ -69,6 +69,9 @@ class DeploymentHandle:
         self._rr = 0
         self._multiplexed_model_id = multiplexed_model_id
         self._stream = stream
+        # Pluggable routing policy (reference: request_router/); None =
+        # the built-in power-of-two-choices in _pick_replica.
+        self._router = None
         # model_id -> actor id of the replica that last served it (session
         # affinity — the reference's multiplex-aware router prefers replicas
         # already holding the model).
@@ -77,7 +80,7 @@ class DeploymentHandle:
     _UNSET = object()
 
     def options(self, *, multiplexed_model_id=_UNSET,
-                stream=_UNSET) -> "DeploymentHandle":
+                stream=_UNSET, request_router=_UNSET) -> "DeploymentHandle":
         """Chaining-safe: options not passed keep their current values
         (``h.options(multiplexed_model_id="m").options(stream=True)``
         retains the model id)."""
@@ -92,6 +95,9 @@ class DeploymentHandle:
         clone._replicas = self._replicas
         clone._refreshed = self._refreshed
         clone._model_affinity = self._model_affinity
+        clone._router = (
+            self._router if request_router is self._UNSET else request_router
+        )
         return clone
 
     def _get_controller(self):
@@ -108,24 +114,31 @@ class DeploymentHandle:
             )
             self._refreshed = now
 
-    def _pick_replica(self):
-        """Power-of-two-choices by queue depth (2+ replicas), else direct."""
-        self._refresh()
-        if not self._replicas:
-            raise RuntimeError(
-                f"deployment {self.deployment_name!r} has no replicas"
+    def _pick_replica(self, args=(), kwargs=None):
+        """Route via the configured RequestRouter (default: power-of-two
+        choices by queue depth).  On a probe failure the replica list is
+        force-refreshed once and the route retried (a cached dead replica
+        must not poison routing until the next periodic refresh)."""
+        from .request_router import PowerOfTwoChoicesRouter, ReplicaProbeError
+
+        router = self._router
+        if router is None:
+            router = self.__dict__.setdefault(
+                "_default_router", PowerOfTwoChoicesRouter()
             )
-        if len(self._replicas) == 1:
-            return self._replicas[0]
-        a, b = random.sample(self._replicas, 2)
-        try:
-            qa, qb = ray_tpu.get(
-                [a.queue_len.remote(), b.queue_len.remote()], timeout=5
-            )
-        except Exception:
-            self._refresh(force=True)
-            return self._replicas[self._rr % len(self._replicas)]
-        return a if qa <= qb else b
+        kwargs = kwargs or {}
+        for attempt in (0, 1):
+            self._refresh(force=attempt > 0)
+            if not self._replicas:
+                raise RuntimeError(
+                    f"deployment {self.deployment_name!r} has no replicas"
+                )
+            try:
+                return router.choose(self._replicas, args, kwargs)
+            except ReplicaProbeError:
+                if attempt:
+                    self._rr += 1
+                    return self._replicas[self._rr % len(self._replicas)]
 
     def _invoke(self, method: str, args, kwargs) -> DeploymentResponse:
         model_id = self._multiplexed_model_id
@@ -146,7 +159,7 @@ class DeploymentHandle:
                     self._refresh(force=True)
                     replica = None
         if replica is None:
-            replica = self._pick_replica()
+            replica = self._pick_replica(args, kwargs)
             if model_id is not None:
                 self._model_affinity[model_id] = replica._actor_id
         self._rr += 1

@@ -4,6 +4,8 @@ from .schedulers import (  # noqa: F401
     FIFOScheduler,
     HyperBandScheduler,
     MedianStoppingRule,
+    PB2,
     PopulationBasedTraining,
 )
+from .tpe import Searcher, TPESearcher  # noqa: F401
 from .tuner import ResultGrid, TuneConfig, Tuner, TrialResult  # noqa: F401

@@ -243,3 +243,97 @@ class PopulationBasedTraining:
     def pop_clones(self) -> List[Tuple[dict, Any]]:
         clones, self._clones = self._clones, []
         return clones
+
+
+class PB2(PopulationBasedTraining):
+    """Population Based Bandits (reference: ``tune/schedulers/pb2.py``,
+    Parker-Holder et al. 2020): PBT where the explore step picks new
+    hyperparameters by maximizing a GP-UCB acquisition fit on observed
+    (config, score-improvement) data, instead of random perturbation.
+    The GP is a small native numpy RBF-kernel regressor over configs
+    normalized into [0,1]^d by ``hyperparam_bounds``.
+    """
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 perturbation_interval: int = 4,
+                 quantile_fraction: float = 0.25,
+                 hyperparam_bounds: Optional[Dict[str, Tuple[float, float]]] = None,
+                 ucb_kappa: float = 1.5,
+                 n_candidates: int = 64,
+                 seed: int = 0):
+        super().__init__(
+            metric=metric, mode=mode,
+            perturbation_interval=perturbation_interval,
+            quantile_fraction=quantile_fraction,
+            hyperparam_mutations=None, seed=seed,
+        )
+        if not hyperparam_bounds:
+            raise ValueError("PB2 requires hyperparam_bounds={key: (lo, hi)}")
+        self.bounds = dict(hyperparam_bounds)
+        self.kappa = ucb_kappa
+        self.n_candidates = n_candidates
+        # Observations: (normalized config vector, score improvement).
+        self._gp_x: List[List[float]] = []
+        self._gp_y: List[float] = []
+        self._last_score: Dict[str, float] = {}
+
+    # ----------------------------------------------------------------- data
+    def _norm(self, config: dict) -> List[float]:
+        out = []
+        for k, (lo, hi) in self.bounds.items():
+            v = float(config.get(k, lo))
+            out.append((v - lo) / max(hi - lo, 1e-12))
+        return out
+
+    def on_result(self, trial_id: str, metrics: Dict, **info) -> str:
+        value = metrics.get(self.metric)
+        if value is not None:
+            prev = self._last_score.get(trial_id)
+            if prev is not None:
+                delta = float(value) - prev
+                if self.mode == "min":
+                    delta = -delta  # improvement = decrease
+                self._gp_x.append(self._norm(info.get("config", {})))
+                self._gp_y.append(delta)
+            self._last_score[trial_id] = float(value)
+        return super().on_result(trial_id, metrics, **info)
+
+    # -------------------------------------------------------------- explore
+    def _mutate(self, config: dict) -> dict:
+        """GP-UCB over the bounded keys (the PB2 explore step)."""
+        import numpy as np
+
+        out = dict(config)
+        if len(self._gp_y) < 3:
+            for k, (lo, hi) in self.bounds.items():
+                out[k] = lo + self._rng.random() * (hi - lo)
+            return out
+        X = np.asarray(self._gp_x[-64:], dtype=float)
+        y = np.asarray(self._gp_y[-64:], dtype=float)
+        y_std = y.std() or 1.0
+        yn = (y - y.mean()) / y_std
+        ell, noise = 0.2, 1e-3
+        def rbf(A, B):
+            d2 = ((A[:, None, :] - B[None, :, :]) ** 2).sum(-1)
+            return np.exp(-0.5 * d2 / (ell * ell))
+        K = rbf(X, X) + noise * np.eye(len(X))
+        Kinv_y = np.linalg.solve(K, yn)
+        cands = np.asarray(
+            [
+                [self._rng.random() for _ in self.bounds]
+                for _ in range(self.n_candidates)
+            ]
+        )
+        Ks = rbf(cands, X)
+        mu = Ks @ Kinv_y
+        var = np.maximum(
+            1.0 - np.einsum("ij,jk,ik->i", Ks, np.linalg.inv(K), Ks), 1e-9
+        )
+        ucb = mu + self.kappa * np.sqrt(var)
+        best = cands[int(np.argmax(ucb))]
+        for i, (k, (lo, hi)) in enumerate(self.bounds.items()):
+            val = lo + float(best[i]) * (hi - lo)
+            if isinstance(config.get(k), int):
+                val = int(round(val))
+            out[k] = val
+        return out

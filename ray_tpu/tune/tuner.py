@@ -30,6 +30,10 @@ class TuneConfig:
     metric: str = "loss"
     mode: str = "min"
     scheduler: Any = None
+    # Sequential model-based searcher (e.g. TPESearcher); when set,
+    # configs are suggested lazily as capacity frees and completed results
+    # feed the model (reference: tune.search.Searcher protocol).
+    search_alg: Any = None
     seed: Optional[int] = None
     # Stop criteria applied to every trial's metrics, e.g.
     # {"training_iteration": 20} (reference: RunConfig(stop=...)).
@@ -199,19 +203,34 @@ class Tuner:
         record_library_usage("tune")
         cfg = self.tune_config
         scheduler = cfg.scheduler or FIFOScheduler()
-        variants = generate_variants(
-            self.param_space, cfg.num_samples, cfg.seed
-        )
+        searcher = cfg.search_alg
         payload = dumps_function(self.trainable)
-        pending = [
-            (f"trial_{i:04d}", variant, None)
-            for i, variant in enumerate(variants)
-        ]
-        next_trial = len(pending)
+        if searcher is None:
+            variants = generate_variants(
+                self.param_space, cfg.num_samples, cfg.seed
+            )
+            pending = [
+                (f"trial_{i:04d}", variant, None)
+                for i, variant in enumerate(variants)
+            ]
+            to_suggest = 0
+            next_trial = len(pending)
+        else:
+            pending = []
+            to_suggest = cfg.num_samples
+            next_trial = cfg.num_samples
         running: Dict[str, dict] = {}
         results: List[TrialResult] = []
 
-        while pending or running:
+        while pending or running or to_suggest > 0:
+            while (
+                searcher is not None
+                and to_suggest > 0
+                and len(pending) + len(running) < cfg.max_concurrent_trials
+            ):
+                tid = f"trial_{cfg.num_samples - to_suggest:04d}"
+                to_suggest -= 1
+                pending.append((tid, searcher.suggest(tid), None))
             while pending and len(running) < cfg.max_concurrent_trials:
                 trial_id, variant, start_ckpt = pending.pop(0)
                 # max_concurrency: poll()/request_stop() must stay responsive
@@ -270,6 +289,10 @@ class Tuner:
                             )
                     except Exception:
                         pass
+                    if searcher is not None and st["history"]:
+                        searcher.on_trial_complete(
+                            trial_id, st["history"][-1]
+                        )
                     results.append(
                         TrialResult(
                             trial_id=trial_id,

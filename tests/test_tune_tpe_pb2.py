@@ -1,0 +1,156 @@
+"""Model-based tune search (TPE) + PB2 scheduler.
+
+Reference: ``python/ray/tune/search/`` (optuna/hyperopt wrap TPE),
+``tune/schedulers/pb2.py``.  The TPE test is the VERDICT's acceptance
+gate: the searcher beats random search on a seeded synthetic objective,
+deterministically.
+"""
+
+import random
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune import PB2, TPESearcher, TuneConfig, Tuner
+from ray_tpu.tune.search import choice, loguniform, uniform
+
+
+def _objective_value(x, y):
+    # Smooth bowl with optimum at (0.3, 0.7); plus a categorical bonus.
+    return (x - 0.3) ** 2 + (y - 0.7) ** 2
+
+
+class TestTPESearcher:
+    def test_moves_toward_optimum_offline(self):
+        """Pure-searcher loop (no cluster): TPE's later suggestions score
+        better than its random startup phase."""
+        space = {"x": uniform(0, 1), "y": uniform(0, 1)}
+        tpe = TPESearcher(space, metric="loss", mode="min",
+                          n_startup_trials=8, seed=7)
+        scores = []
+        for i in range(48):
+            cfg = tpe.suggest(f"t{i}")
+            loss = _objective_value(cfg["x"], cfg["y"])
+            scores.append(loss)
+            tpe.on_trial_complete(f"t{i}", {"loss": loss})
+        startup = sum(scores[:8]) / 8
+        guided = sum(scores[-16:]) / 16
+        assert guided < startup * 0.6, (startup, guided)
+
+    def test_beats_random_on_seeded_objective(self):
+        """Same budget, same seed family: best-found by TPE <= best-found
+        by pure random sampling (the VERDICT acceptance check)."""
+        space = {"x": uniform(0, 1), "y": uniform(0, 1)}
+        budget = 40
+
+        tpe = TPESearcher(space, metric="loss", mode="min",
+                          n_startup_trials=8, seed=3)
+        tpe_best = float("inf")
+        for i in range(budget):
+            cfg = tpe.suggest(f"t{i}")
+            loss = _objective_value(cfg["x"], cfg["y"])
+            tpe_best = min(tpe_best, loss)
+            tpe.on_trial_complete(f"t{i}", {"loss": loss})
+
+        rng = random.Random(3)
+        rand_best = min(
+            _objective_value(rng.uniform(0, 1), rng.uniform(0, 1))
+            for _ in range(budget)
+        )
+        assert tpe_best <= rand_best
+
+    def test_categorical_and_log_domains(self):
+        space = {
+            "lr": loguniform(1e-5, 1e-1),
+            "act": choice(["relu", "gelu", "tanh"]),
+        }
+        tpe = TPESearcher(space, metric="loss", mode="min",
+                          n_startup_trials=4, seed=0)
+        # gelu + lr near 1e-3 is best; check the model prefers them later.
+        for i in range(30):
+            cfg = tpe.suggest(f"t{i}")
+            import math
+
+            loss = (math.log10(cfg["lr"]) + 3) ** 2 + (
+                0.0 if cfg["act"] == "gelu" else 1.0
+            )
+            tpe.on_trial_complete(f"t{i}", {"loss": loss})
+        tail = [tpe.suggest(f"p{i}") for i in range(8)]
+        gelu_frac = sum(1 for c in tail if c["act"] == "gelu") / len(tail)
+        assert gelu_frac >= 0.5
+
+
+class TestTunerWithSearcher:
+    @pytest.fixture
+    def ray_cluster(self):
+        ray_tpu.init(num_cpus=4)
+        yield
+        ray_tpu.shutdown()
+
+    def test_tuner_runs_tpe_end_to_end(self, ray_cluster):
+        from ray_tpu.train import session as train_session
+
+        space = {"x": uniform(0, 1)}
+
+        def trainable(config):
+            train_session.report(
+                {"loss": (config["x"] - 0.5) ** 2}
+            )
+
+        searcher = TPESearcher(space, metric="loss", mode="min",
+                               n_startup_trials=3, seed=1)
+        grid = Tuner(
+            trainable,
+            tune_config=TuneConfig(
+                num_samples=8, max_concurrent_trials=2,
+                metric="loss", mode="min", search_alg=searcher,
+            ),
+        ).fit()
+        assert len(grid) == 8
+        best = grid.get_best_result()
+        assert best.metrics["loss"] < 0.1
+
+
+class TestPB2:
+    def test_requires_bounds(self):
+        with pytest.raises(ValueError):
+            PB2(metric="score", mode="max")
+
+    def test_explores_within_bounds_and_clones(self):
+        pb2 = PB2(
+            metric="score", mode="max", perturbation_interval=1,
+            quantile_fraction=0.34,
+            hyperparam_bounds={"lr": (0.001, 0.1)}, seed=0,
+        )
+        # Three trials reporting twice each: deltas feed the GP; the
+        # bottom trial gets exploited into a clone.
+        for step in (1, 2):
+            for tid, lr, score in (
+                ("a", 0.05, 1.0 * step),
+                ("b", 0.02, 0.8 * step),
+                ("c", 0.001, 0.1 * step),
+            ):
+                pb2.on_result(
+                    tid, {"score": score, "training_iteration": step},
+                    config={"lr": lr}, checkpoint=f"ck-{tid}-{step}",
+                    terminal=False,
+                )
+        clones = pb2.pop_clones()
+        assert clones, "bottom trial was not exploited"
+        for cfg, ckpt in clones:
+            assert 0.001 <= cfg["lr"] <= 0.1
+            assert ckpt and ckpt.startswith("ck-")
+
+    def test_gp_explore_uses_observations(self):
+        pb2 = PB2(
+            metric="score", mode="max", perturbation_interval=1,
+            hyperparam_bounds={"lr": (0.0, 1.0)}, seed=2,
+        )
+        # Feed observations: improvement grows with lr (monotone signal).
+        for i, lr in enumerate([0.1, 0.3, 0.5, 0.7, 0.9]):
+            pb2._gp_x.append([lr])
+            pb2._gp_y.append(lr)  # delta == lr
+        out = pb2._mutate({"lr": 0.2})
+        # UCB should chase the high-lr region.
+        assert out["lr"] > 0.5
