@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import os
 import logging
 import pickle
 import time
@@ -36,6 +37,14 @@ from .ids import ActorID, JobID, NodeID, PlacementGroupID
 from .resources import ResourceSet
 from .rpc import ClientPool, RpcServer, ServerConnection
 from .scheduler import ClusterScheduler, InfeasibleError
+from .event_export import (
+    ACTOR_DEFINITION,
+    ACTOR_LIFECYCLE,
+    JOB_LIFECYCLE,
+    NODE_LIFECYCLE,
+    PG_LIFECYCLE,
+    EventRecorder,
+)
 from .store_client import make_store_client
 from .task_events import TaskEventStore
 from .task_spec import ActorSpec
@@ -121,6 +130,12 @@ class ControlPlane:
         self._requested_resources: List[dict] = []
         self._recent_unplaceable: List[tuple] = []  # (monotonic ts, resources)
         self.store = make_store_client(store_path)
+        export_path = None
+        if store_path:
+            export_path = os.path.join(
+                os.path.dirname(store_path), "events.jsonl"
+            )
+        self.events = EventRecorder(export_path)
         self._recovered = self._recover()
         # Grace window after a recovery: ALIVE actors whose node never
         # re-registers are declared dead only after agents have had a full
@@ -259,6 +274,7 @@ class ControlPlane:
         await self.server.stop()
         await self.agent_clients.close_all()
         self.store.close()
+        self.events.close()
 
     # ---------------------------------------------------------------- pubsub
     def _publish(self, channel: str, message: dict):
@@ -307,6 +323,11 @@ class ControlPlane:
             payload["snapshot"]["total"],
         )
         self._publish("nodes", {"event": "added", "node_id": node_id})
+        self.events.record(
+            NODE_LIFECYCLE, node_id.hex(), "ALIVE",
+            agent_address=payload["agent_address"],
+            resources=payload["snapshot"].get("total", {}),
+        )
         self._kick_pending()
         return {"ok": True, "session_id": self.session_id}
 
@@ -364,6 +385,7 @@ class ControlPlane:
                     and now - job.get("last_heartbeat", now) > timeout
                 ):
                     job["state"] = "FINISHED"
+                    self.events.record(JOB_LIFECYCLE, job_id.hex(), "FINISHED")
                     self._persist_job(job_id)
                     logger.info("job %s lost its driver; cleaning up",
                                 job_id.hex())
@@ -376,6 +398,7 @@ class ControlPlane:
         entry.alive = False
         self.scheduler.remove_node(node_id)
         logger.warning("node %s marked dead", node_id.hex()[:8])
+        self.events.record(NODE_LIFECYCLE, node_id.hex(), "DEAD")
         self._publish("nodes", {"event": "removed", "node_id": node_id})
         # Fail or restart actors that lived there.
         for actor_id, a in list(self.actors.items()):
@@ -424,6 +447,10 @@ class ControlPlane:
             "last_heartbeat": time.monotonic(),
         }
         conn.metadata["job_id"] = job_id
+        self.events.record(
+            JOB_LIFECYCLE, job_id.hex(), "RUNNING",
+            driver_address=payload.get("driver_address"),
+        )
         self._persist_job(job_id)
         return {"ok": True, "session_id": self.session_id}
 
@@ -454,6 +481,12 @@ class ControlPlane:
             self.named_actors[key] = spec.actor_id
         entry = ActorEntry(spec)
         self.actors[spec.actor_id] = entry
+        self.events.record(
+            ACTOR_DEFINITION, spec.actor_id.hex(), "REGISTERED",
+            name=spec.name or "", namespace=spec.namespace,
+            resources=dict(spec.resources),
+            max_restarts=spec.max_restarts,
+        )
         self._persist_actor(entry)
         await self._try_schedule_actor(entry)
         return entry.public_info()
@@ -541,7 +574,13 @@ class ControlPlane:
         self._publish_actor(entry)
 
     def _publish_actor(self, entry: ActorEntry):
-        # Every actor state transition publishes — persist at the same spot.
+        # Every actor state transition publishes — persist + export events
+        # at the same spot.
+        self.events.record(
+            ACTOR_LIFECYCLE, entry.spec.actor_id.hex(), entry.state,
+            death_cause=entry.death_cause,
+            num_restarts=entry.num_restarts,
+        )
         self._persist_actor(entry)
         self._publish("actor:" + entry.spec.actor_id.hex(), entry.public_info())
 
@@ -632,6 +671,7 @@ class ControlPlane:
             pg_id, payload["bundles"], payload["strategy"], payload.get("name", "")
         )
         self.placement_groups[pg_id] = entry
+        self.events.record(PG_LIFECYCLE, pg_id.hex(), "PENDING")
         self._persist_pg(entry)
         await self._try_schedule_pg(entry)
         return entry.public_info()
@@ -682,6 +722,7 @@ class ControlPlane:
             await client.call("commit_bundles", {"pg_id": entry.pg_id})
         entry.bundle_nodes = list(assignment)
         entry.state = "CREATED"
+        self.events.record(PG_LIFECYCLE, entry.pg_id.hex(), "CREATED")
         self._persist_pg(entry)
         self._publish("pg:" + entry.pg_id.hex(), entry.public_info())
 
@@ -700,6 +741,7 @@ class ControlPlane:
                 except Exception:
                     pass
         entry.state = "REMOVED"
+        self.events.record(PG_LIFECYCLE, entry.pg_id.hex(), "REMOVED")
         self._persist_pg(entry)
         if payload["pg_id"] in self._pending_pgs:
             self._pending_pgs.remove(payload["pg_id"])
@@ -881,6 +923,13 @@ class ControlPlane:
             )
         )
         return [row for reply in replies for row in reply]
+
+    def handle_list_cluster_events(self, payload, conn):
+        """Typed lifecycle events (reference: RayEventRecorder export)."""
+        return self.events.list_events(
+            payload.get("event_type"), payload.get("entity_id"),
+            payload.get("limit", 1000),
+        )
 
     def handle_ping(self, payload, conn):
         return "pong"

@@ -59,6 +59,12 @@ from .task_spec import ActorSpec, ObjectRef, TaskSpec, _RefMarker, function_key
 
 logger = logging.getLogger(__name__)
 
+
+def _tracing_context():
+    from ray_tpu.util.tracing import current_context
+
+    return current_context()
+
 _global_worker: Optional["CoreWorker"] = None
 
 
@@ -1246,6 +1252,7 @@ class CoreWorker:
             placement_group_id=placement_group_id,
             bundle_index=bundle_index,
             env_vars=env_vars or {},
+            trace_ctx=_tracing_context(),
         )
         spec._held_refs = held  # type: ignore[attr-defined]
         refs = []
@@ -1470,6 +1477,7 @@ class CoreWorker:
             streaming=streaming,
             owner_address=self.address,
             actor_id=actor_id,
+            trace_ctx=_tracing_context(),
         )
         spec.method_name = method_name  # type: ignore[attr-defined]
         spec._held_refs = held  # type: ignore[attr-defined]
@@ -1734,11 +1742,17 @@ class CoreWorker:
         )
 
     async def _execute(self, spec: TaskSpec, fn) -> dict:
+        from ray_tpu.util.tracing import task_execution_span
+
         ev_kw = {
             "job_id_hex": spec.job_id.hex(),
             "actor_id_hex": spec.actor_id.hex() if spec.actor_id else "",
         }
         self.task_events.record(spec.task_id.hex(), spec.name, "RUNNING", **ev_kw)
+        with task_execution_span(spec):
+            return await self._execute_inner(spec, fn, ev_kw)
+
+    async def _execute_inner(self, spec: TaskSpec, fn, ev_kw) -> dict:
         try:
             args, kwargs = await self._resolve_args(spec.args_payload)
             if self._device_transport_active():
@@ -1773,8 +1787,14 @@ class CoreWorker:
             if asyncio.iscoroutinefunction(fn):
                 result = await fn(*args, **kwargs)
             else:
+                # copy_context: the tracing contextvar (and any other
+                # context) follows user code into the executor thread.
+                import contextvars as _cv
+
+                _ctx = _cv.copy_context()
                 result = await loop.run_in_executor(
-                    self._task_executor, lambda: fn(*args, **kwargs)
+                    self._task_executor,
+                    lambda: _ctx.run(fn, *args, **kwargs),
                 )
             if self._device_transport_active():
                 result = self._device_wrap(result)

@@ -1,0 +1,80 @@
+"""Structured cluster lifecycle event export.
+
+Reference: ray ``src/ray/observability/ray_event_recorder.h`` + the
+``export_*.proto`` schemas — typed definition/lifecycle events for
+nodes, actors, jobs, and placement groups, recorded centrally and shipped
+to an external aggregator.  Native redesign: the control plane records
+events into a bounded ring and appends them as JSON lines to
+``events.jsonl`` under the session directory (the external-export file an
+operator's collector tails); the state API exposes ``list_cluster_events``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+# Event types (reference: observability/ray_*_event.h).
+NODE_LIFECYCLE = "NODE_LIFECYCLE"
+ACTOR_DEFINITION = "ACTOR_DEFINITION"
+ACTOR_LIFECYCLE = "ACTOR_LIFECYCLE"
+JOB_DEFINITION = "JOB_DEFINITION"
+JOB_LIFECYCLE = "JOB_LIFECYCLE"
+PG_LIFECYCLE = "PG_LIFECYCLE"
+
+
+class EventRecorder:
+    """Bounded in-memory ring + append-only JSONL export file."""
+
+    def __init__(self, export_path: Optional[str] = None,
+                 max_events: int = 10_000):
+        self._ring: deque = deque(maxlen=max_events)
+        self._export_path = export_path
+        self._file = None
+        if export_path:
+            os.makedirs(os.path.dirname(export_path) or ".", exist_ok=True)
+            self._file = open(export_path, "a", buffering=1)  # line-buffered
+        self._seq = 0
+
+    def record(self, event_type: str, entity_id: str, state: str,
+               **attrs: Any) -> None:
+        self._seq += 1
+        event = {
+            "seq": self._seq,
+            "timestamp": time.time(),
+            "event_type": event_type,
+            "entity_id": entity_id,
+            "state": state,
+            **attrs,
+        }
+        self._ring.append(event)
+        if self._file is not None:
+            try:
+                self._file.write(json.dumps(event, default=str) + "\n")
+            except Exception:
+                pass  # export is observability, not truth
+
+    def list_events(self, event_type: Optional[str] = None,
+                    entity_id: Optional[str] = None,
+                    limit: int = 1000) -> List[Dict[str, Any]]:
+        out = []
+        for ev in reversed(self._ring):
+            if event_type and ev["event_type"] != event_type:
+                continue
+            if entity_id and ev["entity_id"] != entity_id:
+                continue
+            out.append(ev)
+            if len(out) >= limit:
+                break
+        out.reverse()
+        return out
+
+    def close(self) -> None:
+        if self._file is not None:
+            try:
+                self._file.close()
+            except Exception:
+                pass

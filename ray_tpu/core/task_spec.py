@@ -51,6 +51,9 @@ class TaskSpec:
     bundle_index: int = -1
     # Runtime env (round-1: env vars only)
     env_vars: Dict[str, str] = field(default_factory=dict)
+    # Distributed tracing: (trace_id, span_id) of the submitting span
+    # (reference: tracing_helper.py injects the OTel context here).
+    trace_ctx: Optional[Tuple[str, str]] = None
 
     @property
     def scheduling_class(self) -> Tuple:

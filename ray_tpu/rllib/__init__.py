@@ -6,10 +6,28 @@ runner actors for sampling and JAX learners for SGD; algorithms: PPO, DQN
 """
 
 from .actor_manager import FaultTolerantActorManager  # noqa: F401
+from .connectors import (  # noqa: F401
+    ClipActions,
+    ComputeGAE,
+    Connector,
+    ConnectorPipeline,
+    NormalizeAdvantages,
+    NormalizeObs,
+    ObsToFloatBatch,
+    ScaleActions,
+)
 from .algorithm import Algorithm, AlgorithmConfig  # noqa: F401
 from .bc import BC, BCConfig, MARWIL, MARWILConfig  # noqa: F401
 from .dqn import DQN, DQNConfig  # noqa: F401
-from .env import CartPole  # noqa: F401
+from .env import CartPole, Pendulum  # noqa: F401
 from .impala import APPO, APPOConfig, IMPALA, IMPALAConfig  # noqa: F401
 from .ppo import PPO, PPOConfig  # noqa: F401
 from .replay import PrioritizedReplayBuffer, ReplayBuffer  # noqa: F401
+from .rl_module import (  # noqa: F401
+    DiscretePolicyModule,
+    MultiRLModule,
+    RLModule,
+    RLModuleSpec,
+    SACModule,
+)
+from .sac import SAC, SACConfig  # noqa: F401

@@ -58,3 +58,47 @@ class CartPole:
             or self.steps >= self.max_steps
         )
         return self.state.copy(), 1.0, done, {}
+
+
+class Pendulum:
+    """Classic pendulum swing-up — the continuous-control smoke env
+    (observation (3,), one action in [-2, 2])."""
+
+    observation_size = 3
+    action_size = 1
+    action_low = -2.0
+    action_high = 2.0
+
+    def __init__(self, seed: int = 0, max_steps: int = 200):
+        self.rng = np.random.default_rng(seed)
+        self.max_steps = max_steps
+        self.g, self.m, self.l, self.dt = 10.0, 1.0, 1.0, 0.05
+        self.state = None
+        self.steps = 0
+
+    def _obs(self):
+        th, thdot = self.state
+        return np.array([np.cos(th), np.sin(th), thdot], np.float32)
+
+    def reset(self):
+        self.state = np.array(
+            [self.rng.uniform(-np.pi, np.pi), self.rng.uniform(-1.0, 1.0)]
+        )
+        self.steps = 0
+        return self._obs()
+
+    def step(self, action):
+        th, thdot = self.state
+        u = float(np.clip(np.asarray(action).reshape(-1)[0], -2.0, 2.0))
+        norm_th = ((th + np.pi) % (2 * np.pi)) - np.pi
+        cost = norm_th ** 2 + 0.1 * thdot ** 2 + 0.001 * u ** 2
+        thdot = thdot + (
+            3 * self.g / (2 * self.l) * np.sin(th)
+            + 3.0 / (self.m * self.l ** 2) * u
+        ) * self.dt
+        thdot = float(np.clip(thdot, -8.0, 8.0))
+        th = th + thdot * self.dt
+        self.state = np.array([th, thdot])
+        self.steps += 1
+        done = self.steps >= self.max_steps
+        return self._obs(), -cost, done, {}

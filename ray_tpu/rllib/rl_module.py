@@ -1,0 +1,216 @@
+"""RLModule — the model abstraction of the learner stack.
+
+Reference: ray ``rllib/core/rl_module/rl_module.py`` (+
+``multi_rl_module.py``): one object owns the neural nets and exposes three
+forward passes — inference (greedy/deterministic), exploration (sampling),
+train (everything the loss needs) — so algorithms, env runners, and
+learners share a single model definition.
+
+TPU-first redesign: an RLModule here is a *stateless* bundle of pure
+functions over an explicit params pytree (init/forwards), so every forward
+jits and shards like any other JAX function and params ship to env-runner
+actors as plain arrays — no module pickling, no framework wrappers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclasses.dataclass
+class RLModuleSpec:
+    """Builds an RLModule (reference ``RLModuleSpec.build``)."""
+
+    module_class: type
+    model_config: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def build(self, obs_size: int, action_size: int) -> "RLModule":
+        return self.module_class(obs_size, action_size, **self.model_config)
+
+
+class RLModule:
+    """Pure-function model bundle.  Subclasses define the architecture."""
+
+    def __init__(self, obs_size: int, action_size: int, **model_config):
+        self.obs_size = obs_size
+        self.action_size = action_size
+        self.model_config = model_config
+
+    # -- params ------------------------------------------------------------
+    def init_state(self, key) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    # -- forwards (pure; jit-safe) ------------------------------------------
+    def forward_inference(self, params, batch) -> Dict[str, Any]:
+        """Deterministic outputs for serving/eval."""
+        raise NotImplementedError
+
+    def forward_exploration(self, params, batch, key) -> Dict[str, Any]:
+        """Sampling outputs for env runners."""
+        raise NotImplementedError
+
+    def forward_train(self, params, batch) -> Dict[str, Any]:
+        """Everything the loss needs (logits, values, q-values, …)."""
+        raise NotImplementedError
+
+
+def _mlp_init(key, sizes, out_scale=0.01):
+    import jax
+
+    params = {}
+    keys = jax.random.split(key, len(sizes) - 1)
+    for i, (fan_in, fan_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+        scale = (2.0 / fan_in) ** 0.5 if i < len(sizes) - 2 else out_scale
+        params[f"w{i}"] = jax.random.normal(keys[i], (fan_in, fan_out)) * scale
+        params[f"b{i}"] = jax.numpy.zeros((fan_out,))
+    return params
+
+
+def _mlp_apply(params, x, n_layers, activation="tanh"):
+    import jax
+    import jax.numpy as jnp
+
+    act = {"tanh": jnp.tanh, "relu": jax.nn.relu}[activation]
+    for i in range(n_layers):
+        x = x @ params[f"w{i}"] + params[f"b{i}"]
+        if i < n_layers - 1:
+            x = act(x)
+    return x
+
+
+class DiscretePolicyModule(RLModule):
+    """Categorical policy + value head over a shared MLP torso (the default
+    module shape PPO/IMPALA-style algorithms consume)."""
+
+    def init_state(self, key):
+        import jax
+
+        hidden = self.model_config.get("hidden", 64)
+        k1, k2 = jax.random.split(key)
+        return {
+            "pi": _mlp_init(k1, [self.obs_size, hidden, self.action_size]),
+            "vf": _mlp_init(k2, [self.obs_size, hidden, 1], out_scale=1.0),
+        }
+
+    def _heads(self, params, obs):
+        logits = _mlp_apply(params["pi"], obs, 2)
+        value = _mlp_apply(params["vf"], obs, 2)[..., 0]
+        return logits, value
+
+    def forward_inference(self, params, batch):
+        import jax.numpy as jnp
+
+        logits, value = self._heads(params, batch["obs"])
+        return {"actions": jnp.argmax(logits, -1), "logits": logits,
+                "vf_preds": value}
+
+    def forward_exploration(self, params, batch, key):
+        import jax
+
+        logits, value = self._heads(params, batch["obs"])
+        actions = jax.random.categorical(key, logits)
+        logp = jax.nn.log_softmax(logits)
+        import jax.numpy as jnp
+
+        action_logp = jnp.take_along_axis(
+            logp, actions[:, None], axis=1
+        )[:, 0]
+        return {"actions": actions, "logits": logits, "vf_preds": value,
+                "action_logp": action_logp}
+
+    def forward_train(self, params, batch):
+        logits, value = self._heads(params, batch["obs"])
+        return {"logits": logits, "vf_preds": value}
+
+
+class SACModule(RLModule):
+    """Tanh-squashed gaussian policy + twin Q networks (reference
+    ``rllib/algorithms/sac/``'s default RLModule, JAX-native)."""
+
+    LOG_STD_MIN, LOG_STD_MAX = -10.0, 2.0
+
+    def init_state(self, key):
+        import jax
+
+        hidden = self.model_config.get("hidden", 64)
+        k1, k2, k3 = jax.random.split(key, 3)
+        qin = self.obs_size + self.action_size
+        return {
+            "pi": _mlp_init(
+                k1, [self.obs_size, hidden, hidden, 2 * self.action_size]
+            ),
+            "q1": _mlp_init(k2, [qin, hidden, hidden, 1], out_scale=1.0),
+            "q2": _mlp_init(k3, [qin, hidden, hidden, 1], out_scale=1.0),
+        }
+
+    def _pi(self, params, obs):
+        import jax.numpy as jnp
+
+        out = _mlp_apply(params["pi"], obs, 3, activation="relu")
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        log_std = jnp.clip(log_std, self.LOG_STD_MIN, self.LOG_STD_MAX)
+        return mean, log_std
+
+    def sample_action(self, params, obs, key):
+        """Reparameterized tanh-gaussian sample with squash-corrected
+        log-prob."""
+        import jax
+        import jax.numpy as jnp
+
+        mean, log_std = self._pi(params, obs)
+        std = jnp.exp(log_std)
+        eps = jax.random.normal(key, mean.shape)
+        pre = mean + std * eps
+        action = jnp.tanh(pre)
+        logp = (
+            -0.5 * (eps ** 2 + 2 * log_std + jnp.log(2 * jnp.pi))
+        ).sum(-1)
+        # tanh change-of-variables correction
+        logp = logp - jnp.log(1 - action ** 2 + 1e-6).sum(-1)
+        return action, logp
+
+    def q_values(self, params, obs, actions):
+        import jax.numpy as jnp
+
+        x = jnp.concatenate([obs, actions], axis=-1)
+        q1 = _mlp_apply(params["q1"], x, 3, activation="relu")[..., 0]
+        q2 = _mlp_apply(params["q2"], x, 3, activation="relu")[..., 0]
+        return q1, q2
+
+    def forward_inference(self, params, batch):
+        import jax.numpy as jnp
+
+        mean, _ = self._pi(params, batch["obs"])
+        return {"actions": jnp.tanh(mean)}
+
+    def forward_exploration(self, params, batch, key):
+        actions, logp = self.sample_action(params, batch["obs"], key)
+        return {"actions": actions, "action_logp": logp}
+
+    def forward_train(self, params, batch):
+        q1, q2 = self.q_values(params, batch["obs"], batch["actions"])
+        return {"q1": q1, "q2": q2}
+
+
+class MultiRLModule:
+    """module_id -> RLModule (+ per-module params) — the multi-agent
+    surface (reference ``multi_rl_module.py``)."""
+
+    def __init__(self, modules: Dict[str, RLModule]):
+        self.modules = dict(modules)
+
+    def init_state(self, key):
+        import jax
+
+        keys = jax.random.split(key, len(self.modules))
+        return {
+            mid: m.init_state(k)
+            for (mid, m), k in zip(sorted(self.modules.items()), keys)
+        }
+
+    def __getitem__(self, module_id: str) -> RLModule:
+        return self.modules[module_id]
+
+    def keys(self):
+        return self.modules.keys()

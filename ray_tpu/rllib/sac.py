@@ -1,0 +1,308 @@
+"""SAC — soft actor-critic for continuous control, on the RLModule +
+connector architecture.
+
+Reference: ray ``rllib/algorithms/sac/`` (tanh-gaussian policy, twin Q
+with target networks, automatic entropy temperature).  TPU-first: the
+whole update (actor + twin critics + alpha + polyak) is ONE jitted
+function over the replay batch; env runner actors sample with broadcast
+params through the connector pipelines (env→module obs batching,
+module→env action scaling).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import ray_tpu
+
+from .algorithm import Algorithm, AlgorithmConfig
+from .connectors import (
+    ConnectorPipeline,
+    ObsToFloatBatch,
+    ScaleActions,
+)
+from .replay import ReplayBuffer
+from .rl_module import RLModuleSpec, SACModule
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class _SACHyper:
+    gamma: float = 0.99
+    tau: float = 0.01  # polyak
+    lr: float = 3e-3
+    init_alpha: float = 0.1
+    target_entropy: Optional[float] = None  # default: -action_size
+    buffer_capacity: int = 50_000
+    batch_size: int = 128
+    rollout_steps: int = 200
+    learn_steps_per_iter: int = 64
+    warmup_steps: int = 500
+    hidden: int = 64
+    num_env_runners: int = 1
+    seed: int = 0
+
+
+class SACConfig(AlgorithmConfig):
+    ALGO_CLS = None  # filled after SAC is defined
+
+    def __init__(self):
+        super().__init__()
+        self.hp = _SACHyper()
+        self.rl_module_spec = RLModuleSpec(SACModule)
+
+    def training(self, **kwargs) -> "SACConfig":
+        for k, v in kwargs.items():
+            if hasattr(self.hp, k):
+                setattr(self.hp, k, v)
+            else:
+                super().training(**{k: v})
+        return self
+
+    def rl_module(self, spec: RLModuleSpec) -> "SACConfig":
+        self.rl_module_spec = spec
+        return self
+
+
+@ray_tpu.remote
+class _SACRunner:
+    """CPU sampling actor: steps the env with the exploration forward of a
+    broadcast RLModule params snapshot, through connector pipelines."""
+
+    def __init__(self, env_payload, spec: RLModuleSpec, seed: int,
+                 scale_low: float, scale_high: float):
+        from ray_tpu.core.serialization import loads_function
+
+        self.env = loads_function(env_payload)()
+        self.module = spec.build(
+            self.env.observation_size, self.env.action_size
+        )
+        self.env_to_module = ConnectorPipeline([ObsToFloatBatch()])
+        self.module_to_env = ConnectorPipeline(
+            [ScaleActions(scale_low, scale_high)]
+        )
+        self.seed = seed
+        self._step_count = 0
+        self.obs = self.env.reset()
+        self.episode_return = 0.0
+        self.completed: list = []
+
+    def sample(self, params, n_steps: int, random_actions: bool = False):
+        import jax
+
+        rows = {k: [] for k in
+                ("obs", "actions", "rewards", "next_obs", "dones")}
+        rng = np.random.default_rng(self.seed + self._step_count)
+        for _ in range(n_steps):
+            if random_actions:
+                action = rng.uniform(-1.0, 1.0, self.env.action_size)
+            else:
+                batch = self.env_to_module({"obs": self.obs})
+                key = jax.random.PRNGKey(self.seed + self._step_count)
+                out = self.module.forward_exploration(params, batch, key)
+                action = np.asarray(out["actions"])[0]
+            env_action = self.module_to_env({"actions": action})["actions"]
+            next_obs, reward, done, _ = self.env.step(env_action)
+            rows["obs"].append(np.asarray(self.obs, np.float32))
+            rows["actions"].append(np.asarray(action, np.float32))
+            rows["rewards"].append(np.float32(reward))
+            rows["next_obs"].append(np.asarray(next_obs, np.float32))
+            rows["dones"].append(done)
+            self.episode_return += reward
+            self._step_count += 1
+            if done:
+                self.completed.append(self.episode_return)
+                self.episode_return = 0.0
+                self.obs = self.env.reset()
+            else:
+                self.obs = next_obs
+        episodes, self.completed = self.completed, []
+        return (
+            {k: np.asarray(v) for k, v in rows.items()},
+            episodes,
+        )
+
+
+class SAC(Algorithm):
+    def setup(self, config: SACConfig):
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from ray_tpu.core.serialization import dumps_function
+
+        hp = self.hp = config.hp
+        env_maker = config.env_maker
+        if env_maker is None:
+            from .env import Pendulum
+
+            env_maker = Pendulum
+        probe = env_maker()
+        self.obs_size = probe.observation_size
+        self.action_size = probe.action_size
+        low = getattr(probe, "action_low", -1.0)
+        high = getattr(probe, "action_high", 1.0)
+
+        config.rl_module_spec.model_config.setdefault("hidden", hp.hidden)
+        self.module = config.rl_module_spec.build(
+            self.obs_size, self.action_size
+        )
+        key = jax.random.PRNGKey(hp.seed)
+        self.params = self.module.init_state(key)
+        self.target_params = jax.tree.map(lambda x: x, self.params)
+        self.log_alpha = jnp.asarray(np.log(hp.init_alpha), jnp.float32)
+        target_entropy = (
+            hp.target_entropy
+            if hp.target_entropy is not None
+            else -float(self.action_size)
+        )
+
+        self.tx = optax.adam(hp.lr)
+        self.opt_state = self.tx.init(self.params)
+        self.alpha_tx = optax.adam(hp.lr)
+        self.alpha_opt_state = self.alpha_tx.init(self.log_alpha)
+        self.buffer = ReplayBuffer(hp.buffer_capacity, seed=hp.seed)
+        module = self.module
+        gamma, tau = hp.gamma, hp.tau
+
+        def update(params, target_params, log_alpha, opt_state,
+                   alpha_opt_state, batch, key):
+            alpha = jnp.exp(log_alpha)
+            k1, k2 = jax.random.split(key)
+
+            # Critic target: r + gamma * (min target-Q(s', a') - alpha logp')
+            next_a, next_logp = module.sample_action(
+                target_params, batch["next_obs"], k1
+            )
+            tq1, tq2 = module.q_values(
+                target_params, batch["next_obs"], next_a
+            )
+            target_v = jnp.minimum(tq1, tq2) - alpha * next_logp
+            nonterminal = 1.0 - batch["dones"].astype(jnp.float32)
+            target_q = batch["rewards"] + gamma * nonterminal * target_v
+            target_q = jax.lax.stop_gradient(target_q)
+
+            def critic_loss(p):
+                q1, q2 = module.q_values(p, batch["obs"], batch["actions"])
+                return ((q1 - target_q) ** 2 + (q2 - target_q) ** 2).mean()
+
+            def actor_loss(p):
+                a, logp = module.sample_action(p, batch["obs"], k2)
+                q1, q2 = module.q_values(p, batch["obs"], a)
+                # Critic params are held fixed for the actor step via the
+                # combined-gradient trick below (single optimizer).
+                return (alpha * logp - jnp.minimum(q1, q2)).mean(), logp
+
+            closs, cgrads = jax.value_and_grad(critic_loss)(params)
+            (aloss, logp), agrads = jax.value_and_grad(
+                actor_loss, has_aux=True
+            )(params)
+            # Actor gradients must not update the critics (and vice versa):
+            # zero the cross terms.
+            grads = {
+                "pi": agrads["pi"],
+                "q1": cgrads["q1"],
+                "q2": cgrads["q2"],
+            }
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            import optax as _optax
+
+            params = _optax.apply_updates(params, updates)
+
+            def alpha_loss(la):
+                return (
+                    -jnp.exp(la)
+                    * jax.lax.stop_gradient(logp + target_entropy)
+                ).mean()
+
+            al, agrad = jax.value_and_grad(alpha_loss)(log_alpha)
+            aupd, alpha_opt_state = self.alpha_tx.update(
+                agrad, alpha_opt_state, log_alpha
+            )
+            log_alpha = _optax.apply_updates(log_alpha, aupd)
+
+            target_params = jax.tree.map(
+                lambda t, p: (1 - tau) * t + tau * p, target_params, params
+            )
+            stats = {
+                "critic_loss": closs,
+                "actor_loss": aloss,
+                "alpha": jnp.exp(log_alpha),
+            }
+            return (params, target_params, log_alpha, opt_state,
+                    alpha_opt_state, stats)
+
+        self._update = jax.jit(update)
+        env_payload = dumps_function(env_maker)
+        self.runners = [
+            _SACRunner.remote(
+                env_payload, config.rl_module_spec, hp.seed + 17 * i,
+                low, high,
+            )
+            for i in range(max(1, hp.num_env_runners))
+        ]
+        self._total_steps = 0
+        self._episode_returns: list = []
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+
+        hp = self.hp
+        random_phase = self._total_steps < hp.warmup_steps
+        refs = [
+            r.sample.remote(self.params, hp.rollout_steps, random_phase)
+            for r in self.runners
+        ]
+        for batch, episodes in ray_tpu.get(refs, timeout=600):
+            self.buffer.add_batch(batch)
+            self._episode_returns.extend(episodes)
+            self._total_steps += len(batch["rewards"])
+        stats = {}
+        if len(self.buffer) >= hp.batch_size and not random_phase:
+            key = jax.random.PRNGKey(self._total_steps)
+            for i, k in enumerate(jax.random.split(key, hp.learn_steps_per_iter)):
+                batch = self.buffer.sample(hp.batch_size)
+                batch = {
+                    k2: jax.numpy.asarray(v) for k2, v in batch.items()
+                }
+                (self.params, self.target_params, self.log_alpha,
+                 self.opt_state, self.alpha_opt_state, stats) = self._update(
+                    self.params, self.target_params, self.log_alpha,
+                    self.opt_state, self.alpha_opt_state, batch, k,
+                )
+        recent = self._episode_returns[-20:]
+        return {
+            "episode_return_mean": (
+                float(np.mean(recent)) if recent else float("nan")
+            ),
+            "num_env_steps_sampled": self._total_steps,
+            **{k: float(v) for k, v in stats.items()},
+        }
+
+    def get_state(self):
+        return {
+            "params": self.params,
+            "target_params": self.target_params,
+            "log_alpha": self.log_alpha,
+            "total_steps": self._total_steps,
+        }
+
+    def set_state(self, state):
+        self.params = state["params"]
+        self.target_params = state["target_params"]
+        self.log_alpha = state["log_alpha"]
+        self._total_steps = state["total_steps"]
+
+    def cleanup(self):
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+
+
+SACConfig.ALGO_CLS = SAC

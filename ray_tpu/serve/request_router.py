@@ -109,7 +109,10 @@ class PrefixAwareRouter(RequestRouter):
         if warm is not None:
             lens = self._queue_lens(replicas)
             if lens is None:
-                return warm  # probes failed: keep affinity
+                # A probe failure may mean the warm replica is dead —
+                # surface it so the handle force-refreshes and retries
+                # (returning warm here would poison the hot prefix).
+                raise ReplicaProbeError("queue probes failed")
             warm_len = lens[replicas.index(warm)]
             min_len = min(lens)
             if warm_len <= max(self.imbalance_factor * max(min_len, 1), 1):

@@ -1,10 +1,13 @@
-"""Checkpoints: directory-based, storage-path persisted.
+"""Checkpoints: directory-based, persisted through a StorageContext.
 
-Reference surface: ray ``python/ray/train/_checkpoint.py`` (Checkpoint) and
+Reference surface: ray ``python/ray/train/_checkpoint.py`` (Checkpoint),
 ``train/v2/_internal/execution/checkpoint/checkpoint_manager.py`` (top-K
-retention).  TPU note: sharded jax.Array checkpoints should be saved with
-orbax into a checkpoint directory and then reported here — the manager only
-moves directories, it never loads tensors.
+retention), and ``train/_internal/storage.py:358`` (fsspec StorageContext).
+``storage_path`` may be a local directory or a remote URI
+(``memory://…`` = the cluster-KV-backed remote — see ``storage.py``); the
+manager and the worker-side commit route every transfer through the
+storage backend.  TPU note: sharded jax.Array checkpoints save via
+``train.jax_ckpt`` (async per-leaf save) into the directory before report.
 """
 
 from __future__ import annotations
@@ -35,14 +38,25 @@ class Checkpoint:
         return cls(d)
 
     def to_directory(self) -> str:
+        from .storage import get_storage, is_remote_uri
+
+        if is_remote_uri(self.path):
+            # One download per Checkpoint object — repeated to_dict()/
+            # as_directory() calls reuse the local copy instead of filling
+            # /tmp with duplicates.
+            cached = getattr(self, "_local_cache", None)
+            if cached is None or not os.path.isdir(cached):
+                cached = get_storage(self.path).download_dir(self.path)
+                self._local_cache = cached
+            return cached
         return self.path
 
     def to_dict(self) -> Dict[str, Any]:
-        with open(os.path.join(self.path, "data.json")) as f:
+        with open(os.path.join(self.to_directory(), "data.json")) as f:
             return json.load(f)
 
     def as_directory(self):
-        return _CheckpointDirCtx(self.path)
+        return _CheckpointDirCtx(self.to_directory())
 
     def __reduce__(self):
         return (Checkpoint, (self.path,))
@@ -63,13 +77,15 @@ class _CheckpointDirCtx:
 
 
 def commit_to_storage(checkpoint: Checkpoint, run_dir: str) -> Checkpoint:
-    """Worker-side synchronous persist: copy a local checkpoint dir into the
-    run's durable storage *before* report() returns, so a crash immediately
-    after report loses nothing (the reference's report semantics).  Names are
-    time-ordered so `latest` is a directory scan."""
-    os.makedirs(run_dir, exist_ok=True)
-    dest = os.path.join(run_dir, f"checkpoint_{time.time_ns():020d}")
-    shutil.copytree(checkpoint.path, dest)
+    """Worker-side synchronous persist: upload a local checkpoint dir into
+    the run's durable storage *before* report() returns, so a crash
+    immediately after report loses nothing (the reference's report
+    semantics).  Names are time-ordered so `latest` is a listing scan."""
+    from .storage import get_storage
+
+    dest = get_storage(run_dir).upload_dir(
+        checkpoint.path, f"checkpoint_{time.time_ns():020d}"
+    )
     return Checkpoint(dest)
 
 
@@ -79,26 +95,20 @@ class CheckpointManager:
     attempt) and prunes to top-K."""
 
     def __init__(self, storage_path: str, run_name: str, num_to_keep=None):
-        self.run_dir = os.path.join(storage_path, run_name or "run")
-        os.makedirs(self.run_dir, exist_ok=True)
+        from .storage import get_storage, is_remote_uri, join_path
+
+        self.run_dir = join_path(storage_path, run_name or "run")
+        self._storage = get_storage(self.run_dir)
+        if not is_remote_uri(self.run_dir):
+            os.makedirs(self.run_dir, exist_ok=True)
         self.num_to_keep = num_to_keep
         self._extra: List[str] = []  # e.g. resume_from_checkpoint
 
     def register(self, path: str):
         self._extra.append(path)
 
-    def _scan(self) -> List[str]:
-        try:
-            names = sorted(
-                n for n in os.listdir(self.run_dir)
-                if n.startswith("checkpoint_")
-            )
-        except FileNotFoundError:
-            names = []
-        return [os.path.join(self.run_dir, n) for n in names]
-
     def latest(self) -> Optional[Checkpoint]:
-        found = self._scan()
+        found = self._storage.list_checkpoints()
         if found:
             return Checkpoint(found[-1])
         if self._extra:
@@ -108,6 +118,6 @@ class CheckpointManager:
     def prune(self):
         if self.num_to_keep is None:
             return
-        found = self._scan()
+        found = self._storage.list_checkpoints()
         for victim in found[: -self.num_to_keep]:
-            shutil.rmtree(victim, ignore_errors=True)
+            self._storage.delete(victim)

@@ -1,0 +1,114 @@
+"""Async sharded checkpointing for jax.Array pytrees.
+
+The orbax-style save hook (reference role: ray Train's torch/lightning
+checkpoint utilities; on TPU the ecosystem answer is orbax
+``AsyncCheckpointer``): device arrays transfer to host and write as one
+``.npy`` per leaf plus a pytree manifest, with the disk writes running on
+a background thread so the train step resumes as soon as device→host
+transfer finishes (the async-checkpoint overlap that matters at pod
+scale).  Restore optionally re-places leaves with a sharding tree.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Optional
+
+_MANIFEST = "pytree.json"
+
+
+def _flatten_with_paths(tree):
+    import jax
+
+    leaves_with_paths = jax.tree_util.tree_flatten_with_path(tree)[0]
+    out = []
+    for path, leaf in leaves_with_paths:
+        name = "_".join(
+            str(getattr(p, "key", getattr(p, "idx", p))) for p in path
+        )
+        out.append((name or "leaf", leaf))
+    return out
+
+
+def save_sharded(tree, directory: str) -> None:
+    """Synchronous save: one .npy per leaf + manifest."""
+    import jax
+    import numpy as np
+
+    os.makedirs(directory, exist_ok=True)
+    named = _flatten_with_paths(tree)
+    treedef = jax.tree_util.tree_structure(tree)
+    manifest = {"leaves": [n for n, _ in named], "treedef": str(treedef)}
+    # Device→host first (this is the part the caller must wait for).
+    host = [(n, np.asarray(l)) for n, l in named]
+    for name, arr in host:
+        np.save(os.path.join(directory, f"{name}.npy"), arr)
+    with open(os.path.join(directory, _MANIFEST), "w") as f:
+        json.dump(manifest, f)
+
+
+class AsyncSave:
+    """Handle for an in-flight background save; ``wait()`` before
+    committing the checkpoint directory."""
+
+    def __init__(self, thread: threading.Thread):
+        self._thread = thread
+        self.error: Optional[BaseException] = None
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise TimeoutError("async checkpoint save still running")
+        if self.error is not None:
+            raise self.error
+
+
+def async_save_sharded(tree, directory: str) -> AsyncSave:
+    """Device→host transfer happens NOW (so training may mutate the donated
+    buffers immediately after return); the .npy writes run on a thread."""
+    import jax
+    import numpy as np
+
+    named = _flatten_with_paths(tree)
+    host = [(n, np.asarray(l)) for n, l in named]  # sync transfer
+    treedef = jax.tree_util.tree_structure(tree)
+    manifest = {"leaves": [n for n, _ in host], "treedef": str(treedef)}
+
+    handle_box = {}
+
+    def write():
+        try:
+            os.makedirs(directory, exist_ok=True)
+            for name, arr in host:
+                np.save(os.path.join(directory, f"{name}.npy"), arr)
+            with open(os.path.join(directory, _MANIFEST), "w") as f:
+                json.dump(manifest, f)
+        except BaseException as e:  # noqa: BLE001 — surfaced via wait()
+            handle_box["handle"].error = e
+
+    thread = threading.Thread(target=write, daemon=True)
+    handle = AsyncSave(thread)
+    handle_box["handle"] = handle
+    thread.start()
+    return handle
+
+
+def restore_sharded(tree_like, directory: str, shardings=None):
+    """Restore into the structure of ``tree_like``; with ``shardings`` (a
+    matching pytree of NamedShardings) leaves are placed sharded."""
+    import jax
+    import numpy as np
+
+    named = _flatten_with_paths(tree_like)
+    arrays = [
+        np.load(os.path.join(directory, f"{name}.npy")) for name, _ in named
+    ]
+    treedef = jax.tree_util.tree_structure(tree_like)
+    restored = jax.tree_util.tree_unflatten(treedef, arrays)
+    if shardings is not None:
+        restored = jax.tree.map(
+            lambda a, s: jax.device_put(a, s), restored, shardings
+        )
+    return restored

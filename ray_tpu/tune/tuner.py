@@ -289,10 +289,13 @@ class Tuner:
                             )
                     except Exception:
                         pass
-                    if searcher is not None and st["history"]:
-                        searcher.on_trial_complete(
-                            trial_id, st["history"][-1]
-                        )
+                    if searcher is not None:
+                        final = dict(st["history"][-1]) if st["history"] else {}
+                        if error is not None:
+                            final["error"] = True
+                        # Always fires (even for crashed/report-less trials)
+                        # so the searcher's live-trial table cannot leak.
+                        searcher.on_trial_complete(trial_id, final)
                     results.append(
                         TrialResult(
                             trial_id=trial_id,

@@ -1,0 +1,152 @@
+"""Distributed tracing: span propagation across task and actor calls.
+
+Reference: ray ``python/ray/util/tracing/tracing_helper.py:34,165`` — an
+OpenTelemetry context is injected into every task spec at submission and
+extracted on the executing worker, so one trace follows a request through
+arbitrary task/actor hops.  Native redesign (no opentelemetry dependency,
+which this image does not ship): spans are (trace_id, span_id, parent_id,
+name, start, end, attrs) tuples carried in a contextvar, injected into
+``TaskSpec.trace_ctx``, and recorded through the existing task-event
+buffer's profile channel — so traces land in the same control-plane store
+the timeline and state API already read, and export as Chrome-trace rows.
+
+Usage:
+    with tracing.start_span("preprocess") as span:
+        ...                       # user code; nested submits inherit
+    spans = tracing.get_trace(span.trace_id)   # driver-side query
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import dataclasses
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+# (trace_id, span_id) of the currently active span in THIS process/task.
+_current: contextvars.ContextVar[Optional[Tuple[str, str]]] = (
+    contextvars.ContextVar("ray_tpu_trace_ctx", default=None)
+)
+
+
+def _rand_id(nbytes: int = 8) -> str:
+    return os.urandom(nbytes).hex()
+
+
+@dataclasses.dataclass
+class Span:
+    trace_id: str
+    span_id: str
+    parent_id: Optional[str]
+    name: str
+    start: float = 0.0
+    end: float = 0.0
+    attributes: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        self.attributes[key] = value
+
+
+def current_context() -> Optional[Tuple[str, str]]:
+    """(trace_id, span_id) to inject into outgoing task specs."""
+    return _current.get()
+
+
+def set_context(ctx: Optional[Tuple[str, str]]):
+    """Install an extracted trace context (executor side)."""
+    return _current.set(ctx)
+
+
+def _record(span: Span) -> None:
+    from ray_tpu.core.core_worker import try_global_worker
+
+    w = try_global_worker()
+    if w is None or w.task_events is None:
+        return
+    # Ride the profile-event channel: same buffer, flush loop, and
+    # control-plane store as the task timeline.
+    w.task_events._profile_events.append(
+        {
+            "name": span.name,
+            "start": span.start,
+            "end": span.end,
+            "worker_id": w.worker_id.hex(),
+            "node_id": w.node_id.hex(),
+            "extra": {
+                "span": True,
+                "trace_id": span.trace_id,
+                "span_id": span.span_id,
+                "parent_id": span.parent_id,
+                **span.attributes,
+            },
+        }
+    )
+
+
+@contextlib.contextmanager
+def start_span(name: str, attributes: Optional[Dict[str, Any]] = None):
+    """Open a span; children (including spans opened inside tasks this
+    block submits) parent to it."""
+    parent = _current.get()
+    span = Span(
+        trace_id=parent[0] if parent else _rand_id(16),
+        span_id=_rand_id(),
+        parent_id=parent[1] if parent else None,
+        name=name,
+        start=time.time(),
+        attributes=dict(attributes or {}),
+    )
+    token = _current.set((span.trace_id, span.span_id))
+    try:
+        yield span
+    finally:
+        span.end = time.time()
+        _current.reset(token)
+        _record(span)
+
+
+@contextlib.contextmanager
+def task_execution_span(spec) -> Any:
+    """Executor-side: extract the submitted trace context (if any) and wrap
+    the task body in a span (the tracing_helper wrap of task execution)."""
+    ctx = getattr(spec, "trace_ctx", None)
+    if ctx is None:
+        yield None
+        return
+    token = set_context(tuple(ctx))
+    try:
+        with start_span(
+            f"task:{spec.name}", {"task_id": spec.task_id.hex()}
+        ) as span:
+            yield span
+    finally:
+        _current.reset(token)
+
+
+def get_trace(trace_id: str, timeout: float = 30.0,
+              min_spans: int = 0) -> List[Dict[str, Any]]:
+    """Fetch all recorded spans of a trace from the control plane.
+
+    Remote workers flush their span buffers on a short period; with
+    ``min_spans`` the query polls until that many spans arrived (or
+    ``timeout`` elapses) instead of racing the flush."""
+    from ray_tpu.core.core_worker import global_worker
+
+    w = global_worker()
+    deadline = time.monotonic() + timeout
+    while True:
+        # Push local spans out before asking.
+        w._run_sync(w.task_events.flush())
+        reply = w._run_sync(
+            w.cp.call("list_task_events", {}, timeout=timeout)
+        )
+        spans = []
+        for ev in reply.get("profile_events", ()):
+            extra = ev.get("extra") or {}
+            if extra.get("span") and extra.get("trace_id") == trace_id:
+                spans.append(ev)
+        if len(spans) >= min_spans or time.monotonic() > deadline:
+            return spans
+        time.sleep(0.2)

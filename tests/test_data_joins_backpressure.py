@@ -83,19 +83,35 @@ class TestHashJoin:
         assert all(r["v"] % 2 == 0 and r["w"] == r["k"] for r in got)
 
     def test_join_key_function(self, ray_cluster):
-        left = rd.from_items([1, 2, 3, 4], parallelism=2)
-        right = rd.from_items([2, 4, 6], parallelism=1)
+        """Callable join keys route through row_key on both sides."""
         got = (
-            rd.from_items([{"k": v} for v in [1, 2, 3, 4]], parallelism=2)
+            rd.from_items(
+                [{"a": v} for v in [1, 2, 3, 4]], parallelism=2
+            )
             .join(
-                rd.from_items([{"k": v} for v in [2, 4, 6]], parallelism=1),
-                on="k",
+                rd.from_items([{"b": v} for v in [12, 14, 16]], parallelism=1),
+                on=lambda r: r["a"] % 10,
+                right_on=lambda r: r["b"] % 10,
                 num_partitions=2,
             )
             .take_all()
         )
-        assert sorted(r["k"] for r in got) == [2, 4]
-        _ = left, right
+        assert sorted((r["a"], r["b"]) for r in got) == [(2, 12), (4, 14)]
+
+    def test_join_string_keys_across_workers(self, ray_cluster):
+        """String keys must partition identically in different worker
+        processes (seed-randomized builtin hash would break this)."""
+        names = ["alice", "bob", "carol", "dave", "erin", "frank"]
+        left = rd.from_items(
+            [{"k": n, "l": i} for i, n in enumerate(names)], parallelism=3
+        )
+        right = rd.from_items(
+            [{"k": n, "r": i * 10} for i, n in enumerate(names)],
+            parallelism=2,
+        )
+        got = left.join(right, on="k", num_partitions=3).take_all()
+        assert len(got) == len(names)
+        assert all(r["r"] == r["l"] * 10 for r in got)
 
     def test_unsupported_join_type(self, ray_cluster):
         with pytest.raises(ValueError):

@@ -1,0 +1,156 @@
+"""RLModule abstraction, connector pipelines, and SAC.
+
+Reference: ray ``rllib/core/rl_module/rl_module.py``,
+``rllib/connectors/``, ``rllib/algorithms/sac/``.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import (
+    ComputeGAE,
+    ConnectorPipeline,
+    DiscretePolicyModule,
+    MultiRLModule,
+    NormalizeAdvantages,
+    NormalizeObs,
+    ObsToFloatBatch,
+    Pendulum,
+    RLModuleSpec,
+    SAC,
+    SACConfig,
+    SACModule,
+    ScaleActions,
+)
+
+
+class TestRLModule:
+    def test_discrete_module_forwards(self):
+        import jax
+
+        mod = RLModuleSpec(DiscretePolicyModule, {"hidden": 16}).build(4, 2)
+        params = mod.init_state(jax.random.PRNGKey(0))
+        batch = {"obs": np.zeros((3, 4), np.float32)}
+        inf = mod.forward_inference(params, batch)
+        assert inf["actions"].shape == (3,)
+        exp = mod.forward_exploration(params, batch, jax.random.PRNGKey(1))
+        assert exp["action_logp"].shape == (3,)
+        tr = mod.forward_train(params, batch)
+        assert tr["logits"].shape == (3, 2) and tr["vf_preds"].shape == (3,)
+
+    def test_sac_module_tanh_bounds_and_logp(self):
+        import jax
+
+        mod = RLModuleSpec(SACModule, {"hidden": 16}).build(3, 1)
+        params = mod.init_state(jax.random.PRNGKey(0))
+        obs = np.random.default_rng(0).normal(size=(64, 3)).astype(np.float32)
+        a, logp = mod.sample_action(params, obs, jax.random.PRNGKey(1))
+        assert a.shape == (64, 1) and np.all(np.abs(np.asarray(a)) <= 1.0)
+        assert np.isfinite(np.asarray(logp)).all()
+        q1, q2 = mod.q_values(params, obs, np.asarray(a))
+        assert q1.shape == (64,) and not np.allclose(
+            np.asarray(q1), np.asarray(q2)
+        )
+
+    def test_multi_rl_module(self):
+        import jax
+
+        multi = MultiRLModule({
+            "a": RLModuleSpec(DiscretePolicyModule).build(4, 2),
+            "b": RLModuleSpec(SACModule).build(3, 1),
+        })
+        params = multi.init_state(jax.random.PRNGKey(0))
+        assert set(params.keys()) == {"a", "b"}
+        assert set(multi.keys()) == {"a", "b"}
+        assert isinstance(multi["b"], SACModule)
+
+
+class TestConnectors:
+    def test_pipeline_composes_in_order(self):
+        pipe = ConnectorPipeline([ObsToFloatBatch()])
+        pipe.append(NormalizeObs())
+        out = pipe({"obs": [1.0, 2.0, 3.0]})
+        assert out["obs"].shape == (1, 3)
+        assert out["obs"].dtype == np.float32
+
+    def test_scale_actions(self):
+        scale = ScaleActions(low=-2.0, high=2.0)
+        out = scale({"actions": np.array([-1.0, 0.0, 1.0])})
+        np.testing.assert_allclose(out["actions"], [-2.0, 0.0, 2.0])
+
+    def test_gae_matches_handwritten(self):
+        gae = ComputeGAE(gamma=0.5, lam=1.0)
+        batch = {
+            "rewards": [1.0, 1.0],
+            "dones": [False, True],
+            "vf_preds": [0.0, 0.0],
+        }
+        out = gae(batch, last_value=0.0)
+        # t=1: delta = 1; t=0: delta = 1 + 0.5*0 - 0 = 1, gae = 1 + .5*1
+        np.testing.assert_allclose(out["advantages"], [1.5, 1.0])
+        np.testing.assert_allclose(out["returns"], [1.5, 1.0])
+
+    def test_normalize_advantages(self):
+        out = NormalizeAdvantages()({"advantages": np.array([1.0, 3.0])})
+        np.testing.assert_allclose(out["advantages"].mean(), 0.0, atol=1e-6)
+
+
+class TestSAC:
+    @pytest.fixture
+    def ray_cluster(self):
+        ray_tpu.init(num_cpus=4)
+        yield
+        ray_tpu.shutdown()
+
+    def test_sac_improves_on_pendulum(self, ray_cluster):
+        algo = (
+            SACConfig()
+            .environment(Pendulum)
+            .training(
+                rollout_steps=400, learn_steps_per_iter=100,
+                warmup_steps=600, batch_size=128, hidden=64, seed=0,
+            )
+            .build()
+        )
+        try:
+            returns = []
+            for _ in range(20):
+                result = algo.train()
+                if not np.isnan(result["episode_return_mean"]):
+                    returns.append(result["episode_return_mean"])
+            assert len(returns) >= 6
+            first = float(np.mean(returns[:3]))
+            last = float(np.mean(returns[-3:]))
+            # Pendulum returns are negative; learning must lift them far
+            # above the random-policy baseline (measured: -1300 → -450
+            # around 8k env steps with this config).
+            assert last > first + 250, (first, last)
+        finally:
+            algo.stop()
+
+    def test_sac_state_roundtrip(self, ray_cluster, tmp_path):
+        algo = (
+            SACConfig()
+            .environment(Pendulum)
+            .training(rollout_steps=50, warmup_steps=10,
+                      learn_steps_per_iter=4, batch_size=32, hidden=16)
+            .build()
+        )
+        try:
+            algo.train()
+            path = algo.save(str(tmp_path / "ck"))
+            algo2 = (
+                SACConfig()
+                .environment(Pendulum)
+                .training(rollout_steps=50, warmup_steps=10,
+                          learn_steps_per_iter=4, batch_size=32, hidden=16)
+                .build()
+            )
+            try:
+                algo2.restore(path)
+                assert algo2._total_steps == algo._total_steps
+            finally:
+                algo2.stop()
+        finally:
+            algo.stop()

@@ -1,0 +1,129 @@
+"""Distributed tracing spans + structured cluster event export.
+
+Reference: ray ``python/ray/util/tracing/tracing_helper.py:34,165`` (span
+context injected into task specs, extracted on executors) and
+``src/ray/observability/ray_event_recorder.h`` (typed lifecycle events
+shipped for external export).
+"""
+
+import json
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import tracing
+
+
+@pytest.fixture
+def ray_cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+class TestTracing:
+    def test_span_parenting_local(self, ray_cluster):
+        with tracing.start_span("outer") as outer:
+            with tracing.start_span("inner") as inner:
+                pass
+        assert inner.trace_id == outer.trace_id
+        assert inner.parent_id == outer.span_id
+        spans = tracing.get_trace(outer.trace_id)
+        names = {s["name"] for s in spans}
+        assert {"outer", "inner"} <= names
+
+    def test_trace_propagates_through_tasks(self, ray_cluster):
+        @ray_tpu.remote
+        def child():
+            # The executing worker carries the submitted trace context:
+            # spans opened inside the task join the caller's trace.
+            with tracing.start_span("inside-child"):
+                return True
+
+        with tracing.start_span("driver-root") as root:
+            assert ray_tpu.get(child.remote(), timeout=60)
+
+        spans = tracing.get_trace(root.trace_id, min_spans=3)
+        names = {s["name"] for s in spans}
+        assert "driver-root" in names
+        assert "task:child" in names  # auto span around task execution
+        assert "inside-child" in names
+        # The task's auto-span parents to the driver span.
+        by_name = {s["name"]: s["extra"] for s in spans}
+        assert by_name["task:child"]["parent_id"] == root.span_id
+        assert by_name["inside-child"]["trace_id"] == root.trace_id
+
+    def test_trace_propagates_through_actor_calls(self, ray_cluster):
+        @ray_tpu.remote
+        class A:
+            def work(self):
+                with tracing.start_span("actor-work"):
+                    return 1
+
+        a = A.remote()
+        with tracing.start_span("actor-root") as root:
+            assert ray_tpu.get(a.work.remote(), timeout=60) == 1
+        spans = tracing.get_trace(root.trace_id, min_spans=2)
+        names = {s["name"] for s in spans}
+        assert "actor-work" in names
+        ray_tpu.kill(a)
+
+    def test_no_span_no_context(self, ray_cluster):
+        @ray_tpu.remote
+        def probe():
+            return tracing.current_context()
+
+        assert ray_tpu.get(probe.remote(), timeout=60) is None
+
+
+class TestClusterEvents:
+    def _events(self, **filters):
+        from ray_tpu.api import global_worker
+
+        w = global_worker()
+        return w._run_sync(
+            w.cp.call("list_cluster_events", filters, timeout=30)
+        )
+
+    def test_lifecycle_events_recorded(self, ray_cluster):
+        @ray_tpu.remote
+        class C:
+            def ping(self):
+                return 1
+
+        c = C.options(name="evt-actor").remote()
+        assert ray_tpu.get(c.ping.remote(), timeout=60) == 1
+        pg = ray_tpu.placement_group([{"CPU": 1}])
+        assert pg.ready(timeout=60)
+        ray_tpu.remove_placement_group(pg)
+        ray_tpu.kill(c)
+
+        events = self._events()
+        types = {e["event_type"] for e in events}
+        assert {"NODE_LIFECYCLE", "ACTOR_DEFINITION", "ACTOR_LIFECYCLE",
+                "JOB_LIFECYCLE", "PG_LIFECYCLE"} <= types
+        pg_states = [
+            e["state"] for e in events if e["event_type"] == "PG_LIFECYCLE"
+        ]
+        assert pg_states == ["PENDING", "CREATED", "REMOVED"]
+        actor_defs = [
+            e for e in events if e["event_type"] == "ACTOR_DEFINITION"
+        ]
+        assert any(e["name"] == "evt-actor" for e in actor_defs)
+
+    def test_filtering(self, ray_cluster):
+        events = self._events(event_type="JOB_LIFECYCLE")
+        assert events and all(
+            e["event_type"] == "JOB_LIFECYCLE" for e in events
+        )
+
+    def test_export_file_written(self, ray_cluster):
+        from ray_tpu import api
+
+        log_dir = api._local_node.log_dir
+        # Events export next to the control-plane store.
+        path = os.path.join(log_dir, "events.jsonl")
+        assert os.path.exists(path)
+        lines = [json.loads(l) for l in open(path) if l.strip()]
+        assert lines and {"seq", "event_type", "state"} <= set(lines[0])
