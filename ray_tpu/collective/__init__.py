@@ -17,3 +17,11 @@ from .collective import (  # noqa: F401
 )
 from .device_objects import DeviceObjectStore, DeviceRef, device_object_store  # noqa: F401
 from .types import Backend, GroupInfo, ReduceOp  # noqa: F401
+from .experimental import (  # noqa: F401
+    RemoteCommunicatorManager,
+    create_collective_group,
+    get_collective_groups,
+)
+from .experimental import (  # noqa: F401
+    destroy_collective_group as destroy_actor_collective_group,
+)

@@ -37,24 +37,28 @@ class DeviceRef:
 
 
 class DeviceObjectStore:
-    """Per-process store of device-resident jax.Arrays."""
+    """Per-process store of device-resident jax.Arrays.
+
+    Residency is governed by owner-side REFERENCE COUNTS (the reference's
+    ``gpu_object_store.py:169`` semantics), not a fixed LRU cap: ``put``
+    creates one reference, ``retain``/``release`` adjust it (remotely via
+    the owner's worker RPC for borrowed refs), and the array leaves HBM
+    exactly when the count hits zero."""
 
     def __init__(self):
         self._objects: Dict[ObjectID, object] = {}
+        self._refcounts: Dict[ObjectID, int] = {}
         self._lock = threading.Lock()
-
-    # Residency cap: without distributed ref counting, an unbounded store
-    # would leak HBM across a long-lived actor's lifetime.  Oldest entries
-    # evict (consumers then pay a re-fetch failure — loud, not a leak).
-    MAX_OBJECTS = 256
+        # Instrumentation: how the most recent fetch() resolved —
+        # "local" | "collective" | "p2p_rpc" (tests assert the transfer
+        # path; ops dashboards read it as a counter source).
+        self.last_transfer_path: Optional[str] = None
 
     def put(self, array, group_name: str = "default", rank: int = 0) -> DeviceRef:
         oid = ObjectID.from_random()
         with self._lock:
             self._objects[oid] = array
-            while len(self._objects) > self.MAX_OBJECTS:
-                evicted = next(iter(self._objects))
-                del self._objects[evicted]
+            self._refcounts[oid] = 1
         owner_address = ""
         from ray_tpu.core.core_worker import try_global_worker
 
@@ -64,6 +68,29 @@ class DeviceObjectStore:
         return DeviceRef(
             oid, tuple(array.shape), str(array.dtype), rank, group_name,
             owner_address,
+        )
+
+    def retain(self, ref: DeviceRef) -> int:
+        """Add one owner-side reference (local fast path, RPC otherwise)."""
+        with self._lock:
+            if ref.object_id in self._objects:
+                self._refcounts[ref.object_id] += 1
+                return self._refcounts[ref.object_id]
+        return self._owner_call(ref, "device_retain")
+
+    def refcount(self, ref: DeviceRef) -> int:
+        with self._lock:
+            if ref.object_id in self._refcounts:
+                return self._refcounts[ref.object_id]
+        return self._owner_call(ref, "device_refcount")
+
+    def _owner_call(self, ref: DeviceRef, method: str) -> int:
+        from ray_tpu.core.core_worker import global_worker
+
+        worker = global_worker()
+        client = worker.worker_clients.get(ref.owner_address)
+        return worker._run_sync(
+            client.call(method, {"object_id": ref.object_id})
         )
 
     def get_local(self, ref: DeviceRef):
@@ -88,16 +115,32 @@ class DeviceObjectStore:
            NCCL-transport shape; pair with ``serve_fetch`` on the owner).
         """
         if self.contains(ref):
+            self.last_transfer_path = "local"
             return self.get_local(ref)
+        from .collective import is_group_initialized
+
+        if is_group_initialized(ref.group_name):
+            # Collective path: the transfer is a device-level broadcast
+            # (jax collective over the mesh — ICI on TPU), no host-staged
+            # byte copy.  All group members call fetch() collectively; the
+            # owner pairs it with serve_fetch().
+            from .collective import get_group
+
+            group = get_group(ref.group_name)
+            import jax.numpy as jnp
+
+            placeholder = jnp.zeros(ref.shape, dtype=ref.dtype)
+            out = group.broadcast(placeholder, src_rank=ref.owner_rank)
+            self.last_transfer_path = "collective"
+            return out
         if ref.owner_address:
-            return self._fetch_rpc(ref)
-        from .collective import get_group
-
-        group = get_group(ref.group_name)
-        import jax.numpy as jnp
-
-        placeholder = jnp.zeros(ref.shape, dtype=ref.dtype)
-        return group.broadcast(placeholder, src_rank=ref.owner_rank)
+            out = self._fetch_rpc(ref)
+            self.last_transfer_path = "p2p_rpc"
+            return out
+        raise KeyError(
+            f"device object {ref.object_id}: no group initialized and no "
+            "owner address to fetch from"
+        )
 
     def _fetch_rpc(self, ref: DeviceRef):
         from ray_tpu.core.core_worker import global_worker
@@ -110,10 +153,16 @@ class DeviceObjectStore:
         return array_from_fetch_reply(ref, reply)
 
     def free(self, ref: DeviceRef) -> bool:
-        """Release locally; if remote-owned, ask the owner to release."""
+        """Drop one reference; the array leaves HBM at refcount zero.
+        Remote-owned refs release at the owner via RPC."""
         with self._lock:
-            if self._objects.pop(ref.object_id, None) is not None:
-                return True
+            if ref.object_id in self._objects:
+                self._refcounts[ref.object_id] -= 1
+                if self._refcounts[ref.object_id] <= 0:
+                    del self._objects[ref.object_id]
+                    del self._refcounts[ref.object_id]
+                    return True
+                return False
         if ref.owner_address:
             from ray_tpu.core.core_worker import try_global_worker
 

@@ -189,6 +189,18 @@ class ActorMethod:
         return ClassMethodNode(self._handle, self._method_name, args, kwargs)
 
 
+def execute_on_actor(handle: "ActorHandle", fn, *args, **kwargs):
+    """Run an arbitrary callable inside an actor's process with the actor
+    instance as first argument (ray's ``actor.__ray_call__`` analog) —
+    the hook out-of-band protocols (collective group init, device-object
+    transfers) use to reach inside user actors."""
+    from .serialization import dumps_function
+
+    return ActorMethod(handle, "__rtpu_exec__").remote(
+        dumps_function(fn), *args, **kwargs
+    )
+
+
 class ActorHandle:
     def __init__(self, actor_id: ActorID):
         self._actor_id = actor_id

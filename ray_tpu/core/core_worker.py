@@ -1880,6 +1880,22 @@ class CoreWorker:
                 from ..dag.worker_loop import dag_exec_loop
 
                 method = functools.partial(dag_exec_loop, self.actor_instance)
+            elif method_name == "__rtpu_exec__":
+                # Generic in-actor execution (ray's ``__ray_call__`` analog):
+                # first arg is a pickled callable invoked with the actor
+                # instance — how out-of-band protocols (collective group
+                # init, device-object hooks) run inside user actors without
+                # requiring methods on the user class.
+                import functools
+
+                from .serialization import loads_function
+
+                def _exec(fn_payload, *a, **kw):
+                    return loads_function(fn_payload)(
+                        self.actor_instance, *a, **kw
+                    )
+
+                method = _exec
             else:
                 method = getattr(self.actor_instance, method_name)
             async with self._actor_exec_lock:
@@ -1909,11 +1925,38 @@ class CoreWorker:
         return {"found": True, "data": np.asarray(arr).tobytes()}
 
     def handle_device_free(self, payload, conn):
+        """Owner-side release of one reference (refcounted residency)."""
+        from ..collective.device_objects import device_object_store
+
+        store = device_object_store()
+        oid = payload["object_id"]
+        with store._lock:
+            if oid not in store._objects:
+                return False
+            store._refcounts[oid] -= 1
+            if store._refcounts[oid] <= 0:
+                del store._objects[oid]
+                del store._refcounts[oid]
+                return True
+            return False
+
+    def handle_device_retain(self, payload, conn):
+        from ..collective.device_objects import device_object_store
+
+        store = device_object_store()
+        oid = payload["object_id"]
+        with store._lock:
+            if oid not in store._objects:
+                raise KeyError(f"device object {oid} not resident")
+            store._refcounts[oid] += 1
+            return store._refcounts[oid]
+
+    def handle_device_refcount(self, payload, conn):
         from ..collective.device_objects import device_object_store
 
         store = device_object_store()
         with store._lock:
-            return store._objects.pop(payload["object_id"], None) is not None
+            return store._refcounts.get(payload["object_id"], 0)
 
     def handle_ping(self, payload, conn):
         return "pong"

@@ -43,12 +43,16 @@ class CollectiveOpNode(ClassMethodNode):
     participant's upstream value, emits the reduced tensor on this
     participant's actor."""
 
-    def __init__(self, actor_handle, participants: Sequence[DAGNode], op: str):
+    def __init__(self, actor_handle, participants: Sequence[DAGNode],
+                 op: str, group_name: str = None):
+        kwargs = {"_op": op}
+        if group_name is not None:
+            kwargs["_group"] = group_name
         super().__init__(
             actor_handle,
             RESERVED_COLLECTIVE_METHOD,
             tuple(participants),
-            {"_op": op},
+            kwargs,
         )
 
     def _execute_impl(self, cache, input_args, input_kwargs):
@@ -83,7 +87,8 @@ class CollectiveOpNode(ClassMethodNode):
 
 
 def allreduce_bind(
-    nodes: Sequence[ClassMethodNode], op: str = "sum"
+    nodes: Sequence[ClassMethodNode], op: str = "sum",
+    group_name: str = None,
 ) -> List[CollectiveOpNode]:
     """Bind an allreduce across per-actor nodes; returns one output node per
     participant (reference: ``ray.experimental.collective.allreduce.bind``).
@@ -100,4 +105,7 @@ def allreduce_bind(
     actor_ids = {n._actor._actor_id for n in nodes}
     if len(actor_ids) != len(nodes):
         raise ValueError("each participant must live on a distinct actor")
-    return [CollectiveOpNode(n._actor, nodes, op) for n in nodes]
+    # group_name: participants reduce through the named collective group's
+    # device op (psum over the group mesh — ICI on a TPU slice with the
+    # xla backend) instead of the host numpy reduction.
+    return [CollectiveOpNode(n._actor, nodes, op, group_name) for n in nodes]

@@ -110,9 +110,35 @@ def dag_exec_loop(instance, plan: Dict[str, Any]) -> str:
                         )
 
                         if op["method"] == RESERVED_COLLECTIVE_METHOD:
-                            # In-graph allreduce: args are every
-                            # participant's value; reduce locally.
-                            result = apply_collective(kwargs["_op"], args)
+                            group_name = kwargs.get("_group")
+                            if group_name is not None:
+                                # Device-path reduction: psum over the
+                                # bound collective group's mesh (the same
+                                # path DeviceRef transfers ride; ICI with
+                                # the xla backend on a real slice).
+                                from ray_tpu.collective import (
+                                    ReduceOp, allreduce,
+                                )
+
+                                _rop = {
+                                    "sum": ReduceOp.SUM,
+                                    "mean": ReduceOp.MEAN,
+                                    "max": ReduceOp.MAX,
+                                    "min": ReduceOp.MIN,
+                                    "product": ReduceOp.PRODUCT,
+                                }[kwargs["_op"]]
+                                import numpy as _np
+
+                                outs = allreduce(
+                                    list(args), group_name, _rop
+                                )
+                                result = _np.asarray(outs[0])
+                            else:
+                                # Host fallback: numpy reduction over the
+                                # channel-delivered values.
+                                result = apply_collective(
+                                    kwargs["_op"], args
+                                )
                         else:
                             result = getattr(instance, op["method"])(
                                 *args, **kwargs
