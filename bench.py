@@ -239,6 +239,10 @@ def run_control_plane_suite():
             "n_n_actor_calls_async", n / (time.perf_counter() - t0),
             "calls/s", BASELINES["n_n_actor_calls_async"],
         )
+        # Free the 4 CPUs before the PG stage — with them held, the
+        # {"CPU": 1} bundle below can never be placed.
+        for b in actors:
+            ray_tpu.kill(b)
 
         # put / get small objects
         t0 = time.perf_counter()
