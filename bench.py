@@ -276,6 +276,12 @@ def run_control_plane_suite():
         # placement group churn
         from ray_tpu import placement_group, remove_placement_group
 
+        # Warmup: waits out the async resource release of the actors killed
+        # above (a timed create would otherwise stall in PENDING).
+        wpg = placement_group([{"CPU": 1}])
+        assert wpg.ready(timeout=60)
+        remove_placement_group(wpg)
+
         t0 = time.perf_counter()
         n = 50
         for _ in range(n):

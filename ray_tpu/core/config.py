@@ -86,7 +86,14 @@ _KNOBS: Dict[str, tuple] = {
 
 
 class Config:
-    """Process-wide configuration singleton."""
+    """Process-wide configuration singleton.
+
+    Knob reads are hot-path (RPC timeouts, inline thresholds, event gates
+    fire per task), so each knob is resolved once — env var consulted at
+    first access, like the reference's process-start env parse — and cached
+    in the instance ``__dict__`` where subsequent reads bypass
+    ``__getattr__`` entirely.  ``override()`` updates the cache;
+    ``reload()`` drops it (tests that mutate the environment)."""
 
     def __init__(self):
         self._overrides: Dict[str, Any] = {}
@@ -99,17 +106,24 @@ class Config:
         except KeyError:
             raise AttributeError(f"unknown config knob {name!r}") from None
         if name in self._overrides:
-            return self._overrides[name]
-        raw = os.environ.get(_ENV_PREFIX + name)
-        if raw is not None:
-            return _parse(typ, raw)
-        return default
+            value = self._overrides[name]
+        else:
+            raw = os.environ.get(_ENV_PREFIX + name)
+            value = _parse(typ, raw) if raw is not None else default
+        self.__dict__[name] = value
+        return value
 
     def override(self, **kwargs):
         for k, v in kwargs.items():
             if k not in _KNOBS:
                 raise ValueError(f"unknown config knob {k!r}")
             self._overrides[k] = v
+            self.__dict__[k] = v
+
+    def reload(self):
+        """Drop cached knob values so the next access re-reads the env."""
+        for k in _KNOBS:
+            self.__dict__.pop(k, None)
 
     def overrides_as_env(self) -> Dict[str, str]:
         """Serialize programmatic overrides as env vars to ship to child

@@ -43,6 +43,7 @@ from .exceptions import (
 from .ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID, new_task_id
 from .object_store import MemoryStore, ShmObjectStore
 from .rpc import (
+    UNBOUNDED,
     ClientPool,
     RetryableRpcClient,
     RpcConnectionError,
@@ -60,10 +61,16 @@ from .task_spec import ActorSpec, ObjectRef, TaskSpec, _RefMarker, function_key
 logger = logging.getLogger(__name__)
 
 
-def _tracing_context():
-    from ray_tpu.util.tracing import current_context
+_current_trace_context = None
 
-    return current_context()
+
+def _tracing_context():
+    global _current_trace_context
+    if _current_trace_context is None:
+        from ray_tpu.util.tracing import current_context
+
+        _current_trace_context = current_context
+    return _current_trace_context()
 
 _global_worker: Optional["CoreWorker"] = None
 
@@ -117,8 +124,12 @@ class _ActorState:
         self.next_seq = 0
         self.subscribed = False
         # Serializes wait-for-ALIVE + seq assignment so submission order is
-        # preserved even when waiters wake in arbitrary order.
+        # preserved even when waiters wake in arbitrary order.  ``waiters``
+        # counts submissions queued on (or about to take) the lock: the
+        # synchronous ALIVE fast path may only run when it is zero, or it
+        # would overtake an earlier submission still parked in the queue.
         self.submit_lock = asyncio.Lock()
+        self.waiters = 0
 
 
 class _LeasePool:
@@ -237,7 +248,7 @@ class _LeasePool:
             reply = await lease["client"].call(
                 "push_task",
                 {"spec": spec, "attempt": attempt},
-                timeout=86400.0,  # tasks may run arbitrarily long
+                timeout=UNBOUNDED,  # tasks may run arbitrarily long
                 retries=1,
             )
             self.worker._handle_task_reply(spec, reply)
@@ -400,6 +411,32 @@ class CoreWorker:
         # gets of lost objects share one resubmission).
         self._reconstructions: Dict[TaskID, asyncio.Future] = {}
         self._recovery_waiters: Dict[TaskID, asyncio.Event] = {}
+        # Cross-thread callback batching: a burst of submissions/ref events
+        # from user threads wakes the loop once, not once per callback.
+        self._post_lock = threading.Lock()
+        self._post_queue: List = []
+
+    def _post(self, cb) -> None:
+        """Run ``cb()`` on the protocol loop; bursts coalesce into a single
+        loop wakeup (the per-call ``call_soon_threadsafe`` socketpair write
+        was the dominant cost of high-rate submission from user threads)."""
+        with self._post_lock:
+            self._post_queue.append(cb)
+            if len(self._post_queue) > 1:
+                return  # a drain is already scheduled
+        self.loop.call_soon_threadsafe(self._drain_posts)
+
+    def _drain_posts(self) -> None:
+        # One swap per invocation: callbacks posted while this batch runs
+        # schedule their own drain (the len==1 guard in _post), so a fast
+        # producer cannot starve the event loop inside one callback.
+        with self._post_lock:
+            cbs, self._post_queue = self._post_queue, []
+        for cb in cbs:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — isolate callbacks
+                logger.exception("posted callback failed")
 
     # ------------------------------------------------------------- lifecycle
     async def async_start(self):
@@ -619,7 +656,7 @@ class CoreWorker:
             # deadline fire.
             reply = await owner.call(
                 "get_object", {"object_id": oid, "lost_locations": lost},
-                timeout=86400.0,
+                timeout=UNBOUNDED,
             )
             kind = reply["kind"]
             if kind == "inline":
@@ -802,10 +839,10 @@ class CoreWorker:
         if ref.owner_address == self.address:
             obj = self.owned.get(ref.id)
             if obj is not None and self.loop is not None:
-                self.loop.call_soon_threadsafe(self._incr_local, ref.id)
+                self._post(lambda oid=ref.id: self._incr_local(oid))
         else:
             if self.loop is not None:
-                self.loop.call_soon_threadsafe(self._send_incref, ref)
+                self._post(lambda r=ref: self._send_incref(r))
 
     def _incr_local(self, oid: ObjectID):
         obj = self.owned.get(oid)
@@ -828,7 +865,7 @@ class CoreWorker:
         if self._shutdown or self.loop is None or self.loop.is_closed():
             return
         if owner_address == self.address:
-            self.loop.call_soon_threadsafe(self._decr_local, oid)
+            self._post(lambda o=oid: self._decr_local(o))
         else:
             def send():
                 client = self.worker_clients.get(owner_address)
@@ -836,7 +873,7 @@ class CoreWorker:
                     self._oneway(client, "decref", {"object_id": oid})
                 )
             try:
-                self.loop.call_soon_threadsafe(send)
+                self._post(send)
             except RuntimeError:
                 pass
 
@@ -1282,7 +1319,7 @@ class CoreWorker:
                 self.lease_pools[spec.scheduling_class] = pool
             pool.submit(spec)
 
-        self.loop.call_soon_threadsafe(setup)
+        self._post(setup)
         if streaming:
             return ObjectRefGenerator(spec.task_id, self)
         for oid in return_ids:
@@ -1499,7 +1536,7 @@ class CoreWorker:
                 obj.local_refs += 1
             asyncio.get_running_loop().create_task(self._submit_actor_task(spec))
 
-        self.loop.call_soon_threadsafe(setup)
+        self._post(setup)
         if streaming:
             return ObjectRefGenerator(spec.task_id, self)
         refs = []
@@ -1513,35 +1550,66 @@ class CoreWorker:
 
     async def _submit_actor_task(self, spec: TaskSpec, attempt: int = 0):
         state = self._actor_state(spec.actor_id)
-        await self._subscribe_actor(state)
-        # Wait-for-ALIVE and seq assignment happen under a FIFO lock so two
-        # concurrent submissions can't swap order via the poll fallback.
-        async with state.submit_lock:
-            deadline = time.monotonic() + GlobalConfig.worker_startup_timeout_s * 2
-            while state.state in ("PENDING_CREATION", "RESTARTING"):
-                if time.monotonic() > deadline:
-                    self._fail_task_returns(
-                        spec, ActorDiedError(spec.actor_id.hex(), "creation timed out")
-                    )
-                    return
-                changed = state.changed
-                try:
-                    await asyncio.wait_for(changed.wait(), timeout=1.0)
-                except asyncio.TimeoutError:
-                    # Re-poll the control plane in case we missed a pub.
-                    info = await self.cp.call(
-                        "get_actor_info", {"actor_id": spec.actor_id}
-                    )
-                    if info is not None:
-                        self._apply_actor_info(info)
-            if state.state == "DEAD":
-                self._fail_task_returns(
-                    spec, ActorDiedError(spec.actor_id.hex(), state.death_cause)
-                )
-                return
+        if state.state == "ALIVE" and state.waiters == 0 and state.subscribed:
+            # Fast path: actor alive, nothing queued ahead of us — assign the
+            # sequence number synchronously (no lock round trip).  Submission
+            # tasks start in FIFO order on the loop, so order is preserved.
             incarnation = state.incarnation
             seq = state.next_seq
             state.next_seq += 1
+        else:
+            ok = await self._submit_actor_task_slow(spec, state)
+            if ok is None:
+                return
+            incarnation, seq = ok
+        await self._push_actor_task(spec, state, incarnation, seq, attempt)
+
+    async def _submit_actor_task_slow(self, spec: TaskSpec, state: _ActorState):
+        """Wait-for-ALIVE path: seq assignment under a FIFO lock so two
+        concurrent submissions can't swap order via the poll fallback.
+        Returns (incarnation, seq) or None if the task was failed."""
+        state.waiters += 1
+        try:
+            if not state.subscribed:
+                await self._subscribe_actor(state)
+            async with state.submit_lock:
+                deadline = (
+                    time.monotonic() + GlobalConfig.worker_startup_timeout_s * 2
+                )
+                while state.state in ("PENDING_CREATION", "RESTARTING"):
+                    if time.monotonic() > deadline:
+                        self._fail_task_returns(
+                            spec,
+                            ActorDiedError(
+                                spec.actor_id.hex(), "creation timed out"
+                            ),
+                        )
+                        return None
+                    changed = state.changed
+                    try:
+                        await asyncio.wait_for(changed.wait(), timeout=1.0)
+                    except asyncio.TimeoutError:
+                        # Re-poll the control plane in case we missed a pub.
+                        info = await self.cp.call(
+                            "get_actor_info", {"actor_id": spec.actor_id}
+                        )
+                        if info is not None:
+                            self._apply_actor_info(info)
+                if state.state == "DEAD":
+                    self._fail_task_returns(
+                        spec, ActorDiedError(spec.actor_id.hex(), state.death_cause)
+                    )
+                    return None
+                seq = state.next_seq
+                state.next_seq += 1
+                return state.incarnation, seq
+        finally:
+            state.waiters -= 1
+
+    async def _push_actor_task(
+        self, spec: TaskSpec, state: _ActorState, incarnation: int, seq: int,
+        attempt: int,
+    ):
         client = self.worker_clients.get(state.address)
         try:
             reply = await client.call(
@@ -1553,7 +1621,7 @@ class CoreWorker:
                     "incarnation": incarnation,
                     "attempt": attempt,
                 },
-                timeout=86400.0,
+                timeout=UNBOUNDED,
                 retries=1,
             )
             self._handle_task_reply(spec, reply)

@@ -36,6 +36,11 @@ logger = logging.getLogger(__name__)
 
 Address = str  # "host:port"
 
+# Sentinel timeout meaning "no per-call timer": the call completes when the
+# reply arrives or the connection dies (read-loop failure fails the future).
+# Any finite timeout a caller passes is enforced with a real timer.
+UNBOUNDED = float("inf")
+
 
 class RpcError(Exception):
     pass
@@ -145,8 +150,17 @@ class RpcServer:
                 pass
 
     async def _on_connection(self, reader, writer):
+        try:
+            writer.transport.set_write_buffer_limits(high=4 << 20)
+        except Exception:
+            pass
         conn = ServerConnection(reader, writer)
         self._conns.add(conn)
+        loop = asyncio.get_running_loop()
+        # Per-connection handler cache: (fn, is_coroutine_fn).  Sync handlers
+        # dispatch inline — no task allocation, reply coalesced into the
+        # connection's write buffer.
+        hcache: Dict[str, tuple] = {}
         try:
             while True:
                 try:
@@ -154,12 +168,49 @@ class RpcServer:
                 except (asyncio.IncompleteReadError, ConnectionError):
                     break
                 msg_id, method, payload = frame
-                # Handlers run as independent tasks so one slow call never
-                # blocks the connection (actor ordering is enforced above
-                # this layer by sequence numbers, not by transport order).
-                asyncio.get_running_loop().create_task(
-                    self._dispatch(conn, msg_id, method, payload)
-                )
+                entry = hcache.get(method)
+                if entry is None:
+                    fn = getattr(self._handler, "handle_" + method, None)
+                    entry = (fn, fn is None or asyncio.iscoroutinefunction(fn))
+                    hcache[method] = entry
+                fn, needs_task = entry
+                if needs_task:
+                    # Coroutine handlers run as independent tasks so one slow
+                    # call never blocks the connection (actor ordering is
+                    # enforced above this layer by sequence numbers).
+                    loop.create_task(
+                        self._dispatch(conn, msg_id, method, payload, fn)
+                    )
+                    continue
+                start = time.perf_counter()
+                try:
+                    result = fn(payload, conn)
+                    if asyncio.iscoroutine(result):
+                        # Sync wrapper returning a coroutine: await in a task.
+                        loop.create_task(
+                            self._finish_async(conn, msg_id, method, result)
+                        )
+                    elif msg_id > 0:
+                        conn.send_nowait((-msg_id, "R", result))
+                except Exception as e:  # noqa: BLE001
+                    if msg_id > 0:
+                        try:
+                            conn.send_nowait(
+                                (-msg_id, "E", (e, traceback.format_exc()))
+                            )
+                        except Exception:
+                            # e.g. unpicklable exception: report, keep the
+                            # connection (only this call errors out).
+                            logger.exception(
+                                "failed to send error reply for %s", method
+                            )
+                    else:
+                        logger.exception("oneway handler %s failed", method)
+                s = self.stats.get(method)
+                if s is None:
+                    s = self.stats[method] = [0, 0.0]
+                s[0] += 1
+                s[1] += time.perf_counter() - start
         finally:
             self._conns.discard(conn)
             conn.close()
@@ -171,10 +222,25 @@ class RpcServer:
                 except Exception:
                     logger.exception("on_connection_closed failed")
 
-    async def _dispatch(self, conn, msg_id, method, payload):
+    async def _finish_async(self, conn, msg_id, method, coro):
+        try:
+            result = await coro
+            if msg_id > 0:
+                await conn.send((-msg_id, "R", result))
+        except Exception as e:  # noqa: BLE001
+            if msg_id > 0:
+                try:
+                    await conn.send((-msg_id, "E", (e, traceback.format_exc())))
+                except Exception:
+                    logger.exception("failed to send error reply for %s", method)
+            else:
+                logger.exception("oneway handler %s failed", method)
+
+    async def _dispatch(self, conn, msg_id, method, payload, fn=None):
         start = time.perf_counter()
         try:
-            fn = getattr(self._handler, "handle_" + method, None)
+            if fn is None:
+                fn = getattr(self._handler, "handle_" + method, None)
             if fn is None:
                 raise RpcError(f"no handler for method {method!r}")
             result = fn(payload, conn)
@@ -198,18 +264,51 @@ class RpcServer:
 
 
 class ServerConnection:
-    """Server-side view of a client connection; supports server-push."""
+    """Server-side view of a client connection; supports server-push.
+
+    Writes coalesce: frames append to a per-connection buffer flushed once
+    per event-loop pass (one syscall for a burst of replies instead of one
+    per reply).  Single-threaded event loop ⇒ no lock needed; each frame is
+    appended atomically so frames never interleave."""
 
     def __init__(self, reader, writer):
         self._reader = reader
         self._writer = writer
-        self._lock = asyncio.Lock()
+        self._wbuf = bytearray()
+        self._flush_scheduled = False
         self.metadata: Dict[str, Any] = {}  # handlers can stash identity here
 
+    def send_nowait(self, frame):
+        """Queue a frame; flushed on the next loop pass."""
+        self._wbuf += _encode_frame(frame)
+        if not self._flush_scheduled:
+            self._flush_scheduled = True
+            asyncio.get_running_loop().call_soon(self._flush)
+
+    def _flush(self):
+        self._flush_scheduled = False
+        if not self._wbuf:
+            return
+        data, self._wbuf = self._wbuf, bytearray()
+        try:
+            self._writer.write(data)
+        except Exception:  # connection torn down mid-flush
+            pass
+
     async def send(self, frame):
-        async with self._lock:
-            self._writer.write(_encode_frame(frame))
-            await self._writer.drain()
+        self.send_nowait(frame)
+        # Flow control: only await the transport when it has a real backlog
+        # (large replies / slow peer), not on every small frame.  Count both
+        # the not-yet-flushed coalescing buffer and the transport's own.
+        try:
+            if (
+                len(self._wbuf)
+                + self._writer.transport.get_write_buffer_size()
+            ) > (4 << 20):
+                self._flush()
+                await self._writer.drain()
+        except Exception:
+            pass
 
     async def push(self, method: str, payload):
         """One-way server→client message (pubsub delivery)."""
@@ -241,7 +340,9 @@ class RpcClient:
         self._writer = None
         self._pending: Dict[int, asyncio.Future] = {}
         self._next_id = 1
-        self._lock = asyncio.Lock()
+        self._wbuf = bytearray()
+        self._flush_scheduled = False
+        self._loop = None
         self._read_task = None
         self._closed = False
         self._chaos = _ChaosInjector()
@@ -252,8 +353,32 @@ class RpcClient:
             asyncio.open_connection(host, port),
             timeout=GlobalConfig.rpc_connect_timeout_s,
         )
-        self._read_task = asyncio.get_running_loop().create_task(self._read_loop())
+        self._loop = asyncio.get_running_loop()
+        try:
+            self._writer.transport.set_write_buffer_limits(high=4 << 20)
+        except Exception:
+            pass
+        self._read_task = self._loop.create_task(self._read_loop())
         return self
+
+    # Outgoing frames coalesce into one buffer flushed once per loop pass —
+    # a burst of calls (pipelined tasks, batched submissions) costs one
+    # write syscall, not one per call.
+    def _write_frame(self, frame):
+        self._wbuf += _encode_frame(frame)
+        if not self._flush_scheduled:
+            self._flush_scheduled = True
+            self._loop.call_soon(self._flush_wbuf)
+
+    def _flush_wbuf(self):
+        self._flush_scheduled = False
+        if not self._wbuf:
+            return
+        data, self._wbuf = self._wbuf, bytearray()
+        try:
+            self._writer.write(data)
+        except Exception:
+            pass  # torn down mid-flush; read loop surfaces the failure
 
     async def _read_loop(self):
         try:
@@ -303,20 +428,29 @@ class RpcClient:
             raise RpcConnectionError(f"not connected to {self.address}")
         if self._chaos.enabled() and self._chaos.fail_request(method):
             raise RpcConnectionError(f"[chaos] dropped request {method}")
-        async with self._lock:
-            msg_id = self._next_id
-            self._next_id += 1
-        fut = asyncio.get_running_loop().create_future()
+        # Single-threaded loop: id allocation + buffer append are atomic.
+        msg_id = self._next_id
+        self._next_id += 1
+        fut = self._loop.create_future()
         self._pending[msg_id] = fut
-        try:
-            self._writer.write(_encode_frame((msg_id, method, payload)))
-            await self._writer.drain()
-        except (ConnectionError, RuntimeError) as e:
-            self._pending.pop(msg_id, None)
-            raise RpcConnectionError(str(e)) from e
+        self._write_frame((msg_id, method, payload))
+        if (
+            len(self._wbuf) + self._writer.transport.get_write_buffer_size()
+        ) > (4 << 20):
+            try:
+                self._flush_wbuf()
+                await self._writer.drain()
+            except (ConnectionError, RuntimeError) as e:
+                self._pending.pop(msg_id, None)
+                raise RpcConnectionError(str(e)) from e
         timeout = timeout if timeout is not None else GlobalConfig.rpc_call_timeout_s
         try:
-            result = await asyncio.wait_for(fut, timeout=timeout)
+            if timeout == UNBOUNDED:
+                # Explicitly-unbounded calls (task pushes, owner gets) skip
+                # the per-call timer; connection loss still fails the future.
+                result = await fut
+            else:
+                result = await asyncio.wait_for(fut, timeout=timeout)
         except asyncio.TimeoutError:
             self._pending.pop(msg_id, None)
             raise RpcError(f"rpc {method} to {self.address} timed out after {timeout}s")
@@ -327,8 +461,15 @@ class RpcClient:
     async def notify(self, method: str, payload=None):
         if not self.connected:
             raise RpcConnectionError(f"not connected to {self.address}")
-        self._writer.write(_encode_frame((0, method, payload)))
-        await self._writer.drain()
+        self._write_frame((0, method, payload))
+        if (
+            len(self._wbuf) + self._writer.transport.get_write_buffer_size()
+        ) > (4 << 20):
+            try:
+                self._flush_wbuf()
+                await self._writer.drain()
+            except (ConnectionError, RuntimeError) as e:
+                raise RpcConnectionError(str(e)) from e
 
     async def close(self):
         self._closed = True

@@ -56,9 +56,14 @@ class TestConfig:
         assert cfg.rpc_max_retries == 8
         os.environ["RAY_TPU_rpc_max_retries"] = "3"
         try:
+            # Knob values are cached at first access (reference semantics:
+            # env parsed once per process); reload() re-reads the env.
+            assert cfg.rpc_max_retries == 8
+            cfg.reload()
             assert cfg.rpc_max_retries == 3
         finally:
             del os.environ["RAY_TPU_rpc_max_retries"]
+            cfg.reload()
 
     def test_programmatic_override_and_env_ship(self):
         cfg = Config()

@@ -192,6 +192,9 @@ def test_job_cli_list(ray_start_regular, capsys):
 
 def test_usage_report(ray_start_regular, monkeypatch):
     monkeypatch.setenv("RAY_TPU_usage_stats_enabled", "true")
+    from ray_tpu.core.config import GlobalConfig
+
+    GlobalConfig.reload()  # knob values are cached; pick up the env change
     from ray_tpu.core.usage import record_library_usage, usage_report
 
     record_library_usage("train")
