@@ -65,7 +65,8 @@ def init(
 
     if address in (None, "local"):
         node = node_mod.Node(
-            head=True, resources=resources, labels=labels, num_cpus=num_cpus
+            head=True, resources=resources, labels=labels, num_cpus=num_cpus,
+            die_with_parent=True,
         )
         node.start()
         _local_node = node

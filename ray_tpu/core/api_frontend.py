@@ -208,7 +208,11 @@ class ActorHandle:
     def __getattr__(self, name: str) -> ActorMethod:
         if name.startswith("_"):
             raise AttributeError(name)
-        return ActorMethod(self, name)
+        method = ActorMethod(self, name)
+        # Cache: repeated `handle.m.remote()` calls skip __getattr__ and the
+        # per-call ActorMethod allocation.  __reduce__ ignores the cache.
+        self.__dict__[name] = method
+        return method
 
     def __repr__(self):
         return f"ActorHandle({self._actor_id.hex()[:12]})"

@@ -49,6 +49,11 @@ _KNOBS: Dict[str, tuple] = {
     "lineage_pinning": (int, 1, "Pin task args while returns live (reconstruction)"),
     "max_object_reconstructions": (int, 3, "Lineage re-execution attempts per get"),
     "object_store_memory_bytes": (int, 2 * 1024**3, "Per-node shm budget"),
+    "object_store_prefault": (
+        bool, False,
+        "Fault in every arena page at creation (plasma preallocate analog): "
+        "slower startup + committed tmpfs, full-bandwidth first-touch puts",
+    ),
     "object_chunk_bytes": (int, 5 * 1024 * 1024, "Chunk size for node-to-node transfer"),
     "memory_store_fallback_bytes": (int, 512 * 1024 * 1024, "In-process store budget"),
     # -- workers --

@@ -949,6 +949,7 @@ class ControlPlane:
         }
 
 
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--host", default="127.0.0.1")
@@ -956,6 +957,9 @@ def main():
     parser.add_argument("--session-id", required=True)
     parser.add_argument("--store-path", default=None)
     args = parser.parse_args()
+    from .reaper import watch_parent_process
+
+    watch_parent_process()
     logging.basicConfig(
         level=GlobalConfig.log_level,
         format="%(asctime)s %(levelname)s control_plane: %(message)s",

@@ -40,7 +40,16 @@ from .exceptions import (
     TaskError,
     WorkerCrashedError,
 )
-from .ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID, new_task_id
+from .ids import (
+    ActorID,
+    JobID,
+    NodeID,
+    ObjectID,
+    TaskID,
+    WorkerID,
+    new_object_id,
+    new_task_id,
+)
 from .object_store import MemoryStore, ShmObjectStore
 from .rpc import (
     UNBOUNDED,
@@ -91,6 +100,8 @@ def set_global_worker(w: Optional["CoreWorker"]):
 
 
 PENDING, READY, ERROR = "PENDING", "READY", "ERROR"
+
+_EMPTY_ARGS_PAYLOAD: Optional[bytes] = None
 
 
 class OwnedObject:
@@ -154,6 +165,9 @@ class _LeasePool:
 
     def _pump(self):
         # Dispatch queued tasks onto leases with spare in-flight capacity.
+        # Pushes use transport-level call batching: a burst dispatched in
+        # one loop pass rides one multiplexed frame with independent
+        # per-call replies (see RpcClient.call(batch=True)).
         max_inflight = (
             self.max_inflight
             if self.max_inflight is not None
@@ -250,6 +264,7 @@ class _LeasePool:
                 {"spec": spec, "attempt": attempt},
                 timeout=UNBOUNDED,  # tasks may run arbitrarily long
                 retries=1,
+                batch=True,
             )
             self.worker._handle_task_reply(spec, reply)
         except RpcRemoteError as e:
@@ -424,7 +439,13 @@ class CoreWorker:
             self._post_queue.append(cb)
             if len(self._post_queue) > 1:
                 return  # a drain is already scheduled
-        self.loop.call_soon_threadsafe(self._drain_posts)
+        try:
+            self.loop.call_soon_threadsafe(self._drain_posts)
+        except RuntimeError:
+            # Loop already closed (interpreter teardown racing GC-driven
+            # ref releases): drop the callback, nothing left to run it on.
+            with self._post_lock:
+                self._post_queue.clear()
 
     def _drain_posts(self) -> None:
         # One swap per invocation: callbacks posted while this batch runs
@@ -568,14 +589,17 @@ class CoreWorker:
         return obj
 
     async def _put_async(self, value: Any) -> ObjectRef:
-        from .serialization import serialize, serialized_nbytes
+        from .serialization import (
+            is_plain_data,
+            serialize,
+            serialized_nbytes,
+            write_serialized,
+        )
 
-        oid = ObjectID.from_random()
+        oid = new_object_id()
         obj = self._new_owned(oid)
         obj.local_refs += 1
-        from .serialization import write_serialized
-
-        header, views = serialize(value)
+        header, views = serialize(value, prefer_plain=is_plain_data(value))
         size = serialized_nbytes(header, views)
         obj.size = size
         if size <= GlobalConfig.max_inline_object_bytes:
@@ -783,12 +807,21 @@ class CoreWorker:
         single = isinstance(refs, ObjectRef)
         if single:
             refs = [refs]
+
         async def get_all():
             # Resolve concurrently: remote-owner round-trips and shm pulls
-            # overlap instead of summing.
-            return await asyncio.gather(
-                *(self.get_async(r, timeout) for r in refs)
-            )
+            # overlap instead of summing.  One deadline timer covers the
+            # whole batch (not one per ref) — same semantics, since every
+            # ref resolves concurrently under the same timeout.
+            gathered = asyncio.gather(*(self._get_one(r) for r in refs))
+            if timeout is None:
+                return await gathered
+            try:
+                return await asyncio.wait_for(gathered, timeout)
+            except asyncio.TimeoutError:
+                raise GetTimeoutError(
+                    f"get() timed out on {len(refs)} object(s)"
+                )
 
         results = self._run_sync(get_all())
         return results[0] if single else results
@@ -1175,10 +1208,19 @@ class CoreWorker:
             self._fn_cache[function_id] = fn
         return fn
 
+    _PLAIN_LEAF_TYPES = frozenset(
+        (int, float, bool, str, bytes, bytearray, type(None))
+    )
+
     def _prepare_args(self, args, kwargs) -> Tuple[bytes, List[ObjectRef]]:
         """Top-level ObjectRefs become resolve-markers (Ray semantics: task
         args are resolved to values; nested refs stay refs).  Returns the
         payload and the list of refs to hold until the task completes."""
+        global _EMPTY_ARGS_PAYLOAD
+        if not args and not kwargs:
+            if _EMPTY_ARGS_PAYLOAD is None:
+                _EMPTY_ARGS_PAYLOAD = serialize_to_bytes(([], {}))
+            return _EMPTY_ARGS_PAYLOAD, []
         held: List[ObjectRef] = []
 
         def convert(v):
@@ -1190,26 +1232,59 @@ class CoreWorker:
         conv_args = [convert(a) for a in args]
         conv_kwargs = {k: convert(v) for k, v in kwargs.items()}
 
-        # Hold refs nested anywhere inside standard containers so the owner
-        # keeps them alive while the task is in flight (refs inside arbitrary
-        # user objects are still covered by the worker's deserialize-time
-        # incref, with a small window — same caveat as the reference's
-        # borrower protocol).
+        # One walk does two jobs: hold refs nested anywhere inside standard
+        # containers so the owner keeps them alive while the task is in
+        # flight (refs inside arbitrary user objects are still covered by
+        # the worker's deserialize-time incref, with a small window — same
+        # caveat as the reference's borrower protocol), and classify whether
+        # every leaf is a plain-picklable builtin/ndarray so serialization
+        # can skip cloudpickle (see serialize(prefer_plain=...)).
+        import numpy as _np
+
+        plain = True
+        leaf_types = self._PLAIN_LEAF_TYPES
+
         def scan(v, depth=0):
-            if depth > 10:
+            nonlocal plain
+            t = type(v)
+            if t in leaf_types:
                 return
-            if isinstance(v, ObjectRef):
+            if depth > 10:
+                plain = False
+                return
+            if t is ObjectRef:
                 held.append(v)
-            elif isinstance(v, (list, tuple, set, frozenset)):
+            elif t in (list, tuple, set, frozenset):
                 for x in v:
                     scan(x, depth + 1)
-            elif isinstance(v, dict):
-                for x in v.values():
+            elif t is dict:
+                for kk, x in v.items():
+                    # Keys can't be refs (unhashable) but CAN be
+                    # __main__-defined objects — they affect plainness.
+                    kt = type(kk)
+                    if kt not in leaf_types:
+                        plain = False
                     scan(x, depth + 1)
+            elif t is _np.ndarray:
+                if v.dtype.hasobject:
+                    plain = False
+            else:
+                plain = False
+                # Subclassed containers/refs still get ref-hold semantics.
+                if isinstance(v, ObjectRef):
+                    held.append(v)
+                elif isinstance(v, (list, tuple, set, frozenset)):
+                    for x in v:
+                        scan(x, depth + 1)
+                elif isinstance(v, dict):
+                    for x in v.values():
+                        scan(x, depth + 1)
 
         for v in list(args) + list(kwargs.values()):
             scan(v, 1)
-        payload = serialize_to_bytes((conv_args, conv_kwargs))
+        payload = serialize_to_bytes(
+            (conv_args, conv_kwargs), prefer_plain=plain
+        )
         return payload, held
 
     def _hold_args(self, held: List[ObjectRef]):
@@ -1551,17 +1626,20 @@ class CoreWorker:
     async def _submit_actor_task(self, spec: TaskSpec, attempt: int = 0):
         state = self._actor_state(spec.actor_id)
         if state.state == "ALIVE" and state.waiters == 0 and state.subscribed:
-            # Fast path: actor alive, nothing queued ahead of us — assign the
-            # sequence number synchronously (no lock round trip).  Submission
-            # tasks start in FIFO order on the loop, so order is preserved.
+            # Fast path: actor alive, nothing queued ahead of us — assign
+            # the sequence number synchronously (no lock round trip) and
+            # push; a burst of pushes coalesces into one multiplexed frame
+            # at the transport (call(batch=True)).  Submission tasks start
+            # in FIFO order on the loop, so order is preserved.
             incarnation = state.incarnation
             seq = state.next_seq
             state.next_seq += 1
-        else:
-            ok = await self._submit_actor_task_slow(spec, state)
-            if ok is None:
-                return
-            incarnation, seq = ok
+            await self._push_actor_task(spec, state, incarnation, seq, attempt)
+            return
+        ok = await self._submit_actor_task_slow(spec, state)
+        if ok is None:
+            return
+        incarnation, seq = ok
         await self._push_actor_task(spec, state, incarnation, seq, attempt)
 
     async def _submit_actor_task_slow(self, spec: TaskSpec, state: _ActorState):
@@ -1623,6 +1701,7 @@ class CoreWorker:
                 },
                 timeout=UNBOUNDED,
                 retries=1,
+                batch=True,
             )
             self._handle_task_reply(spec, reply)
         except (RpcConnectionError, RpcRemoteError) as e:
@@ -1670,9 +1749,14 @@ class CoreWorker:
     async def _package_value(self, spec: TaskSpec, value, index: int) -> tuple:
         """Package one return/stream value: inline if small, else sealed
         zero-copy into the shm arena."""
-        from .serialization import serialize, serialized_nbytes, write_serialized
+        from .serialization import (
+            is_plain_data,
+            serialize,
+            serialized_nbytes,
+            write_serialized,
+        )
 
-        header, views = serialize(value)
+        header, views = serialize(value, prefer_plain=is_plain_data(value))
         size = serialized_nbytes(header, views)
         if size <= GlobalConfig.max_inline_object_bytes:
             buf = bytearray(size)

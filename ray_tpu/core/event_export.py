@@ -34,10 +34,25 @@ class EventRecorder:
         self._ring: deque = deque(maxlen=max_events)
         self._export_path = export_path
         self._file = None
+        self._seq = 0
         if export_path:
             os.makedirs(os.path.dirname(export_path) or ".", exist_ok=True)
+            # Seed the sequence (and the queryable ring) from any existing
+            # export: a restarted control plane appends with monotonic seq
+            # instead of restarting at 0, and pre-crash events stay
+            # servable through list_events.
+            try:
+                with open(export_path, "r") as f:
+                    for line in f:
+                        try:
+                            ev = json.loads(line)
+                        except ValueError:
+                            continue
+                        self._ring.append(ev)
+                        self._seq = max(self._seq, int(ev.get("seq", 0)))
+            except OSError:
+                pass
             self._file = open(export_path, "a", buffering=1)  # line-buffered
-        self._seq = 0
 
     def record(self, event_type: str, entity_id: str, state: str,
                **attrs: Any) -> None:

@@ -115,9 +115,36 @@ class _Counter:
 
 _task_counter = _Counter()
 
+# One urandom syscall per process (re-read after fork), not one per task id:
+# ids need uniqueness, not unpredictability.  8 random prefix bytes per
+# process + an 8-byte little-endian in-process counter.  The counter's LOW
+# bytes sit at offsets 8-11, inside the [:12] slice for_task_return keeps,
+# so derived ObjectIDs stay distinct for 2^32 tasks per process.
+_id_prefix: bytes = b""
+_id_prefix_pid: int = -1
+
 
 def new_task_id() -> TaskID:
-    """Random task id; uniqueness within a process is additionally guaranteed
-    by mixing in a process-local counter."""
-    ctr = _task_counter.next().to_bytes(6, "little")
-    return TaskID(os.urandom(TaskID.SIZE - 6) + ctr)
+    """Unique task id: per-process random prefix + process-local counter."""
+    global _id_prefix, _id_prefix_pid
+    if _id_prefix_pid != os.getpid():
+        _id_prefix = os.urandom(8)
+        _id_prefix_pid = os.getpid()
+    ctr = _task_counter.next().to_bytes(8, "little")
+    return TaskID(_id_prefix + ctr)
+
+
+_object_counter = _Counter()
+_obj_prefix: bytes = b""
+_obj_prefix_pid: int = -1
+
+
+def new_object_id() -> ObjectID:
+    """Unique object id for puts (own random prefix, disjoint from the
+    task-id space, + process-local counter)."""
+    global _obj_prefix, _obj_prefix_pid
+    if _obj_prefix_pid != os.getpid():
+        _obj_prefix = os.urandom(8)
+        _obj_prefix_pid = os.getpid()
+    ctr = _object_counter.next().to_bytes(8, "little")
+    return ObjectID(_obj_prefix + ctr)

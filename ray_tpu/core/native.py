@@ -74,12 +74,14 @@ def _declare(lib):
     sigs = {
         "rtpu_arena_create": (p, [cp, u64, u64]),
         "rtpu_arena_create2": (p, [cp, u64, u64, ctypes.c_int]),
+        "rtpu_arena_create3": (p, [cp, u64, u64, ctypes.c_int, ctypes.c_int]),
         "rtpu_arena_attach": (p, [cp]),
         "rtpu_arena_close": (None, [p]),
         "rtpu_arena_base": (ctypes.c_void_p, [p]),
         "rtpu_arena_capacity": (u64, [p]),
         "rtpu_arena_used": (u64, [p]),
         "rtpu_arena_live": (u64, [p]),
+        "rtpu_memcpy_nt": (None, [p, p, u64]),
         "rtpu_alloc": (u64, [p, cp, u64]),
         "rtpu_seal": (ctypes.c_int, [p, cp]),
         "rtpu_lookup": (ctypes.c_int, [p, cp, ctypes.POINTER(u64), ctypes.POINTER(u64)]),
@@ -149,6 +151,24 @@ def get_lib():
 
 def available() -> bool:
     return get_lib() is not None
+
+
+def memcpy_nt(dst_mv: memoryview, src_mv: memoryview) -> bool:
+    """Non-temporal copy of ``src_mv`` into ``dst_mv`` (equal sizes, both
+    C-contiguous).  Returns False when the native library is unavailable —
+    caller falls back to a plain slice copy."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "rtpu_memcpy_nt"):
+        return False
+    import numpy as np
+
+    d = np.frombuffer(dst_mv, np.uint8)
+    s = np.frombuffer(src_mv, np.uint8)
+    lib.rtpu_memcpy_nt(
+        ctypes.c_void_p(d.ctypes.data), ctypes.c_void_p(s.ctypes.data),
+        s.nbytes,
+    )
+    return True
 
 
 def _default_n_slots(capacity: int) -> int:
@@ -238,8 +258,11 @@ class NativeArena:
                 if h:
                     return cls(h, lib)
             else:
-                h = lib.rtpu_arena_create2(
-                    path.encode(), capacity, _default_n_slots(capacity), 1
+                from .config import GlobalConfig
+
+                h = lib.rtpu_arena_create3(
+                    path.encode(), capacity, _default_n_slots(capacity), 1,
+                    1 if GlobalConfig.object_store_prefault else 0,
                 )
                 if h:
                     return cls(h, lib)

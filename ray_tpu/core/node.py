@@ -53,12 +53,20 @@ def _wait_for_server(address: str, timeout: float = 30.0) -> None:
 class ProcessGroup:
     def __init__(self):
         self.procs: List[subprocess.Popen] = []
+        self.die_with_parent = False
 
     def spawn(self, argv: List[str], log_path: str, env: Optional[dict] = None):
         full_env = dict(os.environ)
         if env:
             full_env.update(env)
         full_env.update(GlobalConfig.overrides_as_env())
+        if self.die_with_parent:
+            # System processes watch this pid and self-exit when it dies —
+            # a SIGKILLed driver must not leave an orphaned cluster behind
+            # (reference precedent: ray's process reaper).
+            full_env["RAY_TPU_PARENT_PID"] = str(os.getpid())
+        else:
+            full_env.pop("RAY_TPU_PARENT_PID", None)
         out = open(log_path, "ab")
         proc = subprocess.Popen(
             argv, stdout=out, stderr=subprocess.STDOUT, env=full_env,
@@ -98,6 +106,7 @@ class Node:
         session_id: Optional[str] = None,
         num_cpus: Optional[float] = None,
         port: Optional[int] = None,
+        die_with_parent: bool = False,
     ):
         self.head = head
         self.port = port
@@ -107,6 +116,7 @@ class Node:
         )
         os.makedirs(self.log_dir, exist_ok=True)
         self.pg = ProcessGroup()
+        self.pg.die_with_parent = die_with_parent
         self.cp_address = cp_address
         self.agent_address: Optional[str] = None
         self._cp_argv: Optional[List[str]] = None
@@ -229,7 +239,8 @@ class Cluster:
     def add_node(self, num_cpus: float = 1, resources=None, labels=None) -> Node:
         if self.head_node is None:
             node = Node(
-                head=True, resources=resources, labels=labels, num_cpus=num_cpus
+                head=True, resources=resources, labels=labels,
+                num_cpus=num_cpus, die_with_parent=True,
             )
             node.start()
             self.head_node = node
@@ -241,6 +252,7 @@ class Cluster:
                 labels=labels,
                 session_id=self.head_node.session_id,
                 num_cpus=num_cpus,
+                die_with_parent=True,
             )
             node.start()
             self.worker_nodes.append(node)

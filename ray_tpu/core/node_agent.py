@@ -746,6 +746,7 @@ class NodeAgent:
         }
 
 
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--host", default="127.0.0.1")
@@ -755,6 +756,18 @@ def main():
     parser.add_argument("--resources", required=True, help="JSON dict")
     parser.add_argument("--labels", default="{}", help="JSON dict")
     args = parser.parse_args()
+
+    def _unlink_session_arena(session_id=args.session_id):
+        from .object_store import arena_path
+
+        try:
+            os.unlink(arena_path(session_id))
+        except OSError:
+            pass
+
+    from .reaper import watch_parent_process
+
+    watch_parent_process(on_exit=_unlink_session_arena)
     import json
 
     logging.basicConfig(

@@ -1,0 +1,42 @@
+"""Parent-death reaper for spawned system processes.
+
+When a driver spawns the control plane / node agents with
+``die_with_parent`` (``ray_tpu.init`` and the in-process test ``Cluster``),
+they receive ``RAY_TPU_PARENT_PID`` and self-exit once that process is gone
+— a SIGKILLed driver must not orphan cluster processes (reference
+precedent: ray's process reaper).  Detached starts (``ray-tpu start``) set
+no parent pid and are unaffected.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+
+def watch_parent_process(on_exit: Optional[Callable[[], None]] = None) -> None:
+    """Start the reaper thread if ``RAY_TPU_PARENT_PID`` is set.
+
+    ``on_exit`` runs (best-effort) just before the process exits — e.g. the
+    node agent unlinks its session's shm arena.
+    """
+    ppid = int(os.environ.get("RAY_TPU_PARENT_PID", "0") or "0")
+    if not ppid:
+        return
+
+    def loop():
+        while True:
+            time.sleep(1.0)
+            try:
+                os.kill(ppid, 0)
+            except OSError:
+                if on_exit is not None:
+                    try:
+                        on_exit()
+                    except Exception:  # noqa: BLE001 — exiting anyway
+                        pass
+                os._exit(0)
+
+    threading.Thread(target=loop, daemon=True, name="parent-watch").start()

@@ -168,49 +168,13 @@ class RpcServer:
                 except (asyncio.IncompleteReadError, ConnectionError):
                     break
                 msg_id, method, payload = frame
-                entry = hcache.get(method)
-                if entry is None:
-                    fn = getattr(self._handler, "handle_" + method, None)
-                    entry = (fn, fn is None or asyncio.iscoroutinefunction(fn))
-                    hcache[method] = entry
-                fn, needs_task = entry
-                if needs_task:
-                    # Coroutine handlers run as independent tasks so one slow
-                    # call never blocks the connection (actor ordering is
-                    # enforced above this layer by sequence numbers).
-                    loop.create_task(
-                        self._dispatch(conn, msg_id, method, payload, fn)
-                    )
+                if method == "__batch__" and msg_id == 0:
+                    # Multiplexed frame: each sub-call dispatches and
+                    # replies independently, exactly as if sent alone.
+                    for sub in payload:
+                        self._process_frame(conn, loop, hcache, *sub)
                     continue
-                start = time.perf_counter()
-                try:
-                    result = fn(payload, conn)
-                    if asyncio.iscoroutine(result):
-                        # Sync wrapper returning a coroutine: await in a task.
-                        loop.create_task(
-                            self._finish_async(conn, msg_id, method, result)
-                        )
-                    elif msg_id > 0:
-                        conn.send_nowait((-msg_id, "R", result))
-                except Exception as e:  # noqa: BLE001
-                    if msg_id > 0:
-                        try:
-                            conn.send_nowait(
-                                (-msg_id, "E", (e, traceback.format_exc()))
-                            )
-                        except Exception:
-                            # e.g. unpicklable exception: report, keep the
-                            # connection (only this call errors out).
-                            logger.exception(
-                                "failed to send error reply for %s", method
-                            )
-                    else:
-                        logger.exception("oneway handler %s failed", method)
-                s = self.stats.get(method)
-                if s is None:
-                    s = self.stats[method] = [0, 0.0]
-                s[0] += 1
-                s[1] += time.perf_counter() - start
+                self._process_frame(conn, loop, hcache, msg_id, method, payload)
         finally:
             self._conns.discard(conn)
             conn.close()
@@ -221,6 +185,51 @@ class RpcServer:
                         await res
                 except Exception:
                     logger.exception("on_connection_closed failed")
+
+    def _process_frame(self, conn, loop, hcache, msg_id, method, payload):
+        entry = hcache.get(method)
+        if entry is None:
+            fn = getattr(self._handler, "handle_" + method, None)
+            entry = (fn, fn is None or asyncio.iscoroutinefunction(fn))
+            hcache[method] = entry
+        fn, needs_task = entry
+        if needs_task:
+            # Coroutine handlers run as independent tasks so one slow
+            # call never blocks the connection (actor ordering is
+            # enforced above this layer by sequence numbers).
+            loop.create_task(
+                self._dispatch(conn, msg_id, method, payload, fn)
+            )
+            return
+        start = time.perf_counter()
+        try:
+            result = fn(payload, conn)
+            if asyncio.iscoroutine(result):
+                # Sync wrapper returning a coroutine: await in a task.
+                loop.create_task(
+                    self._finish_async(conn, msg_id, method, result)
+                )
+            elif msg_id > 0:
+                conn.send_nowait((-msg_id, "R", result))
+        except Exception as e:  # noqa: BLE001
+            if msg_id > 0:
+                try:
+                    conn.send_nowait(
+                        (-msg_id, "E", (e, traceback.format_exc()))
+                    )
+                except Exception:
+                    # e.g. unpicklable exception: report, keep the
+                    # connection (only this call errors out).
+                    logger.exception(
+                        "failed to send error reply for %s", method
+                    )
+            else:
+                logger.exception("oneway handler %s failed", method)
+        s = self.stats.get(method)
+        if s is None:
+            s = self.stats[method] = [0, 0.0]
+        s[0] += 1
+        s[1] += time.perf_counter() - start
 
     async def _finish_async(self, conn, msg_id, method, coro):
         try:
@@ -342,6 +351,8 @@ class RpcClient:
         self._next_id = 1
         self._wbuf = bytearray()
         self._flush_scheduled = False
+        self._batch_buf: list = []
+        self._batch_scheduled = False
         self._loop = None
         self._read_task = None
         self._closed = False
@@ -369,6 +380,31 @@ class RpcClient:
         if not self._flush_scheduled:
             self._flush_scheduled = True
             self._loop.call_soon(self._flush_wbuf)
+
+    # Transport-level call multiplexing: calls made with batch=True within
+    # one loop pass ride a single __batch__ frame (one pickle, one frame
+    # parse on the server) while keeping fully independent per-call replies
+    # — semantics identical to individual calls.
+    _BATCH_MAX_FRAMES = 256  # bound un-flushed batch memory before the
+    # 4 MB transport backpressure check in call() can see the bytes
+
+    def _queue_batched(self, frame):
+        self._batch_buf.append(frame)
+        if len(self._batch_buf) >= self._BATCH_MAX_FRAMES:
+            self._flush_batch()
+        elif not self._batch_scheduled:
+            self._batch_scheduled = True
+            self._loop.call_soon(self._flush_batch)
+
+    def _flush_batch(self):
+        self._batch_scheduled = False
+        items, self._batch_buf = self._batch_buf, []
+        if not items:
+            return
+        if len(items) == 1:
+            self._write_frame(items[0])
+        else:
+            self._write_frame((0, "__batch__", items))
 
     def _flush_wbuf(self):
         self._flush_scheduled = False
@@ -423,7 +459,10 @@ class RpcClient:
             and not self._writer.is_closing()
         )
 
-    async def call(self, method: str, payload=None, timeout: Optional[float] = None):
+    async def call(
+        self, method: str, payload=None, timeout: Optional[float] = None,
+        batch: bool = False,
+    ):
         if not self.connected:
             raise RpcConnectionError(f"not connected to {self.address}")
         if self._chaos.enabled() and self._chaos.fail_request(method):
@@ -433,7 +472,10 @@ class RpcClient:
         self._next_id += 1
         fut = self._loop.create_future()
         self._pending[msg_id] = fut
-        self._write_frame((msg_id, method, payload))
+        if batch:
+            self._queue_batched((msg_id, method, payload))
+        else:
+            self._write_frame((msg_id, method, payload))
         if (
             len(self._wbuf) + self._writer.transport.get_write_buffer_size()
         ) > (4 << 20):
@@ -503,14 +545,17 @@ class RetryableRpcClient:
             await self._client.connect()
             return self._client
 
-    async def call(self, method: str, payload=None, timeout=None, retries=None):
+    async def call(
+        self, method: str, payload=None, timeout=None, retries=None,
+        batch: bool = False,
+    ):
         retries = retries if retries is not None else GlobalConfig.rpc_max_retries
         delay = GlobalConfig.rpc_retry_base_delay_s
         last_exc = None
         for _attempt in range(max(1, retries)):
             try:
                 client = await self._ensure()
-                return await client.call(method, payload, timeout)
+                return await client.call(method, payload, timeout, batch=batch)
             except (RpcConnectionError, ConnectionError, OSError, asyncio.TimeoutError) as e:
                 last_exc = e
                 self._client = None

@@ -129,10 +129,30 @@ def dag_exec_loop(instance, plan: Dict[str, Any]) -> str:
                                 }[kwargs["_op"]]
                                 import numpy as _np
 
-                                outs = allreduce(
-                                    list(args), group_name, _rop
+                                from ray_tpu.collective import get_group
+                                from ray_tpu.collective.local_group import (
+                                    LocalXlaGroup,
                                 )
-                                result = _np.asarray(outs[0])
+
+                                group = get_group(group_name)
+                                if isinstance(group, LocalXlaGroup):
+                                    # Single-process simulator: its API
+                                    # takes the full per-rank tensor list.
+                                    outs = group.allreduce(list(args), _rop)
+                                    result = _np.asarray(outs[0])
+                                else:
+                                    # Multi-process backend (xla): each
+                                    # rank contributes ONLY its own shard —
+                                    # participants are bound in rank order,
+                                    # so this actor's value is args[rank].
+                                    own = (
+                                        args[group.rank]
+                                        if len(args) > 1
+                                        else args[0]
+                                    )
+                                    result = _np.asarray(
+                                        group.allreduce(own, _rop)
+                                    )
                             else:
                                 # Host fallback: numpy reduction over the
                                 # channel-delivered values.

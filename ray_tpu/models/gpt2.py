@@ -35,6 +35,11 @@ class GPT2Config:
     dtype: str = "bfloat16"
     attention: str = "dense"  # dense | flash | ring | ulysses
     remat: bool = False
+    # "full" recomputes the whole block in backward; "dots" saves matmul
+    # outputs and recomputes only elementwise ops (jax
+    # dots_with_no_batch_dims_saveable) — most of the memory win at a
+    # fraction of the recompute FLOPs.
+    remat_policy: str = "full"  # full | dots
 
     @property
     def head_dim(self) -> int:
@@ -191,7 +196,13 @@ def gpt2_hidden(params, tokens, cfg: GPT2Config, mesh=None):
 
     block = functools.partial(_block, cfg=cfg, mesh=mesh)
     if cfg.remat:
-        block = jax.checkpoint(block)
+        if cfg.remat_policy == "dots":
+            block = jax.checkpoint(
+                block,
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            )
+        else:
+            block = jax.checkpoint(block)
 
     def scan_body(x, layer):
         return block(x, layer), None

@@ -9,9 +9,9 @@ reference has no attention kernels of its own).  TPU-first design:
     as the ground truth for kernel tests.
   - ``flash_attention``: blocked online-softmax Pallas kernel (VMEM-tiled,
     MXU matmuls with f32 accumulation) for long sequences on TPU; falls
-    back to the reference off-TPU.  Forward kernel + custom VJP whose
-    backward rematerializes in plain XLA (Pallas bwd kernel is the known
-    follow-up).
+    back to the reference off-TPU.  Forward kernel + custom VJP backed by
+    the Pallas backward kernels below (``_flash_bwd_*``), which recompute
+    per-block attention probabilities from the saved softmax statistics.
 """
 
 from __future__ import annotations
@@ -61,7 +61,11 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
 
     iota = jax.lax.broadcasted_iota
     q_block = pl.program_id(1)
-    q = q_ref[:].astype(jnp.float32) * scale
+    # Matmul inputs stay in the storage dtype (bf16): the MXU's native rate
+    # is bf16xbf16->f32; upcasting tiles first would run every dot at the
+    # much slower f32 rate.  Scale and softmax arithmetic happen on the f32
+    # accumulator.
+    q = q_ref[:]
 
     m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
     l = jnp.zeros((block_q, 1), jnp.float32)
@@ -70,9 +74,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
 
     def body(kb, carry):
         m, l, acc = carry
-        k_tile = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v_tile = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        s = jnp.dot(q, k_tile.T, preferred_element_type=jnp.float32)
+        k_tile = k_ref[pl.ds(kb * block_k, block_k), :]
+        v_tile = v_ref[pl.ds(kb * block_k, block_k), :]
+        s = jnp.dot(q, k_tile.T, preferred_element_type=jnp.float32) * scale
         if causal:
             q_pos = q_block * block_q + iota(jnp.int32, (block_q, block_k), 0)
             k_pos = kb * block_k + iota(jnp.int32, (block_q, block_k), 1)
@@ -81,7 +85,10 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
         l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * alpha + jnp.dot(p, v_tile, preferred_element_type=jnp.float32)
+        acc = acc * alpha + jnp.dot(
+            p.astype(v_tile.dtype), v_tile,
+            preferred_element_type=jnp.float32,
+        )
         return m_new, l, acc
 
     if causal:
@@ -145,16 +152,17 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     iota = jax.lax.broadcasted_iota
     q_block = pl.program_id(1)
-    q = q_ref[:].astype(jnp.float32) * scale
-    do = do_ref[:].astype(jnp.float32)
+    # bf16 matmul operands, f32 accumulation/arithmetic (see fwd kernel).
+    q = q_ref[:]
+    do = do_ref[:]
     lse = lse_ref[:]
     delta = delta_ref[:]
     num_k_blocks = sk // block_k
 
     def body(kb, dq):
-        k_tile = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v_tile = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        s = jnp.dot(q, k_tile.T, preferred_element_type=jnp.float32)
+        k_tile = k_ref[pl.ds(kb * block_k, block_k), :]
+        v_tile = v_ref[pl.ds(kb * block_k, block_k), :]
+        s = jnp.dot(q, k_tile.T, preferred_element_type=jnp.float32) * scale
         if causal:
             q_pos = q_block * block_q + iota(jnp.int32, (block_q, block_k), 0)
             k_pos = kb * block_k + iota(jnp.int32, (block_q, block_k), 1)
@@ -162,7 +170,10 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         p = jnp.exp(s - lse)
         dp = jnp.dot(do, v_tile.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
-        return dq + jnp.dot(ds, k_tile, preferred_element_type=jnp.float32)
+        return dq + jnp.dot(
+            ds.astype(k_tile.dtype), k_tile,
+            preferred_element_type=jnp.float32,
+        )
 
     if causal:
         num_iter = jnp.minimum(
@@ -186,26 +197,28 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     iota = jax.lax.broadcasted_iota
     k_block = pl.program_id(1)
-    k_tile = k_ref[:].astype(jnp.float32)
-    v_tile = v_ref[:].astype(jnp.float32)
+    # bf16 matmul operands, f32 accumulation/arithmetic (see fwd kernel).
+    k_tile = k_ref[:]
+    v_tile = v_ref[:]
     num_q_blocks = sq // block_q
 
     def body(qb, carry):
         dk, dv = carry
-        q_tile = q_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        q_tile = q_ref[pl.ds(qb * block_q, block_q), :]
+        do = do_ref[pl.ds(qb * block_q, block_q), :]
         lse = lse_ref[pl.ds(qb * block_q, block_q), :]
         delta = delta_ref[pl.ds(qb * block_q, block_q), :]
-        s = jnp.dot(q_tile * scale, k_tile.T,
-                    preferred_element_type=jnp.float32)
+        s = jnp.dot(q_tile, k_tile.T,
+                    preferred_element_type=jnp.float32) * scale
         if causal:
             q_pos = qb * block_q + iota(jnp.int32, (block_q, block_k), 0)
             k_pos = k_block * block_k + iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(k_pos <= q_pos, s, NEG_INF)
         p = jnp.exp(s - lse)
-        dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        pb = p.astype(do.dtype)
+        dv = dv + jnp.dot(pb.T, do, preferred_element_type=jnp.float32)
         dp = jnp.dot(do, v_tile.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
+        ds = (p * (dp - delta)).astype(q_tile.dtype)
         dk = dk + jnp.dot(ds.T, q_tile, preferred_element_type=jnp.float32)
         return dk, dv
 

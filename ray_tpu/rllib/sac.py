@@ -75,7 +75,7 @@ class _SACRunner:
     broadcast RLModule params snapshot, through connector pipelines."""
 
     def __init__(self, env_payload, spec: RLModuleSpec, seed: int,
-                 scale_low: float, scale_high: float):
+                 runner_idx: int, scale_low: float, scale_high: float):
         from ray_tpu.core.serialization import loads_function
 
         self.env = loads_function(env_payload)()
@@ -87,6 +87,10 @@ class _SACRunner:
             [ScaleActions(scale_low, scale_high)]
         )
         self.seed = seed
+        # Distinct key stream per runner: fold_in(base, runner_idx) — small
+        # additive seed offsets would alias runner i's stream at step t with
+        # runner j's at t + offset*(i-j) (correlated exploration noise).
+        self.runner_idx = runner_idx
         self._step_count = 0
         self.obs = self.env.reset()
         self.episode_return = 0.0
@@ -97,13 +101,18 @@ class _SACRunner:
 
         rows = {k: [] for k in
                 ("obs", "actions", "rewards", "next_obs", "dones")}
-        rng = np.random.default_rng(self.seed + self._step_count)
+        rng = np.random.default_rng(
+            (self.seed, self.runner_idx, self._step_count)
+        )
+        base_key = jax.random.fold_in(
+            jax.random.PRNGKey(self.seed), self.runner_idx
+        )
         for _ in range(n_steps):
             if random_actions:
                 action = rng.uniform(-1.0, 1.0, self.env.action_size)
             else:
                 batch = self.env_to_module({"obs": self.obs})
-                key = jax.random.PRNGKey(self.seed + self._step_count)
+                key = jax.random.fold_in(base_key, self._step_count)
                 out = self.module.forward_exploration(params, batch, key)
                 action = np.asarray(out["actions"])[0]
             env_action = self.module_to_env({"actions": action})["actions"]
@@ -240,7 +249,7 @@ class SAC(Algorithm):
         env_payload = dumps_function(env_maker)
         self.runners = [
             _SACRunner.remote(
-                env_payload, config.rl_module_spec, hp.seed + 17 * i,
+                env_payload, config.rl_module_spec, hp.seed, i,
                 low, high,
             )
             for i in range(max(1, hp.num_env_runners))

@@ -84,14 +84,29 @@ class PrefixAwareRouter(RequestRouter):
         self.extract = prompt_extractor
         self._affinity: Dict[str, Any] = {}  # prefix -> actor id
         self._fallback = PowerOfTwoChoicesRouter()
+        # Probing every replica per warm-prefix hit is O(n) RPCs on the hot
+        # path; a short TTL bounds it to O(n) per interval (the reference's
+        # bounded-probe design).  Queue depths staler than ~100 ms only
+        # delay the re-home decision by one interval.
+        self._lens_ttl_s = 0.1
+        self._lens_cache: tuple = (0.0, None, None)  # (ts, replica_key, lens)
 
     def _queue_lens(self, replicas):
+        import time as _time
+
+        key = tuple(r._actor_id for r in replicas)
+        ts, cached_key, lens = self._lens_cache
+        now = _time.monotonic()
+        if lens is not None and cached_key == key and now - ts < self._lens_ttl_s:
+            return lens
         try:
-            return ray_tpu.get(
+            lens = ray_tpu.get(
                 [r.queue_len.remote() for r in replicas], timeout=5
             )
         except Exception:
             return None
+        self._lens_cache = (now, key, lens)
+        return lens
 
     def choose(self, replicas: List, args, kwargs):
         prompt = self.extract(args, kwargs)

@@ -20,6 +20,9 @@ import time
 from typing import Any, Dict, List, Optional
 
 
+_ckpt_cache_root: Optional[str] = None
+
+
 class Checkpoint:
     """A directory of checkpoint data."""
 
@@ -41,13 +44,25 @@ class Checkpoint:
         from .storage import get_storage, is_remote_uri
 
         if is_remote_uri(self.path):
-            # One download per Checkpoint object — repeated to_dict()/
-            # as_directory() calls reuse the local copy instead of filling
-            # /tmp with duplicates.
-            cached = getattr(self, "_local_cache", None)
-            if cached is None or not os.path.isdir(cached):
-                cached = get_storage(self.path).download_dir(self.path)
-                self._local_cache = cached
+            # Downloads land in a process-wide cache keyed by URI: repeated
+            # restores of the same checkpoint (long tune/train loops) reuse
+            # one copy instead of filling /tmp, returned paths stay valid
+            # for the process lifetime regardless of Checkpoint object
+            # lifetime, and the whole cache root is removed at exit.
+            import atexit
+            import hashlib
+
+            global _ckpt_cache_root
+            if _ckpt_cache_root is None:
+                _ckpt_cache_root = tempfile.mkdtemp(prefix="rtpu_ckpt_cache_")
+                atexit.register(shutil.rmtree, _ckpt_cache_root, True)
+            cached = os.path.join(
+                _ckpt_cache_root,
+                hashlib.sha256(self.path.encode()).hexdigest()[:16],
+            )
+            if not os.path.isdir(cached):
+                tmp = get_storage(self.path).download_dir(self.path)
+                os.replace(tmp, cached)
             return cached
         return self.path
 
