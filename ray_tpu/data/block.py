@@ -1,29 +1,85 @@
 """Block utilities.
 
-A *block* is the unit of parallelism: a list of rows, where a row is a dict
-of column values or a bare scalar/array (reference: ray
-``python/ray/data/block.py`` — there blocks are Arrow tables; lists of rows
-keep zero-copy numpy batches available without an Arrow dependency on the
-hot path).
+A *block* is the unit of parallelism.  Two physical layouts exist, mirroring
+the reference's Arrow-table blocks (ray ``python/ray/data/block.py``,
+``_internal/arrow_block.py``) without an Arrow dependency on the hot path:
+
+  - row blocks: a list of rows (dicts / scalars / arrays) — the layout
+    row-level transforms (map/filter/flat_map, shuffles) operate on;
+  - ``ColumnarBlock``: a dict of equal-length numpy column arrays — the
+    layout batch pipelines (parquet → map_batches → iter_batches) stay in
+    end-to-end.  Batch views and slices are zero-copy (numpy views), the
+    object-store representation ships the arrays through pickle-5
+    out-of-band buffers, and per-row Python objects are materialized only
+    if a row-level transform actually iterates.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Union
+from typing import Any, Dict, Iterator, List, Union
 
 import numpy as np
 
-Block = List[Any]
+
+class ColumnarBlock:
+    """Columnar block: ``{column: np.ndarray}`` with one shared length.
+
+    Quacks like a row sequence (len / iteration / int indexing / slicing)
+    so every row-oriented code path works unchanged; columnar-aware paths
+    (``to_batch("numpy")``, select/projection, batch slicing) skip row
+    materialization entirely.
+    """
+
+    __slots__ = ("columns", "_n")
+
+    def __init__(self, columns: Dict[str, np.ndarray]):
+        self.columns = {k: np.asarray(v) for k, v in columns.items()}
+        self._n = len(next(iter(self.columns.values()))) if self.columns else 0
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __iter__(self) -> Iterator[dict]:
+        keys = list(self.columns)
+        cols = [self.columns[k] for k in keys]
+        for i in range(self._n):
+            yield {k: c[i] for k, c in zip(keys, cols)}
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return ColumnarBlock(
+                {k: v[idx] for k, v in self.columns.items()}
+            )
+        return {k: v[idx] for k, v in self.columns.items()}
+
+    def to_rows(self) -> List[dict]:
+        return list(self)
+
+    def select(self, cols: List[str]) -> "ColumnarBlock":
+        return ColumnarBlock({c: self.columns[c] for c in cols})
+
+    def __repr__(self):
+        return f"ColumnarBlock({list(self.columns)}, n={self._n})"
+
+
+Block = Union[List[Any], ColumnarBlock]
 Batch = Union[List[Any], Dict[str, np.ndarray], np.ndarray]
 
 
 def to_batch(rows: Block, batch_format: str) -> Batch:
-    """Assemble a list of rows into the requested batch format.
+    """Assemble a block into the requested batch format.
 
     ``"default"`` → the row list; ``"numpy"`` → dict of stacked column
     arrays for dict rows, or one stacked array for scalar/array rows (the
-    shape trainers feed to jax.device_put).
+    shape trainers feed to jax.device_put).  Columnar blocks hand out their
+    column dict as-is (zero-copy).
     """
+    if isinstance(rows, ColumnarBlock):
+        if batch_format == "numpy":
+            return dict(rows.columns)
+        if batch_format in ("default", "list"):
+            return rows.to_rows()
+        raise ValueError(f"unknown batch_format {batch_format!r}")
     if batch_format in ("default", "list"):
         return rows
     if batch_format == "numpy":
@@ -38,13 +94,14 @@ def to_batch(rows: Block, batch_format: str) -> Batch:
 
 
 def from_batch(batch: Batch) -> Block:
-    """Inverse of ``to_batch`` for map_batches UDFs that return numpy."""
+    """Inverse of ``to_batch`` for map_batches UDFs that return numpy.
+    Dict batches stay columnar — a numpy-batch pipeline never rowifies."""
+    if isinstance(batch, ColumnarBlock):
+        return batch
     if isinstance(batch, dict):
-        cols = list(batch.keys())
-        if not cols:
+        if not batch:
             return []
-        n = len(batch[cols[0]])
-        return [{k: batch[k][i] for k in cols} for i in range(n)]
+        return ColumnarBlock(batch)
     if isinstance(batch, np.ndarray):
         return list(batch)
     return list(batch)
@@ -70,6 +127,12 @@ def stable_hash(value: Any) -> int:
     the same key to different reducers from different map workers."""
     import hashlib
     import pickle
+
+    # Numpy scalars (what ColumnarBlock row views yield) must hash like
+    # their Python equivalents or parquet-sourced keys would never meet
+    # row-sourced keys on the same reducer.
+    if isinstance(value, np.generic):
+        value = value.item()
 
     if isinstance(value, str):
         data = b"s" + value.encode()

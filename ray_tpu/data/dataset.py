@@ -30,7 +30,14 @@ from .aggregate import (
     finalize_partials,
     merge_partials,
 )
-from .block import Block, from_batch, row_key, stable_hash, to_batch
+from .block import (
+    Block,
+    ColumnarBlock,
+    from_batch,
+    row_key,
+    stable_hash,
+    to_batch,
+)
 from .datasource import (
     BinaryFilesDatasource,
     CSVDatasource,
@@ -86,7 +93,40 @@ class Dataset:
     def map(self, fn: Callable[[Any], Any]) -> "Dataset":
         return self._narrow("Map", lambda block: [fn(r) for r in block])
 
-    def filter(self, fn: Callable[[Any], bool]) -> "Dataset":
+    def filter(self, fn=None, *, predicate=None) -> "Dataset":
+        """Keep rows where ``fn(row)`` is true — or, with ``predicate``, a
+        structured comparison ``(col, op, value)`` (or a list of them,
+        ANDed; op in ==/!=/>/>=/</<=) that the plan optimizer can push
+        down into columnar datasources (parquet predicate pushdown)."""
+        if predicate is not None:
+            preds = (
+                [predicate] if isinstance(predicate, tuple) else list(predicate)
+            )
+            import operator as _op
+
+            ops = {
+                "==": _op.eq, "!=": _op.ne, ">": _op.gt,
+                ">=": _op.ge, "<": _op.lt, "<=": _op.le,
+            }
+
+            def pred_filter(block: Block) -> Block:
+                if isinstance(block, ColumnarBlock):
+                    import numpy as _np
+
+                    mask = _np.ones(len(block), dtype=bool)
+                    for col, op, val in preds:
+                        mask &= ops[op](block.columns[col], val)
+                    return ColumnarBlock(
+                        {k: v[mask] for k, v in block.columns.items()}
+                    )
+                return [
+                    r for r in block
+                    if all(ops[op](r[col], val) for col, op, val in preds)
+                ]
+
+            stage = MapStage([pred_filter], [f"Filter{preds}"])
+            stage.predicate = preds
+            return self._with_stage(stage)
         return self._narrow("Filter", lambda block: [r for r in block if fn(r)])
 
     def flat_map(self, fn: Callable[[Any], Sequence[Any]]) -> "Dataset":
@@ -149,7 +189,14 @@ class Dataset:
         return self.map(add)
 
     def select_columns(self, cols: List[str]) -> "Dataset":
-        return self.map(lambda r: {c: r[c] for c in cols})
+        def proj(block: Block) -> Block:
+            if isinstance(block, ColumnarBlock):
+                return block.select(cols)
+            return [{c: r[c] for c in cols} for r in block]
+
+        stage = MapStage([proj], [f"Select{cols}"])
+        stage.projection = list(cols)
+        return self._with_stage(stage)
 
     def drop_columns(self, cols: List[str]) -> "Dataset":
         drop = set(cols)
@@ -391,12 +438,47 @@ class Dataset:
         batch_format: str = "default",
         drop_last: bool = False,
     ) -> Iterator:
-        buf: Block = []
+        # Columnar path: slice column arrays (numpy views — zero-copy
+        # within a block) instead of materializing per-row dicts.
+        pending: List[ColumnarBlock] = []  # columnar carry between blocks
+        n_pending = 0
+        buf: List[Any] = []  # row carry (mixed/row blocks)
         for block in self.iter_blocks():
+            if isinstance(block, ColumnarBlock) and not buf:
+                pending.append(block)
+                n_pending += len(block)
+                while n_pending >= batch_size:
+                    take, taken = [], 0
+                    while taken < batch_size:
+                        head = pending[0]
+                        need = batch_size - taken
+                        if len(head) <= need:
+                            take.append(pending.pop(0))
+                            taken += len(take[-1])
+                        else:
+                            take.append(head[:need])
+                            pending[0] = head[need:]
+                            taken += need
+                    n_pending -= batch_size
+                    if len(take) == 1:
+                        yield to_batch(take[0], batch_format)
+                    else:
+                        cols = {
+                            k: np.concatenate([t.columns[k] for t in take])
+                            for k in take[0].columns
+                        }
+                        yield to_batch(ColumnarBlock(cols), batch_format)
+                continue
+            # Row path (also drains any columnar carry into rows first).
+            for p in pending:
+                buf.extend(p)
+            pending, n_pending = [], 0
             buf.extend(block)
             while len(buf) >= batch_size:
                 yield to_batch(buf[:batch_size], batch_format)
                 buf = buf[batch_size:]
+        for p in pending:
+            buf.extend(p)
         if buf and not drop_last:
             yield to_batch(buf, batch_format)
 

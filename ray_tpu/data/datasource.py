@@ -121,8 +121,98 @@ def _expand_paths(path: str, suffix: str = "") -> List[str]:
     return matches or [path]
 
 
+def _table_to_columnar(table):
+    """pyarrow Table → ColumnarBlock (numpy columns; zero-copy where the
+    arrow buffer layout allows, object arrays for strings/nested)."""
+    from .block import ColumnarBlock
+
+    cols = {}
+    for name in table.column_names:
+        col = table.column(name)
+        try:
+            cols[name] = col.to_numpy(zero_copy_only=False)
+        except Exception:  # noqa: BLE001 — exotic nested types
+            cols[name] = np.asarray(col.to_pylist(), dtype=object)
+    return ColumnarBlock(cols)
+
+
+class ParquetReadTask(ReadTask):
+    """Parquet read with pushdown hooks: the plan optimizer can narrow the
+    read to a column subset (projection pushdown) and/or attach a row
+    predicate (filter pushdown) — reference
+    ``data/_internal/logical/rules/`` projection/filter pushdown into
+    ParquetDatasource."""
+
+    def __init__(self, path: str, row_group: Optional[int] = None,
+                 columns: Optional[List[str]] = None,
+                 filters: Optional[list] = None,
+                 metadata: Optional[dict] = None):
+        self.path = path
+        self.row_group = row_group
+        self.columns = columns
+        self.filters = filters
+        super().__init__(self._read, metadata)
+
+    def with_projection(self, cols: List[str]) -> "ParquetReadTask":
+        merged = (
+            [c for c in self.columns if c in cols]
+            if self.columns is not None
+            else list(cols)
+        )
+        return ParquetReadTask(
+            self.path, self.row_group, merged, self.filters, dict(self.metadata)
+        )
+
+    def with_predicate(self, filters: list) -> "ParquetReadTask":
+        return ParquetReadTask(
+            self.path, self.row_group, self.columns,
+            (self.filters or []) + list(filters), dict(self.metadata),
+        )
+
+    def _read(self):
+        import pyarrow.parquet as pq
+
+        if self.filters is not None:
+            import pyarrow.compute as pc
+            import pyarrow.dataset as pads
+
+            # Dataset API: row-exact predicate evaluation during the scan.
+            expr = None
+            for col, op, val in self.filters:
+                field = pc.field(col)
+                term = {
+                    "==": field == val, "!=": field != val,
+                    ">": field > val, ">=": field >= val,
+                    "<": field < val, "<=": field <= val,
+                }[op]
+                expr = term if expr is None else (expr & term)
+            ds = pads.dataset(self.path)
+            if self.row_group is not None:
+                frag = list(ds.get_fragments())[0]
+                frag = frag.subset(row_group_ids=[self.row_group])
+                table = frag.to_table(filter=expr, columns=self.columns)
+            else:
+                table = ds.to_table(filter=expr, columns=self.columns)
+            return _table_to_columnar(table)
+        if self.row_group is not None:
+            table = pq.ParquetFile(self.path).read_row_group(
+                self.row_group, columns=self.columns
+            )
+        else:
+            table = pq.read_table(self.path, columns=self.columns)
+        return _table_to_columnar(table)
+
+    def __reduce__(self):
+        return (
+            ParquetReadTask,
+            (self.path, self.row_group, self.columns, self.filters,
+             self.metadata),
+        )
+
+
 class ParquetDatasource(Datasource):
-    """One read task per file (row-group granularity when a single file)."""
+    """One read task per file (row-group granularity when a single file).
+    Emits columnar blocks."""
 
     def __init__(self, path: str, columns: Optional[List[str]] = None):
         self._paths = _expand_paths(path, ".parquet")
@@ -137,26 +227,16 @@ class ParquetDatasource(Datasource):
             # parallelizes.
             path = self._paths[0]
             n_groups = pq.ParquetFile(path).num_row_groups
-            tasks = []
-            for g in range(n_groups):
-                def read(p=path, grp=g):
-                    import pyarrow.parquet as pq  # noqa: PLC0415
-
-                    return pq.ParquetFile(p).read_row_group(
-                        grp, columns=cols
-                    ).to_pylist()
-
-                tasks.append(ReadTask(read, {"path": path, "row_group": g}))
-            return tasks
-        tasks = []
-        for path in self._paths:
-            def read(p=path):
-                import pyarrow.parquet as pq  # noqa: PLC0415
-
-                return pq.read_table(p, columns=cols).to_pylist()
-
-            tasks.append(ReadTask(read, {"path": path}))
-        return tasks
+            return [
+                ParquetReadTask(
+                    path, g, cols, None, {"path": path, "row_group": g}
+                )
+                for g in range(n_groups)
+            ]
+        return [
+            ParquetReadTask(path, None, cols, None, {"path": path})
+            for path in self._paths
+        ]
 
 
 class CSVDatasource(Datasource):
@@ -248,8 +328,16 @@ def write_block_parquet(block: Block, path: str) -> str:
     import pyarrow as pa
     import pyarrow.parquet as pq
 
-    rows = [r if isinstance(r, dict) else {"value": r} for r in block]
-    pq.write_table(pa.Table.from_pylist(rows), path)
+    from .block import ColumnarBlock
+
+    if isinstance(block, ColumnarBlock):
+        table = pa.Table.from_pydict(
+            {k: pa.array(v) for k, v in block.columns.items()}
+        )
+    else:
+        rows = [r if isinstance(r, dict) else {"value": r} for r in block]
+        table = pa.Table.from_pylist(rows)
+    pq.write_table(table, path)
     return path
 
 

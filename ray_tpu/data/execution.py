@@ -122,7 +122,11 @@ class OpStats:
 
 
 class MapStage:
-    """Fused narrow transforms executed by tasks (or an actor pool)."""
+    """Fused narrow transforms executed by tasks (or an actor pool).
+
+    ``projection`` / ``predicate`` mark pushdown-eligible stages (set by
+    ``select_columns`` / ``filter(predicate=...)``) for the plan optimizer.
+    """
 
     def __init__(
         self,
@@ -133,6 +137,8 @@ class MapStage:
         self.transforms = list(transforms)
         self.names = list(names or [])
         self.compute = compute
+        self.projection: Optional[List[str]] = None
+        self.predicate: Optional[list] = None
 
     @property
     def name(self) -> str:
@@ -365,18 +371,84 @@ class StreamingExecutor:
         self.stats: List[OpStats] = []
 
     def run(self) -> Iterator:
-        stages = _optimize(self.inputs, self.stages)
-        stream: Iterator = iter(self.inputs)
+        inputs, stages = _optimize(self.inputs, self.stages)
+        stream: Iterator = iter(inputs)
         for stage in stages:
             stream = stage.run(stream, self.stats)
         return stream
 
 
-def _optimize(inputs: List[Any], stages: List[Any]) -> List[Any]:
-    """Fusion rules (reference ``data/_internal/logical/rules/``):
-    (1) adjacent task-compute MapStages fuse; (2) a MapStage directly
-    before an AllToAllStage fuses into its map phase; (3) a leading
-    non-map stage over ReadTasks gets a normalization MapStage."""
+def _pushdown_rules(inputs: List[Any], stages: List[Any]):
+    """Projection/predicate pushdown into pushdown-capable read tasks
+    (reference ``data/_internal/logical/rules/``: projection + filter
+    pushdown into ParquetDatasource).  Walks the leading marker stages:
+    a predicate pushes into the read AND its stage is dropped (the scan
+    is row-exact); a projection narrows the read but the stage stays (it
+    is a cheap column slice and also covers non-pushdown inputs)."""
+    from .datasource import ParquetReadTask
+
+    if not stages or not inputs or not all(
+        isinstance(i, ParquetReadTask) for i in inputs
+    ):
+        return inputs, stages
+    stages = list(stages)
+    out_inputs = list(inputs)
+    idx = 0
+    needed_cols: Optional[set] = None
+    while idx < len(stages):
+        st = stages[idx]
+        if not isinstance(st, MapStage):
+            break
+        if st.predicate is not None:
+            out_inputs = [t.with_predicate(st.predicate) for t in out_inputs]
+            # Predicate columns must survive any projection pushed later.
+            pred_cols = {c for c, _op, _v in st.predicate}
+            if needed_cols is not None:
+                needed_cols |= pred_cols
+            stages.pop(idx)
+            continue
+        if st.projection is not None:
+            cols = set(st.projection)
+            needed_cols = cols if needed_cols is None else needed_cols | cols
+            idx += 1
+            continue
+        break
+    if needed_cols is not None:
+        out_inputs = [
+            t.with_projection(sorted(needed_cols)) for t in out_inputs
+        ]
+    return out_inputs, stages
+
+
+def _elide_repartitions(inputs: List[Any], stages: List[Any]) -> List[Any]:
+    """Repartition elision (reference fuse/elide-repartition rules):
+    consecutive repartitions collapse to the last (the earlier exchange's
+    block assignment is fully overwritten by the later one).  A repartition
+    matching the current block COUNT is deliberately NOT elided — it also
+    rebalances row counts across blocks."""
+    out: List[Any] = []
+    for stage in stages:
+        is_rep = isinstance(stage, AllToAllStage) and stage.name == "Repartition"
+        if (
+            is_rep
+            and out
+            and isinstance(out[-1], AllToAllStage)
+            and out[-1].name == "Repartition"
+        ):
+            out[-1] = stage  # last repartition wins
+            continue
+        out.append(stage)
+    return out
+
+
+def _optimize(inputs: List[Any], stages: List[Any]):
+    """Plan rewriting (reference ``data/_internal/logical/rules/``):
+    (0) projection/predicate pushdown into parquet reads and repartition
+    elision; (1) adjacent task-compute MapStages fuse; (2) a MapStage
+    directly before an AllToAllStage fuses into its map phase; (3) a
+    leading non-map stage over ReadTasks gets a normalization MapStage."""
+    inputs, stages = _pushdown_rules(inputs, stages)
+    stages = _elide_repartitions(inputs, stages)
     fused: List[Any] = []
     for stage in stages:
         if fused and isinstance(stage, MapStage) and isinstance(fused[-1], MapStage):
@@ -402,4 +474,4 @@ def _optimize(inputs: List[Any], stages: List[Any]) -> List[Any]:
         and (isinstance(fused[0], MapStage) or hasattr(fused[0], "with_fused"))
     ):
         fused.insert(0, MapStage([], ["Read"]))
-    return fused
+    return inputs, fused

@@ -28,7 +28,9 @@ def _get_or_create_controller():
         return ray_tpu.get_actor(CONTROLLER_NAME)
     except ValueError:
         return ServeController.options(
-            name=CONTROLLER_NAME, get_if_exists=True, max_concurrency=8
+            # Long-poll listeners park an actor slot each for up to 30s
+            # (one per subscribing process), on top of normal control calls.
+            name=CONTROLLER_NAME, get_if_exists=True, max_concurrency=64
         ).remote()
 
 
@@ -132,6 +134,9 @@ def delete(name: str) -> bool:
 
 
 def shutdown():
+    from .long_poll import reset_client
+
+    reset_client()
     stop_http_proxy()
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
@@ -153,21 +158,30 @@ def start_http_proxy(host: str = "127.0.0.1", port: int = 8000) -> str:
 
     controller = _get_or_create_controller()
     handles: Dict[str, DeploymentHandle] = {}
-    # Route-table cache: the controller must stay OUT of the request hot
-    # path (reference: routes push to proxies via long-poll; a short TTL
-    # pull approximates that).
-    route_cache: Dict[str, Any] = {"routes": {}, "ts": 0.0}
+    # Route table: PUSHED by the controller's long-poll host (reference:
+    # routes push to proxies via LongPollHost) — the controller stays out
+    # of the request hot path and a deploy/delete is visible here within
+    # one RPC latency.  Bootstrap: one direct pull before the first push.
+    from .long_poll import long_poll_client
+
+    lp = long_poll_client()
+    lp.register(("routes",))
+    route_bootstrap: Dict[str, Any] = {}
+    route_bootstrap_miss: Dict[str, float] = {}
 
     def get_routes_cached():
-        import time as _time
-
-        now = _time.monotonic()
-        if now - route_cache["ts"] > 2.0:
-            route_cache["routes"] = ray_tpu.get(
-                controller.get_routes.remote(), timeout=30
+        pushed = lp.get(("routes",))
+        if pushed is not None:
+            return pushed
+        # Pre-first-push: pull once and memoize even an EMPTY table (the
+        # controller must stay out of the hot path for request streams
+        # against a routeless proxy).
+        if "fetched" not in route_bootstrap_miss:
+            route_bootstrap_miss["fetched"] = 1.0
+            route_bootstrap.update(
+                ray_tpu.get(controller.get_routes.remote(), timeout=30)
             )
-            route_cache["ts"] = now
-        return route_cache["routes"]
+        return route_bootstrap
 
     def match_route(path: str, routes: Dict[str, str]):
         # Longest-prefix match (reference route_prefix semantics): a
@@ -224,14 +238,22 @@ def start_http_proxy(host: str = "127.0.0.1", port: int = 8000) -> str:
 
         name = match_route(request.path, get_routes_cached())
         if name is None:
-            # Maybe the route is newer than the cache — refresh, but at
-            # most once per second: a stream of 404s (scanners, health
-            # probes) must not put the controller back in the hot path.
+            # Route misses are usually real 404s (routes are PUSHED, so the
+            # table is fresh); the one legit race is a deploy whose first
+            # push hasn't landed.  One direct pull, rate-limited to once a
+            # second so 404 streams never put the controller in the hot path.
             now = _time.monotonic()
-            if now - route_cache.get("miss_ts", 0.0) > 1.0:
-                route_cache["miss_ts"] = now
-                route_cache["ts"] = 0.0
-                name = match_route(request.path, get_routes_cached())
+            if now - route_bootstrap_miss.get("ts", 0.0) > 1.0:
+                route_bootstrap_miss["ts"] = now
+                try:
+                    fresh = ray_tpu.get(
+                        controller.get_routes.remote(), timeout=5
+                    )
+                    route_bootstrap.clear()
+                    route_bootstrap.update(fresh)
+                    name = match_route(request.path, fresh)
+                except Exception:  # noqa: BLE001 — fall through to 404
+                    pass
         if name is None:
             return web.json_response(
                 {"error": f"no deployment at {request.path}"}, status=404

@@ -184,10 +184,80 @@ class ServeController:
         self.deployments: Dict[str, dict] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
+        # Long-poll host state (reference LongPollHost, serve/_private/
+        # long_poll.py:252): per-key monotonically-increasing snapshot ids;
+        # listeners block in listen_for_change until a key advances.
+        # Mutations happen on actor calls AND the reconcile thread, so the
+        # snapshot table is lock-guarded and waiters are asyncio events
+        # woken via their owning loop.
+        self._lp_lock = threading.Lock()
+        self._lp_snapshots: Dict[tuple, tuple] = {}  # key -> (id, value)
+        self._lp_waiters: list = []  # [(loop, asyncio.Event)]
         self._reconciler = threading.Thread(
             target=self._reconcile_loop, daemon=True, name="serve-reconcile"
         )
         self._reconciler.start()
+
+    # ----------------------------------------------------------- long poll
+    def _publish(self, key: tuple, value) -> None:
+        with self._lp_lock:
+            next_id = self._lp_snapshots.get(key, (0, None))[0] + 1
+            self._lp_snapshots[key] = (next_id, value)
+            waiters, self._lp_waiters = self._lp_waiters, []
+        for loop, ev in waiters:
+            try:
+                loop.call_soon_threadsafe(ev.set)
+            except RuntimeError:
+                pass  # loop gone (shutdown)
+
+    def _publish_state(self, name: Optional[str] = None) -> None:
+        """Push the current replica list (for ``name``) and route table."""
+        if name is not None:
+            entry = self.deployments.get(name)
+            self._publish(
+                ("replicas", name),
+                list(entry["replicas"]) if entry is not None else [],
+            )
+        self._publish(("routes",), self.get_routes())
+
+    async def listen_for_change(
+        self, keys_to_ids: Dict[tuple, int], timeout_s: float = 30.0
+    ) -> Dict[tuple, tuple]:
+        """Block until any subscribed key's snapshot id exceeds the
+        client's, then return every advanced key's (id, snapshot).  Returns
+        {} on timeout (client re-issues)."""
+        import asyncio
+
+        keys_to_ids = {tuple(k): v for k, v in keys_to_ids.items()}
+        deadline = time.monotonic() + timeout_s
+        while True:
+            with self._lp_lock:
+                updates = {
+                    k: self._lp_snapshots[k]
+                    for k, i in keys_to_ids.items()
+                    if k in self._lp_snapshots and self._lp_snapshots[k][0] > i
+                }
+                if updates:
+                    return updates
+                ev = asyncio.Event()
+                self._lp_waiters.append((asyncio.get_running_loop(), ev))
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                with self._lp_lock:
+                    self._lp_waiters = [
+                        w for w in self._lp_waiters if w[1] is not ev
+                    ]
+                return {}
+            try:
+                await asyncio.wait_for(ev.wait(), remaining)
+            except asyncio.TimeoutError:
+                # Drop our waiter: timed-out listens must not accrete in
+                # the host's waiter list on an idle cluster.
+                with self._lp_lock:
+                    self._lp_waiters = [
+                        w for w in self._lp_waiters if w[1] is not ev
+                    ]
+                return {}
 
     # ------------------------------------------------------------- deploy API
     def deploy(self, name: str, payload: bytes, init_args, init_kwargs,
@@ -238,6 +308,7 @@ class ServeController:
             entry["scale_pressure_since"] = None
             self._set_replica_count(entry, num_replicas)
             self.deployments[name] = entry
+            self._publish_state(name)
             return {"name": name, "num_replicas": len(entry["replicas"])}
 
     def _spawn_replica(self, entry: dict):
@@ -315,6 +386,7 @@ class ServeController:
                 )
                 self._kill(h)
                 entry["replicas"][idx] = self._spawn_replica(entry)
+            self._publish_state(name)
 
     def _autoscale(self, name: str, entry: dict):
         cfg = entry["autoscaling"]
@@ -360,6 +432,7 @@ class ServeController:
                 self._set_replica_count(entry, desired)
                 entry["scale_pressure_since"] = None
                 entry["last_scale_ts"] = now
+                self._publish_state(name)
 
     # -------------------------------------------------------------- query API
     def get_replicas(self, name: str) -> List:
@@ -380,6 +453,7 @@ class ServeController:
                 return False
             for h in entry["replicas"]:
                 self._kill(h)
+            self._publish_state(name)
             return True
 
     def status(self) -> Dict[str, Any]:

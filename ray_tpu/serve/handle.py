@@ -15,8 +15,6 @@ import ray_tpu
 
 from .controller import CONTROLLER_NAME
 
-_REPLICA_REFRESH_S = 5.0
-
 
 class DeploymentResponse:
     """Future-like wrapper over the replica call's ObjectRef."""
@@ -106,13 +104,29 @@ class DeploymentHandle:
         return self._controller
 
     def _refresh(self, force=False):
-        now = time.monotonic()
-        if force or not self._replicas or now - self._refreshed > _REPLICA_REFRESH_S:
-            self._replicas = ray_tpu.get(
-                self._get_controller().get_replicas.remote(self.deployment_name),
-                timeout=30,
-            )
-            self._refreshed = now
+        """Replica list updates are PUSHED by the controller's long-poll
+        host (reference ``LongPollHost``): the process-wide client holds
+        one blocking listen; this method just reads its latest snapshot —
+        no periodic polling, and a killed replica's removal lands here
+        within one RPC latency.  ``force`` (probe-failure recovery) and
+        first use bootstrap with a direct RPC."""
+        from .long_poll import long_poll_client
+
+        key = ("replicas", self.deployment_name)
+        client = long_poll_client()
+        client.register(key)
+        if not force:
+            pushed = client.get(key)
+            if pushed is not None:
+                self._replicas = pushed
+                return
+            if self._replicas:
+                return  # bootstrap copy still valid until a push lands
+        self._replicas = ray_tpu.get(
+            self._get_controller().get_replicas.remote(self.deployment_name),
+            timeout=30,
+        )
+        self._refreshed = time.monotonic()
 
     def _pick_replica(self, args=(), kwargs=None):
         """Route via the configured RequestRouter (default: power-of-two

@@ -62,7 +62,13 @@ class Checkpoint:
             )
             if not os.path.isdir(cached):
                 tmp = get_storage(self.path).download_dir(self.path)
-                os.replace(tmp, cached)
+                try:
+                    os.replace(tmp, cached)
+                except OSError:
+                    # Concurrent restore won the rename; its copy is ours too.
+                    if not os.path.isdir(cached):
+                        raise
+                    shutil.rmtree(tmp, True)
             return cached
         return self.path
 

@@ -1,0 +1,200 @@
+"""Columnar blocks + plan-optimizer rules.
+
+Reference: ray ``python/ray/data/_internal/arrow_block.py`` (columnar
+blocks with zero-copy batch views) and ``_internal/logical/rules/``
+(projection/filter pushdown, repartition elision).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+import ray_tpu.data as rd
+from ray_tpu.data.block import ColumnarBlock, from_batch, to_batch
+from ray_tpu.data.datasource import ParquetReadTask
+from ray_tpu.data.execution import _optimize
+
+
+@pytest.fixture
+def pq_dir(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    paths = []
+    for i in range(3):
+        n = 100
+        t = pa.table(
+            {
+                "x": np.arange(n) + i * n,
+                "y": (np.arange(n) + i * n) * 2.0,
+                "z": [f"s{j}" for j in range(n)],
+            }
+        )
+        p = str(tmp_path / f"part{i}.parquet")
+        pq.write_table(t, p)
+        paths.append(p)
+    return str(tmp_path)
+
+
+class TestColumnarBlock:
+    def test_row_protocol(self):
+        b = ColumnarBlock({"a": np.arange(5), "b": np.arange(5) * 10})
+        assert len(b) == 5
+        rows = list(b)
+        assert rows[2] == {"a": 2, "b": 20}
+        assert b[3] == {"a": 3, "b": 30}
+
+    def test_slice_is_zero_copy_view(self):
+        base = np.arange(10)
+        b = ColumnarBlock({"a": base})
+        s = b[2:6]
+        assert isinstance(s, ColumnarBlock)
+        assert s.columns["a"].base is base  # numpy view, not a copy
+
+    def test_to_batch_numpy_zero_copy(self):
+        arr = np.arange(8)
+        b = ColumnarBlock({"a": arr})
+        batch = to_batch(b, "numpy")
+        assert batch["a"] is arr
+
+    def test_from_batch_stays_columnar(self):
+        out = from_batch({"a": np.arange(4), "b": np.ones(4)})
+        assert isinstance(out, ColumnarBlock)
+
+
+class TestParquetColumnar:
+    def test_read_produces_columnar_and_batches(self, ray_start_regular, pq_dir):
+        ds = rd.read_parquet(pq_dir)
+        blocks = list(ds.iter_blocks())
+        assert all(isinstance(b, ColumnarBlock) for b in blocks)
+        batches = list(
+            ds.iter_batches(batch_size=64, batch_format="numpy")
+        )
+        assert all(isinstance(bt, dict) for bt in batches)
+        total = sum(len(bt["x"]) for bt in batches)
+        assert total == 300
+
+    def test_map_batches_numpy_roundtrip_columnar(self, ray_start_regular, pq_dir):
+        ds = rd.read_parquet(pq_dir).map_batches(
+            lambda b: {"x2": b["x"] * 2}, batch_format="numpy"
+        )
+        rows = ds.take_all()
+        assert len(rows) == 300
+        assert sorted(r["x2"] for r in rows) == [2 * i for i in range(300)]
+
+
+class TestOptimizerRules:
+    def test_projection_pushdown_into_parquet(self, ray_start_regular, pq_dir):
+        ds = rd.read_parquet(pq_dir).select_columns(["x"])
+        inputs, _stages = _optimize(ds._inputs, ds._stages)
+        assert all(isinstance(t, ParquetReadTask) for t in inputs)
+        assert all(t.columns == ["x"] for t in inputs)
+        rows = ds.take_all()
+        assert set(rows[0].keys()) == {"x"}
+        assert len(rows) == 300
+
+    def test_filter_pushdown_into_parquet(self, ray_start_regular, pq_dir):
+        ds = rd.read_parquet(pq_dir).filter(predicate=("x", "<", 50))
+        inputs, stages = _optimize(ds._inputs, ds._stages)
+        assert all(t.filters == [("x", "<", 50)] for t in inputs)
+        # the filter stage itself was dropped (scan is row-exact)
+        assert not any(
+            getattr(s, "predicate", None) for s in stages
+        )
+        rows = ds.take_all()
+        assert len(rows) == 50
+        assert all(r["x"] < 50 for r in rows)
+
+    def test_filter_then_select_keeps_predicate_columns(
+        self, ray_start_regular, pq_dir
+    ):
+        ds = (
+            rd.read_parquet(pq_dir)
+            .select_columns(["y"])
+            .filter(predicate=("y", ">=", 100.0))
+        )
+        # pushdown must not narrow the read below the predicate's columns
+        rows = ds.take_all()
+        assert all(set(r.keys()) == {"y"} for r in rows)
+        assert len(rows) == 250
+
+    def test_predicate_filter_without_parquet(self, ray_start_regular):
+        ds = rd.from_items(
+            [{"v": i} for i in range(20)], parallelism=2
+        ).filter(predicate=("v", ">=", 10))
+        assert sorted(r["v"] for r in ds.take_all()) == list(range(10, 20))
+
+    def test_repartition_elision_consecutive(self, ray_start_regular):
+        ds = rd.from_items(list(range(30)), parallelism=3)
+        ds2 = ds.repartition(10).repartition(5)
+        _inputs, stages = _optimize(ds2._inputs, ds2._stages)
+        reps = [
+            s for s in stages
+            if getattr(s, "name", "") == "Repartition"
+        ]
+        assert len(reps) == 1 and reps[0].n_out == 5
+        assert ds2.num_blocks() == 3  # plan-level; execution yields 5
+        assert len(list(ds2.materialize()._inputs)) == 5
+        assert sorted(ds2.take_all()) == list(range(30))
+
+    def test_same_count_repartition_not_elided(self, ray_start_regular):
+        # repartition(n) with n == current blocks still REBALANCES rows —
+        # it must survive optimization.
+        ds = rd.from_items(list(range(12)), parallelism=4).repartition(4)
+        inputs, stages = _optimize(ds._inputs, ds._stages)
+        assert any(
+            getattr(s, "name", "") == "Repartition" for s in stages
+        )
+        assert sorted(ds.take_all()) == list(range(12))
+
+
+class TestColumnarPipelinePerf:
+    def test_columnar_avoids_row_materialization(self, ray_start_regular, tmp_path):
+        """A parquet → map_batches(numpy) → iter_batches pipeline stays
+        columnar end-to-end: per-batch wall time must scale with column
+        arithmetic, not per-row dict construction.  Guarded as a
+        comparative bound (columnar ≥3x faster than the equivalent
+        row-materializing pipeline on the same data)."""
+        import time
+
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        n = 200_000
+        p = str(tmp_path / "big.parquet")
+        pq.write_table(
+            pa.table({"a": np.arange(n), "b": np.arange(n) * 0.5}), p,
+            row_group_size=n // 4,
+        )
+
+        ds = rd.read_parquet(p).map_batches(
+            lambda b: {"s": b["a"] + b["b"]}, batch_format="numpy"
+        )
+        rowds = rd.read_parquet(p).map(lambda r: {"s": r["a"] + r["b"]})
+
+        # Warm pass: worker cold-start (process spawn + imports) dominates
+        # the first execution of EITHER pipeline and is not what this test
+        # measures.
+        ds.count()
+        rowds.count()
+
+        t0 = time.perf_counter()
+        total = sum(
+            len(bt["s"])
+            for bt in ds.iter_batches(batch_size=32768, batch_format="numpy")
+        )
+        columnar_s = time.perf_counter() - t0
+        assert total == n
+
+        t0 = time.perf_counter()
+        total_rows = sum(
+            len(bt)
+            for bt in rowds.iter_batches(batch_size=32768)
+        )
+        row_s = time.perf_counter() - t0
+        assert total_rows == n
+        assert columnar_s * 3 < row_s, (
+            f"columnar {columnar_s:.3f}s not ≥3x faster than rows {row_s:.3f}s"
+        )
