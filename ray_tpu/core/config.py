@@ -47,6 +47,11 @@ _KNOBS: Dict[str, tuple] = {
     # -- object store --
     "max_inline_object_bytes": (int, 100 * 1024, "Inline small objects in RPCs"),
     "lineage_pinning": (int, 1, "Pin task args while returns live (reconstruction)"),
+    "borrow_handoff_grace_s": (
+        float, 10.0,
+        "Keep escaped/borrowed refs alive this long past their last local "
+        "ref so in-flight borrower increfs never race a free",
+    ),
     "max_object_reconstructions": (int, 3, "Lineage re-execution attempts per get"),
     "object_store_memory_bytes": (int, 2 * 1024**3, "Per-node shm budget"),
     "object_store_prefault": (

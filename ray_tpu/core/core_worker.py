@@ -430,6 +430,9 @@ class CoreWorker:
         # from user threads wakes the loop once, not once per callback.
         self._post_lock = threading.Lock()
         self._post_queue: List = []
+        # Borrowed refs this process re-serialized (lent onward): their
+        # outgoing decref is grace-delayed.  See on_ref_relent.
+        self._relent_refs: Set[ObjectID] = set()
 
     def _post(self, cb) -> None:
         """Run ``cb()`` on the protocol loop; bursts coalesce into a single
@@ -882,6 +885,47 @@ class CoreWorker:
         if obj is not None:
             obj.local_refs += 1
 
+    def on_ref_relent(self, oid: ObjectID):
+        """A borrowed ref was re-serialized (lent onward): mark it so this
+        process's eventual decref is grace-delayed.  Thread-safe (called
+        from pickling on arbitrary threads); set mutation is atomic."""
+        self._relent_refs.add(oid)
+
+    def on_ref_escaped(self, oid: ObjectID):
+        """An owned ref was serialized for another process: hold a borrow
+        for a grace period so the receiver's incref can't race our free.
+
+        Honest scope (vs the reference's exact borrower registration in
+        reply metadata, reference_counter.cc): task ARGS are protected
+        exactly by args_holds until the task reply; this grace hold covers
+        the remaining escape paths (refs inside return values / stored
+        messages), where the receiver deserializes within one RPC hop —
+        a receiver stalled longer than borrow_handoff_grace_s after
+        physically receiving the bytes can still lose the race."""
+        if self._shutdown or self.loop is None or self.loop.is_closed():
+            return
+
+        def hold():
+            obj = self.owned.get(oid)
+            if obj is None:
+                return
+            obj.borrows += 1
+
+            def release():
+                o = self.owned.get(oid)
+                if o is not None:
+                    o.borrows -= 1
+                    self._maybe_free(oid)
+
+            asyncio.get_running_loop().call_later(
+                GlobalConfig.borrow_handoff_grace_s, release
+            )
+
+        try:
+            self._post(hold)
+        except RuntimeError:
+            pass
+
     def _send_incref(self, ref: ObjectRef):
         client = self.worker_clients.get(ref.owner_address)
         asyncio.get_running_loop().create_task(
@@ -901,10 +945,23 @@ class CoreWorker:
             self._post(lambda o=oid: self._decr_local(o))
         else:
             def send():
-                client = self.worker_clients.get(owner_address)
-                asyncio.get_running_loop().create_task(
-                    self._oneway(client, "decref", {"object_id": oid})
-                )
+                # Only refs this borrower actually RE-LENT need the grace
+                # delay (the sub-borrower's incref must reach the owner
+                # before our decref); plain borrows decref immediately so
+                # owner-side lifetime isn't inflated.
+                def fire():
+                    client = self.worker_clients.get(owner_address)
+                    asyncio.get_running_loop().create_task(
+                        self._oneway(client, "decref", {"object_id": oid})
+                    )
+
+                if oid in self._relent_refs:
+                    self._relent_refs.discard(oid)
+                    asyncio.get_running_loop().call_later(
+                        GlobalConfig.borrow_handoff_grace_s, fire
+                    )
+                else:
+                    fire()
             try:
                 self._post(send)
             except RuntimeError:

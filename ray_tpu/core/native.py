@@ -16,7 +16,11 @@ import threading
 from typing import List, Optional, Tuple
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-_LIB_PATH = os.path.join(_REPO_ROOT, "build", "librtpu_native.so")
+# Overridable so the stress harness can load sanitizer builds
+# (librtpu_native_{asan,tsan}.so; see src/native/Makefile).
+_LIB_PATH = os.environ.get("RAY_TPU_NATIVE_LIB") or os.path.join(
+    _REPO_ROOT, "build", "librtpu_native.so"
+)
 _SRC_DIR = os.path.join(_REPO_ROOT, "src", "native")
 
 _lib = None
@@ -130,6 +134,17 @@ def get_lib():
         return None
     with _lib_lock:
         if _lib is not None or _load_failed:
+            return _lib
+        if os.environ.get("RAY_TPU_NATIVE_LIB"):
+            # Explicit override (e.g. a sanitizer build): load it verbatim —
+            # auto-rebuilding would silently replace it with a default-flags
+            # build.
+            try:
+                lib = ctypes.CDLL(_LIB_PATH)
+                _declare(lib)
+                _lib = lib
+            except (OSError, AttributeError):
+                _load_failed = True
             return _lib
         if _stale() and not _build() and not os.path.exists(_LIB_PATH):
             # Rebuild failed AND there is nothing to load.  (A stale .so

@@ -243,8 +243,11 @@ class NodeAgent:
         log_dir = os.environ.get("RAY_TPU_LOG_DIR", "/tmp/ray_tpu")
         os.makedirs(log_dir, exist_ok=True)
         out = open(os.path.join(log_dir, f"worker-{worker_id.hex()[:12]}.log"), "ab")
+        # A pip runtime env runs the worker under its venv's interpreter
+        # (reference: per-env virtualenv workers, _private/runtime_env/pip.py).
+        python = env.get("RAY_TPU_RT_VENV_PY") or sys.executable
         proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.core.worker_main"],
+            [python, "-m", "ray_tpu.core.worker_main"],
             env=env,
             stdout=out,
             stderr=subprocess.STDOUT,

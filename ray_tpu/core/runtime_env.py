@@ -18,8 +18,13 @@ Supported keys (the reference's most-used subset):
   - ``py_modules``: list of local dirs/files staged the same way and
     prepended to ``sys.path``.
 
-conda/pip/uv/container envs are intentionally out of scope (they imply
-package installation, which this image forbids); requesting them raises.
+``pip`` envs build a real virtualenv per requirements set, keyed by the
+hash of the requirement list, cached and reused across tasks/actors/jobs
+(the reference's most-used isolation mode after working_dir; ray
+``_private/runtime_env/pip.py``).  Workers for a pip env run under the
+venv's interpreter; ``--system-site-packages`` keeps the image's baked-in
+stack (jax et al.) visible, exactly like the reference's virtualenv
+inheritance.  conda/container envs stay out of scope.
 """
 
 from __future__ import annotations
@@ -28,14 +33,16 @@ import hashlib
 import json
 import os
 import shutil
+import subprocess
 import sys
 from typing import Any, Dict, List, Optional
 
 # Env vars used to ship the resolved env to the worker process.
 WORKING_DIR_ENV = "RAY_TPU_RT_WORKING_DIR"
 PY_MODULES_ENV = "RAY_TPU_RT_PY_MODULES"
+VENV_PY_ENV = "RAY_TPU_RT_VENV_PY"
 
-_UNSUPPORTED = ("conda", "pip", "uv", "container", "image_uri")
+_UNSUPPORTED = ("conda", "container", "image_uri")
 
 
 def _cache_root() -> str:
@@ -105,6 +112,87 @@ def package_path(path: str) -> str:
     return staged
 
 
+def _normalize_pip(spec) -> Dict[str, Any]:
+    """``pip`` accepts a list of requirements or
+    ``{"packages": [...], "pip_install_options": [...]}``."""
+    if isinstance(spec, (list, tuple)):
+        return {"packages": [str(p) for p in spec], "pip_install_options": []}
+    if isinstance(spec, dict):
+        return {
+            "packages": [str(p) for p in spec.get("packages", [])],
+            "pip_install_options": [
+                str(o) for o in spec.get("pip_install_options", [])
+            ],
+        }
+    raise TypeError("runtime_env['pip'] must be a list or a dict")
+
+
+def build_pip_env(spec) -> str:
+    """Build (or reuse) the virtualenv for a pip spec; returns the venv's
+    python path.  Keyed by the hash of (sorted packages, options); builds
+    are serialized per key with an flock so concurrent drivers/agents
+    never interleave writes into one venv."""
+    norm = _normalize_pip(spec)
+    if not norm["packages"]:
+        return sys.executable
+    digest = hashlib.sha1(
+        json.dumps(
+            [sorted(norm["packages"]), norm["pip_install_options"]]
+        ).encode()
+    ).hexdigest()[:16]
+    venv_dir = os.path.join(_cache_root(), "venvs", digest)
+    py = os.path.join(venv_dir, "bin", "python")
+    ready = os.path.join(venv_dir, ".ready")
+    if os.path.exists(ready):
+        return py
+    import fcntl
+
+    os.makedirs(os.path.dirname(venv_dir), exist_ok=True)
+    with open(venv_dir + ".lock", "w") as lk:
+        fcntl.flock(lk, fcntl.LOCK_EX)
+        if os.path.exists(ready):
+            return py
+        shutil.rmtree(venv_dir, ignore_errors=True)
+        # --system-site-packages: the image's baked-in stack stays visible;
+        # the venv only ADDS the requested packages (reference semantics).
+        subprocess.run(
+            [sys.executable, "-m", "venv", "--system-site-packages", venv_dir],
+            check=True, capture_output=True, timeout=300,
+        )
+        # When the driver itself runs inside a venv, --system-site-packages
+        # inherits the BASE interpreter's site, not the driver venv's —
+        # bridge the driver's actual site-packages with a .pth so the
+        # image's stack (jax, cloudpickle, ...) stays importable.
+        import site
+        import sysconfig
+
+        new_site = sysconfig.get_path(
+            "purelib", vars={"base": venv_dir, "platbase": venv_dir}
+        )
+        parent_paths = [
+            p for p in site.getsitepackages() if os.path.isdir(p)
+        ]
+        if parent_paths and os.path.isdir(new_site):
+            with open(os.path.join(new_site, "_rtpu_parent_site.pth"), "w") as f:
+                f.write("\n".join(parent_paths) + "\n")
+        cmd = (
+            [py, "-m", "pip", "install", "--disable-pip-version-check"]
+            + norm["pip_install_options"]
+            + norm["packages"]
+        )
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=1800
+        )
+        if proc.returncode != 0:
+            shutil.rmtree(venv_dir, ignore_errors=True)
+            raise RuntimeError(
+                f"pip runtime_env build failed: {proc.stderr[-2000:]}"
+            )
+        with open(ready, "w") as f:
+            f.write(digest)
+    return py
+
+
 def resolve_runtime_env(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, str]:
     """Driver side: normalize a runtime_env dict into worker env vars.
 
@@ -116,10 +204,12 @@ def resolve_runtime_env(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, str]
     for key in _UNSUPPORTED:
         if runtime_env.get(key):
             raise ValueError(
-                f"runtime_env[{key!r}] is not supported: package installation "
-                "is unavailable; pre-bake dependencies into the image"
+                f"runtime_env[{key!r}] is not supported: pre-bake these "
+                "dependencies into the image"
             )
-    unknown = set(runtime_env) - {"env_vars", "working_dir", "py_modules"}
+    unknown = set(runtime_env) - {
+        "env_vars", "working_dir", "py_modules", "pip", "uv"
+    }
     if unknown:
         raise ValueError(f"unknown runtime_env keys: {sorted(unknown)}")
     env: Dict[str, str] = dict(runtime_env.get("env_vars") or {})
@@ -134,6 +224,11 @@ def resolve_runtime_env(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, str]
         mods.append(package_path(m))
     if mods:
         env[PY_MODULES_ENV] = json.dumps(mods)
+    # "uv" shares the venv path (the reference's uv plugin mirrors pip's
+    # contract; the installer binary differs, which we don't ship).
+    pip_spec = runtime_env.get("pip") or runtime_env.get("uv")
+    if pip_spec:
+        env[VENV_PY_ENV] = build_pip_env(pip_spec)
     return env
 
 

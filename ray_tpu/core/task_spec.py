@@ -132,6 +132,20 @@ class ObjectRef:
 
     def __reduce__(self):
         # Deserializing side re-binds to its local core worker (borrow).
+        # If WE own the object, serialization means the ref is escaping to
+        # another process: take a grace-period escape hold so the object
+        # survives the window between our last local ref dying and the
+        # receiver's incref arriving (reference: borrower registration in
+        # reply metadata, reference_counter.cc).
+        w = self._worker
+        if w is not None:
+            if self.owner_address == w.address:
+                w.on_ref_escaped(self.id)
+            else:
+                # A borrower re-lending the ref: remember it so this
+                # process's eventual decref is grace-delayed (the
+                # sub-borrower's incref must reach the owner first).
+                w.on_ref_relent(self.id)
         return (_rehydrate_ref, (self.id, self.owner_address))
 
     # Allow `await ref` inside async actors / driver coroutines.

@@ -31,3 +31,5 @@ from .rl_module import (  # noqa: F401
     SACModule,
 )
 from .sac import SAC, SACConfig  # noqa: F401
+from .offline import OfflineData, record_transitions  # noqa: F401
+from .cql import CQL, CQLConfig  # noqa: F401

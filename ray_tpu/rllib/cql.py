@@ -1,0 +1,278 @@
+"""Conservative Q-Learning — offline RL on the SAC module.
+
+Reference: ray ``rllib/algorithms/cql/cql.py`` (+ ``cql_torch_learner``):
+SAC's actor/critic/alpha losses plus the CQL(H) conservative regularizer
+on both critics — logsumexp of Q over sampled (uniform + policy) actions
+minus Q on dataset actions — which pushes Q down on out-of-distribution
+actions so the learned policy stays inside the dataset's support.  Purely
+offline: no env runners; transitions stream from ``OfflineData``.
+
+Actions are stored NORMALIZED to the module's [-1, 1] tanh range; callers
+scale to env units at evaluation time (``ScaleActions``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from .algorithm import Algorithm, AlgorithmConfig
+from .offline import OfflineData
+from .rl_module import RLModuleSpec, SACModule
+from .sac import make_sac_update
+
+
+@dataclasses.dataclass
+class CQLHyperparams:
+    lr: float = 3e-4
+    gamma: float = 0.99
+    tau: float = 0.005
+    hidden: int = 64
+    batch_size: int = 256
+    learn_steps_per_iter: int = 200
+    init_alpha: float = 0.2
+    target_entropy: Optional[float] = None
+    # CQL(H) regularizer
+    cql_alpha: float = 1.0
+    cql_n_actions: int = 8
+    seed: int = 0
+
+
+class CQLConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.hp = CQLHyperparams()
+        self.offline_data = None
+        self.env_maker: Optional[Callable] = None  # evaluation only
+        self.rl_module_spec = RLModuleSpec(SACModule, {})
+
+    def training(self, **kwargs) -> "CQLConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self.hp, k):
+                raise ValueError(f"unknown CQL hyperparam {k!r}")
+            setattr(self.hp, k, v)
+        return self
+
+    def offline(self, data) -> "CQLConfig":
+        self.offline_data = data
+        return self
+
+    def environment(self, env_maker) -> "CQLConfig":
+        self.env_maker = env_maker
+        return self
+
+    def rl_module(self, spec: RLModuleSpec) -> "CQLConfig":
+        self.rl_module_spec = spec
+        return self
+
+
+class CQL(Algorithm):
+    def setup(self, config: CQLConfig) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        hp = self.hp = config.hp
+        if config.offline_data is None:
+            raise ValueError("CQL requires .offline(data)")
+        self.data = (
+            config.offline_data
+            if isinstance(config.offline_data, OfflineData)
+            else OfflineData(config.offline_data, seed=hp.seed)
+        )
+        self.env_maker = config.env_maker
+        probe_batch = self.data.sample(2)
+        obs_size = probe_batch["obs"].shape[1]
+        action_size = probe_batch["actions"].shape[1]
+        self.obs_size, self.action_size = obs_size, action_size
+
+        config.rl_module_spec.model_config.setdefault("hidden", hp.hidden)
+        self.module = module = config.rl_module_spec.build(
+            obs_size, action_size
+        )
+        key = jax.random.PRNGKey(hp.seed)
+        self.params = module.init_state(key)
+        self.target_params = jax.tree.map(lambda x: x, self.params)
+        self.log_alpha = jnp.asarray(np.log(hp.init_alpha), jnp.float32)
+        target_entropy = (
+            hp.target_entropy
+            if hp.target_entropy is not None
+            else -float(action_size)
+        )
+        self.tx = optax.adam(hp.lr)
+        self.opt_state = self.tx.init(self.params)
+        self.alpha_tx = optax.adam(hp.lr)
+        self.alpha_opt_state = self.alpha_tx.init(self.log_alpha)
+
+        gamma, tau = hp.gamma, hp.tau
+        cql_alpha, n_rand = hp.cql_alpha, hp.cql_n_actions
+
+        def cql_penalty(p, obs, data_q1, data_q2, key):
+            """logsumexp over {uniform, current-policy} actions minus the
+            dataset-action Q — per critic (the CQL(H) estimator)."""
+            b = obs.shape[0]
+            krand, kpi = jax.random.split(key)
+            rand_a = jax.random.uniform(
+                krand, (n_rand, b, action_size), minval=-1.0, maxval=1.0
+            )
+            pi_a, pi_logp = module.sample_action(p, obs, kpi)
+
+            def q_of(a):
+                return module.q_values(p, obs, a)
+
+            q1s, q2s = jax.vmap(q_of)(rand_a)  # [n_rand, B]
+            pq1, pq2 = module.q_values(p, obs, pi_a)
+            # Importance correction: uniform proposals have log-density
+            # -A*log(2); policy proposals use their own logp.
+            log_u = -action_size * jnp.log(2.0)
+            cat1 = jnp.concatenate(
+                [q1s - log_u, (pq1 - pi_logp)[None]], axis=0
+            )
+            cat2 = jnp.concatenate(
+                [q2s - log_u, (pq2 - pi_logp)[None]], axis=0
+            )
+            ls1 = jax.scipy.special.logsumexp(cat1, axis=0)
+            ls2 = jax.scipy.special.logsumexp(cat2, axis=0)
+            return (ls1 - data_q1).mean() + (ls2 - data_q2).mean()
+
+        def conservative_extra(p, batch, q1_data, q2_data, key):
+            return cql_alpha * cql_penalty(p, batch["obs"], q1_data,
+                                           q2_data, key)
+
+        update = make_sac_update(
+            module, self.tx, self.alpha_tx, gamma, tau, target_entropy,
+            extra_critic_loss=conservative_extra,
+        )
+
+        # Many updates per jit call: stack K sampled batches and lax.scan
+        # the update over them — the dominant cost at this model size is
+        # per-call dispatch, not FLOPs.
+        def update_many(params, target_params, log_alpha, opt_state,
+                       alpha_opt_state, batches, base_key):
+            def body(carry, xs):
+                batch, key = xs
+                out = update(*carry, batch, key)
+                return out[:-1], out[-1]
+
+            n = batches["rewards"].shape[0]
+            keys = jax.random.split(base_key, n)
+            (params, target_params, log_alpha, opt_state,
+             alpha_opt_state), stats = jax.lax.scan(
+                body,
+                (params, target_params, log_alpha, opt_state,
+                 alpha_opt_state),
+                (batches, keys),
+            )
+            last = jax.tree.map(lambda s: s[-1], stats)
+            return (params, target_params, log_alpha, opt_state,
+                    alpha_opt_state, last)
+
+        self._update_many = jax.jit(update_many)
+        self._steps = 0
+
+    _SCAN_CHUNK = 50
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        hp = self.hp
+        stats = {}
+        remaining = hp.learn_steps_per_iter
+        while remaining > 0:
+            k = min(self._SCAN_CHUNK, remaining)
+            remaining -= k
+            sampled = [self.data.sample(hp.batch_size) for _ in range(k)]
+            batches = {
+                "obs": jnp.asarray(
+                    np.stack([b["obs"] for b in sampled]), jnp.float32
+                ),
+                "actions": jnp.asarray(
+                    np.stack([b["actions"] for b in sampled]), jnp.float32
+                ),
+                "rewards": jnp.asarray(
+                    np.stack([b["rewards"] for b in sampled]), jnp.float32
+                ),
+                "next_obs": jnp.asarray(
+                    np.stack([b["next_obs"] for b in sampled]), jnp.float32
+                ),
+                "dones": jnp.asarray(np.stack([b["dones"] for b in sampled])),
+            }
+            self._steps += k
+            key = jax.random.fold_in(
+                jax.random.PRNGKey(hp.seed), self._steps
+            )
+            (self.params, self.target_params, self.log_alpha,
+             self.opt_state, self.alpha_opt_state, stats) = self._update_many(
+                self.params, self.target_params, self.log_alpha,
+                self.opt_state, self.alpha_opt_state, batches, key,
+            )
+        out = {k: float(v) for k, v in stats.items()}
+        if "extra_critic_loss" in out:
+            out["cql_penalty"] = out.pop("extra_critic_loss")
+        out["learn_steps_total"] = self._steps
+        return out
+
+    # ------------------------------------------------------------ evaluation
+    def evaluate(self, episodes: int = 5, seed: int = 100) -> Dict[str, Any]:
+        """Greedy rollout of the learned policy in the (eval-only) env."""
+        if self.env_maker is None:
+            raise ValueError("evaluate() requires .environment(env_maker)")
+        import jax.numpy as jnp
+
+        returns = []
+        for ep in range(episodes):
+            env = self.env_maker(seed=seed + ep) if _takes_seed(
+                self.env_maker
+            ) else self.env_maker()
+            lo = getattr(env, "action_low", -1.0)
+            hi = getattr(env, "action_high", 1.0)
+            obs = env.reset()
+            total, done = 0.0, False
+            while not done:
+                out = self.module.forward_inference(
+                    self.params, {"obs": jnp.asarray(obs, jnp.float32)[None]}
+                )
+                a = np.asarray(out["actions"])[0]
+                env_a = lo + (a + 1.0) * 0.5 * (hi - lo)
+                obs, r, done, _ = env.step(env_a)
+                total += r
+            returns.append(total)
+        return {
+            "episode_return_mean": float(np.mean(returns)),
+            "episodes": episodes,
+        }
+
+    def get_state(self) -> Dict[str, Any]:
+        import jax
+
+        return {
+            "params": jax.tree.map(np.asarray, self.params),
+            "target_params": jax.tree.map(np.asarray, self.target_params),
+            "log_alpha": np.asarray(self.log_alpha),
+            "steps": self._steps,
+        }
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.params = state["params"]
+        self.target_params = state["target_params"]
+        import jax.numpy as jnp
+
+        self.log_alpha = jnp.asarray(state["log_alpha"])
+        self.opt_state = self.tx.init(self.params)
+        self.alpha_opt_state = self.alpha_tx.init(self.log_alpha)
+        self._steps = state.get("steps", 0)
+
+
+def _takes_seed(env_maker) -> bool:
+    import inspect
+
+    try:
+        return "seed" in inspect.signature(env_maker).parameters
+    except (TypeError, ValueError):
+        return False
+
+
+CQLConfig.ALGO_CLS = CQL

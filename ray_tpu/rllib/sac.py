@@ -137,6 +137,96 @@ class _SACRunner:
         )
 
 
+def make_sac_update(module, tx, alpha_tx, gamma, tau, target_entropy,
+                    extra_critic_loss=None):
+    """Build the jittable SAC update step shared by SAC and its offline
+    extensions (reference: ray CQL extends SAC's learner for exactly this
+    reason).  ``extra_critic_loss(params, batch, q1_data, q2_data, key)``
+    adds a regularizer to the Bellman loss (CQL's conservative penalty);
+    its gradient flows into the critic nets only, like the Bellman term.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax as _optax
+
+    def update(params, target_params, log_alpha, opt_state,
+               alpha_opt_state, batch, key):
+        alpha = jnp.exp(log_alpha)
+        k1, k2, k3 = jax.random.split(key, 3)
+
+        # Critic target: r + gamma * (min target-Q(s', a') - alpha logp')
+        next_a, next_logp = module.sample_action(
+            target_params, batch["next_obs"], k1
+        )
+        tq1, tq2 = module.q_values(
+            target_params, batch["next_obs"], next_a
+        )
+        target_v = jnp.minimum(tq1, tq2) - alpha * next_logp
+        nonterminal = 1.0 - batch["dones"].astype(jnp.float32)
+        target_q = batch["rewards"] + gamma * nonterminal * target_v
+        target_q = jax.lax.stop_gradient(target_q)
+
+        def critic_loss(p):
+            q1, q2 = module.q_values(p, batch["obs"], batch["actions"])
+            bellman = ((q1 - target_q) ** 2 + (q2 - target_q) ** 2).mean()
+            extra = (
+                extra_critic_loss(p, batch, q1, q2, k3)
+                if extra_critic_loss is not None
+                else jnp.float32(0.0)
+            )
+            return bellman + extra, (bellman, extra)
+
+        def actor_loss(p):
+            a, logp = module.sample_action(p, batch["obs"], k2)
+            q1, q2 = module.q_values(p, batch["obs"], a)
+            # Critic params are held fixed for the actor step via the
+            # combined-gradient trick below (single optimizer).
+            return (alpha * logp - jnp.minimum(q1, q2)).mean(), logp
+
+        (closs, (bellman, extra)), cgrads = jax.value_and_grad(
+            critic_loss, has_aux=True
+        )(params)
+        (aloss, logp), agrads = jax.value_and_grad(
+            actor_loss, has_aux=True
+        )(params)
+        # Actor gradients must not update the critics (and vice versa):
+        # zero the cross terms.
+        grads = {
+            "pi": agrads["pi"],
+            "q1": cgrads["q1"],
+            "q2": cgrads["q2"],
+        }
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = _optax.apply_updates(params, updates)
+
+        def alpha_loss(la):
+            return (
+                -jnp.exp(la)
+                * jax.lax.stop_gradient(logp + target_entropy)
+            ).mean()
+
+        _al, agrad = jax.value_and_grad(alpha_loss)(log_alpha)
+        aupd, alpha_opt_state = alpha_tx.update(
+            agrad, alpha_opt_state, log_alpha
+        )
+        log_alpha = _optax.apply_updates(log_alpha, aupd)
+
+        target_params = jax.tree.map(
+            lambda t, p: (1 - tau) * t + tau * p, target_params, params
+        )
+        stats = {
+            "critic_loss": closs,
+            "bellman_loss": bellman,
+            "extra_critic_loss": extra,
+            "actor_loss": aloss,
+            "alpha": jnp.exp(log_alpha),
+        }
+        return (params, target_params, log_alpha, opt_state,
+                alpha_opt_state, stats)
+
+    return update
+
+
 class SAC(Algorithm):
     def setup(self, config: SACConfig):
         import jax
@@ -178,74 +268,11 @@ class SAC(Algorithm):
         module = self.module
         gamma, tau = hp.gamma, hp.tau
 
-        def update(params, target_params, log_alpha, opt_state,
-                   alpha_opt_state, batch, key):
-            alpha = jnp.exp(log_alpha)
-            k1, k2 = jax.random.split(key)
-
-            # Critic target: r + gamma * (min target-Q(s', a') - alpha logp')
-            next_a, next_logp = module.sample_action(
-                target_params, batch["next_obs"], k1
+        self._update = jax.jit(
+            make_sac_update(
+                module, self.tx, self.alpha_tx, gamma, tau, target_entropy
             )
-            tq1, tq2 = module.q_values(
-                target_params, batch["next_obs"], next_a
-            )
-            target_v = jnp.minimum(tq1, tq2) - alpha * next_logp
-            nonterminal = 1.0 - batch["dones"].astype(jnp.float32)
-            target_q = batch["rewards"] + gamma * nonterminal * target_v
-            target_q = jax.lax.stop_gradient(target_q)
-
-            def critic_loss(p):
-                q1, q2 = module.q_values(p, batch["obs"], batch["actions"])
-                return ((q1 - target_q) ** 2 + (q2 - target_q) ** 2).mean()
-
-            def actor_loss(p):
-                a, logp = module.sample_action(p, batch["obs"], k2)
-                q1, q2 = module.q_values(p, batch["obs"], a)
-                # Critic params are held fixed for the actor step via the
-                # combined-gradient trick below (single optimizer).
-                return (alpha * logp - jnp.minimum(q1, q2)).mean(), logp
-
-            closs, cgrads = jax.value_and_grad(critic_loss)(params)
-            (aloss, logp), agrads = jax.value_and_grad(
-                actor_loss, has_aux=True
-            )(params)
-            # Actor gradients must not update the critics (and vice versa):
-            # zero the cross terms.
-            grads = {
-                "pi": agrads["pi"],
-                "q1": cgrads["q1"],
-                "q2": cgrads["q2"],
-            }
-            updates, opt_state = self.tx.update(grads, opt_state, params)
-            import optax as _optax
-
-            params = _optax.apply_updates(params, updates)
-
-            def alpha_loss(la):
-                return (
-                    -jnp.exp(la)
-                    * jax.lax.stop_gradient(logp + target_entropy)
-                ).mean()
-
-            al, agrad = jax.value_and_grad(alpha_loss)(log_alpha)
-            aupd, alpha_opt_state = self.alpha_tx.update(
-                agrad, alpha_opt_state, log_alpha
-            )
-            log_alpha = _optax.apply_updates(log_alpha, aupd)
-
-            target_params = jax.tree.map(
-                lambda t, p: (1 - tau) * t + tau * p, target_params, params
-            )
-            stats = {
-                "critic_loss": closs,
-                "actor_loss": aloss,
-                "alpha": jnp.exp(log_alpha),
-            }
-            return (params, target_params, log_alpha, opt_state,
-                    alpha_opt_state, stats)
-
-        self._update = jax.jit(update)
+        )
         env_payload = dumps_function(env_maker)
         self.runners = [
             _SACRunner.remote(

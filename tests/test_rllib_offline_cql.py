@@ -1,0 +1,148 @@
+"""Offline RL pipeline + CQL (reference ``rllib/offline/`` +
+``rllib/algorithms/cql/``): dataset-backed sample reading feeds the
+learner; CQL learns Pendulum from a logged behavior dataset, evaluated
+against the random-policy baseline.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import (
+    CQL,
+    CQLConfig,
+    OfflineData,
+    Pendulum,
+    record_transitions,
+)
+
+
+def _behavior_policy(obs, rng):
+    """Noisy energy-shaping swing-up + PD catch, NORMALIZED to [-1, 1]
+    (the module's tanh range).  Medium-quality on purpose (~-550 mean
+    return vs ~-215 noise-free, ~-1270 random): 30% uniform exploration
+    gives the dataset off-policy action coverage."""
+    cos_th, sin_th, thdot = float(obs[0]), float(obs[1]), float(obs[2])
+    if rng.random() < 0.3:
+        return np.array([rng.uniform(-1.0, 1.0)], np.float32)
+    energy = thdot ** 2 / 6.0 + 5.0 * cos_th  # E_top = 5 at rest upright
+    if cos_th > 0.85 and abs(thdot) < 4.0:
+        u = -(5.0 * sin_th + 1.0 * thdot)  # stabilize near the top
+    else:  # pump energy with the swing direction
+        u = (
+            2.0 * np.sign(thdot) * np.sign(5.0 - energy)
+            if abs(thdot) > 1e-3
+            else 2.0
+        )
+    return np.array([np.clip(u, -2.0, 2.0) / 2.0], np.float32)
+
+
+def _rollout_return(policy, episodes=4, seed=500):
+    returns = []
+    for ep in range(episodes):
+        env = Pendulum(seed=seed + ep)
+        rng = np.random.default_rng(seed + ep)
+        obs = env.reset()
+        total, done = 0.0, False
+        while not done:
+            a = policy(obs, rng)
+            obs, r, done, _ = env.step(np.asarray(a) * 2.0)  # scale to env
+            total += r
+        returns.append(total)
+    return float(np.mean(returns))
+
+
+@pytest.fixture
+def offline_dataset(ray_start_regular):
+    return record_transitions(
+        Pendulum, _behavior_policy, n_steps=8_000, seed=3
+    )
+
+
+class TestOfflineData:
+    def test_sample_from_dataset_stream(self, offline_dataset):
+        data = OfflineData(offline_dataset, seed=0)
+        batch = data.sample(128)
+        assert set(batch) == {"obs", "actions", "rewards", "next_obs", "dones"}
+        assert batch["obs"].shape == (128, 3)
+        assert batch["actions"].shape == (128, 1)
+        assert np.abs(batch["actions"]).max() <= 1.0
+        # Repeated samples differ (shuffled reads, not a fixed window).
+        b2 = data.sample(128)
+        assert not np.array_equal(batch["obs"], b2["obs"])
+
+    def test_sample_from_dict(self):
+        data = OfflineData(
+            {
+                "obs": np.zeros((50, 3), np.float32),
+                "actions": np.zeros((50, 1), np.float32),
+                "rewards": np.zeros(50, np.float32),
+                "next_obs": np.zeros((50, 3), np.float32),
+                "dones": np.zeros(50, bool),
+            }
+        )
+        assert data.sample(16)["obs"].shape == (16, 3)
+        assert data.num_rows() == 50
+
+    def test_parquet_roundtrip(self, ray_start_regular, offline_dataset,
+                               tmp_path):
+        path = str(tmp_path / "transitions")
+        offline_dataset.write_parquet(path)
+        data = OfflineData(path, seed=1)
+        batch = data.sample(64)
+        assert batch["obs"].shape == (64, 3)
+
+
+class TestCQL:
+    def test_cql_learns_pendulum_from_offline_data(
+        self, ray_start_regular, offline_dataset
+    ):
+        algo = (
+            CQLConfig()
+            .offline(offline_dataset)
+            .environment(Pendulum)
+            .training(
+                batch_size=256, learn_steps_per_iter=500, hidden=64,
+                cql_alpha=0.5, cql_n_actions=8, seed=0,
+            )
+            .build()
+        )
+        random_baseline = _rollout_return(
+            lambda obs, rng: rng.uniform(-1.0, 1.0, size=1)
+        )
+        best = -np.inf
+        stats = {}
+        for _ in range(6):
+            stats = algo.training_step()
+            best = max(
+                best, algo.evaluate(episodes=2)["episode_return_mean"]
+            )
+        assert np.isfinite(stats["critic_loss"])
+        assert np.isfinite(stats["cql_penalty"])
+        # Pendulum returns are negative; the offline-learned policy must
+        # clearly beat random (measured: random ~ -1270, best CQL eval
+        # ~ -700..-1000 within 3k updates on this medium dataset; full
+        # convergence ~ -250 takes ~10k updates, beyond test budget).
+        assert best > random_baseline + 250, (best, random_baseline)
+
+    def test_cql_state_roundtrip(self, ray_start_regular, offline_dataset):
+        algo = (
+            CQLConfig()
+            .offline(offline_dataset)
+            .environment(Pendulum)
+            .training(learn_steps_per_iter=5, batch_size=64, hidden=16)
+            .build()
+        )
+        algo.training_step()
+        state = algo.get_state()
+        algo2 = (
+            CQLConfig()
+            .offline(offline_dataset)
+            .environment(Pendulum)
+            .training(learn_steps_per_iter=5, batch_size=64, hidden=16)
+            .build()
+        )
+        algo2.set_state(state)
+        r1 = algo.evaluate(episodes=2)["episode_return_mean"]
+        r2 = algo2.evaluate(episodes=2)["episode_return_mean"]
+        assert r1 == pytest.approx(r2)

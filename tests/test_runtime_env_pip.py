@@ -1,0 +1,128 @@
+"""pip runtime-env isolation: per-requirements virtualenv workers.
+
+Reference: ray ``python/ray/_private/runtime_env/pip.py`` — a cached
+virtualenv per requirements hash; tasks/actors with that env run under the
+venv's interpreter.  Zero-egress box: the test builds a local wheel and
+installs it with ``--no-index --find-links`` (the implementation is plain
+``pip install`` and takes any requirement form).
+"""
+
+import os
+import subprocess
+import sys
+import zipfile
+
+import pytest
+
+import ray_tpu
+
+WHEEL_PKG = "rtpu_testpkg"
+WHEEL_VERSION = "1.2.3"
+
+
+@pytest.fixture(scope="module")
+def local_wheel(tmp_path_factory):
+    """Hand-roll a minimal wheel (no build backend needed)."""
+    d = tmp_path_factory.mktemp("wheel")
+    name = f"{WHEEL_PKG}-{WHEEL_VERSION}-py3-none-any.whl"
+    path = str(d / name)
+    dist_info = f"{WHEEL_PKG}-{WHEEL_VERSION}.dist-info"
+    with zipfile.ZipFile(path, "w") as z:
+        z.writestr(
+            f"{WHEEL_PKG}/__init__.py",
+            f"MAGIC = 'installed-{WHEEL_VERSION}'\n",
+        )
+        z.writestr(
+            f"{dist_info}/METADATA",
+            f"Metadata-Version: 2.1\nName: {WHEEL_PKG}\n"
+            f"Version: {WHEEL_VERSION}\n",
+        )
+        z.writestr(
+            f"{dist_info}/WHEEL",
+            "Wheel-Version: 1.0\nGenerator: test\nRoot-Is-Purelib: true\n"
+            "Tag: py3-none-any\n",
+        )
+        z.writestr(
+            f"{dist_info}/RECORD",
+            f"{WHEEL_PKG}/__init__.py,,\n{dist_info}/METADATA,,\n"
+            f"{dist_info}/WHEEL,,\n{dist_info}/RECORD,,\n",
+        )
+    return str(d), path
+
+
+def _pip_env(wheel_dir):
+    return {
+        "pip": {
+            "packages": [WHEEL_PKG],
+            "pip_install_options": [
+                "--no-index", "--find-links", wheel_dir,
+            ],
+        }
+    }
+
+
+class TestPipRuntimeEnv:
+    def test_wheel_visible_only_inside_env(
+        self, ray_start_regular, local_wheel
+    ):
+        wheel_dir, _ = local_wheel
+
+        def probe():
+            try:
+                import rtpu_testpkg
+
+                return rtpu_testpkg.MAGIC
+            except ImportError:
+                return "absent"
+
+        import_probe = ray_tpu.remote(probe)
+
+        # Outside the env: the package must NOT exist.
+        assert (
+            ray_tpu.get(import_probe.remote(), timeout=120) == "absent"
+        )
+        # Inside the pip env: installed and importable.
+        got = ray_tpu.get(
+            import_probe.options(
+                runtime_env=_pip_env(wheel_dir)
+            ).remote(),
+            timeout=300,
+        )
+        assert got == f"installed-{WHEEL_VERSION}"
+        # And the driver process itself is untouched.
+        with pytest.raises(ImportError):
+            import rtpu_testpkg  # noqa: F401
+
+    def test_venv_cached_across_tasks(self, ray_start_regular, local_wheel):
+        wheel_dir, _ = local_wheel
+        from ray_tpu.core.runtime_env import build_pip_env
+
+        spec = _pip_env(wheel_dir)["pip"]
+        py1 = build_pip_env(spec)
+        py2 = build_pip_env(spec)
+        assert py1 == py2 and os.path.exists(py1)
+        # The cached venv's interpreter can import both the wheel and the
+        # system stack (system-site-packages inheritance).
+        out = subprocess.run(
+            [py1, "-c",
+             "import rtpu_testpkg, numpy; print(rtpu_testpkg.MAGIC)"],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert out.returncode == 0, out.stderr
+        assert f"installed-{WHEEL_VERSION}" in out.stdout
+
+    def test_actor_in_pip_env(self, ray_start_regular, local_wheel):
+        wheel_dir, _ = local_wheel
+
+        class EnvProbe:
+            def which(self):
+                import rtpu_testpkg
+
+                return sys.executable, rtpu_testpkg.MAGIC
+
+        Probe = ray_tpu.remote(EnvProbe)
+        a = Probe.options(runtime_env=_pip_env(wheel_dir)).remote()
+        exe, magic = ray_tpu.get(a.which.remote(), timeout=300)
+        assert magic == f"installed-{WHEEL_VERSION}"
+        assert "venvs" in exe  # actually running under the cached venv
+        ray_tpu.kill(a)
