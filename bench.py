@@ -36,6 +36,12 @@ BASELINES = {  # reference release/perf_metrics/microbenchmark.json
     "single_client_put_calls": 4116.0,
     "single_client_put_gigabytes": 18.18,
     "placement_group_create_removal": 679.0,
+    # Scalability-envelope analogs (reference release/benchmarks/ — their
+    # numbers come from multi-node fleets; ours run on this box).
+    "multi_client_tasks_async": 20114.0,
+    "many_actors_launch_per_s": 404.0,
+    "many_tasks_per_s": 583.0,
+    "many_pgs_per_s": 18.9,
 }
 
 
@@ -185,7 +191,10 @@ def run_control_plane_suite():
 
     import ray_tpu
 
-    ray_tpu.init(num_cpus=4)
+    # Long worker-startup deadline: the scale stages spawn a dozen worker
+    # processes at once and their interpreter startups serialize on this
+    # box's core.
+    ray_tpu.init(num_cpus=4, _system_config={"worker_startup_timeout_s": 240.0})
     try:
         @ray_tpu.remote
         def f():
@@ -322,6 +331,92 @@ def run_control_plane_suite():
         emit(
             "placement_group_create_removal", n / (time.perf_counter() - t0),
             "ops/s", BASELINES["placement_group_create_removal"],
+        )
+        # multi-client: two extra driver processes submit concurrently
+        # (reference multi_client_tasks_async; harness ray_perf.py).
+        import subprocess
+
+        client_code = (
+            "import sys, time\n"
+            "import ray_tpu\n"
+            "ray_tpu.init(address=sys.argv[1], num_cpus=0)\n"
+            "@ray_tpu.remote\n"
+            "def f(): return b'ok'\n"
+            "ray_tpu.get([f.remote() for _ in range(20)], timeout=120)\n"
+            "n = 500\n"
+            "t0 = time.perf_counter()\n"
+            "ray_tpu.get([f.remote() for _ in range(n)], timeout=300)\n"
+            "print('RATE', n / (time.perf_counter() - t0))\n"
+            "ray_tpu.shutdown()\n"
+        )
+        cp_addr = ray_tpu.api._local_node.cp_address
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", client_code, cp_addr],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            )
+            for _ in range(2)
+        ]
+        rates = []
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            for line in out.splitlines():
+                if line.startswith("RATE"):
+                    rates.append(float(line.split()[1]))
+        if len(rates) == 2:
+            emit(
+                "multi_client_tasks_async", sum(rates),
+                "tasks/s", BASELINES["multi_client_tasks_async"],
+            )
+
+        # scalability-envelope analogs (reference release/benchmarks/
+        # many_actors / many_tasks / many_pgs, single-node wide get)
+        @ray_tpu.remote(num_cpus=0.01)
+        class Tiny:
+            def ping(self):
+                return b"ok"
+
+        # Each actor is a worker process; startup (python + imports)
+        # serializes on the box's cores, so keep the gang sized to finish
+        # well inside the actor-creation deadline.
+        t0 = time.perf_counter()
+        n = 12
+        tiny = [Tiny.remote() for _ in range(n)]
+        ray_tpu.get([a.ping.remote() for a in tiny], timeout=600)
+        emit(
+            "many_actors_launch_per_s", n / (time.perf_counter() - t0),
+            "actors/s", BASELINES["many_actors_launch_per_s"],
+        )
+        for a in tiny:
+            ray_tpu.kill(a)
+
+        t0 = time.perf_counter()
+        n = 2000
+        ray_tpu.get([f.remote() for _ in range(n)], timeout=600)
+        emit(
+            "many_tasks_per_s", n / (time.perf_counter() - t0),
+            "tasks/s", BASELINES["many_tasks_per_s"],
+        )
+
+        t0 = time.perf_counter()
+        n = 60
+        pgs = [placement_group([{"CPU": 0.01}]) for _ in range(n)]
+        for pg in pgs:
+            assert pg.ready(timeout=120)
+        emit(
+            "many_pgs_per_s", n / (time.perf_counter() - t0),
+            "pgs/s", BASELINES["many_pgs_per_s"],
+        )
+        for pg in pgs:
+            remove_placement_group(pg)
+
+        # single-node limits probe: one wide get over thousands of refs
+        refs = [ray_tpu.put(b"x") for _ in range(3000)]
+        t0 = time.perf_counter()
+        out = ray_tpu.get(refs, timeout=300)
+        assert len(out) == 3000
+        emit(
+            "wide_get_3000_refs_s", time.perf_counter() - t0, "s",
         )
     finally:
         ray_tpu.shutdown()

@@ -1,0 +1,138 @@
+"""cgroup-v2 resource isolation for worker processes.
+
+Reference: ray ``src/ray/common/cgroup2/`` (+ ``enable_resource_isolation``
+in ``ray.init``, ``_private/worker.py:1427``): system processes and
+application workers are placed in separate cgroup subtrees so a runaway
+worker cannot starve the control plane.  Redesign: a small driver ABC with
+a real cgroup2 filesystem driver and a fake driver for tests (the
+reference ships ``fake_cgroup_driver.h`` for the same reason — cgroup
+writes need root + a v2 mount, which CI may not have).
+
+Enabled by the ``enable_resource_isolation`` knob; the node agent then
+creates ``<root>/ray_tpu_<session>/workers`` with memory/cpu limits and
+attaches every spawned worker pid.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+CGROUP_ROOT = "/sys/fs/cgroup"
+
+
+class CgroupDriver:
+    """Interface: create a subgroup, apply limits, attach pids."""
+
+    def available(self) -> bool:
+        raise NotImplementedError
+
+    def create_group(self, name: str, limits: Dict[str, str]) -> str:
+        raise NotImplementedError
+
+    def attach(self, group: str, pid: int) -> None:
+        raise NotImplementedError
+
+    def remove_group(self, group: str) -> None:
+        raise NotImplementedError
+
+
+class Cgroup2Driver(CgroupDriver):
+    """Real driver over the unified cgroup-v2 hierarchy."""
+
+    def __init__(self, root: str = CGROUP_ROOT):
+        self.root = root
+
+    def available(self) -> bool:
+        ctrl = os.path.join(self.root, "cgroup.controllers")
+        return os.path.exists(ctrl) and os.access(self.root, os.W_OK)
+
+    def create_group(self, name: str, limits: Dict[str, str]) -> str:
+        path = os.path.join(self.root, name)
+        os.makedirs(path, exist_ok=True)
+        for knob, value in limits.items():
+            try:
+                with open(os.path.join(path, knob), "w") as f:
+                    f.write(value)
+            except OSError as e:
+                logger.warning("cgroup limit %s=%s failed: %s", knob, value, e)
+        return path
+
+    def attach(self, group: str, pid: int) -> None:
+        try:
+            with open(os.path.join(group, "cgroup.procs"), "w") as f:
+                f.write(str(pid))
+        except OSError as e:
+            logger.warning("cgroup attach pid %d failed: %s", pid, e)
+
+    def remove_group(self, group: str) -> None:
+        try:
+            os.rmdir(group)
+        except OSError:
+            pass
+
+
+class FakeCgroupDriver(CgroupDriver):
+    """Records operations instead of touching the filesystem (the
+    reference's fake_cgroup_driver.h analog)."""
+
+    def __init__(self):
+        self.groups: Dict[str, Dict[str, str]] = {}
+        self.attached: Dict[str, List[int]] = {}
+        self.removed: List[str] = []
+
+    def available(self) -> bool:
+        return True
+
+    def create_group(self, name: str, limits: Dict[str, str]) -> str:
+        self.groups[name] = dict(limits)
+        self.attached.setdefault(name, [])
+        return name
+
+    def attach(self, group: str, pid: int) -> None:
+        self.attached.setdefault(group, []).append(pid)
+
+    def remove_group(self, group: str) -> None:
+        self.removed.append(group)
+
+
+class WorkerIsolation:
+    """The node agent's view: one workers subgroup per session, every
+    spawned worker attached; no-op when isolation is disabled or the
+    driver reports unavailable."""
+
+    def __init__(self, session_id: str, driver: Optional[CgroupDriver] = None,
+                 memory_limit_bytes: Optional[int] = None,
+                 cpu_weight: int = 100):
+        from .config import GlobalConfig
+
+        self.enabled = bool(GlobalConfig.enable_resource_isolation)
+        self.driver = driver or Cgroup2Driver()
+        self.group: Optional[str] = None
+        if not self.enabled:
+            return
+        if not self.driver.available():
+            logger.warning(
+                "resource isolation requested but cgroup2 is unavailable "
+                "(missing mount or permissions); continuing without it"
+            )
+            self.enabled = False
+            return
+        limits: Dict[str, str] = {"cpu.weight": str(cpu_weight)}
+        if memory_limit_bytes:
+            limits["memory.max"] = str(memory_limit_bytes)
+        self.group = self.driver.create_group(
+            f"ray_tpu_{session_id}_workers", limits
+        )
+
+    def attach_worker(self, pid: int) -> None:
+        if self.enabled and self.group is not None:
+            self.driver.attach(self.group, pid)
+
+    def cleanup(self) -> None:
+        if self.enabled and self.group is not None:
+            self.driver.remove_group(self.group)
+            self.group = None

@@ -74,6 +74,15 @@ _KNOBS: Dict[str, tuple] = {
     # -- fault tolerance --
     "task_max_retries_default": (int, 3, "Default retries for idempotent tasks"),
     "actor_max_restarts_default": (int, 0, "Default actor restarts"),
+    # -- isolation --
+    "enable_resource_isolation": (
+        bool, False,
+        "Place workers in a cgroup-v2 subtree with cpu/memory limits "
+        "(needs a writable /sys/fs/cgroup; silently disabled otherwise)",
+    ),
+    "worker_cgroup_memory_limit_bytes": (
+        int, 0, "0 = no memory.max on the workers cgroup"
+    ),
     # -- TPU --
     "tpu_visible_chips_env": (str, "TPU_VISIBLE_CHIPS", "Env var used for chip isolation"),
     # -- data --

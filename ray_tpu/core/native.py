@@ -86,6 +86,8 @@ def _declare(lib):
         "rtpu_arena_used": (u64, [p]),
         "rtpu_arena_live": (u64, [p]),
         "rtpu_memcpy_nt": (None, [p, p, u64]),
+        "rtpu_arena_lock": (None, [p]),
+        "rtpu_arena_unlock": (None, [p]),
         "rtpu_alloc": (u64, [p, cp, u64]),
         "rtpu_seal": (ctypes.c_int, [p, cp]),
         "rtpu_lookup": (ctypes.c_int, [p, cp, ctypes.POINTER(u64), ctypes.POINTER(u64)]),

@@ -219,7 +219,11 @@ class Node:
         from .object_store import drop_arena
 
         drop_arena(self.session_id)
-        shm.cleanup_session(self.session_id)
+        if self.head:
+            # Session-wide shm (arena + segments) belongs to the HEAD's
+            # lifetime: a worker/client node leaving must not delete the
+            # store out from under every other node in the session.
+            shm.cleanup_session(self.session_id)
 
 
 class Cluster:

@@ -98,6 +98,16 @@ class NodeAgent:
         self.shm_store = ShmObjectStore(session_id)
         self.workers: Dict[WorkerID, WorkerHandle] = {}
         self.idle_pool: Dict[tuple, List[WorkerHandle]] = {}
+        # cgroup-v2 isolation of application workers (no-op unless
+        # enable_resource_isolation and a writable cgroup mount).
+        from .cgroup import WorkerIsolation
+
+        self.isolation = WorkerIsolation(
+            session_id,
+            memory_limit_bytes=(
+                GlobalConfig.worker_cgroup_memory_limit_bytes or None
+            ),
+        )
         self.leases: Dict[int, Lease] = {}
         self._next_lease_id = 1
         self.bundles: Dict[Tuple[PlacementGroupID, int], BundlePool] = {}
@@ -184,7 +194,7 @@ class NodeAgent:
         # report consumed by GcsAutoscalerStateManager).
         pending = [
             dict(payload.get("resources") or {})
-            for payload, fut in self._lease_queue
+            for payload, fut, _conn in self._lease_queue
             if not fut.done()
         ]
         busy = bool(pending) or (
@@ -254,6 +264,7 @@ class NodeAgent:
             start_new_session=True,
         )
         handle = WorkerHandle(worker_id, proc, env_key)
+        self.isolation.attach_worker(proc.pid)
         self.workers[worker_id] = handle
         return handle
 
@@ -356,21 +367,21 @@ class NodeAgent:
     async def handle_request_lease(self, payload, conn):
         """Grant a worker lease, queue it, or reply with a spillback target."""
         fut = asyncio.get_running_loop().create_future()
-        self._lease_queue.append((payload, fut))
+        self._lease_queue.append((payload, fut, conn))
         self._drain_lease_queue()
         return await fut
 
     def _drain_lease_queue(self):
         still_waiting = []
-        for payload, fut in self._lease_queue:
+        for payload, fut, conn in self._lease_queue:
             if fut.done():
                 continue
-            granted = self._try_grant(payload, fut)
+            granted = self._try_grant(payload, fut, conn)
             if not granted:
-                still_waiting.append((payload, fut))
+                still_waiting.append((payload, fut, conn))
         self._lease_queue = still_waiting
 
-    def _try_grant(self, payload, fut) -> bool:
+    def _try_grant(self, payload, fut, conn=None) -> bool:
         resources = ResourceSet(payload.get("resources") or {})
         pg_id = payload.get("placement_group_id")
         bundle_index = payload.get("bundle_index", -1)
@@ -405,7 +416,9 @@ class NodeAgent:
                 self.resources.release(resources)
             return False
         asyncio.get_running_loop().create_task(
-            self._finish_grant(payload, fut, resources, instances, pg_id, bundle_index)
+            self._finish_grant(
+                payload, fut, resources, instances, pg_id, bundle_index, conn
+            )
         )
         return True
 
@@ -449,7 +462,8 @@ class NodeAgent:
                 env_extra.setdefault("JAX_PLATFORMS", "cpu")
                 env_extra.setdefault("PALLAS_AXON_POOL_IPS", "")
 
-    async def _finish_grant(self, payload, fut, resources, instances, pg_id, bundle_index):
+    async def _finish_grant(self, payload, fut, resources, instances, pg_id,
+                            bundle_index, conn=None):
         env_extra = dict(payload.get("env_vars") or {})
         self._apply_chip_isolation(env_extra, instances)
         try:
@@ -466,6 +480,10 @@ class NodeAgent:
             lease_id, worker, resources, instances, pg_id, bundle_index
         )
         lease.retriable = payload.get("retriable", True)
+        # The lease belongs to the requesting driver's connection: if that
+        # driver dies without returning it, the resources would leak
+        # forever (observed: dead multi-client drivers pinning all CPUs).
+        lease.owner_conn = conn
         self.leases[lease_id] = lease
         if not fut.done():
             fut.set_result(
@@ -545,6 +563,36 @@ class NodeAgent:
     def handle_return_lease(self, payload, conn):
         self._release_lease(payload["lease_id"])
         return True
+
+    def on_connection_closed(self, conn):
+        """A peer connection dropped.  If it was a lease-holding driver,
+        release its leases (reference: the raylet returns a dead owner's
+        leased workers) — a crashed/exited driver must not pin node
+        resources forever.  Pending queued requests from it unblock too.
+        Worker-registration connections are handled by the process monitor.
+        """
+        leaked = [
+            lid for lid, lease in self.leases.items()
+            if getattr(lease, "owner_conn", None) is conn
+        ]
+        for lid in leaked:
+            logger.info(
+                "releasing lease %d from disconnected driver", lid
+            )
+            self._release_lease(lid)
+        kept = []
+        for payload, fut, qconn in self._lease_queue:
+            if qconn is conn:
+                # Resolve the handler coroutine so it doesn't await forever;
+                # the error reply goes nowhere (connection is gone), which
+                # the dispatch layer tolerates.
+                if not fut.done():
+                    fut.set_exception(
+                        ConnectionError("lease requester disconnected")
+                    )
+            else:
+                kept.append((payload, fut, qconn))
+        self._lease_queue = kept
 
     # ---------------------------------------------------------------- actors
     async def handle_create_actor_worker(self, payload, conn):

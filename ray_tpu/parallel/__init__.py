@@ -1,4 +1,11 @@
 from .mesh import MeshConfig, build_mesh, local_mesh  # noqa: F401
+from .multislice import (  # noqa: F401
+    MULTISLICE_RULES,
+    MultiSliceConfig,
+    build_multislice_mesh,
+    default_rules_for_mesh,
+    group_devices_by_slice,
+)
 from .pipeline import pipeline_local, pipelined  # noqa: F401
 from .ring_attention import ring_attention, ring_attention_local  # noqa: F401
 from .sharding import (  # noqa: F401

@@ -1,0 +1,112 @@
+"""Multi-slice meshes: ICI within a slice, DCN across slices.
+
+SURVEY §2.3's cross-slice story: a TPU pod slice is one ICI domain; jobs
+spanning slices communicate over DCN, which is an order of magnitude
+slower — so the mesh must put the *least chatty* axis (pure data
+parallelism: one gradient psum per step) across slices and keep
+model/fsdp/seq traffic inside each slice.  This module builds such a mesh
+as an outer ``dcn`` axis over per-slice sub-meshes and extends the logical
+sharding rules so ``batch`` spans (dcn, data, fsdp) — XLA then inserts a
+hierarchical gradient reduction (intra-slice reduce-scatter over ICI +
+cross-slice all-reduce over DCN) on its own.
+
+Reference has no multi-slice support to mirror (its GPU analog is
+NCCL-over-IB across nodes); the design follows the jax multi-slice recipe
+(``mesh_utils.create_hybrid_device_mesh``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from .mesh import AXES, MeshConfig
+from .sharding import DEFAULT_RULES, LogicalRules
+
+MULTISLICE_AXES = ("dcn",) + AXES
+
+# Logical rules for a dcn-extended mesh: cross-slice traffic is pure data
+# parallelism; every other axis stays intra-slice.
+MULTISLICE_RULES: LogicalRules = dict(
+    DEFAULT_RULES, batch=("dcn", "data", "fsdp")
+)
+
+
+@dataclass
+class MultiSliceConfig:
+    num_slices: int
+    per_slice: MeshConfig
+
+    @property
+    def shape(self):
+        return (self.num_slices,) + self.per_slice.shape
+
+    @property
+    def num_devices(self) -> int:
+        return int(self.num_slices * self.per_slice.num_devices)
+
+
+def group_devices_by_slice(devices: Sequence, num_slices: int):
+    """Partition devices into slices: real TPU devices carry
+    ``slice_index``; virtual/CPU devices split into equal contiguous
+    chunks (each chunk *modeling* one ICI domain)."""
+    by_idx: Dict[int, list] = {}
+    if all(getattr(d, "slice_index", None) is not None for d in devices):
+        for d in devices:
+            by_idx.setdefault(d.slice_index, []).append(d)
+        if len(by_idx) == num_slices:
+            return [by_idx[i] for i in sorted(by_idx)]
+    n = len(devices)
+    per = n // num_slices
+    if per * num_slices != n:
+        raise ValueError(
+            f"{n} devices not divisible into {num_slices} slices"
+        )
+    return [list(devices[i * per : (i + 1) * per]) for i in range(num_slices)]
+
+
+def build_multislice_mesh(config: MultiSliceConfig,
+                          devices: Optional[Sequence] = None):
+    """Mesh with axes ('dcn', 'data', 'fsdp', 'stage', 'expert', 'seq',
+    'model'): the outer axis crosses slices, inner axes stay inside one.
+
+    On real multi-slice hardware uses ``create_hybrid_device_mesh`` (which
+    knows DCN vs ICI link speeds); virtual devices fall back to a
+    per-slice layout of contiguous chunks.
+    """
+    import jax
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh
+
+    devices = list(devices) if devices is not None else jax.devices()
+    if len(devices) != config.num_devices:
+        raise ValueError(
+            f"multislice mesh {config.shape} needs {config.num_devices} "
+            f"devices, have {len(devices)}"
+        )
+    try:
+        arr = mesh_utils.create_hybrid_device_mesh(
+            config.per_slice.shape,
+            dcn_mesh_shape=(config.num_slices,) + (1,) * len(AXES),
+            devices=devices,
+        )
+        # hybrid mesh returns shape per_slice*dcn broadcast; normalize to
+        # (num_slices, *per_slice.shape)
+        arr = np.asarray(arr).reshape(config.shape)
+    except Exception:
+        slices = group_devices_by_slice(devices, config.num_slices)
+        arr = np.stack(
+            [
+                np.asarray(s, dtype=object).reshape(config.per_slice.shape)
+                for s in slices
+            ]
+        )
+    return Mesh(arr, MULTISLICE_AXES)
+
+
+def default_rules_for_mesh(mesh) -> LogicalRules:
+    """Rule table matching the mesh's axes: dcn-extended meshes get the
+    multislice batch mapping, plain meshes the default."""
+    return MULTISLICE_RULES if "dcn" in mesh.axis_names else DEFAULT_RULES

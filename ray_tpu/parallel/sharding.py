@@ -59,6 +59,12 @@ def logical_spec(logical_axes, rules: Optional[LogicalRules] = None):
 def logical_sharding(mesh, logical_axes, rules: Optional[LogicalRules] = None):
     from jax.sharding import NamedSharding
 
+    if rules is None and "dcn" in mesh.axis_names:
+        # Multi-slice mesh: batch additionally spans the cross-slice dcn
+        # axis (see parallel.multislice) — models need no changes.
+        from .multislice import MULTISLICE_RULES
+
+        rules = MULTISLICE_RULES
     return NamedSharding(mesh, logical_spec(logical_axes, rules))
 
 

@@ -130,16 +130,25 @@ def record_transitions(
 ):
     """Roll a behavior policy and return a ``ray_tpu.data.Dataset`` of
     transitions (the test/offline-generation analog of the reference's
-    output writer, ``rllib/offline/json_writer.py``)."""
+    output writer, ``rllib/offline/json_writer.py``).
+
+    ``policy_fn`` returns actions NORMALIZED to [-1, 1] (the module tanh
+    convention the stored dataset uses); the env is stepped with the same
+    action rescaled to its ``action_low``/``action_high`` units — the
+    exact mapping offline learners' evaluation applies, so training and
+    evaluation see identical dynamics."""
     import ray_tpu.data as rd
 
     env = env_maker()
+    lo = float(getattr(env, "action_low", -1.0))
+    hi = float(getattr(env, "action_high", 1.0))
     rng = random.Random(seed)
     obs = env.reset()
     rows = []
     for _ in range(n_steps):
         action = np.asarray(policy_fn(obs, rng), np.float32).reshape(-1)
-        next_obs, reward, done, _info = env.step(action)
+        env_action = lo + (action + 1.0) * 0.5 * (hi - lo)
+        next_obs, reward, done, _info = env.step(env_action)
         rows.append(
             {
                 "obs": np.asarray(obs, np.float32),

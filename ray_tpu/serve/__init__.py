@@ -8,6 +8,7 @@ from .api import (  # noqa: F401
     status,
     stop_http_proxy,
 )
+from .grpc_ingress import start_grpc_ingress, stop_grpc_ingress  # noqa: F401
 from .batching import batch  # noqa: F401
 from .deployment import Application, Deployment, deployment  # noqa: F401
 from .request_router import (  # noqa: F401

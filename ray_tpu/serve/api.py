@@ -134,10 +134,12 @@ def delete(name: str) -> bool:
 
 
 def shutdown():
+    from .grpc_ingress import stop_grpc_ingress
     from .long_poll import reset_client
 
     reset_client()
     stop_http_proxy()
+    stop_grpc_ingress()
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
     except ValueError:

@@ -1,0 +1,123 @@
+"""gRPC ingress for serve deployments.
+
+Reference: ray ``python/ray/serve/_private/proxy.py:534`` (``gRPCProxy``) —
+a per-node gRPC server routing RPCs to deployment replicas alongside the
+HTTP proxy.  Redesign: one generic service (no per-app protoc step),
+
+    /ray_tpu.serve.Ingress/Call
+
+taking a JSON request ``{"deployment": ..., "method": ..., "args": [...],
+"kwargs": {...}}`` (deployment may instead be inferred from the
+``route_prefix`` field) and returning JSON ``{"result": ...}``; errors map
+to standard gRPC status codes.  Routing rides the same pushed route table
+and DeploymentHandles (pow-2 / prefix-aware routers) as the HTTP proxy.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from concurrent import futures
+from typing import Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+SERVICE_METHOD = "/ray_tpu.serve.Ingress/Call"
+
+_server = None
+_server_lock = threading.Lock()
+
+
+def start_grpc_ingress(host: str = "127.0.0.1", port: int = 9000,
+                       max_workers: int = 8) -> str:
+    """Start the node's gRPC ingress; returns ``host:port``."""
+    global _server
+    import grpc
+
+    import ray_tpu
+
+    from .handle import DeploymentHandle
+    from .long_poll import long_poll_client
+
+    lp = long_poll_client()
+    lp.register(("routes",))
+    handles: Dict[str, DeploymentHandle] = {}
+
+    def resolve_deployment(req: dict) -> Optional[str]:
+        name = req.get("deployment")
+        if name:
+            return name
+        prefix = req.get("route_prefix")
+        routes = lp.get(("routes",)) or {}
+        if prefix and prefix in routes:
+            return routes[prefix]
+        return None
+
+    def call(request_bytes: bytes, context):
+        try:
+            req = json.loads(request_bytes or b"{}")
+        except ValueError:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, "request is not JSON"
+            )
+        name = resolve_deployment(req)
+        if name is None:
+            context.abort(
+                grpc.StatusCode.NOT_FOUND,
+                f"no deployment for {req.get('deployment') or req.get('route_prefix')!r}",
+            )
+        handle = handles.setdefault(name, DeploymentHandle(name))
+        try:
+            result = handle._invoke(
+                req.get("method", "__call__"),
+                tuple(req.get("args", ())),
+                dict(req.get("kwargs", {})),
+            ).result(timeout=req.get("timeout_s", 60.0))
+        except Exception as e:  # noqa: BLE001 — map to gRPC status
+            context.abort(grpc.StatusCode.INTERNAL, repr(e))
+        try:
+            return json.dumps({"result": result}).encode()
+        except TypeError:
+            context.abort(
+                grpc.StatusCode.INTERNAL,
+                f"result of type {type(result).__name__} is not "
+                "JSON-serializable",
+            )
+
+    class Ingress(grpc.GenericRpcHandler):
+        def service(self, details):
+            if details.method == SERVICE_METHOD:
+                return grpc.unary_unary_rpc_method_handler(
+                    call,
+                    request_deserializer=None,   # raw bytes
+                    response_serializer=None,
+                )
+            return None
+
+    with _server_lock:
+        if _server is not None:
+            stop_grpc_ingress()
+        server = grpc.server(
+            futures.ThreadPoolExecutor(
+                max_workers=max_workers, thread_name_prefix="grpc-ingress"
+            )
+        )
+        server.add_generic_rpc_handlers((Ingress(),))
+        bound = server.add_insecure_port(f"{host}:{port}")
+        if bound == 0:
+            raise OSError(f"could not bind gRPC ingress on {host}:{port}")
+        server.start()
+        _server = server
+        _ = ray_tpu  # handle resolution happens lazily per call
+        return f"{host}:{bound}"
+
+
+def stop_grpc_ingress() -> None:
+    global _server
+    if _server is not None:
+        try:
+            _server.stop(grace=1.0)
+        except Exception:  # noqa: BLE001
+            pass
+        _server = None

@@ -344,6 +344,17 @@ static void lock_arena(ArenaHeader* h) {
   if (rc == EOWNERDEAD) pthread_mutex_consistent(&h->mu);  // holder crashed
 }
 
+// Test hooks: take/release the arena mutex directly so crash-recovery
+// tests can SIGKILL a process WHILE it holds the lock (exercising the
+// robust-mutex EOWNERDEAD path above).  Not for production use.
+RTPU_API void rtpu_arena_lock(void* ap) {
+  lock_arena(static_cast<Arena*>(ap)->hdr);
+}
+
+RTPU_API void rtpu_arena_unlock(void* ap) {
+  pthread_mutex_unlock(&static_cast<Arena*>(ap)->hdr->mu);
+}
+
 // Allocate an unsealed object.  Returns payload offset, 0 on failure
 // (exists already, table full, or out of memory).
 RTPU_API uint64_t rtpu_alloc(void* ap, const uint8_t* id, uint64_t size) {

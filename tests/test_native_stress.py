@@ -125,17 +125,15 @@ def test_arena_crashed_holder_recovers(tmp_path):
         os.unlink(path)
     arena = native.NativeArena.create(path, 8 * 1024 * 1024)
     try:
-        # Child grabs the lock (via a long alloc loop) and dies mid-flight.
+        # Child takes the arena mutex via the test hook and SIGKILLs itself
+        # WHILE HOLDING IT — the parent's next lock must hit EOWNERDEAD and
+        # recover via pthread_mutex_consistent.
         code = f"""
 import os, signal
 from ray_tpu.core import native
 a = native.NativeArena.attach({path!r})
-# Take the lock by doing lots of allocs; SIGKILL ourselves mid-stream.
-os.kill(os.getpid(), signal.SIGKILL) if False else None
-for i in range(100000):
-    a.alloc(i.to_bytes(16, "little"), 64)
-    if i == 500:
-        os.kill(os.getpid(), signal.SIGKILL)
+a._lib.rtpu_arena_lock(a._h)
+os.kill(os.getpid(), signal.SIGKILL)
 """
         subprocess.run(
             [sys.executable, "-c", code], cwd="/root/repo", timeout=60
