@@ -183,6 +183,7 @@ class NodeAgent:
             t.cancel()
         for w in self.workers.values():
             self._kill_worker_proc(w)
+        self.isolation.cleanup()
         self.directory.cleanup()
         await self.server.stop()
         await self.cp_client.close()
@@ -485,6 +486,15 @@ class NodeAgent:
         # forever (observed: dead multi-client drivers pinning all CPUs).
         lease.owner_conn = conn
         self.leases[lease_id] = lease
+        if conn is not None and getattr(conn, "closed", False):
+            # Owner died while we were starting its worker: reap now —
+            # on_connection_closed already ran and cannot see this lease.
+            self._reap_lease(lease_id)
+            if not fut.done():
+                fut.set_exception(
+                    ConnectionError("lease requester disconnected")
+                )
+            return
         if not fut.done():
             fut.set_result(
                 {
@@ -566,20 +576,15 @@ class NodeAgent:
 
     def on_connection_closed(self, conn):
         """A peer connection dropped.  If it was a lease-holding driver,
-        release its leases (reference: the raylet returns a dead owner's
+        reap its leases (reference: the raylet reclaims a dead owner's
         leased workers) — a crashed/exited driver must not pin node
-        resources forever.  Pending queued requests from it unblock too.
+        resources forever.  Order matters: purge the dead driver's QUEUED
+        requests first, because releasing a lease re-drains the queue and
+        would otherwise grant the freed resources straight back to the
+        dead driver.  Leased workers are KILLED, not pooled: they may be
+        mid-task for the dead driver and must not serve the next lease.
         Worker-registration connections are handled by the process monitor.
         """
-        leaked = [
-            lid for lid, lease in self.leases.items()
-            if getattr(lease, "owner_conn", None) is conn
-        ]
-        for lid in leaked:
-            logger.info(
-                "releasing lease %d from disconnected driver", lid
-            )
-            self._release_lease(lid)
         kept = []
         for payload, fut, qconn in self._lease_queue:
             if qconn is conn:
@@ -593,6 +598,25 @@ class NodeAgent:
             else:
                 kept.append((payload, fut, qconn))
         self._lease_queue = kept
+        leaked = [
+            lid for lid, lease in self.leases.items()
+            if getattr(lease, "owner_conn", None) is conn
+        ]
+        for lid in leaked:
+            logger.info("reaping lease %d from disconnected driver", lid)
+            self._reap_lease(lid)
+
+    def _reap_lease(self, lease_id: int):
+        """Release a dead owner's lease: free resources, KILL the worker
+        (it may still be running the dead driver's task)."""
+        lease = self.leases.pop(lease_id, None)
+        if lease is None:
+            return
+        self._release_pool_resources(
+            lease.resources, lease.instances, lease.pg_id, lease.bundle_index
+        )
+        self._kill_worker_proc(lease.worker)
+        self._drain_lease_queue()
 
     # ---------------------------------------------------------------- actors
     async def handle_create_actor_worker(self, payload, conn):

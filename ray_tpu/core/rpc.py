@@ -285,6 +285,7 @@ class ServerConnection:
         self._writer = writer
         self._wbuf = bytearray()
         self._flush_scheduled = False
+        self.closed = False  # set on teardown; grant paths check liveness
         self.metadata: Dict[str, Any] = {}  # handlers can stash identity here
 
     def send_nowait(self, frame):
@@ -324,6 +325,7 @@ class ServerConnection:
         await self.send((0, method, payload))
 
     def close(self):
+        self.closed = True
         try:
             self._writer.close()
         except Exception:

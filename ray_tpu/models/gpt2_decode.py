@@ -152,6 +152,33 @@ def gpt2_decode_step(
     return logits.astype(jnp.float32), {"k": ck, "v": cv}
 
 
+def gpt2_decode_multi(
+    params, tokens, pos, cache, cfg: GPT2Config, n_steps: int,
+    *, kernel: bool = False,
+):
+    """Multi-step greedy decode: ``n_steps`` tokens per dispatch via
+    ``lax.scan`` with the argmax fused in-graph (vLLM-style multi-step
+    scheduling).  On a remote-dispatch backend this amortizes the per-call
+    launch latency across n_steps tokens — the single-step loop pays ~2
+    host round trips per token.
+
+    Continuous-batching engines call this between admission points: new
+    requests join slots only at chunk boundaries.  Returns
+    (tokens_out [n_steps, B], next_tokens [B], next_pos [B], cache).
+    """
+
+    def body(carry, _):
+        toks, p, c = carry
+        logits, c = gpt2_decode_step(params, toks, p, c, cfg, kernel=kernel)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (nxt, p + 1, c), nxt
+
+    (nxt, next_pos, cache), out = jax.lax.scan(
+        body, (tokens, pos, cache), None, length=n_steps
+    )
+    return out, nxt, next_pos, cache
+
+
 def sample_logits(logits, key, temperature, top_k: int = 0, top_p: float = 1.0):
     """Temperature / top-k / top-p sampling on [B, V] logits (greedy when
     temperature == 0)."""

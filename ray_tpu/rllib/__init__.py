@@ -33,3 +33,12 @@ from .rl_module import (  # noqa: F401
 from .sac import SAC, SACConfig  # noqa: F401
 from .offline import OfflineData, record_transitions  # noqa: F401
 from .cql import CQL, CQLConfig  # noqa: F401
+from .iql import IQL, IQLConfig, IQLModule  # noqa: F401
+from .multi_agent import (  # noqa: F401
+    ALL_DONE,
+    IndependentTrainer,
+    MultiAgentEnv,
+    MultiAgentEpisode,
+    TwoAgentCoopEnv,
+    collect_episodes,
+)

@@ -1,0 +1,244 @@
+"""Implicit Q-Learning — offline RL without out-of-sample Q queries.
+
+Reference: ray ``rllib/algorithms/iql/`` (expectile-regression IQL):
+  - V is trained by expectile regression toward min(Q1, Q2) on DATASET
+    actions only (tau > 0.5 biases toward the upper envelope, a soft max
+    over in-support actions),
+  - Q is trained by Bellman backup toward r + gamma * V(s') (no policy
+    actions anywhere in the critic path),
+  - the policy is extracted by advantage-weighted regression:
+    maximize exp(beta * (Q - V)) * log pi(a_data | s).
+
+Fully offline on ``OfflineData``; actions use the module's normalized
+[-1, 1] convention (see ``offline.record_transitions``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from .algorithm import Algorithm, AlgorithmConfig
+from .offline import OfflineData
+from .rl_module import RLModuleSpec, SACModule, _mlp_apply, _mlp_init
+
+
+class IQLModule(SACModule):
+    """SAC's tanh-gaussian policy + twin Q, plus the state-value net V
+    that IQL's expectile regression trains."""
+
+    def init_state(self, key):
+        import jax
+
+        params = super().init_state(key)
+        hidden = self.model_config.get("hidden", 64)
+        kv = jax.random.fold_in(key, 997)
+        params["v"] = _mlp_init(
+            kv, [self.obs_size, hidden, hidden, 1], out_scale=1.0
+        )
+        return params
+
+    def v_values(self, params, obs):
+        return _mlp_apply(params["v"], obs, 3, activation="relu")[..., 0]
+
+
+@dataclasses.dataclass
+class IQLHyperparams:
+    lr: float = 3e-4
+    gamma: float = 0.99
+    tau: float = 0.005           # polyak for target Q nets
+    expectile: float = 0.7       # V regression expectile (tau in the paper)
+    beta: float = 3.0            # advantage-weighted regression temperature
+    adv_clip: float = 100.0      # exp-weight clip
+    hidden: int = 64
+    batch_size: int = 256
+    learn_steps_per_iter: int = 200
+    seed: int = 0
+
+
+class IQLConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.hp = IQLHyperparams()
+        self.offline_data = None
+        self.env_maker: Optional[Callable] = None
+        self.rl_module_spec = RLModuleSpec(IQLModule, {})
+
+    def training(self, **kwargs) -> "IQLConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self.hp, k):
+                raise ValueError(f"unknown IQL hyperparam {k!r}")
+            setattr(self.hp, k, v)
+        return self
+
+    def offline(self, data) -> "IQLConfig":
+        self.offline_data = data
+        return self
+
+    def environment(self, env_maker) -> "IQLConfig":
+        self.env_maker = env_maker
+        return self
+
+
+class IQL(Algorithm):
+    def setup(self, config: IQLConfig) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        hp = self.hp = config.hp
+        if config.offline_data is None:
+            raise ValueError("IQL requires .offline(data)")
+        self.data = (
+            config.offline_data
+            if isinstance(config.offline_data, OfflineData)
+            else OfflineData(config.offline_data, seed=hp.seed)
+        )
+        self.env_maker = config.env_maker
+        probe = self.data.sample(2)
+        obs_size = probe["obs"].shape[1]
+        action_size = probe["actions"].shape[1]
+
+        config.rl_module_spec.model_config.setdefault("hidden", hp.hidden)
+        self.module = module = config.rl_module_spec.build(
+            obs_size, action_size
+        )
+        self.params = module.init_state(jax.random.PRNGKey(hp.seed))
+        self.target_params = jax.tree.map(lambda x: x, self.params)
+        self.tx = optax.adam(hp.lr)
+        self.opt_state = self.tx.init(self.params)
+
+        gamma, tau = hp.gamma, hp.tau
+        expectile, beta, adv_clip = hp.expectile, hp.beta, hp.adv_clip
+
+        def update(params, target_params, opt_state, batch, key):
+            import optax as _optax
+
+            obs, acts = batch["obs"], batch["actions"]
+
+            # --- V: expectile regression toward min target-Q(s, a_data)
+            tq1, tq2 = module.q_values(target_params, obs, acts)
+            q_data = jax.lax.stop_gradient(jnp.minimum(tq1, tq2))
+
+            def v_loss(p):
+                v = module.v_values(p, obs)
+                diff = q_data - v
+                w = jnp.where(diff > 0, expectile, 1.0 - expectile)
+                return (w * diff ** 2).mean(), v
+
+            # --- Q: Bellman toward r + gamma * V(s') (dataset actions only)
+            next_v = module.v_values(params, batch["next_obs"])
+            nonterminal = 1.0 - batch["dones"].astype(jnp.float32)
+            target_q = jax.lax.stop_gradient(
+                batch["rewards"] + gamma * nonterminal * next_v
+            )
+
+            def q_loss(p):
+                q1, q2 = module.q_values(p, obs, acts)
+                return ((q1 - target_q) ** 2 + (q2 - target_q) ** 2).mean()
+
+            # --- policy: advantage-weighted regression on dataset actions
+            def pi_loss(p):
+                mean, log_std = module._pi(p, obs)
+                std = jnp.exp(log_std)
+                # log-prob of the dataset action under the tanh-gaussian
+                a = jnp.clip(acts, -1 + 1e-5, 1 - 1e-5)
+                pre = jnp.arctanh(a)
+                logp = (
+                    -0.5 * (((pre - mean) / std) ** 2
+                            + 2 * log_std + jnp.log(2 * jnp.pi))
+                ).sum(-1)
+                logp = logp - jnp.log(1 - a ** 2 + 1e-6).sum(-1)
+                v = module.v_values(jax.lax.stop_gradient(p), obs)
+                adv = q_data - jax.lax.stop_gradient(v)
+                w = jnp.minimum(jnp.exp(beta * adv), adv_clip)
+                return -(jax.lax.stop_gradient(w) * logp).mean()
+
+            (vl, _v), vgrads = jax.value_and_grad(v_loss, has_aux=True)(params)
+            ql, qgrads = jax.value_and_grad(q_loss)(params)
+            pl, pgrads = jax.value_and_grad(pi_loss)(params)
+            grads = {
+                "pi": pgrads["pi"],
+                "q1": qgrads["q1"],
+                "q2": qgrads["q2"],
+                "v": vgrads["v"],
+            }
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = _optax.apply_updates(params, updates)
+            target_params = jax.tree.map(
+                lambda t, p: (1 - tau) * t + tau * p, target_params, params
+            )
+            stats = {"v_loss": vl, "q_loss": ql, "pi_loss": pl}
+            return params, target_params, opt_state, stats
+
+        def update_many(params, target_params, opt_state, batches, base_key):
+            def body(carry, xs):
+                batch, key = xs
+                out = update(*carry, batch, key)
+                return out[:-1], out[-1]
+
+            n = batches["rewards"].shape[0]
+            keys = jax.random.split(base_key, n)
+            (params, target_params, opt_state), stats = jax.lax.scan(
+                body, (params, target_params, opt_state), (batches, keys)
+            )
+            return (params, target_params, opt_state,
+                    jax.tree.map(lambda s: s[-1], stats))
+
+        self._update_many = jax.jit(update_many)
+        self._steps = 0
+
+    _SCAN_CHUNK = 50
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        hp = self.hp
+        stats = {}
+        remaining = hp.learn_steps_per_iter
+        while remaining > 0:
+            k = min(self._SCAN_CHUNK, remaining)
+            remaining -= k
+            sampled = [self.data.sample(hp.batch_size) for _ in range(k)]
+            batches = {
+                key: jnp.asarray(
+                    np.stack([b[key] for b in sampled]),
+                    jnp.float32 if key != "dones" else None,
+                )
+                for key in ("obs", "actions", "rewards", "next_obs", "dones")
+            }
+            self._steps += k
+            key = jax.random.fold_in(jax.random.PRNGKey(hp.seed), self._steps)
+            (self.params, self.target_params, self.opt_state,
+             stats) = self._update_many(
+                self.params, self.target_params, self.opt_state, batches, key,
+            )
+        out = {k2: float(v) for k2, v in stats.items()}
+        out["learn_steps_total"] = self._steps
+        return out
+
+    def evaluate(self, episodes: int = 5, seed: int = 100) -> Dict[str, Any]:
+        from .cql import CQL
+
+        return CQL.evaluate(self, episodes=episodes, seed=seed)
+
+    def get_state(self) -> Dict[str, Any]:
+        import jax
+
+        return {
+            "params": jax.tree.map(np.asarray, self.params),
+            "target_params": jax.tree.map(np.asarray, self.target_params),
+            "steps": self._steps,
+        }
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.params = state["params"]
+        self.target_params = state["target_params"]
+        self.opt_state = self.tx.init(self.params)
+        self._steps = state.get("steps", 0)
+
+
+IQLConfig.ALGO_CLS = IQL

@@ -43,13 +43,41 @@ def start_grpc_ingress(host: str = "127.0.0.1", port: int = 9000,
     lp = long_poll_client()
     lp.register(("routes",))
     handles: Dict[str, DeploymentHandle] = {}
+    bootstrap: Dict[str, str] = {}
+    bootstrap_state: Dict[str, float] = {}
+
+    def routes_table() -> Dict[str, str]:
+        pushed = lp.get(("routes",))
+        if pushed is not None:
+            return pushed
+        # Pre-first-push window: one direct pull (mirrors the HTTP proxy's
+        # bootstrap) so routes deployed before the ingress started resolve
+        # immediately; rate-limited so 404 streams stay off the controller.
+        import time as _time
+
+        now = _time.monotonic()
+        if now - bootstrap_state.get("ts", -10.0) > 1.0:
+            bootstrap_state["ts"] = now
+            try:
+                import ray_tpu as _rt
+
+                from .controller import CONTROLLER_NAME as _CN
+
+                controller = _rt.get_actor(_CN)
+                bootstrap.clear()
+                bootstrap.update(
+                    _rt.get(controller.get_routes.remote(), timeout=10)
+                )
+            except Exception:  # noqa: BLE001 — controller not up yet
+                pass
+        return bootstrap
 
     def resolve_deployment(req: dict) -> Optional[str]:
         name = req.get("deployment")
         if name:
             return name
         prefix = req.get("route_prefix")
-        routes = lp.get(("routes",)) or {}
+        routes = routes_table()
         if prefix and prefix in routes:
             return routes[prefix]
         return None

@@ -91,3 +91,48 @@ def test_borrowed_get_waits_past_rpc_deadline(cluster):
 
     # consume's worker borrows the pending ref and blocks on the owner.
     assert ray_tpu.get(consume.remote(slow_value.remote()), timeout=90) == "slow-consumed"
+
+
+def test_dead_driver_leases_reaped(ray_start_regular):
+    """A second driver process that exits without returning its leases must
+    not pin node resources (owner-connection reaping; the scale bench
+    found dead multi-client drivers freezing all CPUs)."""
+    import subprocess
+    import sys
+    import time
+
+    import ray_tpu
+
+    code = (
+        "import sys, os\n"
+        "import ray_tpu\n"
+        "ray_tpu.init(address=sys.argv[1], num_cpus=0)\n"
+        "@ray_tpu.remote\n"
+        "def spin():\n"
+        "    import time\n"
+        "    time.sleep(600)\n"
+        "refs = [spin.remote() for _ in range(4)]\n"
+        "import time\n"
+        "time.sleep(3)\n"   # leases granted, workers spinning
+        "os._exit(1)\n"     # die WITHOUT returning leases
+    )
+    cp = ray_tpu.api._local_node.cp_address
+    proc = subprocess.run(
+        [sys.executable, "-c", code, cp], timeout=120,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    assert proc.returncode == 1
+
+    # The head's CPUs must come back: a fresh task gets scheduled promptly.
+    @ray_tpu.remote
+    def ping():
+        return b"ok"
+
+    deadline = time.monotonic() + 60
+    while True:
+        try:
+            assert ray_tpu.get(ping.remote(), timeout=30) == b"ok"
+            break
+        except Exception:
+            if time.monotonic() > deadline:
+                raise

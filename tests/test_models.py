@@ -258,3 +258,44 @@ class TestMoE:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-3, atol=2e-3)
         np.testing.assert_allclose(float(aux), float(ref_aux), rtol=1e-4)
+
+
+class TestMultiStepDecode:
+    def test_multi_step_matches_single_step(self):
+        """gpt2_decode_multi (n tokens per dispatch, fused argmax) must
+        produce exactly the greedy single-step token sequence."""
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models import GPT2Config, gpt2_init
+        from ray_tpu.models.gpt2_decode import (
+            gpt2_decode_multi,
+            gpt2_decode_step,
+            gpt2_init_cache,
+        )
+
+        cfg = GPT2Config.tiny(dtype="float32")
+        B, T, K = 2, 32, 5
+        params = gpt2_init(jax.random.PRNGKey(0), cfg)
+
+        tokens = jnp.array([3, 7], jnp.int32)
+        pos = jnp.array([4, 9], jnp.int32)
+
+        cache = gpt2_init_cache(cfg, B, T)
+        single = []
+        t, p = tokens, pos
+        for _ in range(K):
+            logits, cache = gpt2_decode_step(params, t, p, cache, cfg)
+            t = jnp.argmax(logits, -1).astype(jnp.int32)
+            p = p + 1
+            single.append(t)
+
+        cache2 = gpt2_init_cache(cfg, B, T)
+        out, nxt, npos, _cache2 = gpt2_decode_multi(
+            params, tokens, pos, cache2, cfg, K
+        )
+        import numpy as np
+
+        np.testing.assert_array_equal(np.asarray(out), np.stack(single))
+        np.testing.assert_array_equal(np.asarray(nxt), np.asarray(single[-1]))
+        assert int(npos[0]) == 4 + K

@@ -55,22 +55,9 @@ class TestGrpcIngress:
         serve.run(Adder.bind())
         addr = serve.start_grpc_ingress(port=0)
         with grpc.insecure_channel(addr) as ch:
-            # Wait for the route push, then resolve by prefix.
-            import time
-
-            deadline = time.monotonic() + 10
-            while time.monotonic() < deadline:
-                try:
-                    out = _call(
-                        ch, {"route_prefix": "/Adder", "args": [1, 1]}
-                    )
-                    break
-                except grpc.RpcError as e:
-                    if e.code() != grpc.StatusCode.NOT_FOUND:
-                        raise
-                    time.sleep(0.05)
-            else:
-                raise AssertionError("route never resolved")
+            # A route deployed BEFORE the ingress started must resolve on
+            # the very first call (bootstrap pull covers the pre-push gap).
+            out = _call(ch, {"route_prefix": "/Adder", "args": [1, 1]})
             assert out["result"] == {"sum": 2}
             with pytest.raises(grpc.RpcError) as err:
                 _call(ch, {"deployment": "Nope", "args": []})
