@@ -16,6 +16,20 @@ import time
 from typing import Callable, Optional
 
 
+def _proc_start_time(pid: int) -> Optional[str]:
+    """Kernel start time of ``pid`` (field 22 of /proc/<pid>/stat) — the
+    (pid, starttime) pair uniquely identifies a process, so PID reuse
+    cannot masquerade as a live parent."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            data = f.read()
+        # comm may contain spaces/parens: split after the LAST ')'.
+        rest = data[data.rindex(b")") + 2 :].split()
+        return rest[19].decode()  # starttime is field 22 overall
+    except (OSError, ValueError, IndexError):
+        return None
+
+
 def watch_parent_process(on_exit: Optional[Callable[[], None]] = None) -> None:
     """Start the reaper thread if ``RAY_TPU_PARENT_PID`` is set.
 
@@ -25,13 +39,24 @@ def watch_parent_process(on_exit: Optional[Callable[[], None]] = None) -> None:
     ppid = int(os.environ.get("RAY_TPU_PARENT_PID", "0") or "0")
     if not ppid:
         return
+    birth = _proc_start_time(ppid)
 
     def loop():
         while True:
             time.sleep(1.0)
-            try:
-                os.kill(ppid, 0)
-            except OSError:
+            if birth is None:
+                # No readable /proc for the parent (non-Linux or masked):
+                # fall back to the portable signal-0 probe — keeps the
+                # PID-reuse hardening on Linux without killing healthy
+                # clusters elsewhere.
+                try:
+                    os.kill(ppid, 0)
+                    alive = True
+                except OSError:
+                    alive = False
+            else:
+                alive = _proc_start_time(ppid) == birth
+            if not alive:
                 if on_exit is not None:
                     try:
                         on_exit()

@@ -16,6 +16,8 @@ from .dataset import (  # noqa: F401
     from_items,
     range_dataset,
     read_binary_files,
+    read_images,
+    read_tfrecords,
     read_csv,
     read_datasource,
     read_json,

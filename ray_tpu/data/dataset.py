@@ -645,3 +645,33 @@ def read_binary_files(path: str, parallelism: int = 8) -> Dataset:
 
 def read_text(path: str, parallelism: int = 8) -> Dataset:
     return read_datasource(TextDatasource(path), parallelism)
+
+
+def read_images(path: str, parallelism: int = 8, *,
+                size: Optional[tuple] = None, mode: str = "RGB") -> Dataset:
+    """Decode image files into ``{"image": HxWxC uint8 ndarray, "path"}``
+    rows (reference ``data/datasource/image_datasource.py``).  Non-image
+    files in the directory are skipped; ``size`` resizes on read (the
+    usual ingest normalization)."""
+    from .datasource import ImageFilesDatasource
+
+    def decode(row):
+        import io
+
+        from PIL import Image
+
+        img = Image.open(io.BytesIO(row["bytes"])).convert(mode)
+        if size is not None:
+            img = img.resize(size)
+        return {"image": np.asarray(img), "path": row["path"]}
+
+    return read_datasource(ImageFilesDatasource(path), parallelism).map(decode)
+
+
+def read_tfrecords(path: str, parallelism: int = 8) -> Dataset:
+    """tf.train.Example TFRecord files → dict rows, WITHOUT a TensorFlow
+    dependency (ray's tfrecords_datasource imports TF; a JAX-first stack
+    parses the framing + proto directly — see ``data/tfrecord.py``)."""
+    from .datasource import TFRecordsDatasource
+
+    return read_datasource(TFRecordsDatasource(path), parallelism)

@@ -280,6 +280,61 @@ class JSONDatasource(Datasource):
         return tasks
 
 
+class TFRecordsDatasource(Datasource):
+    """tf.train.Example TFRecord files, TF-free (codec in
+    ``data/tfrecord.py``).  Matches both ``.tfrecord`` and ``.tfrecords``."""
+
+    def __init__(self, path: str):
+        paths = _expand_paths(path, ".tfrecord")
+        if os.path.isdir(path):
+            paths = sorted(
+                set(paths) | set(_expand_paths(path, ".tfrecords"))
+            )
+        self._paths = paths
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        from .tfrecord import read_tfrecord_file
+
+        return [
+            ReadTask(lambda p=p: read_tfrecord_file(p), {"path": p})
+            for p in self._paths
+        ]
+
+
+IMAGE_SUFFIXES = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp", ".tiff")
+
+
+class ImageFilesDatasource(Datasource):
+    """Image files → ``{"path", "bytes"}`` rows, filtered to image
+    extensions so a stray README/checksum in the directory can't fail the
+    read (reference image_datasource filters the same way)."""
+
+    def __init__(self, path: str):
+        self._paths = [
+            p for p in _expand_paths(path)
+            if p.lower().endswith(IMAGE_SUFFIXES)
+        ]
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        k = max(1, min(parallelism, len(self._paths) or 1))
+        size = (len(self._paths) + k - 1) // k
+        tasks = []
+        for i in range(k):
+            chunk = self._paths[i * size : (i + 1) * size]
+            if not chunk:
+                continue
+
+            def read(paths=chunk):
+                out = []
+                for p in paths:
+                    with open(p, "rb") as f:
+                        out.append({"path": p, "bytes": f.read()})
+                return out
+
+            tasks.append(ReadTask(read, {"num_files": len(chunk)}))
+        return tasks
+
+
 class BinaryFilesDatasource(Datasource):
     """Rows of ``{"path", "bytes"}`` — the image/webdataset substrate."""
 

@@ -1,0 +1,97 @@
+"""Image + TFRecord datasources (reference image_datasource.py and
+tfrecords_datasource.py — the latter re-implemented TF-free)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu.data as rd
+from ray_tpu.data.tfrecord import (
+    crc32c,
+    encode_example,
+    parse_example,
+    read_tfrecord_file,
+    write_tfrecord_file,
+)
+
+
+class TestTFRecordCodec:
+    def test_crc32c_known_vectors(self):
+        # Castagnoli CRC test vectors (rfc3720 appendix B / common refs).
+        assert crc32c(b"123456789") == 0xE3069283
+        assert crc32c(b"") == 0x0
+
+    def test_example_roundtrip(self):
+        row = {
+            "label": 3,
+            "weights": np.asarray([0.5, 1.5, -2.0], np.float32),
+            "name": b"sample-1",
+            "ids": np.asarray([10, 20, 300], np.int64),
+        }
+        out = parse_example(encode_example(row))
+        assert out["label"] == 3
+        np.testing.assert_allclose(out["weights"], row["weights"])
+        assert out["name"] == b"sample-1"
+        np.testing.assert_array_equal(out["ids"], row["ids"])
+
+    def test_file_roundtrip(self, tmp_path):
+        rows = [{"x": i, "y": float(i) * 0.5} for i in range(25)]
+        path = str(tmp_path / "data.tfrecord")
+        write_tfrecord_file(rows, path)
+        back = read_tfrecord_file(path)
+        assert len(back) == 25
+        assert back[7]["x"] == 7 and back[7]["y"] == pytest.approx(3.5)
+
+
+class TestReadTFRecords:
+    def test_read_tfrecords_dataset(self, ray_start_regular, tmp_path):
+        for part in range(2):
+            write_tfrecord_file(
+                [{"v": part * 10 + i} for i in range(10)],
+                str(tmp_path / f"part{part}.tfrecord"),
+            )
+        ds = rd.read_tfrecords(str(tmp_path))
+        rows = ds.take_all()
+        assert sorted(int(r["v"]) for r in rows) == sorted(
+            list(range(10)) + list(range(10, 20))
+        )
+
+
+class TestReadImages:
+    def test_read_images_decodes_and_resizes(self, ray_start_regular, tmp_path):
+        from PIL import Image
+
+        for i in range(3):
+            arr = np.full((12 + i, 10, 3), i * 40, np.uint8)
+            Image.fromarray(arr).save(str(tmp_path / f"img{i}.png"))
+        ds = rd.read_images(str(tmp_path), size=(8, 8))
+        rows = ds.take_all()
+        assert len(rows) == 3
+        assert all(r["image"].shape == (8, 8, 3) for r in rows)
+        vals = sorted(int(r["image"][0, 0, 0]) for r in rows)
+        assert vals == [0, 40, 80]
+
+
+class TestReviewRegressions:
+    def test_negative_int64_roundtrip(self, tmp_path):
+        path = str(tmp_path / "neg.tfrecord")
+        write_tfrecord_file([{"label": -1, "xs": np.asarray([-5, 7], np.int64)}], path)
+        back = read_tfrecord_file(path)
+        assert int(back[0]["label"]) == -1
+        np.testing.assert_array_equal(back[0]["xs"], [-5, 7])
+
+    def test_plural_tfrecords_suffix(self, ray_start_regular, tmp_path):
+        write_tfrecord_file(
+            [{"v": i} for i in range(5)], str(tmp_path / "d.tfrecords")
+        )
+        rows = rd.read_tfrecords(str(tmp_path)).take_all()
+        assert sorted(int(r["v"]) for r in rows) == list(range(5))
+
+    def test_read_images_skips_non_images(self, ray_start_regular, tmp_path):
+        from PIL import Image
+
+        Image.fromarray(np.zeros((6, 6, 3), np.uint8)).save(
+            str(tmp_path / "ok.png")
+        )
+        (tmp_path / "README.txt").write_text("not an image")
+        rows = rd.read_images(str(tmp_path)).take_all()
+        assert len(rows) == 1 and rows[0]["image"].shape == (6, 6, 3)
