@@ -1791,6 +1791,11 @@ class CoreWorker:
 
     # ------------------------------------------------------------ execution
     async def _resolve_args(self, payload: bytes):
+        global _EMPTY_ARGS_PAYLOAD
+        if _EMPTY_ARGS_PAYLOAD is None:
+            _EMPTY_ARGS_PAYLOAD = serialize_to_bytes(([], {}))
+        if payload == _EMPTY_ARGS_PAYLOAD:
+            return [], {}
         args, kwargs = deserialize_from_bytes(payload)
 
         async def resolve(v):
@@ -1996,8 +2001,11 @@ class CoreWorker:
             if asyncio.iscoroutinefunction(fn):
                 result = await fn(*args, **kwargs)
             else:
-                # copy_context: the tracing contextvar (and any other
-                # context) follows user code into the executor thread.
+                # copy_context does double duty: the tracing contextvar
+                # (and any other context) follows user code into the
+                # executor thread, AND each task runs in its own context so
+                # contextvars set by user code die with the task instead of
+                # leaking into later tasks on the reused pool thread.
                 import contextvars as _cv
 
                 _ctx = _cv.copy_context()
