@@ -1,11 +1,12 @@
-"""Dashboard-lite: cluster state + metrics over HTTP (JSON, no UI).
+"""Dashboard: live HTML UI + cluster state + metrics over HTTP.
 
-Role-equivalent of the reference dashboard's API surface (ray
-``python/ray/dashboard/``: the head process aggregating state + the
-metrics pipeline to Prometheus) without the TypeScript frontend — SURVEY.md
-§7 scopes round 1 to "serve JSON; UI later".  Endpoints:
+Role-equivalent of the reference dashboard (ray ``python/ray/dashboard/``:
+the head process aggregating state + the metrics pipeline to Prometheus),
+with a single-file HTML frontend (``dashboard_ui.py``) instead of the
+TypeScript app.  Endpoints:
 
-    GET /                    endpoint index
+    GET /                    live dashboard UI (auto-refreshing tables)
+    GET /api                 endpoint index
     GET /api/cluster         resource + actor/job summary
     GET /api/nodes|actors|tasks|jobs|placement_groups
     GET /api/timeline        Chrome-trace events
@@ -51,6 +52,11 @@ def start_dashboard(
         return await loop.run_in_executor(None, lambda: fn(*args, **kw))
 
     async def index(request):
+        from .dashboard_ui import INDEX_HTML
+
+        return web.Response(text=INDEX_HTML, content_type="text/html")
+
+    async def api_index(request):
         return _json(
             {
                 "endpoints": [
@@ -121,6 +127,7 @@ def start_dashboard(
 
     app = web.Application()
     app.router.add_get("/", index)
+    app.router.add_get("/api", api_index)
     app.router.add_get("/api/cluster", cluster)
     app.router.add_get("/api/nodes", nodes)
     app.router.add_get("/api/actors", actors)

@@ -152,7 +152,9 @@ class TestDashboard:
                     urllib.request.urlopen(url + path, timeout=30).read()
                 )
 
-            index = fetch("/")
+            html = urllib.request.urlopen(url + "/", timeout=30).read()
+            assert b"ray_tpu dashboard" in html  # UI page
+            index = fetch("/api")
             assert "/api/cluster" in index["endpoints"]
             cluster = fetch("/api/cluster")
             assert cluster["nodes_alive"] == 1
