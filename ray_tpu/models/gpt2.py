@@ -37,9 +37,10 @@ class GPT2Config:
     remat: bool = False
     # "full" recomputes the whole block in backward; "dots" saves matmul
     # outputs and recomputes only elementwise ops (jax
-    # dots_with_no_batch_dims_saveable) — most of the memory win at a
-    # fraction of the recompute FLOPs.
-    remat_policy: str = "full"  # full | dots
+    # dots_with_no_batch_dims_saveable); "save_mlp" saves only the tagged
+    # MLP hidden activations — skips the costliest recompute while keeping
+    # most of full-remat's memory win.
+    remat_policy: str = "full"  # full | dots | save_mlp
 
     @property
     def head_dim(self) -> int:
@@ -174,6 +175,12 @@ def _block(x, layer, cfg: GPT2Config, mesh):
     x = x + (jnp.einsum("bshd,hde->bse", o, layer["wo"]) + layer["bo"]).astype(x.dtype)
     y = _layernorm(x, layer["ln2_g"], layer["ln2_b"])
     hdn = jax.nn.gelu(jnp.einsum("bse,ef->bsf", y, layer["wi"]) + layer["bi"])
+    # Tag for the "save_mlp" remat policy: keeping just this [B,S,4E]
+    # tensor skips the most expensive recompute (the up-projection matmul)
+    # while everything else rematerializes.
+    from jax.ad_checkpoint import checkpoint_name as _ckpt_name
+
+    hdn = _ckpt_name(hdn, "mlp_hidden")
     hdn = wlc(hdn, P("batch", "seq", "mlp"), mesh)
     x = x + (jnp.einsum("bsf,fe->bse", hdn, layer["wo2"]) + layer["bo2"]).astype(x.dtype)
     return wlc(x, P("batch", "seq", "act_embed"), mesh)
@@ -200,6 +207,13 @@ def gpt2_hidden(params, tokens, cfg: GPT2Config, mesh=None):
             block = jax.checkpoint(
                 block,
                 policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            )
+        elif cfg.remat_policy == "save_mlp":
+            block = jax.checkpoint(
+                block,
+                policy=jax.checkpoint_policies.save_only_these_names(
+                    "mlp_hidden"
+                ),
             )
         else:
             block = jax.checkpoint(block)
