@@ -1,0 +1,28 @@
+"""Scaling-efficiency harness (parallel/scaling_bench.py): curve shape,
+retention accounting, and SP parity — the evidence pipeline behind the
+>=90% ICI north star (BASELINE.json)."""
+
+import jax
+import pytest
+
+from ray_tpu.parallel.scaling_bench import run_scaling_curve, run_sp_parity
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 4, reason="needs >=4 virtual devices"
+)
+
+
+def test_scaling_curve_structure():
+    curve = run_scaling_curve((1, 2, 4), n_steps=2, seq_len=64)
+    assert [row["devices"] for row in curve] == [1, 2, 4]
+    assert curve[0]["retention"] == 1.0
+    for row in curve:
+        assert row["step_time_s"] > 0
+        assert row["tokens_per_sec_per_device"] > 0
+        assert 0 < row["retention"] <= 2.0  # sane band, noise included
+
+
+def test_sp_parity_losses_match():
+    parity = run_sp_parity(seq_len=64)
+    assert parity["ring_matches_dense"], parity
+    assert parity["ulysses_matches_dense"], parity
