@@ -91,6 +91,10 @@ def init(
             labels=labels,
             session_id=session_id,
             num_cpus=num_cpus,
+            # A connecting driver's local agent must die with the driver:
+            # client processes exiting uncleanly were orphaning 0-CPU
+            # agents on shared clusters.
+            die_with_parent=True,
         )
         node.start()
         _local_node = node

@@ -578,7 +578,11 @@ class CoreWorker:
                 self._run_sync(self.async_shutdown(), timeout=5)
             except Exception:
                 pass
-            self.loop.call_soon_threadsafe(self.loop.stop)
+            try:
+                self.loop.call_soon_threadsafe(self.loop.stop)
+            except RuntimeError:
+                pass  # loop already closed — don't abort the caller's
+                # teardown (node.stop() must still run)
             self._loop_thread.join(timeout=5)
         self._task_executor.shutdown(wait=False)
 

@@ -177,6 +177,10 @@ class Node:
                 "--port", str(agent_port),
                 "--cp-address", self.cp_address,
                 "--session-id", self.session_id,
+                # The head's agent owns session-wide shm cleanup on
+                # parent-death; worker/client agents must never delete the
+                # shared arena (same ownership rule as Node.stop()).
+                "--owns-session-shm", "1" if self.head else "0",
                 "--resources", json.dumps(self.resources),
                 "--labels", json.dumps(self.labels),
             ],

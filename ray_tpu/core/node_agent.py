@@ -828,6 +828,11 @@ def main():
     parser.add_argument("--port", type=int, required=True)
     parser.add_argument("--cp-address", required=True)
     parser.add_argument("--session-id", required=True)
+    parser.add_argument(
+        "--owns-session-shm", default="0",
+        help="1 = this agent owns session shm cleanup on parent death "
+        "(set for the head node's agent only)",
+    )
     parser.add_argument("--resources", required=True, help="JSON dict")
     parser.add_argument("--labels", default="{}", help="JSON dict")
     args = parser.parse_args()
@@ -842,7 +847,13 @@ def main():
 
     from .reaper import watch_parent_process
 
-    watch_parent_process(on_exit=_unlink_session_arena)
+    watch_parent_process(
+        on_exit=(
+            _unlink_session_arena
+            if args.owns_session_shm == "1"
+            else None
+        )
+    )
     import json
 
     logging.basicConfig(
