@@ -1,3 +1,9 @@
+from .backend import (  # noqa: F401
+    AccelerateBackend,
+    Backend,
+    JaxBackend,
+    TorchBackend,
+)
 from .checkpoint import Checkpoint  # noqa: F401
 from .config import (  # noqa: F401
     CheckpointConfig,

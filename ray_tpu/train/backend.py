@@ -70,3 +70,47 @@ class TorchBackend(Backend):
             ],
             timeout=300,
         )
+
+
+class AccelerateBackend(TorchBackend):
+    """HuggingFace Accelerate over the torch gloo group (reference:
+    ray ``train/huggingface/accelerate`` integration).  The torch process
+    group is bootstrapped exactly like TorchBackend; workers additionally
+    get the env Accelerate reads so ``accelerate.Accelerator()`` inside
+    ``train_loop_per_worker`` picks up the already-initialized group (and
+    a ``transformers.Trainer`` built there trains data-parallel)."""
+
+    def on_start(self, worker_group):
+        import ray_tpu
+
+        n = len(worker_group.workers)
+        addr = ray_tpu.get(
+            worker_group.workers[0].get_coordinator_address.remote(0),
+            timeout=60,
+        )
+        host, port = addr.rsplit(":", 1)
+        # Env FIRST: Accelerate's launcher checks MASTER_ADDR/RANK even
+        # when torch.distributed is already initialized.
+        ray_tpu.get(
+            [
+                w.set_env.remote(
+                    {
+                        "ACCELERATE_USE_CPU": "true",
+                        "MASTER_ADDR": host,
+                        "MASTER_PORT": port,
+                        "RANK": str(rank),
+                        "WORLD_SIZE": str(n),
+                        "LOCAL_RANK": "0",
+                    }
+                )
+                for rank, w in enumerate(worker_group.workers)
+            ],
+            timeout=60,
+        )
+        ray_tpu.get(
+            [
+                w.init_torch_distributed.remote(host, int(port), n, rank)
+                for rank, w in enumerate(worker_group.workers)
+            ],
+            timeout=300,
+        )

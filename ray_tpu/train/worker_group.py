@@ -69,6 +69,14 @@ class TrainWorker:
         )
         return True
 
+    def set_env(self, env: dict) -> bool:
+        """Backend hook: export env vars into the worker process (e.g. the
+        variables Accelerate/transformers read at Accelerator() time)."""
+        import os
+
+        os.environ.update({k: str(v) for k, v in env.items()})
+        return True
+
     # -------------------------------------------------------------- run/poll
     def run(self, train_fn_payload: bytes, config: Optional[dict],
             latest_checkpoint, run_dir: Optional[str] = None,
