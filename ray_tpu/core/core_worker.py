@@ -163,11 +163,35 @@ class _LeasePool:
         self.queue.put_nowait((spec, attempt))
         self._pump()
 
+    @staticmethod
+    def _spawn(coro) -> bool:
+        """create_task if a loop is running; else drop the coroutine.
+
+        _pump/_drop_lease can fire from ``finally`` blocks while the event
+        loop is tearing down (GeneratorExit during interpreter shutdown) —
+        at that point there is no loop to schedule onto and the work is
+        moot anyway.
+        """
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            coro.close()
+            return False
+        loop.create_task(coro)
+        return True
+
     def _pump(self):
         # Dispatch queued tasks onto leases with spare in-flight capacity.
         # Pushes use transport-level call batching: a burst dispatched in
         # one loop pass rides one multiplexed frame with independent
         # per-call replies (see RpcClient.call(batch=True)).
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            # Loop tearing down (e.g. fired from a ``finally`` during
+            # interpreter shutdown): bail before dequeuing anything so no
+            # spec is dropped with its returns never failed.
+            return
         max_inflight = (
             self.max_inflight
             if self.max_inflight is not None
@@ -187,15 +211,14 @@ class _LeasePool:
             timer = self.idle_cancel.pop(lease["lease_id"], None)
             if timer:
                 timer.cancel()
-            asyncio.get_running_loop().create_task(
-                self._push(lease, spec, attempt)
-            )
+            self._spawn(self._push(lease, spec, attempt))
 
     def _maybe_request_lease(self):
         if self.requesting:
             return
         self.requesting = True
-        asyncio.get_running_loop().create_task(self._request_lease())
+        if not self._spawn(self._request_lease()):
+            self.requesting = False
 
     async def _request_lease(self):
         try:
@@ -301,7 +324,10 @@ class _LeasePool:
     def _arm_idle(self, lease):
         if lease["lease_id"] in self.idle_cancel:
             return
-        loop = asyncio.get_running_loop()
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:  # loop tearing down; idle return is moot
+            return
         self.idle_cancel[lease["lease_id"]] = loop.call_later(
             GlobalConfig.lease_idle_timeout_s,
             lambda: self._drop_lease(lease, returned=True),
@@ -313,9 +339,7 @@ class _LeasePool:
         if timer:
             timer.cancel()
         if returned:
-            asyncio.get_running_loop().create_task(
-                self._return_lease_rpc(lease)
-            )
+            self._spawn(self._return_lease_rpc(lease))
 
     async def _return_lease_rpc(self, lease):
         try:

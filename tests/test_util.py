@@ -191,9 +191,11 @@ def test_runtime_env_py_modules(cluster, tmp_path):
 
 
 def test_runtime_env_unsupported_key_raises(cluster):
+    # ``pip`` became a supported key in round 3; ``container`` remains
+    # explicitly unsupported (reference: python/ray/_private/runtime_env/).
     with pytest.raises(ValueError):
 
-        @ray_tpu.remote(runtime_env={"pip": ["requests"]})
+        @ray_tpu.remote(runtime_env={"container": {"image": "x"}})
         def f():
             pass
 
