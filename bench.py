@@ -194,7 +194,16 @@ def run_control_plane_suite():
     # Long worker-startup deadline: the scale stages spawn a dozen worker
     # processes at once and their interpreter startups serialize on this
     # box's core.
-    ray_tpu.init(num_cpus=4, _system_config={"worker_startup_timeout_s": 240.0})
+    ray_tpu.init(
+        num_cpus=4,
+        _system_config={
+            "worker_startup_timeout_s": 240.0,
+            # Warm idle-worker floor: actor creations and task leases pop
+            # pre-started workers instead of cold-starting interpreters
+            # (reference prestarts workers on driver connect too).
+            "prestart_workers": 16,
+        },
+    )
     try:
         @ray_tpu.remote
         def f():

@@ -64,7 +64,7 @@ _KNOBS: Dict[str, tuple] = {
     # -- workers --
     "num_workers_soft_limit": (int, 0, "0 = num_cpus"),
     "worker_niceness": (int, 0, "Nice level for spawned workers"),
-    "prestart_workers": (int, 0, "Workers to pre-start per node"),
+    "prestart_workers": (int, 0, "Idle-pool floor per node (0 off, -1 = CPU count)"),
     # -- OOM defense --
     "memory_monitor_period_s": (float, 1.0, "0 disables the memory monitor"),
     "memory_monitor_threshold": (float, 0.95, "Kill workers above this usage"),

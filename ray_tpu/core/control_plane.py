@@ -124,6 +124,7 @@ class ControlPlane:
         # pubsub: channel -> set of subscriber connections
         self._subs: Dict[str, Set[ServerConnection]] = {}
         self._pending_actors: List[ActorID] = []
+        self._schedule_tasks: set = set()
         self._pending_pgs: List[PlacementGroupID] = []
         self._bg_tasks: List[asyncio.Task] = []
         self.task_event_store = TaskEventStore()
@@ -488,8 +489,37 @@ class ControlPlane:
             max_restarts=spec.max_restarts,
         )
         self._persist_actor(entry)
-        await self._try_schedule_actor(entry)
+        # Schedule in the background: registration replies immediately
+        # (the reference's GCS actor registration is likewise async) so a
+        # burst of .remote() creations pipelines instead of serializing on
+        # worker spawn + __init__.  Callers' method submissions wait on
+        # the PENDING_CREATION -> ALIVE state publish.
+        self._schedule_actor_bg(entry)
         return entry.public_info()
+
+    def _schedule_actor_bg(self, entry: ActorEntry):
+        """Run _try_schedule_actor as a retained task: an escaping
+        exception re-queues the actor for the next reconcile pass instead
+        of silently stranding it in PENDING_CREATION."""
+        task = asyncio.get_running_loop().create_task(
+            self._try_schedule_actor(entry)
+        )
+        self._schedule_tasks.add(task)
+
+        def done(t: asyncio.Task):
+            self._schedule_tasks.discard(t)
+            if t.cancelled():
+                return
+            exc = t.exception()
+            if exc is not None:
+                logger.warning(
+                    "actor %s scheduling failed: %s; re-queueing",
+                    entry.spec.actor_id, exc,
+                )
+                if entry.spec.actor_id not in self._pending_actors:
+                    self._pending_actors.append(entry.spec.actor_id)
+
+        task.add_done_callback(done)
 
     async def _try_schedule_actor(self, entry: ActorEntry):
         spec = entry.spec

@@ -1794,7 +1794,7 @@ class CoreWorker:
                 self._fail_task_returns(spec, e)
                 return
             # Connection died: actor crashed or restarting.
-            self.worker_clients.invalidate(state.address)
+            await self.worker_clients.close(state.address)
             if attempt < state.max_task_retries:
                 await asyncio.sleep(0.2)
                 if spec.streaming:

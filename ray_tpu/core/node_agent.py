@@ -90,6 +90,7 @@ class NodeAgent:
         self.server = RpcServer(self, host, port)
         self.cp_client = RetryableRpcClient(cp_address)
         self.agent_clients = ClientPool()  # peers, for remote pulls
+        self.worker_clients = ClientPool()  # local workers (actor_init etc.)
         self.resources = NodeResources(resources, labels)
         self.instances = ResourceInstanceSet(resources)
         self.directory = NodeObjectDirectory(
@@ -114,6 +115,15 @@ class NodeAgent:
         self._lease_queue: List[tuple] = []  # (payload, future)
         self._idle_since = None  # monotonic ts when node went fully idle
         self._pull_futures: Dict[ObjectID, asyncio.Future] = {}
+        self._prestart_task: Optional[asyncio.Task] = None
+        self._last_pop = 0.0  # monotonic ts of last default-pool pop
+        # Pool key of a plain CPU-only lease (chip isolation applied to an
+        # empty chip set) — constant per process; prestarted workers carry
+        # exactly this env so they match ordinary task/actor leases.
+        env: Dict[str, str] = {}
+        self._apply_chip_isolation(env, {})
+        self._default_env = env
+        self._default_env_key = tuple(sorted(env.items()))
         self._bg: List[asyncio.Task] = []
 
     # ------------------------------------------------------------- lifecycle
@@ -133,6 +143,7 @@ class NodeAgent:
         self._bg.append(loop.create_task(self._monitor_workers_loop()))
         if GlobalConfig.memory_monitor_period_s > 0:
             self._bg.append(loop.create_task(self._memory_monitor_loop()))
+        self._replenish_pool()
         logger.info("node agent %s on %s", self.node_id.hex()[:8], addr)
         return addr
 
@@ -179,6 +190,8 @@ class NodeAgent:
                 logger.warning("memory monitor round failed: %s", e)
 
     async def stop(self):
+        if self._prestart_task is not None:
+            self._prestart_task.cancel()
         for t in self._bg:
             t.cancel()
         for w in self.workers.values():
@@ -188,6 +201,7 @@ class NodeAgent:
         await self.server.stop()
         await self.cp_client.close()
         await self.agent_clients.close_all()
+        await self.worker_clients.close_all()
 
     def _snapshot(self) -> dict:
         # Idle tracking + queued lease demands feed the autoscaler's load
@@ -279,19 +293,109 @@ class NodeAgent:
         conn.metadata["worker_id"] = worker_id
         return {"ok": True}
 
+    def _pool_floor(self) -> int:
+        """Target number of idle default-env workers kept warm.
+
+        Reference: ``WorkerPool::PrestartWorkers`` keeps pre-started
+        workers around so tasks AND actor creations skip the interpreter
+        cold start (ray ``src/ray/raylet/worker_pool.h:281``).
+        ``prestart_workers``: 0 disables, N>0 is an explicit floor, -1
+        auto-sizes to the node's CPU count.
+        """
+        n = GlobalConfig.prestart_workers
+        if n < 0:
+            n = int(self.resources.total.get("CPU"))
+        return n
+
+    def _replenish_pool(self):
+        """Kick the background prestart loop toward the pool floor.
+
+        Fired at agent start and whenever a pooled worker is consumed or
+        dies.  Actual spawning is debounced and serialized in
+        ``_prestart_loop`` so replenishment never competes with a live
+        creation burst for CPU (interpreter startup is ~0.4s of pure
+        import work per worker)."""
+        if self._pool_floor() <= 0:
+            return
+        if self._prestart_task is None or self._prestart_task.done():
+            self._prestart_task = asyncio.get_running_loop().create_task(
+                self._prestart_loop()
+            )
+
+    async def _prestart_loop(self):
+        key = self._default_env_key
+        while True:
+            if self._pool_floor() - len(self.idle_pool.get(key, [])) <= 0:
+                return
+            quiet = time.monotonic() - self._last_pop
+            if quiet < 0.5:
+                await asyncio.sleep(0.5 - quiet)
+                continue
+            if GlobalConfig.memory_monitor_period_s > 0:
+                # Don't refill the pool while the OOM defense is shedding
+                # memory — fresh interpreters would re-consume what the
+                # kill policy just freed.
+                from .memory_monitor import system_memory_fraction
+
+                if system_memory_fraction() > GlobalConfig.memory_monitor_threshold:
+                    await asyncio.sleep(1.0)
+                    continue
+            handle = None
+            try:
+                handle = self._spawn_worker(dict(self._default_env), key)
+                await self._wait_worker_ready(handle)
+                if handle.proc.poll() is None and not handle.leased:
+                    self.idle_pool.setdefault(key, []).append(handle)
+            except Exception:  # noqa: BLE001 — prestart is best-effort
+                if handle is not None:
+                    self._kill_worker_proc(handle)
+                await asyncio.sleep(1.0)
+
+    async def _wait_worker_ready(self, handle: WorkerHandle):
+        """Wait until the worker registers; fail fast if its process dies
+        first (an import-time crash must not cost the full startup
+        timeout)."""
+        deadline = time.monotonic() + GlobalConfig.worker_startup_timeout_s
+        while True:
+            try:
+                await asyncio.wait_for(handle.ready.wait(), timeout=0.2)
+                return
+            except asyncio.TimeoutError:
+                code = handle.proc.poll()
+                if code is not None:
+                    raise RuntimeError(
+                        f"worker exited with code {code} before registering"
+                    )
+                if time.monotonic() > deadline:
+                    raise asyncio.TimeoutError(
+                        "worker did not register within "
+                        f"{GlobalConfig.worker_startup_timeout_s}s"
+                    )
+
     async def _pop_worker(self, env_extra: Dict[str, str]) -> WorkerHandle:
         env_key = tuple(sorted(env_extra.items()))
         pool = self.idle_pool.get(env_key)
+        handle = None
         while pool:
-            handle = pool.pop()
-            if handle.proc.poll() is None:
-                handle.leased = True
-                return handle
-        handle = self._spawn_worker(env_extra, env_key)
+            h = pool.pop()
+            if h.proc.poll() is None:
+                handle = h
+                break
+        if env_key == self._default_env_key:
+            self._last_pop = time.monotonic()
+            self._replenish_pool()
+        if handle is None:
+            handle = self._spawn_worker(env_extra, env_key)
+            handle.leased = True
+            try:
+                await self._wait_worker_ready(handle)
+            except Exception:
+                # Kill the half-started interpreter — nothing else tracks
+                # it (the monitor only reaps procs that already exited).
+                self._kill_worker_proc(handle)
+                raise
+            return handle
         handle.leased = True
-        await asyncio.wait_for(
-            handle.ready.wait(), timeout=GlobalConfig.worker_startup_timeout_s
-        )
         return handle
 
     def _return_worker(self, handle: WorkerHandle):
@@ -313,9 +417,13 @@ class NodeAgent:
             for worker_id, handle in list(self.workers.items()):
                 if handle.proc.poll() is not None:
                     del self.workers[worker_id]
+                    if handle.address is not None:
+                        await self.worker_clients.close(handle.address)
                     pool = self.idle_pool.get(handle.env_key)
                     if pool and handle in pool:
                         pool.remove(handle)
+                    if handle.env_key == self._default_env_key:
+                        self._replenish_pool()
                     # Release any lease held by this worker.
                     for lease_id, lease in list(self.leases.items()):
                         if lease.worker is handle:
@@ -640,25 +748,23 @@ class NodeAgent:
         env_extra = dict(spec.env_vars)
         self._apply_chip_isolation(env_extra, instances)
         try:
-            # Actors always get a fresh worker (their process is their state).
-            env_key = tuple(sorted(env_extra.items()))
-            worker = self._spawn_worker(env_extra, env_key)
-            worker.leased = True
+            # Actor creations pop the same idle pool as task leases — a
+            # pooled worker (pre-started, or recycled after running task
+            # code) hosts the new actor instance, exactly like the
+            # reference (``WorkerPool::PopWorker``,
+            # src/ray/raylet/worker_pool.h:281, which also reuses workers
+            # that executed tasks).  Once the actor is initialized the
+            # process belongs to it: on actor death it is killed, never
+            # re-pooled (_return_worker).
+            worker = await self._pop_worker(env_extra)
             worker.is_actor = True
             worker.actor_id = spec.actor_id
-            await asyncio.wait_for(
-                worker.ready.wait(), timeout=GlobalConfig.worker_startup_timeout_s
-            )
             # Initialize the actor instance in the worker.
-            from .rpc import RetryableRpcClient as _C
-
-            wclient = _C(worker.address)
-            reply = await wclient.call(
+            reply = await self.worker_clients.get(worker.address).call(
                 "actor_init",
                 {"spec": spec, "incarnation": payload.get("incarnation", 0)},
                 timeout=GlobalConfig.worker_startup_timeout_s,
             )
-            await wclient.close()
             if not reply.get("ok"):
                 # Application error (user __init__ raised): kill the worker,
                 # report non-retryably so the control plane marks the actor
@@ -813,6 +919,9 @@ class NodeAgent:
             "resources": self._snapshot(),
             "num_workers": len(self.workers),
             "idle": {str(k): len(v) for k, v in self.idle_pool.items()},
+            "idle_pids": sorted(
+                h.proc.pid for v in self.idle_pool.values() for h in v
+            ),
             "leases": len(self.leases),
             "queued_leases": len(self._lease_queue),
             "objects": len(self.directory.object_ids()),

@@ -597,7 +597,19 @@ class ClientPool:
         return client
 
     def invalidate(self, address: Address):
+        """Drop the cached client WITHOUT closing it (caller knows the
+        connection is already being torn down elsewhere).  Prefer
+        ``close()`` when the peer is simply gone — transports keep their
+        FD open after peer EOF until transport.close()."""
         self._clients.pop(address, None)
+
+    async def close(self, address: Address):
+        client = self._clients.pop(address, None)
+        if client is not None:
+            try:
+                await client.close()
+            except Exception:
+                pass
 
     async def close_all(self):
         for c in self._clients.values():

@@ -1,0 +1,81 @@
+"""Prestarted worker pool: tasks AND actor creations are served from warm
+idle workers, and the pool replenishes to its floor in the background.
+
+Models the reference's worker-pool behavior (``WorkerPool::PopWorker``
+serves both task leases and actor creations from pre-started workers,
+``src/ray/raylet/worker_pool.h:281``; prestart via ``PrestartWorkers``).
+"""
+
+import asyncio
+import os
+import time
+
+import pytest
+
+import ray_tpu
+
+FLOOR = 3
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, _system_config={"prestart_workers": FLOOR})
+    yield
+    ray_tpu.shutdown()
+
+
+def _agent_state() -> dict:
+    from ray_tpu.core import api_frontend
+    from ray_tpu.core.rpc import RetryableRpcClient
+
+    worker = api_frontend.global_worker()
+
+    async def query():
+        client = RetryableRpcClient(worker.agent_address)
+        try:
+            return await client.call("debug_state", {})
+        finally:
+            await client.close()
+
+    return asyncio.run(query())
+
+
+def _wait_for_idle(n: int, timeout: float = 60.0) -> dict:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        state = _agent_state()
+        if len(state["idle_pids"]) >= n:
+            return state
+        time.sleep(0.3)
+    raise AssertionError(f"idle pool never reached {n}: {_agent_state()}")
+
+
+@ray_tpu.remote(num_cpus=0.01)
+class PidActor:
+    def pid(self):
+        return os.getpid()
+
+
+def test_pool_prestarts_to_floor(cluster):
+    state = _wait_for_idle(FLOOR)
+    assert len(state["idle_pids"]) == FLOOR
+
+
+def test_actor_creation_reuses_prestarted_worker(cluster):
+    warm = set(_wait_for_idle(FLOOR)["idle_pids"])
+    actor = PidActor.remote()
+    pid = ray_tpu.get(actor.pid.remote(), timeout=60)
+    assert pid in warm, f"actor got cold worker {pid}, pool was {warm}"
+    # The consumed slot is replenished back to the floor in the background.
+    _wait_for_idle(FLOOR)
+    ray_tpu.kill(actor)
+
+
+def test_task_reuses_prestarted_worker(cluster):
+    warm = set(_wait_for_idle(FLOOR)["idle_pids"])
+
+    @ray_tpu.remote
+    def where():
+        return os.getpid()
+
+    assert ray_tpu.get(where.remote(), timeout=60) in warm
