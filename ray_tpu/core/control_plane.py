@@ -522,6 +522,8 @@ class ControlPlane:
         task.add_done_callback(done)
 
     async def _try_schedule_actor(self, entry: ActorEntry):
+        if entry.state == DEAD:
+            return  # killed before scheduling got to it
         spec = entry.spec
         if spec.placement_group_id is not None:
             # PG-bound actor: its resources come from the bundle, which was
@@ -597,6 +599,16 @@ class ControlPlane:
             entry.state = DEAD
             entry.death_cause = f"actor __init__ failed: {reply['init_error']}"
             self._publish_actor(entry)
+            return
+        if entry.state == DEAD:
+            # Killed while the (async) creation was in flight: the fresh
+            # worker must not come up as a zombie holding its lease — kill
+            # it and keep the DEAD state (the kill's worker-kill RPC was a
+            # no-op because no worker existed yet).
+            entry.node_id = node_id
+            entry.address = reply["worker_address"]
+            await self._kill_actor_worker(entry)
+            entry.address = None
             return
         entry.node_id = node_id
         entry.address = reply["worker_address"]

@@ -73,6 +73,32 @@ logger = logging.getLogger(__name__)
 _current_trace_context = None
 
 
+def _maybe_start_profile():
+    """cProfile the protocol loop thread when RAY_TPU_PROFILE_DIR is set
+    (per-process .prof dumps; see docs/profiling.md).  The loop thread is
+    where all RPC/serialization work happens, so this is the flamegraph
+    that matters for control-plane throughput."""
+    if not os.environ.get("RAY_TPU_PROFILE_DIR"):
+        return None
+    import cProfile
+
+    prof = cProfile.Profile()
+    prof.enable()
+    return prof
+
+
+def _maybe_dump_profile(prof, role: str):
+    if prof is None:
+        return
+    prof.disable()
+    out_dir = os.environ.get("RAY_TPU_PROFILE_DIR", "/tmp")
+    try:
+        os.makedirs(out_dir, exist_ok=True)
+        prof.dump_stats(os.path.join(out_dir, f"{role}-{os.getpid()}.prof"))
+    except Exception:  # noqa: BLE001 — profiling must never break teardown
+        pass
+
+
 def _tracing_context():
     global _current_trace_context
     if _current_trace_context is None:
@@ -104,10 +130,159 @@ PENDING, READY, ERROR = "PENDING", "READY", "ERROR"
 _EMPTY_ARGS_PAYLOAD: Optional[bytes] = None
 
 
+class ExecPipeline:
+    """Sticky exclusive-execution thread for task/actor-call execution at
+    max_concurrency == 1 (the default).
+
+    Why not ThreadPoolExecutor per call: each run_in_executor round trip
+    costs two GIL/futex handoffs (wake the pool thread, wake the loop
+    back) — ~1ms each under contention on a 1-core box, which capped
+    actor-call throughput (reference analog: Ray executes actor tasks on
+    a dedicated execution thread fed by a queue, not a fresh dispatch per
+    call, ``core_worker/task_execution.cc``).  A single sticky drainer
+    thread executes a run of queued calls back-to-back: handoffs amortize
+    across the burst, and completions flush to the loop in batches (one
+    wakeup per drain pass, not per call).
+
+    Exclusivity: the drainer IS the mutual exclusion (one thread).
+    Coroutine/streaming work enqueues a bridge item: the drainer submits
+    it to the event loop and blocks until it finishes, preserving
+    exclusion without holding an asyncio lock across the await.
+
+    Ordering: tickets are issued at dispatch (loop thread, arrival
+    order); the drainer executes strictly in ticket order, so a call
+    whose argument resolution suspends cannot be overtaken by a later
+    call.  A ticket that can't be used (dispatch failed) MUST be
+    abandoned or the cursor wedges — _execute guarantees this.
+    """
+
+    class Ticket:
+        __slots__ = ("seq", "consumed")
+
+        def __init__(self, seq: int):
+            self.seq = seq
+            self.consumed = False
+
+    def __init__(self, loop: asyncio.AbstractEventLoop):
+        self.loop = loop
+        self._cv = threading.Condition()
+        self._items: Dict[int, tuple] = {}
+        self._next_ticket = 0
+        self._next_exec = 0
+        self._done: List[tuple] = []
+        self._done_flush_scheduled = False
+        self._done_lock = threading.Lock()
+        self._stopped = False
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------- loop-thread API
+    def ticket(self) -> "ExecPipeline.Ticket":
+        t = self.Ticket(self._next_ticket)
+        self._next_ticket += 1
+        return t
+
+    async def run_sync(self, ticket: "ExecPipeline.Ticket", fn, *args, **kwargs):
+        """Execute ``fn(*args, **kwargs)`` on the drainer thread."""
+        fut = self.loop.create_future()
+        ticket.consumed = True
+        with self._cv:
+            self._items[ticket.seq] = ("sync", (fn, args, kwargs), fut)
+            self._cv.notify()
+        self._ensure_thread()
+        ok, val = await fut
+        if ok:
+            return val
+        raise val
+
+    async def run_coro(self, ticket: "ExecPipeline.Ticket", coro_factory):
+        """Run a coroutine on the event loop while the drainer blocks on
+        it — exclusive like a sync item, but suspendable."""
+        fut = self.loop.create_future()
+        ticket.consumed = True
+        with self._cv:
+            self._items[ticket.seq] = ("coro", coro_factory, fut)
+            self._cv.notify()
+        self._ensure_thread()
+        ok, val = await fut
+        if ok:
+            return val
+        raise val
+
+    def abandon(self, ticket: "ExecPipeline.Ticket"):
+        """Release an issued-but-unused ticket (dispatch failed before
+        enqueue) so the in-order cursor can pass it.  Idempotent."""
+        if ticket.consumed:
+            return
+        ticket.consumed = True
+        with self._cv:
+            self._items[ticket.seq] = ("skip", None, None)
+            self._cv.notify()
+
+    def stop(self):
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+
+    # ---------------------------------------------------------- drainer side
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._drain, daemon=True, name="exec-pipeline"
+            )
+            self._thread.start()
+
+    def _drain(self):
+        while True:
+            with self._cv:
+                while self._next_exec not in self._items and not self._stopped:
+                    self._cv.wait()
+                if self._next_exec not in self._items:
+                    return  # stopped and drained
+                kind, work, fut = self._items.pop(self._next_exec)
+                self._next_exec += 1
+            if kind == "skip":
+                continue
+            if kind == "sync":
+                fn, args, kwargs = work
+                try:
+                    res = (True, fn(*args, **kwargs))
+                except BaseException as e:  # noqa: BLE001 — reported to caller
+                    res = (False, e)
+            else:
+                try:
+                    cfut = asyncio.run_coroutine_threadsafe(work(), self.loop)
+                    res = (True, cfut.result())
+                except BaseException as e:  # noqa: BLE001
+                    res = (False, e)
+            self._complete(fut, res)
+
+    def _complete(self, fut, res):
+        schedule = False
+        with self._done_lock:
+            self._done.append((fut, res))
+            if not self._done_flush_scheduled:
+                self._done_flush_scheduled = True
+                schedule = True
+        if schedule:
+            try:
+                self.loop.call_soon_threadsafe(self._flush_done)
+            except RuntimeError:  # loop closed at teardown
+                pass
+
+    def _flush_done(self):
+        with self._done_lock:
+            done, self._done = self._done, []
+            self._done_flush_scheduled = False
+        for fut, res in done:
+            if not fut.done():
+                fut.set_result(res)
+
+
 class OwnedObject:
     __slots__ = (
         "state", "inline_payload", "locations", "size", "local_refs",
         "borrows", "args_holds", "error", "event", "lineage",
+        "sync_waiters",
     )
 
     def __init__(self):
@@ -121,6 +296,19 @@ class OwnedObject:
         self.error: Optional[BaseException] = None
         self.event = asyncio.Event()
         self.lineage: Optional[TaskSpec] = None  # for reconstruction
+        # threading.Events registered by user threads blocked in the
+        # no-loop-roundtrip sync get fast path (see CoreWorker.get).
+        self.sync_waiters: Optional[List[threading.Event]] = None
+
+    def wake(self):
+        """Mark complete: wake loop-side awaiters AND user threads blocked
+        in the sync-get fast path.  Loop-thread only."""
+        self.event.set()
+        waiters = self.sync_waiters
+        if waiters:
+            for w in waiters:
+                w.set()
+            self.sync_waiters = None
 
 
 class _ActorState:
@@ -430,7 +618,7 @@ class CoreWorker:
         self._task_executor = ThreadPoolExecutor(
             max_workers=4, thread_name_prefix="task"
         )
-        self._task_semaphore: Optional[asyncio.Semaphore] = None  # created on loop
+        self._exec_pipeline: Optional[ExecPipeline] = None  # created on loop
         # Actor-execution state (when this worker hosts an actor)
         self.actor_instance = None
         self.actor_spec: Optional[ActorSpec] = None
@@ -457,6 +645,9 @@ class CoreWorker:
         # Borrowed refs this process re-serialized (lent onward): their
         # outgoing decref is grace-delayed.  See on_ref_relent.
         self._relent_refs: Set[ObjectID] = set()
+        # token -> (timer handle, fn): grace-delayed ref ops, flushed
+        # immediately at shutdown (see _delay_refop).
+        self._delayed_refops: Dict[object, tuple] = {}
 
     def _post(self, cb) -> None:
         """Run ``cb()`` on the protocol loop; bursts coalesce into a single
@@ -489,7 +680,7 @@ class CoreWorker:
     # ------------------------------------------------------------- lifecycle
     async def async_start(self):
         self.loop = asyncio.get_running_loop()
-        self._task_semaphore = asyncio.Semaphore(1)
+        self._exec_pipeline = ExecPipeline(asyncio.get_running_loop())
         self.address = await self.server.start()
         self.cp = RetryableRpcClient(self.cp_address, push_handler=self._on_push)
         self.agent = RetryableRpcClient(self.agent_address)
@@ -534,6 +725,7 @@ class CoreWorker:
         err: List[BaseException] = []
 
         def run():
+            prof = _maybe_start_profile()
             loop = asyncio.new_event_loop()
             asyncio.set_event_loop(loop)
             self.loop = loop
@@ -551,6 +743,7 @@ class CoreWorker:
                 err.append(e)
                 ready.set()
             finally:
+                _maybe_dump_profile(prof, "driver-loop")
                 try:
                     loop.close()
                 except Exception:
@@ -573,6 +766,10 @@ class CoreWorker:
 
     async def async_shutdown(self):
         self._shutdown = True
+        # Pending grace-delayed decrefs/releases fire NOW (their sends get
+        # one loop tick to reach the wire before clients close).
+        self._flush_delayed_refops()
+        await asyncio.sleep(0)
         # Ordered teardown (reference: core_worker/shutdown_coordinator.h):
         # cancel periodic loops first so nothing is left pending when the
         # event loop stops.
@@ -588,6 +785,8 @@ class CoreWorker:
                 await asyncio.wait_for(self.task_events.stop(), timeout=2)
             except Exception:
                 pass
+        if self._exec_pipeline is not None:
+            self._exec_pipeline.stop()
         await self.server.stop()
         for pool in (self.worker_clients, self.agent_clients):
             await pool.close_all()
@@ -645,7 +844,7 @@ class CoreWorker:
             obj.locations.add(self.agent_address)
             self.memory_store.put(oid, value)  # local cache for owner gets
         obj.state = READY
-        obj.event.set()
+        obj.wake()
         ref = ObjectRef.__new__(ObjectRef)
         ref.id = oid
         ref.owner_address = self.address
@@ -779,7 +978,7 @@ class CoreWorker:
             # Reset every still-owned item record of this stream so getters
             # wait for the replayed values instead of reading dead
             # locations.
-            for robj in self.owned.values():
+            for robj in list(self.owned.values()):  # user threads insert (submit paths)
                 if robj.lineage is spec:
                     robj.state = PENDING
                     robj.error = None
@@ -834,10 +1033,73 @@ class CoreWorker:
         self.memory_store.put(oid, value)
         return value
 
+    _GET_MISS = object()  # sentinel: fast path can't serve, use the loop
+
+    def _try_get_sync(self, refs, timeout: Optional[float]):
+        """Resolve self-owned inline/in-memory results WITHOUT a protocol
+        loop round trip: the user thread parks on a threading.Event that
+        the reply handler wakes directly (OwnedObject.wake).  This removes
+        the run_coroutine_threadsafe wakeup + gather machinery from the
+        hot sync-call path (~2x on 1:1 sync calls on a 1-core box) and
+        moves result deserialization off the protocol loop.  Returns
+        _GET_MISS if any ref needs the full path (borrowed, shm-located,
+        or reconstruction)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out = []
+        for ref in refs:
+            if ref.owner_address != self.address:
+                return self._GET_MISS
+            oid = ref.id
+            obj = self.owned.get(oid)
+            if obj is None:
+                if self.memory_store.contains(oid):
+                    out.append(self.memory_store.peek(oid))
+                    continue
+                return self._GET_MISS
+            if not obj.event.is_set():
+                ev = threading.Event()
+                waiters = obj.sync_waiters
+                if waiters is None:
+                    waiters = obj.sync_waiters = []
+                waiters.append(ev)
+                # Re-check after registering: wake() may have run between
+                # the is_set probe and the append (it reads sync_waiters
+                # after setting the event, so one side always sees the
+                # other).
+                if not obj.event.is_set():
+                    remaining = (
+                        None if deadline is None
+                        else max(0.0, deadline - time.monotonic())
+                    )
+                    if not ev.wait(remaining):
+                        raise GetTimeoutError(
+                            f"get() timed out on {len(refs)} object(s)"
+                        )
+            if obj.state == ERROR:
+                raise obj.error
+            if self.memory_store.contains(oid):
+                out.append(self.memory_store.peek(oid))
+            elif obj.inline_payload is not None:
+                value = deserialize_from_bytes(obj.inline_payload)
+                self.memory_store.put(oid, value)
+                out.append(value)
+            else:
+                return self._GET_MISS  # shm / remote locations
+        return out
+
     def get(self, refs, timeout: Optional[float] = None):
         single = isinstance(refs, ObjectRef)
         if single:
             refs = [refs]
+        # One deadline across both paths: time the fast path burned
+        # waiting before a _GET_MISS must not be granted again to the
+        # async fallback.
+        deadline = None if timeout is None else time.monotonic() + timeout
+        results = self._try_get_sync(refs, timeout)
+        if results is not self._GET_MISS:
+            return results[0] if single else results
+        if deadline is not None:
+            timeout = max(0.001, deadline - time.monotonic())
 
         async def get_all():
             # Resolve concurrently: remote-owner round-trips and shm pulls
@@ -945,14 +1207,37 @@ class CoreWorker:
                     o.borrows -= 1
                     self._maybe_free(oid)
 
-            asyncio.get_running_loop().call_later(
-                GlobalConfig.borrow_handoff_grace_s, release
-            )
+            self._delay_refop(release)
 
         try:
             self._post(hold)
         except RuntimeError:
             pass
+
+    def _delay_refop(self, fn):
+        """Run ``fn`` after the borrow-handoff grace period — but flush it
+        IMMEDIATELY at shutdown: a borrower exiting cleanly inside the
+        grace window must not leak the owner's borrow count forever
+        (the grace-delayed decref would simply never fire)."""
+        token = object()
+
+        def run():
+            self._delayed_refops.pop(token, None)
+            fn()
+
+        handle = asyncio.get_running_loop().call_later(
+            GlobalConfig.borrow_handoff_grace_s, run
+        )
+        self._delayed_refops[token] = (handle, fn)
+
+    def _flush_delayed_refops(self):
+        ops, self._delayed_refops = self._delayed_refops, {}
+        for handle, fn in ops.values():
+            handle.cancel()
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — best-effort at teardown
+                pass
 
     def _send_incref(self, ref: ObjectRef):
         client = self.worker_clients.get(ref.owner_address)
@@ -985,9 +1270,7 @@ class CoreWorker:
 
                 if oid in self._relent_refs:
                     self._relent_refs.discard(oid)
-                    asyncio.get_running_loop().call_later(
-                        GlobalConfig.borrow_handoff_grace_s, fire
-                    )
+                    self._delay_refop(fire)
                 else:
                     fire()
             try:
@@ -1087,7 +1370,7 @@ class CoreWorker:
                 obj.size = ret[2]
             obj.state = READY
             obj.error = None
-            obj.event.set()
+            obj.wake()
             self._maybe_terminate_stream(state)
             return
         obj = self.owned.get(oid)
@@ -1102,7 +1385,7 @@ class CoreWorker:
             obj.size = ret[2]
         obj.state = READY
         obj.error = None
-        obj.event.set()
+        obj.wake()
         state["received"] += 1
         # EVERY ObjectRef handed to the consumer carries one local ref —
         # a retry replay of an index the consumer still holds must not
@@ -1455,6 +1738,19 @@ class CoreWorker:
         refs = []
         return_ids = spec.return_ids()
 
+        # Return-object records are created HERE, on the calling thread,
+        # so an immediate get() on the returned refs finds them and can
+        # take the no-loop-roundtrip fast path (_try_get_sync).  Only
+        # dict/obj mutations — safe under the GIL; the posted setup below
+        # happens-before any reply that could touch them.
+        # Reconstruction eligibility matches the reference: only
+        # retriable tasks re-execute on object loss (a max_retries=0
+        # task may have non-idempotent side effects).
+        lineage = spec if spec.max_retries > 0 else None
+        for oid in return_ids:
+            obj = self._new_owned(oid, lineage=lineage)
+            obj.local_refs += 1
+
         def setup():
             self._hold_args(held)
             self.task_events.record(
@@ -1464,15 +1760,8 @@ class CoreWorker:
                 job_id_hex=spec.job_id.hex(),
                 resources=spec.resources,
             )
-            # Reconstruction eligibility matches the reference: only
-            # retriable tasks re-execute on object loss (a max_retries=0
-            # task may have non-idempotent side effects).
-            lineage = spec if spec.max_retries > 0 else None
             if streaming:
                 self._new_stream(spec.task_id, lineage)
-            for oid in return_ids:
-                obj = self._new_owned(oid, lineage=lineage)
-                obj.local_refs += 1
             pool = self.lease_pools.get(spec.scheduling_class)
             if pool is None:
                 pool = _LeasePool(self, spec.scheduling_class, spec)
@@ -1526,7 +1815,7 @@ class CoreWorker:
                 obj.locations.add(ret[1])
                 obj.size = ret[2]
             obj.state = READY
-            obj.event.set()
+            obj.wake()
             self._maybe_free(oid)
 
     def _fail_task_returns(self, spec: TaskSpec, exc: BaseException):
@@ -1538,11 +1827,11 @@ class CoreWorker:
         if spec.streaming:
             # Item records reset by a failed reconstruction would otherwise
             # stay PENDING forever and hang their getters.
-            for obj in self.owned.values():
+            for obj in list(self.owned.values()):  # user threads insert (submit paths)
                 if obj.lineage is spec and obj.state == PENDING:
                     obj.state = ERROR
                     obj.error = exc
-                    obj.event.set()
+                    obj.wake()
         for oid in spec.return_ids():
             obj = self.owned.get(oid)
             if obj is None:
@@ -1550,7 +1839,7 @@ class CoreWorker:
             self._lineage_detach(obj)  # an errored task is not re-runnable
             obj.state = ERROR
             obj.error = exc
-            obj.event.set()
+            obj.wake()
         self._release_args(spec)
 
     # --------------------------------------------------------------- actors
@@ -1680,6 +1969,12 @@ class CoreWorker:
         spec._held_refs = held  # type: ignore[attr-defined]
         return_ids = spec.return_ids()
 
+        # Created on the calling thread so an immediate get() takes the
+        # sync fast path (see submit_task).
+        for oid in return_ids:
+            obj = self._new_owned(oid)
+            obj.local_refs += 1
+
         def setup():
             self._hold_args(held)
             self.task_events.record(
@@ -1691,9 +1986,6 @@ class CoreWorker:
             )
             if streaming:
                 self._new_stream(spec.task_id, spec)
-            for oid in return_ids:
-                obj = self._new_owned(oid)
-                obj.local_refs += 1
             asyncio.get_running_loop().create_task(self._submit_actor_task(spec))
 
         self._post(setup)
@@ -1983,7 +2275,7 @@ class CoreWorker:
             value,
         )
 
-    async def _execute(self, spec: TaskSpec, fn) -> dict:
+    async def _execute(self, spec: TaskSpec, fn, ticket=None) -> dict:
         from ray_tpu.util.tracing import task_execution_span
 
         ev_kw = {
@@ -1991,10 +2283,17 @@ class CoreWorker:
             "actor_id_hex": spec.actor_id.hex() if spec.actor_id else "",
         }
         self.task_events.record(spec.task_id.hex(), spec.name, "RUNNING", **ev_kw)
-        with task_execution_span(spec):
-            return await self._execute_inner(spec, fn, ev_kw)
+        try:
+            with task_execution_span(spec):
+                return await self._execute_inner(spec, fn, ev_kw, ticket)
+        finally:
+            # A wedged pipeline cursor would stall every later call: any
+            # path that didn't consume the ticket (coroutine fn, streaming,
+            # early error) must release it.
+            if ticket is not None:
+                self._exec_pipeline.abandon(ticket)
 
-    async def _execute_inner(self, spec: TaskSpec, fn, ev_kw) -> dict:
+    async def _execute_inner(self, spec: TaskSpec, fn, ev_kw, ticket=None) -> dict:
         try:
             args, kwargs = await self._resolve_args(spec.args_payload)
             if self._device_transport_active():
@@ -2037,10 +2336,15 @@ class CoreWorker:
                 import contextvars as _cv
 
                 _ctx = _cv.copy_context()
-                result = await loop.run_in_executor(
-                    self._task_executor,
-                    lambda: _ctx.run(fn, *args, **kwargs),
-                )
+                if ticket is not None:
+                    result = await self._exec_pipeline.run_sync(
+                        ticket, _ctx.run, fn, *args, **kwargs
+                    )
+                else:
+                    result = await loop.run_in_executor(
+                        self._task_executor,
+                        lambda: _ctx.run(fn, *args, **kwargs),
+                    )
             if self._device_transport_active():
                 result = self._device_wrap(result)
             returns = await self._package_returns(spec, result)
@@ -2061,8 +2365,18 @@ class CoreWorker:
         spec: TaskSpec = payload["spec"]
         spec._attempt = payload.get("attempt", 0)  # stream notify tagging
         fn = await self._get_function(spec.function_id)
-        async with self._task_semaphore:
-            return await self._execute(spec, fn)
+        # Exclusive execution via the pipeline (ticket order = dispatch
+        # order); coroutine/streaming tasks go through the bridge so the
+        # drainer still provides the mutual exclusion.
+        ticket = self._exec_pipeline.ticket()
+        if spec.streaming or asyncio.iscoroutinefunction(fn):
+            try:
+                return await self._exec_pipeline.run_coro(
+                    ticket, lambda: self._execute(spec, fn)
+                )
+            finally:
+                self._exec_pipeline.abandon(ticket)
+        return await self._execute(spec, fn, ticket=ticket)
 
     async def handle_actor_init(self, payload, conn):
         spec: ActorSpec = payload["spec"]
@@ -2143,11 +2457,27 @@ class CoreWorker:
                 method = _exec
             else:
                 method = getattr(self.actor_instance, method_name)
-            async with self._actor_exec_lock:
-                # Advance as soon as execution begins so max_concurrency > 1
-                # allows overlap.
-                advance()
-                return await self._execute(spec, method)
+            if self.actor_spec is not None and self.actor_spec.max_concurrency > 1:
+                # Overlapping execution: the semaphore bounds concurrency,
+                # the thread pool provides the parallel lanes.
+                async with self._actor_exec_lock:
+                    # Advance as soon as execution begins so overlap is
+                    # possible.
+                    advance()
+                    return await self._execute(spec, method)
+            # max_concurrency == 1: the exec pipeline IS the exclusion.
+            # Ticket before advance() so the next call (released by
+            # advance) cannot overtake this one in execution order.
+            ticket = self._exec_pipeline.ticket()
+            advance()
+            if spec.streaming or asyncio.iscoroutinefunction(method):
+                try:
+                    return await self._exec_pipeline.run_coro(
+                        ticket, lambda: self._execute(spec, method)
+                    )
+                finally:
+                    self._exec_pipeline.abandon(ticket)
+            return await self._execute(spec, method, ticket=ticket)
         except BaseException as e:  # noqa: BLE001 - report as task error
             from .serialization import serialize_to_bytes as _ser
 

@@ -13,6 +13,9 @@ import logging
 import os
 
 
+_profile_exit_hook = lambda: None  # replaced when RAY_TPU_PROFILE_DIR is set
+
+
 def main():
     from .config import GlobalConfig
     from .core_worker import CoreWorker, set_global_worker
@@ -66,9 +69,29 @@ def main():
                     logging.getLogger(__name__).warning(
                         "node agent unreachable; worker exiting"
                     )
+                    _profile_exit_hook()
                     os._exit(1)
 
-    asyncio.run(run())
+    from .core_worker import _maybe_dump_profile, _maybe_start_profile
+
+    global _profile_exit_hook
+    prof = _maybe_start_profile()
+    if prof is not None:
+        # Workers normally die by SIGTERM (agent stop) or the watchdog's
+        # os._exit — both skip the finally below, so dump from a signal
+        # handler / the watchdog hook instead.
+        import signal
+
+        def _dump_and_exit(signum=None, frame=None):
+            _maybe_dump_profile(prof, "worker")
+            os._exit(0)
+
+        _profile_exit_hook = lambda: _maybe_dump_profile(prof, "worker")
+        signal.signal(signal.SIGTERM, _dump_and_exit)
+    try:
+        asyncio.run(run())
+    finally:
+        _maybe_dump_profile(prof, "worker")
 
 
 if __name__ == "__main__":
