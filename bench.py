@@ -38,27 +38,62 @@ BASELINES = {  # reference release/perf_metrics/microbenchmark.json
     "placement_group_create_removal": 679.0,
     # Scalability-envelope analogs (reference release/benchmarks/ — their
     # numbers come from multi-node fleets; ours run on this box).
+    "1_1_actor_calls_concurrent": 4966.0,
+    "1_n_actor_calls_async": 6838.0,
+    "n_n_actor_calls_with_arg_async": 3263.0,
+    "single_client_wait_1k_refs": 4.72,
     "multi_client_tasks_async": 20114.0,
     "many_actors_launch_per_s": 404.0,
     "many_tasks_per_s": 583.0,
     "many_pgs_per_s": 18.9,
 }
 
+# Stages whose published baselines come from multi-node FLEET deadline
+# tests (reference release/benchmarks/), not a single box: a 1-box ratio
+# against them is apples-to-oranges, so vs_baseline is suppressed and the
+# record is tagged not-comparable.
+FLEET_BASELINE_METRICS = {
+    "many_actors_launch_per_s", "many_tasks_per_s", "many_pgs_per_s",
+    "multi_client_tasks_async",
+}
+
+_ALL_RECORDS = []  # every emitted record, re-printed in the final summary
+
 
 def emit(metric, value, unit, baseline=None):
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": round(float(value), 4),
-                "unit": unit,
-                "vs_baseline": (
-                    round(float(value) / baseline, 3) if baseline else None
-                ),
-            }
+    rec = {
+        "metric": metric,
+        "value": round(float(value), 4),
+        "unit": unit,
+        "vs_baseline": (
+            round(float(value) / baseline, 3) if baseline else None
         ),
-        flush=True,
-    )
+    }
+    if metric in FLEET_BASELINE_METRICS:
+        rec["vs_baseline"] = None
+        rec["baseline_comparable"] = False
+        if baseline:
+            rec["fleet_baseline"] = baseline
+    _ALL_RECORDS.append(rec)
+    print(json.dumps(rec), flush=True)
+
+
+def emit_summary():
+    """Re-emit every metric at the very end of stdout.
+
+    The driver records only the TAIL of this process's output — round 3
+    lost its MFU/tokens/decode headline numbers because the model suite
+    printed first and scrolled out.  Model + scaling metrics are re-emitted
+    LAST so even a short tail contains them."""
+    if not _ALL_RECORDS:
+        return
+    print("=== SUMMARY (all metrics re-emitted; model/scaling last) ===",
+          flush=True)
+    core = [r for r in _ALL_RECORDS if r["metric"] in BASELINES
+            or r["metric"].startswith(("single_client", "wide_get"))]
+    model = [r for r in _ALL_RECORDS if r not in core]
+    for rec in core + model:
+        print(json.dumps(rec), flush=True)
 
 
 # ---------------------------------------------------------------- TPU model
@@ -288,6 +323,76 @@ def run_control_plane_suite():
             "n_n_actor_calls_async", best_of(3, nn_async),
             "calls/s", BASELINES["n_n_actor_calls_async"],
         )
+
+        # n:n with a 100KB payload arg (reference
+        # n_n_actor_calls_with_arg_async: measures arg serialization +
+        # inline-transfer overhead on the same fan-out).
+        arg = b"x" * (100 * 1024)
+
+        @ray_tpu.remote
+        class Sink:
+            def sink(self, blob):
+                return len(blob)
+
+        # reuse the 4 CPU slots: replace ping actors with sink actors
+        for b in actors:
+            ray_tpu.kill(b)
+        sinks = [Sink.remote() for _ in range(4)]
+        ray_tpu.get([s.sink.remote(b"") for s in sinks], timeout=60)
+
+        def nn_with_arg(n=400):
+            t0 = time.perf_counter()
+            refs = [sinks[i % 4].sink.remote(arg) for i in range(n)]
+            ray_tpu.get(refs, timeout=300)
+            return n / (time.perf_counter() - t0)
+
+        emit(
+            "n_n_actor_calls_with_arg_async", best_of(3, nn_with_arg),
+            "calls/s", BASELINES["n_n_actor_calls_with_arg_async"],
+        )
+        for s in sinks:
+            ray_tpu.kill(s)
+
+        # 1:1 concurrent: one caller, one actor with max_concurrency=16
+        # (reference 1_1_actor_calls_concurrent — overlapping execution
+        # through the thread-pool lanes instead of the exclusive pipeline).
+        @ray_tpu.remote(max_concurrency=16)
+        class Conc:
+            def ping(self):
+                return b"ok"
+
+        c = Conc.remote()
+        ray_tpu.get(c.ping.remote(), timeout=60)
+
+        def concurrent_calls(n=1000):
+            t0 = time.perf_counter()
+            ray_tpu.get([c.ping.remote() for _ in range(n)], timeout=300)
+            return n / (time.perf_counter() - t0)
+
+        emit(
+            "1_1_actor_calls_concurrent", best_of(3, concurrent_calls),
+            "calls/s", BASELINES["1_1_actor_calls_concurrent"],
+        )
+        ray_tpu.kill(c)
+
+        # 1:n — one caller fanning out over 4 actors is the n_n stage
+        # above on this 4-slot box; the reference's distinct 1:n spreads
+        # over a fleet.  Measure it anyway as its own axis (same actors
+        # count as the reference uses per-core).
+        fan = [Actor.remote() for _ in range(4)]
+        ray_tpu.get([b.ping.remote() for b in fan], timeout=60)
+
+        def one_n_async(n=1200):
+            t0 = time.perf_counter()
+            refs = [fan[i % 4].ping.remote() for i in range(n)]
+            ray_tpu.get(refs, timeout=300)
+            return n / (time.perf_counter() - t0)
+
+        emit(
+            "1_n_actor_calls_async", best_of(3, one_n_async),
+            "calls/s", BASELINES["1_n_actor_calls_async"],
+        )
+        actors = fan  # freed below
         # Free the 4 CPUs before the PG stage — with them held, the
         # {"CPU": 1} bundle below can never be placed.
         for b in actors:
@@ -419,6 +524,24 @@ def run_control_plane_suite():
         for pg in pgs:
             remove_placement_group(pg)
 
+        # wait over 1k ready refs (reference single_client_wait_1k_refs)
+        wrefs = [ray_tpu.put(b"x") for _ in range(1000)]
+
+        def wait_1k(n=10):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                ready, _pending = ray_tpu.wait(
+                    wrefs, num_returns=len(wrefs), timeout=60
+                )
+                assert len(ready) == len(wrefs)
+            return n / (time.perf_counter() - t0)
+
+        emit(
+            "single_client_wait_1k_refs", best_of(3, wait_1k),
+            "ops/s", BASELINES["single_client_wait_1k_refs"],
+        )
+        del wrefs
+
         # single-node limits probe: one wide get over thousands of refs
         refs = [ray_tpu.put(b"x") for _ in range(3000)]
         t0 = time.perf_counter()
@@ -494,6 +617,7 @@ def main():
         run_scaling_suite()
     if only in ("all", "core"):
         run_control_plane_suite()
+    emit_summary()
 
 
 if __name__ == "__main__":

@@ -9,8 +9,8 @@ reference ships ``fake_cgroup_driver.h`` for the same reason — cgroup
 writes need root + a v2 mount, which CI may not have).
 
 Enabled by the ``enable_resource_isolation`` knob; the node agent then
-creates ``<root>/ray_tpu_<session>/workers`` with memory/cpu limits and
-attaches every spawned worker pid.
+creates a flat ``<root>/ray_tpu_<session>_workers`` group with memory/cpu
+limits and attaches every spawned worker pid.
 """
 
 from __future__ import annotations
@@ -69,10 +69,33 @@ class Cgroup2Driver(CgroupDriver):
             logger.warning("cgroup attach pid %d failed: %s", pid, e)
 
     def remove_group(self, group: str) -> None:
-        try:
-            os.rmdir(group)
-        except OSError:
-            pass
+        """rmdir with a short retry: the agent kills workers immediately
+        before cleanup, and cgroup.procs often still lists the dying pids
+        — an immediate rmdir fails with EBUSY and stale
+        ``ray_tpu_<session>_workers`` groups would accumulate.  Remaining
+        pids are migrated to the root group on the last attempt."""
+        import time as _time
+
+        for attempt in range(10):
+            try:
+                os.rmdir(group)
+                return
+            except OSError:
+                if attempt == 8:
+                    # Last resort: move stragglers to the root cgroup so
+                    # the rmdir can succeed.
+                    try:
+                        procs = os.path.join(group, "cgroup.procs")
+                        root_procs = os.path.join(self.root, "cgroup.procs")
+                        with open(procs) as f:
+                            pids = f.read().split()
+                        for pid in pids:
+                            with open(root_procs, "w") as f:
+                                f.write(pid)
+                    except OSError:
+                        pass
+                _time.sleep(0.1)
+        logger.warning("could not remove cgroup %s (still busy)", group)
 
 
 class FakeCgroupDriver(CgroupDriver):

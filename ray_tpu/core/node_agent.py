@@ -36,6 +36,18 @@ from .task_spec import ActorSpec
 logger = logging.getLogger(__name__)
 
 
+def _sched_idle():
+    """preexec_fn: run the child under SCHED_IDLE (falls back to nice 19
+    where unavailable) so prestart imports only use otherwise-idle CPU."""
+    try:
+        os.sched_setscheduler(0, os.SCHED_IDLE, os.sched_param(0))
+    except Exception:  # noqa: BLE001
+        try:
+            os.nice(19)
+        except Exception:  # noqa: BLE001
+            pass
+
+
 class WorkerHandle:
     def __init__(self, worker_id: WorkerID, proc: subprocess.Popen, env_key: tuple):
         self.worker_id = worker_id
@@ -254,7 +266,9 @@ class NodeAgent:
             await asyncio.sleep(period)
 
     # --------------------------------------------------------------- workers
-    def _spawn_worker(self, env_extra: Dict[str, str], env_key: tuple) -> WorkerHandle:
+    def _spawn_worker(
+        self, env_extra: Dict[str, str], env_key: tuple, nice: bool = False
+    ) -> WorkerHandle:
         worker_id = WorkerID.from_random()
         env = dict(os.environ)
         env.update(env_extra)
@@ -277,6 +291,9 @@ class NodeAgent:
             stdout=out,
             stderr=subprocess.STDOUT,
             start_new_session=True,
+            # Prestarted workers import under SCHED_IDLE so pool refill
+            # only uses CPU nothing else wants; restored on pop.
+            preexec_fn=_sched_idle if nice else None,
         )
         handle = WorkerHandle(worker_id, proc, env_key)
         self.isolation.attach_worker(proc.pid)
@@ -325,7 +342,17 @@ class NodeAgent:
     async def _prestart_loop(self):
         key = self._default_env_key
         while True:
-            if self._pool_floor() - len(self.idle_pool.get(key, [])) <= 0:
+            # Task-leased default-env workers count toward the floor: on a
+            # saturated node every slot is busy doing real work, spawning
+            # "replacements" would only steal CPU from it, and task leases
+            # RETURN their workers to the pool.  Actor-held workers do not
+            # count — an actor keeps its process until death, so its pool
+            # slot is genuinely consumed and must be refilled.
+            have = len(self.idle_pool.get(key, [])) + sum(
+                1 for h in self.workers.values()
+                if h.leased and not h.is_actor and h.env_key == key
+            )
+            if self._pool_floor() - have <= 0:
                 return
             quiet = time.monotonic() - self._last_pop
             if quiet < 0.5:
@@ -342,8 +369,20 @@ class NodeAgent:
                     continue
             handle = None
             try:
-                handle = self._spawn_worker(dict(self._default_env), key)
+                handle = self._spawn_worker(
+                    dict(self._default_env), key, nice=True
+                )
                 await self._wait_worker_ready(handle)
+                # Only the interpreter-import phase rides SCHED_IDLE; a
+                # registered idle worker must run at normal priority or a
+                # busy box starves its agent-liveness pings and the
+                # watchdog kills it.
+                try:
+                    os.sched_setscheduler(
+                        handle.proc.pid, os.SCHED_OTHER, os.sched_param(0)
+                    )
+                except Exception:  # noqa: BLE001
+                    pass
                 if handle.proc.poll() is None and not handle.leased:
                     self.idle_pool.setdefault(key, []).append(handle)
             except Exception:  # noqa: BLE001 — prestart is best-effort
@@ -396,6 +435,12 @@ class NodeAgent:
                 raise
             return handle
         handle.leased = True
+        try:  # restore normal scheduling (prestarted under SCHED_IDLE)
+            os.sched_setscheduler(
+                handle.proc.pid, os.SCHED_OTHER, os.sched_param(0)
+            )
+        except Exception:  # noqa: BLE001
+            pass
         return handle
 
     def _return_worker(self, handle: WorkerHandle):
@@ -953,6 +998,47 @@ def main():
             os.unlink(arena_path(session_id))
         except OSError:
             pass
+        try:
+            os.unlink(arena_path(session_id) + ".owner")
+        except OSError:
+            pass
+
+    if args.owns_session_shm == "1":
+        # This agent owns its session's arena: stamp ownership (pid +
+        # starttime, PID-reuse-proof) and sweep arenas orphaned by
+        # SIGKILLed heads of PAST sessions — their reaper never ran, and
+        # nothing else ever deletes them (head-owned cleanup).
+        from .object_store import arena_path as _ap
+        from .reaper import _proc_start_time
+        from .shm import SHM_DIR, _PREFIX
+
+        try:
+            with open(_ap(args.session_id) + ".owner", "w") as f:
+                f.write(f"{os.getpid()} {_proc_start_time(os.getpid())}")
+        except OSError:
+            pass
+        for fname in os.listdir(SHM_DIR):
+            if not (fname.startswith(f"{_PREFIX}_") and
+                    fname.endswith("_arena")):
+                continue
+            path = os.path.join(SHM_DIR, fname)
+            if path == _ap(args.session_id):
+                continue
+            try:
+                with open(path + ".owner") as f:
+                    pid_s, _, start_s = f.read().partition(" ")
+                alive = _proc_start_time(int(pid_s)) == start_s
+            except (OSError, ValueError):
+                # No ownership stamp: NEVER assume dead (mmap writes don't
+                # reliably bump mtime, so age is not proof) — leave it.
+                continue
+            if not alive:
+                logger.info("sweeping orphan session arena %s", fname)
+                for p in (path, path + ".owner"):
+                    try:
+                        os.unlink(p)
+                    except OSError:
+                        pass
 
     from .reaper import watch_parent_process
 

@@ -163,6 +163,7 @@ class RpcServer:
         hcache: Dict[str, tuple] = {}
         try:
             while True:
+                await conn.wait_writable()
                 try:
                     frame = await _read_frame(reader)
                 except (asyncio.IncompleteReadError, ConnectionError):
@@ -285,6 +286,7 @@ class ServerConnection:
         self._writer = writer
         self._wbuf = bytearray()
         self._flush_scheduled = False
+        self._drain_task: Optional[asyncio.Task] = None
         self.closed = False  # set on teardown; grant paths check liveness
         self.metadata: Dict[str, Any] = {}  # handlers can stash identity here
 
@@ -299,11 +301,41 @@ class ServerConnection:
         self._flush_scheduled = False
         if not self._wbuf:
             return
+        if self._drain_task is not None and not self._drain_task.done():
+            # Transport backed up by a slow peer: keep frames in _wbuf
+            # (bounded because the server stops reading this connection —
+            # see wait_writable) until the drain completes.
+            return
         data, self._wbuf = self._wbuf, bytearray()
         try:
             self._writer.write(data)
+            if self._writer.transport.get_write_buffer_size() > (4 << 20):
+                self._drain_task = asyncio.get_running_loop().create_task(
+                    self._await_drain()
+                )
         except Exception:  # connection torn down mid-flush
             pass
+
+    async def _await_drain(self):
+        try:
+            await self._writer.drain()
+        except Exception:  # noqa: BLE001 — peer gone; read side closes us
+            pass
+        if self._wbuf and not self._flush_scheduled:
+            self._flush_scheduled = True
+            asyncio.get_running_loop().call_soon(self._flush)
+
+    async def wait_writable(self):
+        """Backpressure hook for the server's read loop: while this
+        connection's write side is overloaded, stop dispatching more of
+        its requests (sync-handler replies via send_nowait never await, so
+        only pausing intake bounds a slow reader's buffer)."""
+        task = self._drain_task
+        if task is not None and not task.done():
+            try:
+                await asyncio.shield(task)
+            except Exception:  # noqa: BLE001
+                pass
 
     async def send(self, frame):
         self.send_nowait(frame)
@@ -354,6 +386,7 @@ class RpcClient:
         self._wbuf = bytearray()
         self._flush_scheduled = False
         self._batch_buf: list = []
+        self._batch_bytes = 0
         self._batch_scheduled = False
         self._loop = None
         self._read_task = None
@@ -389,10 +422,33 @@ class RpcClient:
     # — semantics identical to individual calls.
     _BATCH_MAX_FRAMES = 256  # bound un-flushed batch memory before the
     # 4 MB transport backpressure check in call() can see the bytes
+    _BATCH_MAX_BYTES = 4 << 20  # same threshold as the transport check —
+    # 256 frames of ~100KB inline args would otherwise hold ~25MB unseen
+
+    @staticmethod
+    def _approx_frame_bytes(frame) -> int:
+        """Cheap payload-size estimate: the dominant bytes in a batched
+        frame are inline args/returns (bytes) or a TaskSpec's
+        args_payload; everything else is a small envelope."""
+        payload = frame[2]
+        n = 256
+        values = payload.values() if isinstance(payload, dict) else (payload,)
+        for v in values:
+            if isinstance(v, (bytes, bytearray, memoryview)):
+                n += len(v)
+            else:
+                ap = getattr(v, "args_payload", None)
+                if isinstance(ap, (bytes, bytearray, memoryview)):
+                    n += len(ap)
+        return n
 
     def _queue_batched(self, frame):
         self._batch_buf.append(frame)
-        if len(self._batch_buf) >= self._BATCH_MAX_FRAMES:
+        self._batch_bytes += self._approx_frame_bytes(frame)
+        if (
+            len(self._batch_buf) >= self._BATCH_MAX_FRAMES
+            or self._batch_bytes >= self._BATCH_MAX_BYTES
+        ):
             self._flush_batch()
         elif not self._batch_scheduled:
             self._batch_scheduled = True
@@ -401,6 +457,7 @@ class RpcClient:
     def _flush_batch(self):
         self._batch_scheduled = False
         items, self._batch_buf = self._batch_buf, []
+        self._batch_bytes = 0
         if not items:
             return
         if len(items) == 1:
@@ -560,7 +617,18 @@ class RetryableRpcClient:
                 return await client.call(method, payload, timeout, batch=batch)
             except (RpcConnectionError, ConnectionError, OSError, asyncio.TimeoutError) as e:
                 last_exc = e
-                self._client = None
+                # CLOSE the old client, never abandon it: a per-call
+                # timeout on a healthy socket would otherwise leave a
+                # zombie connection that servers treat as this client's
+                # liveness signal (e.g. connection-owned leases on the
+                # node agent get reaped whenever the zombie's socket
+                # finally dies — long after this client reconnected).
+                dropped, self._client = self._client, None
+                if dropped is not None:
+                    try:
+                        await dropped.close()
+                    except Exception:  # noqa: BLE001
+                        pass
                 await asyncio.sleep(delay)
                 delay = min(delay * 2, GlobalConfig.rpc_retry_max_delay_s)
         raise RpcConnectionError(

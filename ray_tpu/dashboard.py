@@ -110,7 +110,70 @@ def start_dashboard(
         )
 
     async def jobs(request):
-        return _json(await run_sync(state_api.list_jobs))
+        """Driver jobs (cluster state) + submission jobs (REST-managed)
+        in one listing: driver jobs carry ``job_id``, submissions carry
+        ``submission_id`` — the client filters by the field it knows."""
+        driver_jobs = await run_sync(state_api.list_jobs)
+        try:
+            subs = await run_sync(
+                lambda: [j.__dict__ for j in _job_client().list_jobs()]
+            )
+        except Exception:  # noqa: BLE001 — submissions list is best-effort
+            subs = []
+        return _json(driver_jobs + subs)
+
+    # ---- REST job submission (reference: dashboard/modules/job/
+    # job_manager.py:61 + sdk.py:36 — JobSubmissionClient speaks HTTP to
+    # the dashboard; the implementation behind the endpoint is the
+    # supervisor-actor machinery in ray_tpu.job).
+    def _job_client():
+        from .job.sdk import JobSubmissionClient
+
+        return JobSubmissionClient()
+
+    async def submit_job(request):
+        body = await request.json()
+        if "entrypoint" not in body:
+            return _json({"error": "entrypoint required"}, status=400)
+        try:
+            sid = await run_sync(
+                lambda: _job_client().submit_job(
+                    entrypoint=body["entrypoint"],
+                    submission_id=body.get("submission_id"),
+                    runtime_env=body.get("runtime_env"),
+                    metadata=body.get("metadata"),
+                )
+            )
+        except ValueError as e:
+            return _json({"error": str(e)}, status=409)
+        except Exception as e:  # noqa: BLE001
+            return _json({"error": str(e)}, status=500)
+        return _json({"submission_id": sid})
+
+    async def job_info(request):
+        sid = request.match_info["sid"]
+        info = await run_sync(lambda: _job_client().get_job_info(sid))
+        if info is None:
+            return _json({"error": f"no job {sid}"}, status=404)
+        return _json(info.__dict__)
+
+    async def job_logs(request):
+        sid = request.match_info["sid"]
+        text = await run_sync(lambda: _job_client().get_job_logs(sid))
+        return _json({"logs": text})
+
+    async def job_stop(request):
+        sid = request.match_info["sid"]
+        ok = await run_sync(lambda: _job_client().stop_job(sid))
+        return _json({"stopped": bool(ok)})
+
+    async def job_delete(request):
+        sid = request.match_info["sid"]
+        try:
+            ok = await run_sync(lambda: _job_client().delete_job(sid))
+        except RuntimeError as e:
+            return _json({"error": str(e)}, status=400)
+        return _json({"deleted": bool(ok)})
 
     async def pgs(request):
         return _json(await run_sync(state_api.list_placement_groups))
@@ -133,6 +196,11 @@ def start_dashboard(
     app.router.add_get("/api/actors", actors)
     app.router.add_get("/api/tasks", tasks)
     app.router.add_get("/api/jobs", jobs)
+    app.router.add_post("/api/jobs", submit_job)
+    app.router.add_get("/api/jobs/{sid}", job_info)
+    app.router.add_get("/api/jobs/{sid}/logs", job_logs)
+    app.router.add_post("/api/jobs/{sid}/stop", job_stop)
+    app.router.add_delete("/api/jobs/{sid}", job_delete)
     app.router.add_get("/api/placement_groups", pgs)
     app.router.add_get("/api/timeline", timeline)
     app.router.add_get("/metrics", metrics)

@@ -156,8 +156,21 @@ def _supervisor_name(submission_id: str) -> str:
 
 class JobSubmissionClient:
     """Submit and manage jobs on a running cluster (ray
-    ``dashboard/modules/job/sdk.py:36`` analog; transport is the cluster's
-    own actor RPC instead of the dashboard's REST endpoint)."""
+    ``dashboard/modules/job/sdk.py:36`` analog).
+
+    Two transports, chosen by the address scheme:
+
+    - ``http://host:port`` — REST against the dashboard's ``/api/jobs``
+      endpoints, from OUTSIDE the cluster (no ray_tpu.init), exactly like
+      the reference client.
+    - anything else (or None) — the cluster's own actor RPC from a
+      connected driver.
+    """
+
+    def __new__(cls, address: Optional[str] = None):
+        if address and address.startswith(("http://", "https://")):
+            return object.__new__(_HttpJobSubmissionClient)
+        return object.__new__(cls)
 
     def __init__(self, address: Optional[str] = None):
         import ray_tpu
@@ -252,3 +265,77 @@ class JobSubmissionClient:
                 return status
             time.sleep(poll_s)
         raise TimeoutError(f"job {submission_id} still running after {timeout}s")
+
+
+class _HttpJobSubmissionClient(JobSubmissionClient):
+    """REST transport: talks to the dashboard's /api/jobs endpoints with
+    stdlib urllib — usable from a machine that is NOT part of the cluster
+    (reference: dashboard/modules/job/sdk.py:36)."""
+
+    def __init__(self, address: str):
+        self._base = address.rstrip("/")
+
+    def _request(self, method: str, path: str, body: Optional[dict] = None):
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        data = _json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self._base + path, data=data, method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                return _json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            payload = e.read().decode()
+            try:
+                msg = _json.loads(payload).get("error", payload)
+            except ValueError:
+                msg = payload
+            if e.code == 404:
+                return None
+            if e.code == 409:
+                raise ValueError(msg) from None
+            raise RuntimeError(f"HTTP {e.code}: {msg}") from None
+
+    def submit_job(self, *, entrypoint: str, submission_id=None,
+                   runtime_env=None, metadata=None) -> str:
+        reply = self._request("POST", "/api/jobs", {
+            "entrypoint": entrypoint,
+            "submission_id": submission_id,
+            "runtime_env": runtime_env,
+            "metadata": metadata,
+        })
+        return reply["submission_id"]
+
+    def get_job_info(self, submission_id: str) -> Optional[JobInfo]:
+        reply = self._request("GET", f"/api/jobs/{submission_id}")
+        if reply is None:
+            return None
+        return JobInfo(**{k: reply[k] for k in JobInfo.__dataclass_fields__})
+
+    def get_job_logs(self, submission_id: str) -> str:
+        reply = self._request("GET", f"/api/jobs/{submission_id}/logs")
+        return "" if reply is None else reply.get("logs", "")
+
+    def stop_job(self, submission_id: str) -> bool:
+        reply = self._request("POST", f"/api/jobs/{submission_id}/stop")
+        return bool(reply and reply.get("stopped"))
+
+    def delete_job(self, submission_id: str) -> bool:
+        reply = self._request("DELETE", f"/api/jobs/{submission_id}")
+        return bool(reply and reply.get("deleted"))
+
+    def list_jobs(self) -> List[JobInfo]:
+        reply = self._request("GET", "/api/jobs") or []
+        out = []
+        for rec in reply:
+            try:
+                out.append(JobInfo(**{
+                    k: rec[k] for k in JobInfo.__dataclass_fields__
+                }))
+            except (KeyError, TypeError):
+                continue
+        return out

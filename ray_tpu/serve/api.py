@@ -34,14 +34,27 @@ def _get_or_create_controller():
         ).remote()
 
 
-def run(app, name: str = "", route_prefix: Optional[str] = None) -> DeploymentHandle:
+def run(
+    app,
+    name: str = "",
+    route_prefix: Optional[str] = None,
+    local_testing_mode: bool = False,
+) -> DeploymentHandle:
     """Deploy an Application (or bare Deployment) and return its handle.
 
     Composition: ``.bind()`` arguments may themselves be bound applications
     (``Pipeline.bind(model=Model.bind())``) — children deploy first and
     arrive in the parent's constructor as ``DeploymentHandle``s (reference:
     the deployment-graph build in ray ``serve/_private/build_app.py``).
+
+    ``local_testing_mode=True`` runs the whole graph in THIS process — no
+    cluster, no controller, no replica actors; the same handle surface
+    backed by plain objects (reference: serve/local_testing_mode.py).
     """
+    if local_testing_mode:
+        from .local_mode import run_local
+
+        return run_local(app)
     if isinstance(app, Deployment):
         app = Application(app)
     if not isinstance(app, Application):
@@ -120,15 +133,27 @@ def deploy_config(config: Dict[str, Any]) -> Dict[str, DeploymentHandle]:
 
 
 def get_handle(name: str) -> DeploymentHandle:
+    from . import local_mode
+
+    if name in local_mode._registry:
+        return local_mode.get_local_handle(name)
     return DeploymentHandle(name)
 
 
 def status() -> Dict[str, Any]:
+    from . import local_mode
+
+    if local_mode._active:
+        return local_mode.local_status()
     controller = _get_or_create_controller()
     return ray_tpu.get(controller.status.remote(), timeout=30)
 
 
 def delete(name: str) -> bool:
+    from . import local_mode
+
+    if local_mode._active:
+        return local_mode.delete_local(name)
     controller = _get_or_create_controller()
     return ray_tpu.get(controller.delete_deployment.remote(name), timeout=60)
 
@@ -136,7 +161,9 @@ def delete(name: str) -> bool:
 def shutdown():
     from .grpc_ingress import stop_grpc_ingress
     from .long_poll import reset_client
+    from .local_mode import shutdown_local
 
+    shutdown_local()
     reset_client()
     stop_http_proxy()
     stop_grpc_ingress()

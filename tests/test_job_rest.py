@@ -1,0 +1,59 @@
+"""REST job submission over the dashboard (reference:
+dashboard/modules/job/job_manager.py:61 + sdk.py:36 — the client speaks
+HTTP only; the cluster connection lives on the dashboard side)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.job.sdk import JobStatus, JobSubmissionClient
+
+
+@pytest.fixture(scope="module")
+def dashboard_url():
+    ray_tpu.init(num_cpus=4)
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+    url = start_dashboard(port=8277)
+    yield url
+    stop_dashboard()
+    ray_tpu.shutdown()
+
+
+def test_http_client_selected_by_scheme(dashboard_url):
+    from ray_tpu.job.sdk import _HttpJobSubmissionClient
+
+    client = JobSubmissionClient(address=dashboard_url)
+    assert isinstance(client, _HttpJobSubmissionClient)
+
+
+def test_rest_job_lifecycle(dashboard_url):
+    client = JobSubmissionClient(address=dashboard_url)
+    sid = client.submit_job(
+        entrypoint="echo rest-job-ran && echo done-marker",
+        metadata={"who": "rest-test"},
+    )
+    status = client.wait_until_finished(sid, timeout=120)
+    assert status == JobStatus.SUCCEEDED
+    info = client.get_job_info(sid)
+    assert info.entrypoint.startswith("echo")
+    assert info.metadata == {"who": "rest-test"}
+    assert info.driver_exit_code == 0
+    assert "done-marker" in client.get_job_logs(sid)
+    assert sid in [j.submission_id for j in client.list_jobs()]
+    assert client.delete_job(sid)
+    assert client.get_job_info(sid) is None
+
+
+def test_rest_job_stop_and_errors(dashboard_url):
+    client = JobSubmissionClient(address=dashboard_url)
+    sid = client.submit_job(entrypoint="sleep 60")
+    assert client.stop_job(sid)
+    assert client.wait_until_finished(sid, timeout=60) == JobStatus.STOPPED
+    # duplicate id -> 409 -> ValueError
+    sid2 = client.submit_job(entrypoint="echo x")
+    client.wait_until_finished(sid2, timeout=60)
+    with pytest.raises(ValueError):
+        client.submit_job(entrypoint="echo y", submission_id=sid2)
+    # unknown job -> None / False
+    assert client.get_job_info("nope") is None
+    assert client.stop_job("nope") is False

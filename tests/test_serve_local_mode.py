@@ -1,0 +1,122 @@
+"""serve local testing mode: full deployment-graph semantics, zero cluster.
+
+Mirrors the cluster-backed tests in test_serve.py but runs entirely
+in-process (reference: python/ray/serve/local_testing_mode.py) — these
+should run orders of magnitude faster since nothing spawns.
+"""
+
+import pytest
+
+from ray_tpu import serve
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    from ray_tpu.serve.local_mode import shutdown_local
+
+    shutdown_local()
+
+
+def test_function_deployment_local():
+    @serve.deployment
+    def double(x):
+        return 2 * x
+
+    handle = serve.run(double.bind(), local_testing_mode=True)
+    assert handle.remote(21).result() == 42
+
+
+def test_class_deployment_with_state_local():
+    @serve.deployment
+    class Counter:
+        def __init__(self):
+            self.v = 0
+
+        def __call__(self):
+            self.v += 1
+            return self.v
+
+        def peek(self):
+            return self.v
+
+    handle = serve.run(Counter.bind(), local_testing_mode=True)
+    assert handle.remote().result() == 1
+    assert handle.remote().result() == 2
+    assert handle.peek.remote().result() == 2
+
+
+def test_composition_local():
+    @serve.deployment
+    class Model:
+        def __call__(self, x):
+            return x + 1
+
+    @serve.deployment
+    class Pipeline:
+        def __init__(self, model):
+            self.model = model
+
+        def __call__(self, x):
+            return self.model.remote(x).result() * 10
+
+    handle = serve.run(
+        Pipeline.bind(Model.bind()), local_testing_mode=True
+    )
+    assert handle.remote(1).result() == 20
+
+
+def test_batching_local():
+    @serve.deployment
+    class Batched:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.05)
+        async def __call__(self, xs):
+            self.batch_sizes.append(len(xs))
+            return [x * 10 for x in xs]
+
+        def seen(self):
+            return self.batch_sizes
+
+    handle = serve.run(Batched.bind(), local_testing_mode=True)
+    responses = [handle.remote(i) for i in range(8)]
+    assert [r.result() for r in responses] == [i * 10 for i in range(8)]
+    assert max(handle.seen.remote().result()) > 1
+
+
+def test_streaming_local():
+    @serve.deployment
+    class Streamer:
+        def __call__(self, n):
+            for i in range(n):
+                yield i * i
+
+    handle = serve.run(Streamer.bind(), local_testing_mode=True)
+    out = list(handle.options(stream=True).remote(4))
+    assert out == [0, 1, 4, 9]
+
+
+def test_async_generator_streaming_local():
+    @serve.deployment
+    class AStream:
+        async def __call__(self, n):
+            for i in range(n):
+                yield i + 100
+
+    handle = serve.run(AStream.bind(), local_testing_mode=True)
+    assert list(handle.options(stream=True).remote(3)) == [100, 101, 102]
+
+
+def test_status_delete_get_handle_local():
+    @serve.deployment(name="temp")
+    def t():
+        return 1
+
+    serve.run(t.bind(), local_testing_mode=True)
+    assert serve.status()["temp"]["num_replicas"] == 1
+    h = serve.get_handle("temp")
+    assert h.remote().result() == 1
+    assert serve.delete("temp")
+    assert "temp" not in serve.status()
