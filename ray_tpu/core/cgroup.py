@@ -74,26 +74,33 @@ class Cgroup2Driver(CgroupDriver):
         — an immediate rmdir fails with EBUSY and stale
         ``ray_tpu_<session>_workers`` groups would accumulate.  Remaining
         pids are migrated to the root group on the last attempt."""
+        import errno
         import time as _time
 
         for attempt in range(10):
             try:
                 os.rmdir(group)
                 return
-            except OSError:
+            except OSError as e:
+                if e.errno == errno.ENOENT:
+                    return  # never created / already removed
                 if attempt == 8:
                     # Last resort: move stragglers to the root cgroup so
-                    # the rmdir can succeed.
+                    # the rmdir can succeed.  Per-pid — a single dead pid
+                    # (ESRCH) must not abort migrating the live ones.
                     try:
                         procs = os.path.join(group, "cgroup.procs")
                         root_procs = os.path.join(self.root, "cgroup.procs")
                         with open(procs) as f:
                             pids = f.read().split()
-                        for pid in pids:
+                    except OSError:
+                        pids = []
+                    for pid in pids:
+                        try:
                             with open(root_procs, "w") as f:
                                 f.write(pid)
-                    except OSError:
-                        pass
+                        except OSError:
+                            pass
                 _time.sleep(0.1)
         logger.warning("could not remove cgroup %s (still busy)", group)
 

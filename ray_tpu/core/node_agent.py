@@ -292,7 +292,8 @@ class NodeAgent:
             stderr=subprocess.STDOUT,
             start_new_session=True,
             # Prestarted workers import under SCHED_IDLE so pool refill
-            # only uses CPU nothing else wants; restored on pop.
+            # only uses CPU nothing else wants; _prestart_loop restores
+            # SCHED_OTHER once the worker registers (before pooling).
             preexec_fn=_sched_idle if nice else None,
         )
         handle = WorkerHandle(worker_id, proc, env_key)
@@ -435,12 +436,6 @@ class NodeAgent:
                 raise
             return handle
         handle.leased = True
-        try:  # restore normal scheduling (prestarted under SCHED_IDLE)
-            os.sched_setscheduler(
-                handle.proc.pid, os.SCHED_OTHER, os.sched_param(0)
-            )
-        except Exception:  # noqa: BLE001
-            pass
         return handle
 
     def _return_worker(self, handle: WorkerHandle):
@@ -1034,11 +1029,16 @@ def main():
                 continue
             if not alive:
                 logger.info("sweeping orphan session arena %s", fname)
-                for p in (path, path + ".owner"):
-                    try:
-                        os.unlink(p)
-                    except OSError:
-                        pass
+                # The whole dead session's shm: arena + per-object
+                # segments (rtpu_<sid>_<objhex>) + owner stamp.
+                from .shm import cleanup_session
+
+                dead_sid = fname[len(_PREFIX) + 1:-len("_arena")]
+                cleanup_session(dead_sid)
+                try:
+                    os.unlink(path + ".owner")
+                except OSError:
+                    pass
 
     from .reaper import watch_parent_process
 

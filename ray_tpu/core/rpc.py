@@ -615,14 +615,20 @@ class RetryableRpcClient:
             try:
                 client = await self._ensure()
                 return await client.call(method, payload, timeout, batch=batch)
-            except (RpcConnectionError, ConnectionError, OSError, asyncio.TimeoutError) as e:
+            except (
+                RpcConnectionError, ConnectionError, OSError,
+                asyncio.TimeoutError,
+            ) as e:
+                # NOTE: only transport-level failures land here —
+                # asyncio.TimeoutError can come solely from connect()
+                # (per-call deadlines surface as RpcError, which
+                # deliberately propagates without dropping the client, so
+                # a busy server never costs the shared connection its
+                # connection-owned server state, e.g. leases).
                 last_exc = e
-                # CLOSE the old client, never abandon it: a per-call
-                # timeout on a healthy socket would otherwise leave a
-                # zombie connection that servers treat as this client's
-                # liveness signal (e.g. connection-owned leases on the
-                # node agent get reaped whenever the zombie's socket
-                # finally dies — long after this client reconnected).
+                # Transport actually failed: CLOSE the old client (never
+                # abandon it — its half-dead socket would leak an FD and
+                # linger as a stale liveness signal) and reconnect.
                 dropped, self._client = self._client, None
                 if dropped is not None:
                     try:

@@ -165,21 +165,26 @@ def _block(x, layer, cfg: GPT2Config, mesh):
 
     b, s, e = x.shape
     h, d = cfg.n_head, cfg.head_dim
+    # checkpoint_name tags (no-ops outside a names-based remat policy):
+    # "matmuls" saves every projection output so backward recomputes only
+    # the cheap elementwise chains (LN/gelu/residual) — the sweet spot
+    # between full remat (recompute a whole forward, ~8/6 executed FLOPs)
+    # and no remat (stored-activation reads dominate a bandwidth-poor bwd).
+    from jax.ad_checkpoint import checkpoint_name as _ckpt_name
+
     y = _layernorm(x, layer["ln1_g"], layer["ln1_b"])
     qkv = jnp.einsum("bse,ethd->bsthd", y, layer["wqkv"]) + layer["bqkv"]
+    qkv = _ckpt_name(qkv, "qkv")
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
     q = wlc(q, P("batch", "seq", "heads", "kv"), mesh)
     k = wlc(k, P("batch", "seq", "heads", "kv"), mesh)
     v = wlc(v, P("batch", "seq", "heads", "kv"), mesh)
     o = _attention(q, k, v, cfg, mesh)
+    o = _ckpt_name(o, "attn_out")
     x = x + (jnp.einsum("bshd,hde->bse", o, layer["wo"]) + layer["bo"]).astype(x.dtype)
+    x = _ckpt_name(x, "attn_resid")
     y = _layernorm(x, layer["ln2_g"], layer["ln2_b"])
     hdn = jax.nn.gelu(jnp.einsum("bse,ef->bsf", y, layer["wi"]) + layer["bi"])
-    # Tag for the "save_mlp" remat policy: keeping just this [B,S,4E]
-    # tensor skips the most expensive recompute (the up-projection matmul)
-    # while everything else rematerializes.
-    from jax.ad_checkpoint import checkpoint_name as _ckpt_name
-
     hdn = _ckpt_name(hdn, "mlp_hidden")
     hdn = wlc(hdn, P("batch", "seq", "mlp"), mesh)
     x = x + (jnp.einsum("bsf,fe->bse", hdn, layer["wo2"]) + layer["bo2"]).astype(x.dtype)
@@ -207,6 +212,25 @@ def gpt2_hidden(params, tokens, cfg: GPT2Config, mesh=None):
             block = jax.checkpoint(
                 block,
                 policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            )
+        elif cfg.remat_policy == "dots_all":
+            # Save EVERY contraction result (batched included — our
+            # einsums all carry a batch dim, so the no-batch-dims variant
+            # saves nothing and degenerates to full remat).  Backward then
+            # recomputes only elementwise chains (LN/gelu/residual): a few
+            # percent of executed FLOPs instead of a full second forward.
+            block = jax.checkpoint(
+                block, policy=jax.checkpoint_policies.dots_saveable
+            )
+        elif cfg.remat_policy == "matmuls":
+            # Save the tagged projection outputs (+ the attention-branch
+            # residual so bwd needn't replay attention to rebuild the MLP
+            # branch input); recompute only elementwise chains.
+            block = jax.checkpoint(
+                block,
+                policy=jax.checkpoint_policies.save_only_these_names(
+                    "qkv", "attn_out", "attn_resid", "mlp_hidden"
+                ),
             )
         elif cfg.remat_policy == "save_mlp":
             block = jax.checkpoint(

@@ -41,18 +41,24 @@ def _ensure_loop() -> asyncio.AbstractEventLoop:
         return loop
 
 
-_executor = None
+def _submit_thread(fn, *args, **kwargs):
+    """Thread-per-call execution for sync methods.  A bounded pool would
+    deadlock nested composition (a parent blocking on child.result()
+    holds a pool thread the child then needs); local-mode call volume is
+    test-sized, so a fresh daemon thread per call is the simple safe
+    choice."""
+    from concurrent.futures import Future
 
+    fut: Future = Future()
 
-def _get_executor():
-    global _executor
-    if _executor is None:
-        from concurrent.futures import ThreadPoolExecutor
+    def run():
+        try:
+            fut.set_result(fn(*args, **kwargs))
+        except BaseException as e:  # noqa: BLE001 — delivered to caller
+            fut.set_exception(e)
 
-        _executor = ThreadPoolExecutor(
-            max_workers=8, thread_name_prefix="serve-local"
-        )
-    return _executor
+    threading.Thread(target=run, daemon=True, name="serve-local").start()
+    return fut
 
 
 class LocalResponse:
@@ -110,7 +116,7 @@ class LocalReplica:
             return asyncio.run_coroutine_threadsafe(
                 fn(*args, **kwargs), _ensure_loop()
             )
-        return _get_executor().submit(fn, *args, **kwargs)
+        return _submit_thread(fn, *args, **kwargs)
 
     def call_sync(self, method: str, args, kwargs):
         """Direct call (streaming path: the generator is the result)."""

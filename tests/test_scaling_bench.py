@@ -15,11 +15,13 @@ pytestmark = pytest.mark.skipif(
 def test_scaling_curve_structure():
     curve = run_scaling_curve((1, 2, 4), n_steps=2, seq_len=64)
     assert [row["devices"] for row in curve] == [1, 2, 4]
-    assert curve[0]["retention"] == 1.0
     for row in curve:
         assert row["step_time_s"] > 0
+        assert row["step_time_unpartitioned_s"] > 0
         assert row["tokens_per_sec_per_device"] > 0
-        assert 0 < row["retention"] <= 2.0  # sane band, noise included
+        # Calibrated ratio (t_unpartitioned / t_partitioned), clipped at
+        # 1.0; a measured value must land in a sane noisy band.
+        assert 0 < row["retention"] <= 1.0
 
 
 def test_sp_parity_losses_match():
