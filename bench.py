@@ -563,6 +563,59 @@ def run_control_plane_suite():
         for pg in pgs:
             remove_placement_group(pg)
 
+        # LLM serving pattern A/B: monolithic engine replica vs
+        # prefill/decode disaggregation (2 prefill + 2 decode, KV pages
+        # over the device-object plane).  Engines run CPU-jax inside
+        # worker actors (chip isolation blanks TPU_VISIBLE_CHIPS), so this
+        # measures the serving-pattern orchestration + KV-transfer cost,
+        # not chip throughput.
+        try:
+            from ray_tpu.llm.disagg import DecodeReplica, PrefillReplica
+            from ray_tpu.llm.engine import (
+                EngineConfig, JaxLLMEngine, SamplingParams,
+            )
+
+            eng_cfg = EngineConfig(max_batch_size=4, max_seq_len=64, seed=3)
+            sampling = SamplingParams(max_tokens=16, temperature=0.0)
+            prompts = [f"bench prompt {i}" for i in range(8)]
+
+            actors = []
+            try:
+                Mono = ray_tpu.remote(num_cpus=1)(JaxLLMEngine)
+                mono = Mono.remote(eng_cfg)
+                actors.append(mono)
+                ray_tpu.get(mono.generate.remote(prompts[:1], sampling),
+                            timeout=300)  # compile
+                t0 = time.perf_counter()
+                ray_tpu.get(mono.generate.remote(prompts, sampling),
+                            timeout=300)
+                mono_dt = time.perf_counter() - t0
+                emit("llm_mono_8prompts_s", mono_dt, "s")
+
+                from ray_tpu.llm.disagg import DisaggRouter
+
+                Pre = ray_tpu.remote(num_cpus=0.5)(PrefillReplica)
+                Dec = ray_tpu.remote(num_cpus=0.5)(DecodeReplica)
+                pre = [Pre.remote(eng_cfg) for _ in range(2)]
+                dec = [Dec.remote(eng_cfg) for _ in range(2)]
+                actors.extend(pre + dec)
+                router = DisaggRouter(pre, dec)
+                for _ in range(2):  # round-robin hits every replica pair
+                    router.generate(prompts[0], sampling, timeout_s=300)
+                t0 = time.perf_counter()
+                router.generate_many(prompts, sampling, timeout_s=300)
+                disagg_dt = time.perf_counter() - t0
+                emit("llm_disagg_2p2d_8prompts_s", disagg_dt, "s")
+                emit("llm_disagg_vs_mono_speedup", mono_dt / disagg_dt, "x")
+            finally:
+                for a in actors:
+                    try:
+                        ray_tpu.kill(a)
+                    except Exception:  # noqa: BLE001
+                        pass
+        except Exception as e:  # noqa: BLE001 — A/B is informative, not gating
+            print(f"# llm disagg A/B skipped: {e}", flush=True)
+
         # wait over 1k ready refs (reference single_client_wait_1k_refs)
         wrefs = [ray_tpu.put(b"x") for _ in range(1000)]
 

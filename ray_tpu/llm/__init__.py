@@ -10,3 +10,9 @@ from .engine import EngineConfig, JaxLLMEngine, SamplingParams  # noqa: F401
 from .serve_app import build_openai_app  # noqa: F401
 from .batch import build_llm_processor  # noqa: F401
 from .tokenizer import ByteTokenizer  # noqa: F401
+from .disagg import (  # noqa: F401
+    DecodeReplica,
+    DisaggRouter,
+    PrefillEngine,
+    PrefillReplica,
+)

@@ -1,0 +1,246 @@
+"""Prefill/decode disaggregated serving.
+
+Reference: ray ``llm/_internal/serve/serving_patterns/prefill_decode/`` +
+``engines/vllm/kv_transfer/`` — prefill replicas compute the prompt's KV
+cache, decode replicas continue token generation, and the KV pages move
+replica-to-replica without re-running the prompt.
+
+TPU-native shape: the KV transfer rides the device-object plane
+(``ray_tpu.collective.device_objects``) — the prefill replica keeps the
+[L, 1, H, S, D] KV blocks resident and returns ``DeviceRef`` metadata;
+the decode replica fetches point-to-point from the owner (ICI/DCN-safe:
+same-process hits HBM directly, cross-process streams over the owner's
+RPC channel) and splices the pages into its batch cache with one jitted
+``dynamic_update_slice``.  Compute stays in exactly two XLA programs per
+replica role: prefill compiles only the prefill graph, decode only the
+decode-step graph — each role's chip runs one static-shape program at
+100% duty instead of interleaving both.
+
+Why disaggregate (same motivation as the reference): prefill is
+compute-bound and bursty, decode is HBM-bound and steady; separating them
+lets each pool scale independently and keeps long prompts from stalling
+token streams of in-flight requests.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..models import model_family
+from ..models.gpt2_decode import sample_logits
+from .engine import EngineConfig, JaxLLMEngine, SamplingParams
+from .tokenizer import ByteTokenizer
+
+
+class PrefillEngine:
+    """Prefill-only engine: prompt -> (first token, resident KV pages).
+
+    No batch slots, no decode program — one jitted prefill over a
+    single-row cache; the row is published to the device-object store and
+    ownership transfers to the fetching decode replica.
+    """
+
+    def __init__(self, cfg: EngineConfig, tokenizer=None):
+        import jax
+
+        self.cfg = cfg
+        self.tokenizer = tokenizer or ByteTokenizer()
+        mcfg = cfg.model
+        fam = model_family(mcfg)
+        self.family = fam
+        if cfg.param_loader is not None:
+            self.params = cfg.param_loader()
+        else:
+            self.params = fam.init(jax.random.PRNGKey(cfg.seed), mcfg)
+        self._key = jax.random.PRNGKey(cfg.seed + 1)
+
+        def prefill_row(params, tokens, length):
+            import jax.numpy as jnp
+
+            cache = fam.init_cache(mcfg, 1, cfg.max_seq_len)
+            logits, cache = fam.prefill(
+                params, tokens[None], jnp.asarray([length]), cache, mcfg
+            )
+            return logits[0], cache
+
+        self._prefill_row = jax.jit(prefill_row)
+        self._sample = jax.jit(
+            sample_logits, static_argnames=("temperature", "top_k", "top_p")
+        )
+
+    def prefill(
+        self, prompt: str, params: Optional[SamplingParams] = None
+    ) -> Dict[str, Any]:
+        """Run the prompt; return picklable metadata + KV DeviceRefs.
+
+        The caller (router) hands the dict to a decode replica, which
+        fetches and frees the refs — the KV pages live on this replica
+        only until that single consumer collects them.
+        """
+        import jax
+
+        from ..collective.device_objects import device_object_store
+
+        from .engine import encode_prompt
+
+        params = params or SamplingParams()
+        token_ids = encode_prompt(self.tokenizer, prompt, self.cfg.max_seq_len)
+        tokens = np.zeros(self.cfg.max_seq_len, np.int32)
+        tokens[: len(token_ids)] = token_ids
+        import jax.numpy as jnp
+
+        logits, cache = self._prefill_row(
+            self.params, jnp.asarray(tokens), len(token_ids)
+        )
+        self._key, sub = jax.random.split(self._key)
+        first = int(
+            np.asarray(
+                self._sample(
+                    logits[None], sub,
+                    temperature=params.temperature,
+                    top_k=params.top_k,
+                    top_p=params.top_p,
+                )
+            )[0]
+        )
+        store = device_object_store()
+        return {
+            "prompt_len": len(token_ids),
+            "first_token": first,
+            "sampling": params,
+            "k_ref": store.put(cache["k"]),
+            "v_ref": store.put(cache["v"]),
+        }
+
+
+class DecodeReplica:
+    """Decode-role replica: adopts prefilled KV, streams decode steps.
+
+    Wraps the standard engine (whose ``add_request_from_kv`` owns the
+    disaggregated admission path); the prefill program is simply never
+    compiled or run on this replica — all admissions arrive as KV pages."""
+
+    def __init__(self, engine_cfg: Optional[EngineConfig] = None):
+        self.engine = JaxLLMEngine(engine_cfg or EngineConfig())
+
+    def add_from_kv(self, meta: Dict[str, Any]) -> int:
+        """Fetch the KV pages from the prefill owner and enqueue."""
+        from ..collective.device_objects import device_object_store
+
+        store = device_object_store()
+        k = store.fetch(meta["k_ref"])
+        v = store.fetch(meta["v_ref"])
+        store.free(meta["k_ref"])
+        store.free(meta["v_ref"])
+        return self.engine.add_request_from_kv(meta, k, v)
+
+    def run(self, request_id: int, timeout_s: float = 300.0) -> dict:
+        """Decode until this request finishes; returns its result."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            with self.engine._step_lock:
+                done = self.engine._finished.pop(request_id, None)
+                if done is None:
+                    self.engine.step()
+                    done = self.engine._finished.pop(request_id, None)
+            if done is not None:
+                return done
+            if time.monotonic() > deadline:
+                self.engine.cancel_request(request_id)
+                raise TimeoutError(f"decode of request {request_id} timed out")
+
+
+class PrefillReplica:
+    """Prefill-role replica (actor-friendly wrapper)."""
+
+    def __init__(self, engine_cfg: Optional[EngineConfig] = None):
+        self.engine = PrefillEngine(engine_cfg or EngineConfig())
+
+    def prefill(
+        self, prompt: str, params: Optional[SamplingParams] = None
+    ) -> Dict[str, Any]:
+        return self.engine.prefill(prompt, params)
+
+
+class DisaggRouter:
+    """Routes new requests to prefill replicas and continuations to decode
+    replicas (the reference's prefill_decode serving-pattern router).
+
+    Works with actor handles (``.remote()``/``ray_tpu.get``) or plain
+    local instances (ducks on the presence of ``.prefill.remote``)."""
+
+    def __init__(self, prefill_replicas: List[Any], decode_replicas: List[Any]):
+        if not prefill_replicas or not decode_replicas:
+            raise ValueError("need at least one prefill and one decode replica")
+        self.prefill_replicas = list(prefill_replicas)
+        self.decode_replicas = list(decode_replicas)
+        self._p_rr = itertools.cycle(range(len(self.prefill_replicas)))
+        self._d_rr = itertools.cycle(range(len(self.decode_replicas)))
+
+    @staticmethod
+    def _is_actor(h) -> bool:
+        return hasattr(getattr(h, "prefill", None), "remote") or hasattr(
+            getattr(h, "add_from_kv", None), "remote"
+        )
+
+    def generate(
+        self,
+        prompt: str,
+        params: Optional[SamplingParams] = None,
+        timeout_s: float = 300.0,
+    ) -> dict:
+        import ray_tpu
+
+        p = self.prefill_replicas[next(self._p_rr)]
+        d = self.decode_replicas[next(self._d_rr)]
+        if self._is_actor(p):
+            meta = ray_tpu.get(p.prefill.remote(prompt, params), timeout=timeout_s)
+            rid = ray_tpu.get(d.add_from_kv.remote(meta), timeout=timeout_s)
+            return ray_tpu.get(d.run.remote(rid), timeout=timeout_s)
+        meta = p.prefill(prompt, params)
+        rid = d.add_from_kv(meta)
+        return d.run(rid, timeout_s=timeout_s)
+
+    def generate_many(
+        self,
+        prompts: List[str],
+        params: Optional[SamplingParams] = None,
+        timeout_s: float = 300.0,
+    ) -> List[dict]:
+        """Pipelined fan-out: all prefills dispatch first (spread over the
+        prefill pool), continuations spread over the decode pool."""
+        import ray_tpu
+
+        if not self._is_actor(self.prefill_replicas[0]):
+            return [self.generate(p, params, timeout_s) for p in prompts]
+        # All prefills dispatch immediately (spread over the prefill
+        # pool); each prompt's continuation pipeline (add_from_kv -> run)
+        # starts the moment ITS prefill completes — no barrier, so one
+        # slow prefill never delays the other prompts' decode starts.
+        deadline = time.time() + timeout_s
+        meta_refs = {
+            self.prefill_replicas[next(self._p_rr)].prefill.remote(
+                p, params
+            ): i
+            for i, p in enumerate(prompts)
+        }
+        run_refs: List[Any] = [None] * len(prompts)
+        pending = list(meta_refs)
+        while pending:
+            ready, pending = ray_tpu.wait(
+                pending, num_returns=1,
+                timeout=max(0.0, deadline - time.time()),
+            )
+            if not ready:
+                raise TimeoutError("prefill fan-out timed out")
+            for ref in ready:
+                i = meta_refs[ref]
+                d = self.decode_replicas[next(self._d_rr)]
+                meta = ray_tpu.get(ref, timeout=timeout_s)
+                rid = ray_tpu.get(d.add_from_kv.remote(meta), timeout=timeout_s)
+                run_refs[i] = d.run.remote(rid)
+        return ray_tpu.get(run_refs, timeout=timeout_s)

@@ -52,6 +52,14 @@ class EngineConfig:
     param_loader: Optional[Callable[[], Any]] = None
 
 
+def encode_prompt(tokenizer, prompt: str, max_seq_len: int) -> List[int]:
+    """Tokenize + left-truncate to the cache budget — the ONE place prompt
+    shaping happens (the disagg prefill role must match the monolithic
+    engine byte-for-byte or outputs diverge)."""
+    token_ids = tokenizer.encode(prompt)
+    return token_ids[-(max_seq_len - 1):]
+
+
 @dataclasses.dataclass
 class _Slot:
     request_id: int
@@ -113,6 +121,22 @@ class JaxLLMEngine:
             return logits[0], cache
 
         self._prefill_one = jax.jit(prefill_one, donate_argnums=(1,))
+
+        def insert_kv(cache, k1, v1, idx):
+            """Splice a prefilled single-row KV block into batch row idx
+            (disaggregated admission — the row arrives from a prefill
+            replica instead of the local prefill program)."""
+            return {
+                "k": jax.lax.dynamic_update_slice(
+                    cache["k"], k1, (0, idx, 0, 0, 0)
+                ),
+                "v": jax.lax.dynamic_update_slice(
+                    cache["v"], v1, (0, idx, 0, 0, 0)
+                ),
+            }
+
+        self._insert_kv = jax.jit(insert_kv, donate_argnums=(0,))
+        self._waiting_kv: List[tuple] = []  # (rid, meta, k, v)
         self._decode = jax.jit(
             lambda params, cache, tokens, pos: fam.decode_step(
                 params, tokens, pos, cache, mcfg
@@ -131,12 +155,24 @@ class JaxLLMEngine:
         self, prompt: str, params: Optional[SamplingParams] = None
     ) -> int:
         params = params or SamplingParams()
-        token_ids = self.tokenizer.encode(prompt)
-        max_prompt = self.cfg.max_seq_len - 1
-        token_ids = token_ids[-max_prompt:]
+        token_ids = encode_prompt(self.tokenizer, prompt, self.cfg.max_seq_len)
         request_id = next(self._next_id)
         self._waiting.append((request_id, token_ids, params))
         return request_id
+
+    def add_request_from_kv(self, meta: dict, k, v) -> int:
+        """Disaggregated admission: enqueue a request whose prompt was
+        prefilled elsewhere.  ``meta`` carries prompt_len / first_token /
+        sampling (see llm.disagg.PrefillEngine.prefill); ``k``/``v`` are
+        the [L, 1, H, S, D] KV pages for the prompt."""
+        import jax.numpy as jnp
+
+        with self._step_lock:
+            request_id = next(self._next_id)
+            self._waiting_kv.append(
+                (request_id, meta, jnp.asarray(k), jnp.asarray(v))
+            )
+            return request_id
 
     def _free_slot(self) -> Optional[int]:
         for i, s in enumerate(self.slots):
@@ -144,9 +180,27 @@ class JaxLLMEngine:
                 return i
         return None
 
+    def _admit_kv(self):
+        """Drain adopted-KV requests into free slots (no local prefill)."""
+        while self._waiting_kv:
+            idx = self._free_slot()
+            if idx is None:
+                return
+            request_id, meta, k, v = self._waiting_kv.pop(0)
+            self.cache = self._insert_kv(self.cache, k, v, idx)
+            slot = _Slot(
+                request_id=request_id,
+                prompt_len=meta["prompt_len"],
+                generated=[meta["first_token"]],
+                params=meta["sampling"],
+            )
+            self.slots[idx] = slot
+            self._check_done(slot, meta["first_token"])
+
     def _admit(self):
         import jax.numpy as jnp
 
+        self._admit_kv()
         while self._waiting:
             idx = self._free_slot()
             if idx is None:
@@ -259,7 +313,7 @@ class JaxLLMEngine:
         return out
 
     def has_unfinished(self) -> bool:
-        return bool(self._waiting) or any(
+        return bool(self._waiting) or bool(self._waiting_kv) or any(
             s is not None for s in self.slots
         )
 
@@ -270,6 +324,9 @@ class JaxLLMEngine:
         with self._step_lock:
             self._waiting = [
                 w for w in self._waiting if w[0] != request_id
+            ]
+            self._waiting_kv = [
+                w for w in self._waiting_kv if w[0] != request_id
             ]
             for i, slot in enumerate(self.slots):
                 if slot is not None and slot.request_id == request_id:

@@ -35,12 +35,15 @@ class GPT2Config:
     dtype: str = "bfloat16"
     attention: str = "dense"  # dense | flash | ring | ulysses
     remat: bool = False
-    # "full" recomputes the whole block in backward; "dots" saves matmul
-    # outputs and recomputes only elementwise ops (jax
-    # dots_with_no_batch_dims_saveable); "save_mlp" saves only the tagged
-    # MLP hidden activations — skips the costliest recompute while keeping
-    # most of full-remat's memory win.
-    remat_policy: str = "full"  # full | dots | save_mlp
+    # "full" recomputes the whole block in backward (measured FASTEST on
+    # bandwidth-poor parts — storing activations costs more than
+    # recomputing them); "dots" = jax dots_with_no_batch_dims_saveable
+    # (saves nothing for our batched einsums — degenerates to full);
+    # "dots_all" saves every contraction result (dots_saveable);
+    # "matmuls" saves the tagged projection outputs + attention residual;
+    # "save_mlp" saves only the tagged MLP hidden activations.  Unknown
+    # values fall through to "full".
+    remat_policy: str = "full"  # full | dots | dots_all | matmuls | save_mlp
 
     @property
     def head_dim(self) -> int:
