@@ -10,9 +10,19 @@ from .aggregate import (  # noqa: F401
 )
 from .datasource import Datasource, ReadTask  # noqa: F401
 from .execution import ActorPoolStrategy  # noqa: F401
+from .arrow import from_arrow  # noqa: F401
+from .datasink import (  # noqa: F401
+    CSVDatasink,
+    Datasink,
+    JSONDatasink,
+    ManifestedDatasink,
+    NumpyDatasink,
+    ParquetDatasink,
+)
 from .dataset import (  # noqa: F401
     DataIterator,
     Dataset,
+    from_blocks,
     from_items,
     range_dataset,
     read_binary_files,

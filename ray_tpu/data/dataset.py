@@ -49,9 +49,6 @@ from .datasource import (
     RangeDatasource,
     ReadTask,
     TextDatasource,
-    write_block_csv,
-    write_block_json,
-    write_block_parquet,
 )
 from .execution import (
     ActorPoolStrategy,
@@ -69,8 +66,12 @@ from .execution import (
 @ray_tpu.remote
 def _write_block(item, transforms, writer, path: str) -> dict:
     block = apply_chain(item, transforms)
-    writer(block, path)
-    return {"path": path, "num_rows": len(block)}
+    meta = writer(block, path)
+    if not isinstance(meta, dict):
+        meta = {}
+    meta.setdefault("path", path)
+    meta.setdefault("num_rows", len(block))
+    return meta
 
 
 class Dataset:
@@ -425,7 +426,10 @@ class Dataset:
     # ------------------------------------------------------------- consumers
     def iter_blocks(self) -> Iterator[Block]:
         for ref in self._execute():
-            yield ray_tpu.get(ref, timeout=600)
+            if isinstance(ref, ray_tpu.ObjectRef):
+                yield ray_tpu.get(ref, timeout=600)
+            else:  # concrete block (e.g. from_blocks inputs, no stages)
+                yield ref
 
     def iter_rows(self) -> Iterator[Any]:
         for block in self.iter_blocks():
@@ -526,7 +530,8 @@ class Dataset:
         return list(s.keys()) if isinstance(s, dict) else None
 
     # ----------------------------------------------------------------- writes
-    def _write(self, writer, dir_path: str, ext: str) -> List[str]:
+    def _write(self, writer, dir_path: str, ext: str,
+               return_meta: bool = False):
         import os
 
         os.makedirs(dir_path, exist_ok=True)
@@ -543,16 +548,46 @@ class Dataset:
             )
             for i, item in enumerate(items)
         ]
-        return [m["path"] for m in ray_tpu.get(refs, timeout=600)]
+        metas = ray_tpu.get(refs, timeout=600)
+        if return_meta:
+            return metas
+        return [m["path"] for m in metas]
+
+    def write_datasink(self, sink, dir_path: str) -> List[str]:
+        """Write every block through a ``Datasink`` (reference: ray
+        ``Dataset.write_datasink``): per-block writes fan out as tasks,
+        then the sink's driver-side ``on_write_complete`` commit runs."""
+        paths_meta = self._write(sink.write_block, dir_path, sink.extension,
+                                 return_meta=True)
+        sink.on_write_complete(paths_meta)
+        return [m["path"] for m in paths_meta]
 
     def write_parquet(self, dir_path: str) -> List[str]:
-        return self._write(write_block_parquet, dir_path, ".parquet")
+        from .datasink import ParquetDatasink
+
+        return self.write_datasink(ParquetDatasink(), dir_path)
 
     def write_csv(self, dir_path: str) -> List[str]:
-        return self._write(write_block_csv, dir_path, ".csv")
+        from .datasink import CSVDatasink
+
+        return self.write_datasink(CSVDatasink(), dir_path)
 
     def write_json(self, dir_path: str) -> List[str]:
-        return self._write(write_block_json, dir_path, ".jsonl")
+        from .datasink import JSONDatasink
+
+        return self.write_datasink(JSONDatasink(), dir_path)
+
+    def write_numpy(self, dir_path: str) -> List[str]:
+        from .datasink import NumpyDatasink
+
+        return self.write_datasink(NumpyDatasink(), dir_path)
+
+    def to_arrow(self):
+        """Materialize as ONE pyarrow.Table (zero-copy for primitive
+        columnar columns — see ray_tpu.data.arrow)."""
+        from .arrow import dataset_to_arrow
+
+        return dataset_to_arrow(self)
 
     # --------------------------------------------------------------- splits
     def split(self, n: int) -> List["Dataset"]:
@@ -612,6 +647,11 @@ class DataIterator:
 # ------------------------------------------------------------------ sources
 def read_datasource(ds: Datasource, parallelism: int = 8) -> Dataset:
     return Dataset(ds.get_read_tasks(parallelism), [])
+
+
+def from_blocks(blocks: Sequence[Any]) -> Dataset:
+    """Dataset over pre-built blocks (ColumnarBlock or row lists)."""
+    return Dataset(list(blocks), [])
 
 
 def from_items(items: Sequence[Any], parallelism: int = 8) -> Dataset:

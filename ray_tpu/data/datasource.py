@@ -378,40 +378,5 @@ class TextDatasource(Datasource):
         return tasks
 
 
-# -------------------------------------------------------------------- writes
-def write_block_parquet(block: Block, path: str) -> str:
-    import pyarrow as pa
-    import pyarrow.parquet as pq
-
-    from .block import ColumnarBlock
-
-    if isinstance(block, ColumnarBlock):
-        table = pa.Table.from_pydict(
-            {k: pa.array(v) for k, v in block.columns.items()}
-        )
-    else:
-        rows = [r if isinstance(r, dict) else {"value": r} for r in block]
-        table = pa.Table.from_pylist(rows)
-    pq.write_table(table, path)
-    return path
-
-
-def write_block_csv(block: Block, path: str) -> str:
-    import csv
-
-    rows = [r if isinstance(r, dict) else {"value": r} for r in block]
-    with open(path, "w", newline="") as f:
-        if rows:
-            writer = csv.DictWriter(f, fieldnames=list(rows[0].keys()))
-            writer.writeheader()
-            writer.writerows(rows)
-    return path
-
-
-def write_block_json(block: Block, path: str) -> str:
-    import json
-
-    with open(path, "w") as f:
-        for r in block:
-            f.write(json.dumps(r, default=str) + "\n")
-    return path
+# Writes live in datasink.py (Datasink ABC + format sinks) — every
+# Dataset.write_* funnels through Dataset.write_datasink.
