@@ -124,8 +124,16 @@ def shutdown():
         _local_node.stop()
         _local_node = None
     if _config_overrides_before is not None:
-        GlobalConfig._overrides = _config_overrides_before
+        # Restoring _overrides alone is not enough: override() also wrote
+        # the values into the knob CACHE (__dict__), which would leak the
+        # dead cluster's _system_config into the next init in this
+        # process (observed: chaos knobs poisoning the next test).
+        restored = _config_overrides_before
         _config_overrides_before = None
+        GlobalConfig._overrides = {}
+        GlobalConfig.reload()
+        if restored:
+            GlobalConfig.override(**restored)
 
 
 class ClientContext:

@@ -33,6 +33,10 @@ _KNOBS: Dict[str, tuple] = {
     "rpc_retry_max_delay_s": (float, 2.0, "Backoff cap"),
     "rpc_max_retries": (int, 8, "Retryable RPC attempts"),
     "testing_rpc_failure": (str, "", "Chaos spec: 'method:prob_req:prob_resp,…'"),
+    "testing_network_delay": (
+        str, "",
+        "Latency chaos: 'method:prob:delay_ms[:jitter_ms],…' ('*' = all)",
+    ),
     # -- control plane --
     "cp_persistence": (int, 1, "Durable sqlite control-plane tables (restart FT)"),
     "health_check_period_s": (float, 1.0, "Agent heartbeat period"),
@@ -42,6 +46,16 @@ _KNOBS: Dict[str, tuple] = {
     "scheduler_spread_threshold": (float, 0.5, "Pack until this utilization, then spread"),
     "scheduler_top_k_fraction": (float, 0.2, "Top-k random choice fraction"),
     "lease_idle_timeout_s": (float, 0.3, "Return idle leased worker after"),
+    "task_push_keepalive_s": (
+        float, 60.0,
+        "Re-send a task push if no reply within this window (dedup makes "
+        "resends exactly-once; converts silent reply loss into a bounded "
+        "delay instead of an infinite wait)",
+    ),
+    "lease_owner_grace_s": (
+        float, 8.0,
+        "Reconnect window before a disconnected owner's leases are reaped",
+    ),
     "worker_startup_timeout_s": (float, 60.0, "Worker process start deadline"),
     "max_tasks_in_flight_per_worker": (int, 10, "Pipelined pushes per leased worker"),
     # -- object store --

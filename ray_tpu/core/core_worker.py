@@ -29,6 +29,7 @@ import logging
 import os
 import threading
 import time
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Set, Tuple
 
@@ -58,6 +59,7 @@ from .rpc import (
     RpcConnectionError,
     RpcRemoteError,
     RpcServer,
+    RpcTimeoutError,
 )
 from .serialization import (
     deserialize_from_bytes,
@@ -278,6 +280,54 @@ class ExecPipeline:
                 fut.set_result(res)
 
 
+class _InflightReplies:
+    """Exactly-once execution under at-least-once push delivery.
+
+    Transport-level retries of ``push_task``/``actor_push_task`` (the RPC
+    layer reconnects and resends after a lost connection or a dropped
+    reply) must NOT re-execute the task: the first push claims
+    (task_id, attempt) and installs a future; duplicates await the same
+    future and receive the same reply.  Completed entries age out FIFO
+    (bounded memory); in-flight entries are never evicted.
+
+    Reference analog: the raylet/worker task-dedup on lease retries —
+    without it, a dropped REPLY would mean the task ran but the caller
+    counts the attempt as failed, and any resend double-executes.
+    """
+
+    def __init__(self):
+        self._futs: Dict[tuple, asyncio.Future] = {}
+        self._order: deque = deque()  # (key, claim_time)
+
+    def _retention_s(self) -> float:
+        # An entry must outlive every possible resend of its push: the
+        # caller retries after task_push_keepalive_s, so evicting sooner
+        # than a couple of windows would let a late resend re-execute.
+        return GlobalConfig.task_push_keepalive_s * 2 + 30.0
+
+    def claim(self, key: tuple, loop) -> tuple:
+        """Returns (future, is_owner)."""
+        fut = self._futs.get(key)
+        if fut is not None:
+            return fut, False
+        fut = loop.create_future()
+        self._futs[key] = fut
+        now = time.monotonic()
+        self._order.append((key, now))
+        # Age-based eviction ONLY (never count-based): exactly-once under
+        # resends requires completed entries to survive the full resend
+        # window regardless of how busy the worker is.
+        horizon = now - self._retention_s()
+        while self._order and self._order[0][1] < horizon:
+            old, _ = self._order[0]
+            done = self._futs.get(old)
+            if done is not None and not done.done():
+                break  # still running; nothing older can be evicted yet
+            self._order.popleft()
+            self._futs.pop(old, None)
+        return fut, True
+
+
 class OwnedObject:
     __slots__ = (
         "state", "inline_payload", "locations", "size", "local_refs",
@@ -420,6 +470,9 @@ class _LeasePool:
                 # OOM-defense policy input: only leases whose tasks can be
                 # resubmitted should be preferred kill victims.
                 "retriable": self.template.max_retries > 0,
+                # Stable owner identity: leases survive transport
+                # reconnects (grace + owner_ping re-association).
+                "owner_id": self.worker.address,
             }
             while True:
                 try:
@@ -470,13 +523,25 @@ class _LeasePool:
 
     async def _push(self, lease, spec: TaskSpec, attempt: int):
         try:
-            reply = await lease["client"].call(
-                "push_task",
-                {"spec": spec, "attempt": attempt},
-                timeout=UNBOUNDED,  # tasks may run arbitrarily long
-                retries=1,
-                batch=True,
-            )
+            # Keepalive re-push: tasks may run arbitrarily long, but an
+            # UNBOUNDED reply wait turns a silently lost reply (peer
+            # closed between execute and send) into an infinite hang.
+            # Bounded waits + resend are SAFE: the worker dedups by
+            # (task_id, attempt) (_InflightReplies), so a resend either
+            # joins the still-running execution or returns the finished
+            # reply instantly — exactly-once execution either way.
+            while True:
+                try:
+                    reply = await lease["client"].call(
+                        "push_task",
+                        {"spec": spec, "attempt": attempt},
+                        timeout=GlobalConfig.task_push_keepalive_s,
+                        retries=3,
+                        batch=True,
+                    )
+                    break
+                except RpcTimeoutError:
+                    continue
             self.worker._handle_task_reply(spec, reply)
         except RpcRemoteError as e:
             # The worker is healthy — the handler itself raised (e.g. the
@@ -681,6 +746,7 @@ class CoreWorker:
     async def async_start(self):
         self.loop = asyncio.get_running_loop()
         self._exec_pipeline = ExecPipeline(asyncio.get_running_loop())
+        self._inflight_replies = _InflightReplies()
         self.address = await self.server.start()
         self.cp = RetryableRpcClient(self.cp_address, push_handler=self._on_push)
         self.agent = RetryableRpcClient(self.agent_address)
@@ -718,6 +784,24 @@ class CoreWorker:
                     )
             except Exception:
                 pass
+            # Lease re-association + liveness toward EVERY agent that
+            # granted this driver a lease (spillback leases live on remote
+            # agents whose socket may sit idle while pushes go straight to
+            # the worker): after a client reconnect these pings rebind the
+            # leases to the new connection before the grace expires.
+            agents = {id(self.agent): self.agent} if self.agent else {}
+            for pool in list(self.lease_pools.values()):
+                for lease in list(pool.leases.values()):
+                    granter = lease.get("agent")
+                    if granter is not None:
+                        agents[id(granter)] = granter
+            for agent in agents.values():
+                try:
+                    await agent.notify(
+                        "owner_ping", {"owner_id": self.address}
+                    )
+                except Exception:
+                    pass
 
     def start_threaded(self):
         """Driver mode: run the protocol loop on a background thread."""
@@ -2067,19 +2151,26 @@ class CoreWorker:
     ):
         client = self.worker_clients.get(state.address)
         try:
-            reply = await client.call(
-                "actor_push_task",
-                {
-                    "spec": spec,
-                    "caller": self.address,
-                    "seq": seq,
-                    "incarnation": incarnation,
-                    "attempt": attempt,
-                },
-                timeout=UNBOUNDED,
-                retries=1,
-                batch=True,
-            )
+            # Keepalive re-push (see _LeasePool._push): bounded waits +
+            # dedup-safe resends instead of an unbounded reply wait.
+            while True:
+                try:
+                    reply = await client.call(
+                        "actor_push_task",
+                        {
+                            "spec": spec,
+                            "caller": self.address,
+                            "seq": seq,
+                            "incarnation": incarnation,
+                            "attempt": attempt,
+                        },
+                        timeout=GlobalConfig.task_push_keepalive_s,
+                        retries=3,
+                        batch=True,
+                    )
+                    break
+                except RpcTimeoutError:
+                    continue
             self._handle_task_reply(spec, reply)
         except (RpcConnectionError, RpcRemoteError) as e:
             if isinstance(e, RpcRemoteError):
@@ -2364,6 +2455,26 @@ class CoreWorker:
     async def handle_push_task(self, payload, conn):
         spec: TaskSpec = payload["spec"]
         spec._attempt = payload.get("attempt", 0)  # stream notify tagging
+        # At-least-once delivery, exactly-once execution: a transport
+        # retry of the same (task, attempt) awaits the original run.
+        key = (spec.task_id, spec._attempt)
+        fut, owner = self._inflight_replies.claim(
+            key, asyncio.get_running_loop()
+        )
+        if not owner:
+            return await asyncio.shield(fut)
+        try:
+            reply = await self._handle_push_task_once(spec)
+        except BaseException as e:  # noqa: BLE001
+            if not fut.done():
+                fut.set_exception(e)
+                fut.exception()  # consumed here; mark retrieved
+            raise
+        if not fut.done():
+            fut.set_result(reply)
+        return reply
+
+    async def _handle_push_task_once(self, spec: TaskSpec):
         fn = await self._get_function(spec.function_id)
         # Exclusive execution via the pipeline (ticket order = dispatch
         # order); coroutine/streaming tasks go through the bridge so the
@@ -2405,6 +2516,27 @@ class CoreWorker:
     async def handle_actor_push_task(self, payload, conn):
         spec: TaskSpec = payload["spec"]
         spec._attempt = payload.get("attempt", 0)  # stream notify tagging
+        # Dedup BEFORE the sequence gate: a duplicate push's seq has
+        # already been consumed, so re-entering the gate would hang (or,
+        # worse, re-execute); it simply awaits the original run's reply.
+        key = (spec.task_id, spec._attempt)
+        fut, owner = self._inflight_replies.claim(
+            key, asyncio.get_running_loop()
+        )
+        if not owner:
+            return await asyncio.shield(fut)
+        try:
+            reply = await self._handle_actor_push_once(payload, spec)
+        except BaseException as e:  # noqa: BLE001
+            if not fut.done():
+                fut.set_exception(e)
+                fut.exception()  # consumed here; mark retrieved
+            raise
+        if not fut.done():
+            fut.set_result(reply)
+        return reply
+
+    async def _handle_actor_push_once(self, payload, spec: TaskSpec):
         caller = payload["caller"]
         seq = payload["seq"]
         key = (caller, payload.get("incarnation", 0))
@@ -2485,6 +2617,21 @@ class CoreWorker:
                     "error": _ser(TaskError.from_exception(e, spec.name))}
         finally:
             advance()
+
+    def handle_worker_debug(self, payload, conn):
+        """Introspection: exec-pipeline cursor + dedup table state."""
+        pipe = self._exec_pipeline
+        infl = self._inflight_replies
+        return {
+            "pipeline_next_ticket": pipe._next_ticket if pipe else None,
+            "pipeline_next_exec": pipe._next_exec if pipe else None,
+            "pipeline_queued": sorted(pipe._items) if pipe else None,
+            "inflight_total": len(infl._futs) if infl else None,
+            "inflight_pending": (
+                [str(k) for k, f in infl._futs.items() if not f.done()]
+                if infl else None
+            ),
+        }
 
     def handle_device_fetch(self, payload, conn):
         """Point-to-point DeviceRef resolution (RDT analog): serialize the

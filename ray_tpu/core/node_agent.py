@@ -24,7 +24,7 @@ import os
 import subprocess
 import sys
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from .config import GlobalConfig
 from .ids import ActorID, NodeID, ObjectID, PlacementGroupID, WorkerID
@@ -125,6 +125,10 @@ class NodeAgent:
         self._next_lease_id = 1
         self.bundles: Dict[Tuple[PlacementGroupID, int], BundlePool] = {}
         self._lease_queue: List[tuple] = []  # (payload, future)
+        # Stable lease ownership: owner_id -> latest live connection, and
+        # pending grace-reap timers for owners whose conn dropped.
+        self._owner_conns: Dict[str, Any] = {}
+        self._owner_reap_timers: Dict[str, Any] = {}
         self._idle_since = None  # monotonic ts when node went fully idle
         self._pull_futures: Dict[ObjectID, asyncio.Future] = {}
         self._prestart_task: Optional[asyncio.Task] = None
@@ -629,20 +633,33 @@ class NodeAgent:
             lease_id, worker, resources, instances, pg_id, bundle_index
         )
         lease.retriable = payload.get("retriable", True)
-        # The lease belongs to the requesting driver's connection: if that
-        # driver dies without returning it, the resources would leak
-        # forever (observed: dead multi-client drivers pinning all CPUs).
+        # The lease belongs to the requesting DRIVER, identified two ways:
+        # by connection (fast death signal) and by stable owner_id (the
+        # driver's RPC address) — a retrying client that reconnects after
+        # a transient transport failure re-associates its leases via
+        # owner_ping/request_lease instead of losing them (ADVICE r3: a
+        # healthy driver's leases must not die with one socket).
         lease.owner_conn = conn
+        lease.owner_id = payload.get("owner_id")
         self.leases[lease_id] = lease
         if conn is not None and getattr(conn, "closed", False):
             # Owner died while we were starting its worker: reap now —
             # on_connection_closed already ran and cannot see this lease.
+            # MUST precede the re-association below: binding the owner to
+            # this dead conn (and cancelling its grace timer) would orphan
+            # the owner's OTHER leases forever (no further disconnect
+            # event will fire for an already-closed connection).
             self._reap_lease(lease_id)
             if not fut.done():
                 fut.set_exception(
                     ConnectionError("lease requester disconnected")
                 )
             return
+        if lease.owner_id:
+            self._owner_conns[lease.owner_id] = conn
+            timer = self._owner_reap_timers.pop(lease.owner_id, None)
+            if timer:
+                timer.cancel()
         if not fut.done():
             fut.set_result(
                 {
@@ -746,13 +763,75 @@ class NodeAgent:
             else:
                 kept.append((payload, fut, qconn))
         self._lease_queue = kept
-        leaked = [
-            lid for lid, lease in self.leases.items()
+        affected = [
+            (lid, lease) for lid, lease in self.leases.items()
             if getattr(lease, "owner_conn", None) is conn
         ]
-        for lid in leaked:
-            logger.info("reaping lease %d from disconnected driver", lid)
-            self._reap_lease(lid)
+        owners_with_id = set()
+        for lid, lease in affected:
+            owner_id = getattr(lease, "owner_id", None)
+            if owner_id:
+                owners_with_id.add(owner_id)
+            else:
+                # Legacy/no-id lease: the connection WAS the identity.
+                logger.info("reaping lease %d from disconnected driver", lid)
+                self._reap_lease(lid)
+        # Owners bound to this conn with NO leases: nothing to grace —
+        # drop the mapping now so dead connections don't accumulate.
+        for owner_id, oconn in list(self._owner_conns.items()):
+            if oconn is conn and owner_id not in owners_with_id:
+                self._owner_conns.pop(owner_id, None)
+                timer = self._owner_reap_timers.pop(owner_id, None)
+                if timer:
+                    timer.cancel()
+        # Identified owners get a reconnection grace window: a retrying
+        # client that lost one socket re-associates via owner_ping /
+        # request_lease; only an owner that stays silent is reaped.
+        for owner_id in owners_with_id:
+            if self._owner_conns.get(owner_id) is not conn:
+                continue  # already re-associated to a newer connection
+            timer = self._owner_reap_timers.pop(owner_id, None)
+            if timer:
+                timer.cancel()
+            self._owner_reap_timers[owner_id] = (
+                asyncio.get_running_loop().call_later(
+                    GlobalConfig.lease_owner_grace_s,
+                    self._reap_owner_if_silent, owner_id, conn,
+                )
+            )
+
+    def _reap_owner_if_silent(self, owner_id: str, dead_conn):
+        """Grace expired: reap the owner's leases unless it reconnected."""
+        self._owner_reap_timers.pop(owner_id, None)
+        current = self._owner_conns.get(owner_id)
+        if current is not dead_conn and current is not None and not getattr(
+            current, "closed", False
+        ):
+            return  # owner came back on a new connection; leases live on
+        for lid, lease in list(self.leases.items()):
+            if getattr(lease, "owner_id", None) == owner_id:
+                logger.info(
+                    "reaping lease %d from silent owner %s", lid, owner_id
+                )
+                self._reap_lease(lid)
+        self._owner_conns.pop(owner_id, None)
+
+    def handle_owner_ping(self, payload, conn):
+        """Driver liveness + lease re-association (sent periodically and
+        after client reconnects)."""
+        owner_id = payload.get("owner_id")
+        if not owner_id:
+            return True
+        prev = self._owner_conns.get(owner_id)
+        self._owner_conns[owner_id] = conn
+        timer = self._owner_reap_timers.pop(owner_id, None)
+        if timer:
+            timer.cancel()
+        if prev is not conn:
+            for lease in self.leases.values():
+                if getattr(lease, "owner_id", None) == owner_id:
+                    lease.owner_conn = conn
+        return True
 
     def _reap_lease(self, lease_id: int):
         """Release a dead owner's lease: free resources, KILL the worker

@@ -46,6 +46,10 @@ class RpcError(Exception):
     pass
 
 
+class RpcTimeoutError(RpcError):
+    """Per-call deadline expired (connection may be healthy)."""
+
+
 class RpcConnectionError(RpcError):
     """Transport-level failure; safe to retry idempotent calls."""
 
@@ -83,6 +87,37 @@ class _ChaosInjector:
 
     def fail_response(self, method) -> bool:
         return random.random() < self._probs(method)[1]
+
+
+class _DelayInjector:
+    """Network-latency chaos — the transport-level analog of the
+    reference's tc-qdisc delay experiments
+    (``python/ray/tests/chaos/chaos_network_delay.yaml``): outgoing calls
+    sleep delay±jitter before hitting the wire, per the
+    ``testing_network_delay`` spec ('method:prob:delay_ms[:jitter_ms]')."""
+
+    def __init__(self):
+        self._rules: Dict[str, Tuple[float, float, float]] = {}
+        spec = GlobalConfig.testing_network_delay
+        if spec:
+            for entry in spec.split(","):
+                parts = entry.strip().split(":")
+                if len(parts) >= 3:
+                    self._rules[parts[0]] = (
+                        float(parts[1]),
+                        float(parts[2]) / 1e3,
+                        (float(parts[3]) / 1e3 if len(parts) > 3 else 0.0),
+                    )
+
+    def enabled(self) -> bool:
+        return bool(self._rules)
+
+    def delay_s(self, method) -> float:
+        rule = self._rules.get(method) or self._rules.get("*")
+        if rule is None or random.random() >= rule[0]:
+            return 0.0
+        prob, delay, jitter = rule
+        return max(0.0, delay + random.uniform(-jitter, jitter))
 
 
 def parse_address(addr: Address) -> Tuple[str, int]:
@@ -392,6 +427,7 @@ class RpcClient:
         self._read_task = None
         self._closed = False
         self._chaos = _ChaosInjector()
+        self._delay = _DelayInjector()
 
     async def connect(self):
         host, port = parse_address(self.address)
@@ -526,6 +562,10 @@ class RpcClient:
             raise RpcConnectionError(f"not connected to {self.address}")
         if self._chaos.enabled() and self._chaos.fail_request(method):
             raise RpcConnectionError(f"[chaos] dropped request {method}")
+        if self._delay.enabled():
+            d = self._delay.delay_s(method)
+            if d > 0:
+                await asyncio.sleep(d)
         # Single-threaded loop: id allocation + buffer append are atomic.
         msg_id = self._next_id
         self._next_id += 1
@@ -554,7 +594,9 @@ class RpcClient:
                 result = await asyncio.wait_for(fut, timeout=timeout)
         except asyncio.TimeoutError:
             self._pending.pop(msg_id, None)
-            raise RpcError(f"rpc {method} to {self.address} timed out after {timeout}s")
+            raise RpcTimeoutError(
+                f"rpc {method} to {self.address} timed out after {timeout}s"
+            )
         if self._chaos.enabled() and self._chaos.fail_response(method):
             raise RpcConnectionError(f"[chaos] dropped response {method}")
         return result
@@ -595,14 +637,21 @@ class RetryableRpcClient:
         self._connect_lock = asyncio.Lock()
 
     async def _ensure(self) -> RpcClient:
-        if self._client and self._client.connected:
-            return self._client
+        client = self._client
+        if client and client.connected:
+            return client
         async with self._connect_lock:
-            if self._client and self._client.connected:
-                return self._client
-            self._client = RpcClient(self.address, self._push_handler)
-            await self._client.connect()
-            return self._client
+            client = self._client
+            if client and client.connected:
+                return client
+            # Work on a LOCAL and publish only after connect succeeds: a
+            # concurrent call's failure path nulls self._client, and
+            # returning the attribute (not the local) could hand back
+            # None mid-connect.
+            client = RpcClient(self.address, self._push_handler)
+            await client.connect()
+            self._client = client
+            return client
 
     async def call(
         self, method: str, payload=None, timeout=None, retries=None,

@@ -42,7 +42,7 @@ WORKING_DIR_ENV = "RAY_TPU_RT_WORKING_DIR"
 PY_MODULES_ENV = "RAY_TPU_RT_PY_MODULES"
 VENV_PY_ENV = "RAY_TPU_RT_VENV_PY"
 
-_UNSUPPORTED = ("conda", "container", "image_uri")
+_UNSUPPORTED = ("container", "image_uri")
 
 
 def _cache_root() -> str:
@@ -193,6 +193,92 @@ def build_pip_env(spec) -> str:
     return py
 
 
+def _conda_binary() -> Optional[str]:
+    for name in ("conda", "mamba", "micromamba"):
+        path = shutil.which(name)
+        if path:
+            return path
+    return None
+
+
+def build_conda_env(spec) -> str:
+    """Resolve a conda runtime env to its python interpreter.
+
+    Reference: ``python/ray/_private/runtime_env/conda.py`` — three spec
+    shapes: an existing env NAME, a path to an ``environment.yml``, or an
+    inline dict (written to a yml).  Created envs are cached per content
+    hash like pip venvs.  Gated: raises a clear error when no conda-like
+    binary (conda/mamba/micromamba) is on PATH.
+    """
+    conda = _conda_binary()
+    if conda is None:
+        raise RuntimeError(
+            "runtime_env['conda'] requires a conda/mamba/micromamba binary "
+            "on PATH; none found on this host"
+        )
+
+    def env_python(prefix: str) -> str:
+        return os.path.join(prefix, "bin", "python")
+
+    if isinstance(spec, str) and not spec.endswith((".yml", ".yaml")):
+        # Existing named env: ask conda where it lives.
+        proc = subprocess.run(
+            [conda, "env", "list", "--json"],
+            capture_output=True, text=True, timeout=120,
+        )
+        if proc.returncode == 0:
+            for prefix in json.loads(proc.stdout).get("envs", []):
+                if os.path.basename(prefix) == spec:
+                    return env_python(prefix)
+        raise RuntimeError(f"conda env {spec!r} not found")
+
+    if isinstance(spec, str):
+        with open(spec, "rb") as f:
+            content = f.read()
+    else:
+        # Inline dict -> minimal YAML (dependencies / channels lists).
+        lines = []
+        for key in ("name", "channels", "dependencies"):
+            val = spec.get(key)
+            if val is None:
+                continue
+            if isinstance(val, list):
+                lines.append(f"{key}:")
+                lines.extend(f"  - {v}" for v in val)
+            else:
+                lines.append(f"{key}: {val}")
+        content = ("\n".join(lines) + "\n").encode()
+
+    digest = hashlib.sha1(content).hexdigest()[:16]
+    prefix = os.path.join(_cache_root(), "conda", digest)
+    ready = os.path.join(prefix, ".ready")
+    if os.path.exists(ready):
+        return env_python(prefix)
+    import fcntl
+
+    os.makedirs(os.path.dirname(prefix), exist_ok=True)
+    with open(prefix + ".lock", "w") as lk:
+        fcntl.flock(lk, fcntl.LOCK_EX)
+        if os.path.exists(ready):
+            return env_python(prefix)
+        shutil.rmtree(prefix, ignore_errors=True)
+        yml = prefix + ".yml"
+        with open(yml, "wb") as f:
+            f.write(content)
+        proc = subprocess.run(
+            [conda, "env", "create", "-p", prefix, "-f", yml],
+            capture_output=True, text=True, timeout=1800,
+        )
+        if proc.returncode != 0:
+            shutil.rmtree(prefix, ignore_errors=True)
+            raise RuntimeError(
+                f"conda runtime_env build failed: {proc.stderr[-2000:]}"
+            )
+        with open(ready, "w") as f:
+            f.write(digest)
+    return env_python(prefix)
+
+
 def resolve_runtime_env(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, str]:
     """Driver side: normalize a runtime_env dict into worker env vars.
 
@@ -208,7 +294,7 @@ def resolve_runtime_env(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, str]
                 "dependencies into the image"
             )
     unknown = set(runtime_env) - {
-        "env_vars", "working_dir", "py_modules", "pip", "uv"
+        "env_vars", "working_dir", "py_modules", "pip", "uv", "conda"
     }
     if unknown:
         raise ValueError(f"unknown runtime_env keys: {sorted(unknown)}")
@@ -229,6 +315,15 @@ def resolve_runtime_env(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, str]
     pip_spec = runtime_env.get("pip") or runtime_env.get("uv")
     if pip_spec:
         env[VENV_PY_ENV] = build_pip_env(pip_spec)
+    conda_spec = runtime_env.get("conda")
+    if conda_spec:
+        if pip_spec:
+            raise ValueError(
+                "runtime_env cannot combine 'conda' with 'pip'/'uv' — the "
+                "conda env owns the interpreter (put pip deps in the conda "
+                "spec's dependencies)"
+            )
+        env[VENV_PY_ENV] = build_conda_env(conda_spec)
     return env
 
 

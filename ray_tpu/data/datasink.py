@@ -38,6 +38,16 @@ class Datasink:
     def _rows(block: Block) -> List[dict]:
         return [r if isinstance(r, dict) else {"value": r} for r in block]
 
+    @staticmethod
+    def _key_union(rows: List[dict]) -> List[str]:
+        """Ordered union of row keys (heterogeneous rows allowed)."""
+        keys: List[str] = []
+        for r in rows:
+            for k in r:
+                if k not in keys:
+                    keys.append(k)
+        return keys
+
 
 class ParquetDatasink(Datasink):
     extension = ".parquet"
@@ -59,11 +69,7 @@ class CSVDatasink(Datasink):
         import csv
 
         rows = self._rows(block)
-        keys: list = []
-        for r in rows:  # union, ordered — heterogeneous rows allowed
-            for k in r:
-                if k not in keys:
-                    keys.append(k)
+        keys = self._key_union(rows)
         with open(path, "w", newline="") as f:
             if rows:
                 writer = csv.DictWriter(f, fieldnames=keys, restval="")
@@ -99,11 +105,7 @@ class NumpyDatasink(Datasink):
             np.savez(path, **block.columns)
             return {"path": path, "rows": len(block)}
         rows = self._rows(block)
-        keys: list = []
-        for r in rows:  # union, ordered — heterogeneous rows allowed
-            for k in r:
-                if k not in keys:
-                    keys.append(k)
+        keys = self._key_union(rows)
         np.savez(
             path,
             **{k: np.asarray([r.get(k) for r in rows]) for k in keys},

@@ -33,6 +33,7 @@ from .rl_module import (  # noqa: F401
 from .sac import SAC, SACConfig  # noqa: F401
 from .offline import OfflineData, record_transitions  # noqa: F401
 from .cql import CQL, CQLConfig  # noqa: F401
+from .dreamerv3 import DreamerV3, DreamerV3Config  # noqa: F401
 from .iql import IQL, IQLConfig, IQLModule  # noqa: F401
 from .multi_agent import (  # noqa: F401
     ALL_DONE,

@@ -1,0 +1,110 @@
+"""Network-delay chaos + soak-style churn (reference analogs:
+python/ray/tests/chaos/chaos_network_delay.yaml — tc qdisc latency — and
+release/nightly_tests/stress_tests/ long-running actor churn, scaled to
+CI length)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def test_correct_under_network_delay_and_drops():
+    """30% of RPCs +20ms, 2% dropped each way: everything still completes
+    correctly through retries (latency chaos must not corrupt results)."""
+    ray_tpu.init(
+        num_cpus=4,
+        _system_config={
+            "testing_network_delay": "*:0.3:20:10",
+            "task_push_keepalive_s": 5.0,
+            "testing_rpc_failure": "push_task:0.02:0.02",
+            "rpc_max_retries": 8,
+        },
+    )
+    try:
+        @ray_tpu.remote(max_retries=4)
+        def square(x):
+            return x * x
+
+        t0 = time.monotonic()
+        out = ray_tpu.get(
+            [square.remote(i) for i in range(60)], timeout=300
+        )
+        assert out == [i * i for i in range(60)]
+
+        @ray_tpu.remote
+        class Acc:
+            def __init__(self):
+                self.v = 0
+
+            def add(self, x):
+                self.v += x
+                return self.v
+
+        a = Acc.remote()
+        for i in range(20):
+            ray_tpu.get(a.add.remote(1), timeout=120)
+        assert ray_tpu.get(a.add.remote(0), timeout=120) == 20
+        assert time.monotonic() - t0 < 280
+    finally:
+        ray_tpu.shutdown()
+
+
+@pytest.mark.slow
+def test_soak_actor_and_task_churn():
+    """~45s of continuous create/call/kill churn; the node must neither
+    leak workers nor wedge (scaled-down stress_tests analog)."""
+    ray_tpu.init(num_cpus=4, _system_config={"prestart_workers": 2})
+    try:
+        @ray_tpu.remote(num_cpus=0.01)
+        class Worker:
+            def __init__(self, idx):
+                self.idx = idx
+
+            def work(self, x):
+                return self.idx + x
+
+        @ray_tpu.remote
+        def noise(i):
+            return np.int64(i) * 2
+
+        deadline = time.monotonic() + 45
+        cycles = 0
+        while time.monotonic() < deadline:
+            actors = [Worker.remote(i) for i in range(3)]
+            results = ray_tpu.get(
+                [a.work.remote(10) for a in actors], timeout=120
+            )
+            assert results == [10, 11, 12]
+            task_out = ray_tpu.get(
+                [noise.remote(i) for i in range(20)], timeout=120
+            )
+            assert task_out == [2 * i for i in range(20)]
+            for a in actors:
+                ray_tpu.kill(a)
+            cycles += 1
+        assert cycles >= 3
+
+        # Churn must not accumulate workers: give the monitor a beat, then
+        # count live worker processes via the agent.
+        import asyncio
+
+        from ray_tpu.core import api_frontend
+        from ray_tpu.core.rpc import RetryableRpcClient
+
+        time.sleep(3)
+        worker = api_frontend.global_worker()
+
+        async def q():
+            client = RetryableRpcClient(worker.agent_address)
+            try:
+                return await client.call("debug_state", {})
+            finally:
+                await client.close()
+
+        state = asyncio.run(q())
+        assert state["num_workers"] <= 12, state
+    finally:
+        ray_tpu.shutdown()

@@ -1,0 +1,77 @@
+"""DreamerV3: world model + imagination actor-critic (reference:
+rllib/algorithms/dreamerv3/).  Asserts the world-model loss actually
+DECREASES (the model learns the env dynamics), both parameter sets move,
+imagination rollouts are finite, and checkpoints round-trip."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import DreamerV3Config
+from ray_tpu.rllib.env import CartPole, Pendulum
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def _build(env, **training):
+    cfg = (
+        DreamerV3Config()
+        .environment(env)
+        .env_runners(1, rollout_steps=128)
+        .debugging(seed=7)
+    )
+    defaults = dict(min_buffer=256, train_ratio=4, batch_size=8, seq_len=12)
+    defaults.update(training)
+    return cfg.training(**defaults).build()
+
+
+def test_dreamer_world_model_learns_cartpole(cluster):
+    import jax
+
+    algo = _build(CartPole)
+    wm0 = jax.tree.map(np.copy, algo.wm)
+    ac0 = jax.tree.map(np.copy, algo.ac)
+    losses = []
+    for _ in range(6):
+        result = algo.train()
+        if "wm_loss" in result:
+            losses.append(result["wm_loss"])
+    assert len(losses) >= 4, f"never reached min_buffer: {result}"
+    assert all(np.isfinite(l) for l in losses)
+    # The world model fits the dynamics: loss drops from first to last.
+    assert losses[-1] < losses[0], losses
+    assert np.isfinite(result["imag_return"])
+
+    def moved(a, b):
+        return sum(
+            float(np.abs(np.asarray(x) - np.asarray(y)).sum())
+            for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+        )
+
+    assert moved(wm0, algo.wm) > 0
+    assert moved(ac0, algo.ac) > 0
+    algo.stop()
+
+
+def test_dreamer_continuous_and_checkpoint(cluster, tmp_path):
+    algo = _build(Pendulum, min_buffer=128)
+    for _ in range(3):
+        result = algo.train()
+    assert result["buffer_size"] > 0
+    path = algo.save(str(tmp_path))
+    it = algo.iteration
+
+    algo2 = _build(Pendulum, min_buffer=128)
+    algo2.restore(path)
+    assert algo2.iteration == it
+    np.testing.assert_allclose(
+        np.asarray(algo2.wm["gru"]["wi"]),
+        np.asarray(algo.wm["gru"]["wi"]),
+    )
+    algo.stop()
+    algo2.stop()

@@ -1,0 +1,91 @@
+"""conda runtime envs (reference: python/ray/_private/runtime_env/conda.py).
+
+The real conda binary is absent on this box, so these tests exercise the
+full resolution machinery against a FAKE conda on PATH (the reference
+likewise tests with fakes), plus the gated error when nothing is found.
+"""
+
+import json
+import os
+import stat
+import sys
+
+import pytest
+
+from ray_tpu.core import runtime_env as rte
+
+FAKE_CONDA = """#!{python}
+import json, os, sys
+
+args = sys.argv[1:]
+if args[:3] == ["env", "list", "--json"]:
+    print(json.dumps({{"envs": ["{base}/envs/existing-env"]}}))
+elif args[:2] == ["env", "create"]:
+    prefix = args[args.index("-p") + 1]
+    yml = args[args.index("-f") + 1]
+    os.makedirs(os.path.join(prefix, "bin"), exist_ok=True)
+    with open(os.path.join(prefix, "bin", "python"), "w") as f:
+        f.write(open(yml).read())  # record the spec for assertions
+else:
+    sys.exit(2)
+"""
+
+
+@pytest.fixture
+def fake_conda(tmp_path, monkeypatch):
+    base = tmp_path / "conda_base"
+    envdir = base / "envs" / "existing-env" / "bin"
+    envdir.mkdir(parents=True)
+    (envdir / "python").write_text("#!fake\n")
+    script = tmp_path / "bin" / "conda"
+    script.parent.mkdir()
+    script.write_text(FAKE_CONDA.format(python=sys.executable, base=base))
+    script.chmod(script.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("PATH", f"{script.parent}:{os.environ['PATH']}")
+    monkeypatch.setenv("RAY_TPU_LOG_DIR", str(tmp_path / "cache"))
+    return base
+
+
+def test_conda_gated_without_binary(monkeypatch, tmp_path):
+    monkeypatch.setenv("PATH", str(tmp_path))  # no conda anywhere
+    with pytest.raises(RuntimeError, match="conda/mamba/micromamba"):
+        rte.build_conda_env({"dependencies": ["numpy"]})
+
+
+def test_conda_named_env_resolves(fake_conda):
+    py = rte.build_conda_env("existing-env")
+    assert py.endswith("existing-env/bin/python")
+    assert os.path.exists(py)
+    with pytest.raises(RuntimeError, match="not found"):
+        rte.build_conda_env("no-such-env")
+
+
+def test_conda_inline_spec_creates_and_caches(fake_conda):
+    spec = {"channels": ["conda-forge"], "dependencies": ["python=3.11"]}
+    py = rte.build_conda_env(spec)
+    assert os.path.exists(py)
+    recorded = open(py).read()
+    assert "conda-forge" in recorded and "python=3.11" in recorded
+    # Cached: second build returns the same interpreter without recreating.
+    mtime = os.path.getmtime(py)
+    assert rte.build_conda_env(spec) == py
+    assert os.path.getmtime(py) == mtime
+
+
+def test_conda_yml_file_spec(fake_conda, tmp_path):
+    yml = tmp_path / "environment.yml"
+    yml.write_text("name: x\ndependencies:\n  - pip\n")
+    py = rte.build_conda_env(str(yml))
+    assert os.path.exists(py)
+
+
+def test_resolve_rejects_conda_plus_pip(fake_conda):
+    with pytest.raises(ValueError, match="cannot combine"):
+        rte.resolve_runtime_env(
+            {"conda": "existing-env", "pip": ["requests"]}
+        )
+
+
+def test_resolve_conda_sets_interpreter(fake_conda):
+    env = rte.resolve_runtime_env({"conda": "existing-env"})
+    assert env[rte.VENV_PY_ENV].endswith("existing-env/bin/python")
