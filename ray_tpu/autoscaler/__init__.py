@@ -12,5 +12,11 @@ slice-atomically.
 
 from .config import AutoscalingConfig, NodeTypeConfig  # noqa: F401
 from .autoscaler import Autoscaler  # noqa: F401
+from .command_runner import (  # noqa: F401
+    CommandRunner,
+    LocalCommandRunner,
+    ManagedVMProvider,
+    SSHCommandRunner,
+)
 from .provider import FakeMultiNodeProvider, NodeProvider  # noqa: F401
 from .sdk import request_resources  # noqa: F401

@@ -692,6 +692,7 @@ class CoreWorker:
         self._actor_seq_state: Dict[tuple, dict] = {}  # (caller, inc) -> {expected, buffer}
         self._current_task_name = ""
         self._shutdown = False
+        self._inflight_submits: set = set()  # cancelled at shutdown
         self.task_events = None  # TaskEventBuffer, created on the loop
         # Streaming-generator returns: task_id -> stream state.  The item
         # queue holds ("item", ref) | ("end", None) | ("err", exc); "end"
@@ -854,6 +855,9 @@ class CoreWorker:
         # one loop tick to reach the wire before clients close).
         self._flush_delayed_refops()
         await asyncio.sleep(0)
+        for t in list(self._inflight_submits):
+            if not t.done():
+                t.cancel()
         # Ordered teardown (reference: core_worker/shutdown_coordinator.h):
         # cancel periodic loops first so nothing is left pending when the
         # event loop stops.
@@ -2070,7 +2074,13 @@ class CoreWorker:
             )
             if streaming:
                 self._new_stream(spec.task_id, spec)
-            asyncio.get_running_loop().create_task(self._submit_actor_task(spec))
+            t = asyncio.get_running_loop().create_task(
+                self._submit_actor_task(spec)
+            )
+            # Tracked so shutdown can cancel in-flight submissions instead
+            # of leaving "Task was destroyed but it is pending" noise.
+            self._inflight_submits.add(t)
+            t.add_done_callback(self._inflight_submits.discard)
 
         self._post(setup)
         if streaming:
@@ -2475,19 +2485,25 @@ class CoreWorker:
         return reply
 
     async def _handle_push_task_once(self, spec: TaskSpec):
-        fn = await self._get_function(spec.function_id)
-        # Exclusive execution via the pipeline (ticket order = dispatch
-        # order); coroutine/streaming tasks go through the bridge so the
-        # drainer still provides the mutual exclusion.
+        # The ticket MUST be issued before ANY await: ticket order is the
+        # pipeline's execution order, so it has to equal push-arrival
+        # order.  Allocating it after the function fetch deadlocked a
+        # pipelined pair once the LATER task's function was already cached
+        # (cache-hit task got the earlier ticket, then suspended forever
+        # in _resolve_args waiting for the cache-miss task's output, which
+        # sat behind it in the pipeline).
         ticket = self._exec_pipeline.ticket()
-        if spec.streaming or asyncio.iscoroutinefunction(fn):
-            try:
+        try:
+            fn = await self._get_function(spec.function_id)
+            if spec.streaming or asyncio.iscoroutinefunction(fn):
                 return await self._exec_pipeline.run_coro(
                     ticket, lambda: self._execute(spec, fn)
                 )
-            finally:
-                self._exec_pipeline.abandon(ticket)
-        return await self._execute(spec, fn, ticket=ticket)
+            return await self._execute(spec, fn, ticket=ticket)
+        finally:
+            # Idempotent: covers _get_function failures and every
+            # non-consuming path so the cursor can never wedge.
+            self._exec_pipeline.abandon(ticket)
 
     async def handle_actor_init(self, payload, conn):
         spec: ActorSpec = payload["spec"]

@@ -282,6 +282,10 @@ class NodeAgent:
             RAY_TPU_CP_ADDRESS=self.cp_address,
             RAY_TPU_SESSION_ID=self.session_id,
             RAY_TPU_NODE_ID=self.node_id.hex(),
+            # Log lines (and crash dumps) must reach the file when they
+            # happen, not when a block-buffered stdio flushes — a killed
+            # worker would otherwise leave an empty log.
+            PYTHONUNBUFFERED="1",
         )
         log_dir = os.environ.get("RAY_TPU_LOG_DIR", "/tmp/ray_tpu")
         os.makedirs(log_dir, exist_ok=True)

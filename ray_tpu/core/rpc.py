@@ -36,6 +36,24 @@ logger = logging.getLogger(__name__)
 
 Address = str  # "host:port"
 
+# ---------------------------------------------------------------- versioning
+# Cross-version story (the reference gets this from protobuf field
+# numbering + gRPC service evolution; a pickle-frame protocol needs an
+# explicit contract):
+#
+# * Frames are (msg_id, method, payload) tuples — adding a NEW method is
+#   always compatible (unknown methods error per-call, not per-connection),
+#   and payload dicts grow by adding keys that handlers .get() with
+#   defaults.  Those two rules cover same-version evolution.
+# * Incompatible changes bump PROTOCOL_VERSION; MIN_COMPAT_VERSION is the
+#   oldest peer still speakable.  Each client announces its version in a
+#   pipelined ``__hello__`` oneway frame (zero added round-trips); a server
+#   outside the compat window answers ``__goodbye__`` with its own range
+#   and closes, so a mixed-version cluster fails fast with a clear error
+#   instead of corrupting frames.
+PROTOCOL_VERSION = 1
+MIN_COMPAT_VERSION = 1
+
 # Sentinel timeout meaning "no per-call timer": the call completes when the
 # reply arrives or the connection dies (read-loop failure fails the future).
 # Any finite timeout a caller passes is enforced with a real timer.
@@ -52,6 +70,10 @@ class RpcTimeoutError(RpcError):
 
 class RpcConnectionError(RpcError):
     """Transport-level failure; safe to retry idempotent calls."""
+
+
+class RpcVersionError(RpcError):
+    """Peer's protocol version is outside our compatibility window."""
 
 
 class RpcRemoteError(RpcError):
@@ -223,6 +245,19 @@ class RpcServer:
                     logger.exception("on_connection_closed failed")
 
     def _process_frame(self, conn, loop, hcache, msg_id, method, payload):
+        if method == "__hello__" and msg_id == 0:
+            ver, peer_min = payload
+            if ver < MIN_COMPAT_VERSION or peer_min > PROTOCOL_VERSION:
+                conn.send_nowait(
+                    (0, "__goodbye__",
+                     (PROTOCOL_VERSION, MIN_COMPAT_VERSION))
+                )
+                # Close AFTER the goodbye flushes (both are call_soon'd on
+                # this loop, in order).
+                loop.call_soon(conn.close)
+            else:
+                conn.peer_version = ver
+            return
         entry = hcache.get(method)
         if entry is None:
             fn = getattr(self._handler, "handle_" + method, None)
@@ -324,6 +359,7 @@ class ServerConnection:
         self._drain_task: Optional[asyncio.Task] = None
         self.closed = False  # set on teardown; grant paths check liveness
         self.metadata: Dict[str, Any] = {}  # handlers can stash identity here
+        self.peer_version = 1  # pre-handshake peers are assumed v1
 
     def send_nowait(self, frame):
         """Queue a frame; flushed on the next loop pass."""
@@ -441,6 +477,11 @@ class RpcClient:
         except Exception:
             pass
         self._read_task = self._loop.create_task(self._read_loop())
+        # Version announcement: pipelined ahead of the first real call, so
+        # negotiation costs zero round-trips.
+        self._write_frame(
+            (0, "__hello__", (PROTOCOL_VERSION, MIN_COMPAT_VERSION))
+        )
         return self
 
     # Outgoing frames coalesce into one buffer flushed once per loop pass —
@@ -517,6 +558,15 @@ class RpcClient:
                 frame = await _read_frame(self._reader)
                 msg_id, kind, payload = frame
                 if msg_id == 0:
+                    if kind == "__goodbye__":
+                        sv, smin = payload
+                        self._closed = True
+                        self._fail_all_pending(RpcVersionError(
+                            f"server {self.address} speaks protocol "
+                            f"{sv} (min compat {smin}); this client is "
+                            f"{PROTOCOL_VERSION} (min {MIN_COMPAT_VERSION})"
+                        ))
+                        break
                     if self._push_handler:
                         try:
                             res = self._push_handler(kind, payload)

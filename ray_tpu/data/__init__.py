@@ -11,13 +11,19 @@ from .aggregate import (  # noqa: F401
 from .datasource import Datasource, ReadTask  # noqa: F401
 from .execution import ActorPoolStrategy  # noqa: F401
 from .arrow import from_arrow  # noqa: F401
+from .interop import from_huggingface, from_pandas, from_torch  # noqa: F401
 from .datasink import (  # noqa: F401
+    AvroDatasink,
     CSVDatasink,
     Datasink,
+    ImageDatasink,
     JSONDatasink,
     ManifestedDatasink,
     NumpyDatasink,
     ParquetDatasink,
+    SQLDatasink,
+    TFRecordsDatasink,
+    WebDatasetDatasink,
 )
 from .dataset import (  # noqa: F401
     DataIterator,
@@ -25,9 +31,14 @@ from .dataset import (  # noqa: F401
     from_blocks,
     from_items,
     range_dataset,
+    read_audio,
+    read_avro,
     read_binary_files,
     read_images,
+    read_sql,
     read_tfrecords,
+    read_videos,
+    read_webdataset,
     read_csv,
     read_datasource,
     read_json,

@@ -553,13 +553,16 @@ class Dataset:
             return metas
         return [m["path"] for m in metas]
 
-    def write_datasink(self, sink, dir_path: str) -> List[str]:
+    def write_datasink(self, sink, dir_path: str, *,
+                       return_meta: bool = False) -> List:
         """Write every block through a ``Datasink`` (reference: ray
         ``Dataset.write_datasink``): per-block writes fan out as tasks,
         then the sink's driver-side ``on_write_complete`` commit runs."""
         paths_meta = self._write(sink.write_block, dir_path, sink.extension,
                                  return_meta=True)
         sink.on_write_complete(paths_meta)
+        if return_meta:
+            return paths_meta
         return [m["path"] for m in paths_meta]
 
     def write_parquet(self, dir_path: str) -> List[str]:
@@ -582,12 +585,54 @@ class Dataset:
 
         return self.write_datasink(NumpyDatasink(), dir_path)
 
+    def write_tfrecords(self, dir_path: str) -> List[str]:
+        from .datasink import TFRecordsDatasink
+
+        return self.write_datasink(TFRecordsDatasink(), dir_path)
+
+    def write_avro(self, dir_path: str, *, schema: Optional[dict] = None,
+                   codec: str = "null") -> List[str]:
+        from .datasink import AvroDatasink
+
+        return self.write_datasink(AvroDatasink(schema, codec), dir_path)
+
+    def write_webdataset(self, dir_path: str) -> List[str]:
+        from .datasink import WebDatasetDatasink
+
+        return self.write_datasink(WebDatasetDatasink(), dir_path)
+
+    def write_sql(self, table: str, connection_factory, *,
+                  paramstyle: str = "qmark") -> int:
+        """INSERT every row into a DB-API table; returns rows written.
+        The sink creates no files — the write dir is only a task label."""
+        from .datasink import SQLDatasink
+
+        import tempfile
+
+        metas = self.write_datasink(
+            SQLDatasink(table, connection_factory, paramstyle),
+            tempfile.gettempdir(), return_meta=True,
+        )
+        return sum(m.get("rows", 0) for m in metas)
+
+    def write_images(self, dir_path: str, *, column: str = "image",
+                     format: str = "png") -> List[str]:
+        from .datasink import ImageDatasink
+
+        return self.write_datasink(ImageDatasink(column, format), dir_path)
+
     def to_arrow(self):
         """Materialize as ONE pyarrow.Table (zero-copy for primitive
         columnar columns — see ray_tpu.data.arrow)."""
         from .arrow import dataset_to_arrow
 
         return dataset_to_arrow(self)
+
+    def to_pandas(self):
+        """Materialize as ONE pandas.DataFrame (via the Arrow bridge)."""
+        from .interop import dataset_to_pandas
+
+        return dataset_to_pandas(self)
 
     # --------------------------------------------------------------- splits
     def split(self, n: int) -> List["Dataset"]:
@@ -715,3 +760,51 @@ def read_tfrecords(path: str, parallelism: int = 8) -> Dataset:
     from .datasource import TFRecordsDatasource
 
     return read_datasource(TFRecordsDatasource(path), parallelism)
+
+
+def read_avro(path: str, parallelism: int = 8) -> Dataset:
+    """Avro object-container files → dict rows, dependency-free (ray's
+    avro_datasource imports fastavro; the framing + binary codec are
+    hand-rolled in ``data/avro.py``)."""
+    from .datasource import AvroDatasource
+
+    return read_datasource(AvroDatasource(path), parallelism)
+
+
+def read_webdataset(path: str, parallelism: int = 8) -> Dataset:
+    """WebDataset tar shards → one row per sample (``__key__`` + one
+    column per member extension); stdlib-tarfile implementation — see
+    ``WebDatasetDatasource``."""
+    from .datasource import WebDatasetDatasource
+
+    return read_datasource(WebDatasetDatasource(path), parallelism)
+
+
+def read_audio(path: str, parallelism: int = 8) -> Dataset:
+    """PCM WAV files → ``{"audio", "sample_rate", "path"}`` rows
+    (stdlib ``wave`` decode — see ``AudioDatasource``)."""
+    from .datasource import AudioDatasource
+
+    return read_datasource(AudioDatasource(path), parallelism)
+
+
+def read_videos(path: str, parallelism: int = 8, *,
+                stride: int = 1) -> Dataset:
+    """Video files → one row per (strided) frame via OpenCV — see
+    ``VideoDatasource``."""
+    from .datasource import VideoDatasource
+
+    return read_datasource(VideoDatasource(path, stride), parallelism)
+
+
+def read_sql(sql: str, connection_factory, parallelism: int = 8, *,
+             shard_key: Optional[str] = None) -> Dataset:
+    """Rows from any DB-API 2.0 database.  ``connection_factory`` must be
+    a picklable zero-arg callable (connections open inside read tasks);
+    pass ``shard_key`` (an integer column) to split the query across
+    ``parallelism`` tasks."""
+    from .datasource import SQLDatasource
+
+    return read_datasource(
+        SQLDatasource(sql, connection_factory, shard_key), parallelism
+    )

@@ -113,6 +113,144 @@ class NumpyDatasink(Datasink):
         return {"path": path, "rows": len(rows)}
 
 
+class TFRecordsDatasink(Datasink):
+    """tf.train.Example TFRecord files, TF-free (codec shared with the
+    read path in ``data/tfrecord.py``; reference ``tfrecords_datasink.py``
+    imports TensorFlow)."""
+
+    extension = ".tfrecord"
+
+    def write_block(self, block: Block, path: str) -> Dict[str, Any]:
+        from .tfrecord import write_tfrecord_file
+
+        rows = self._rows(block)
+        write_tfrecord_file(rows, path)
+        return {"path": path, "rows": len(rows)}
+
+
+class AvroDatasink(Datasink):
+    """Avro object-container files (codec in ``data/avro.py``).  Schema is
+    inferred per block unless pinned at construction — pin it when blocks
+    may be heterogeneous."""
+
+    extension = ".avro"
+
+    def __init__(self, schema: Dict[str, Any] = None, codec: str = "null"):
+        self.schema = schema
+        self.codec = codec
+
+    def write_block(self, block: Block, path: str) -> Dict[str, Any]:
+        from .avro import write_avro_file
+
+        rows = self._rows(block)
+        write_avro_file(rows, path, schema=self.schema, codec=self.codec)
+        return {"path": path, "rows": len(rows)}
+
+
+class WebDatasetDatasink(Datasink):
+    """One ``.tar`` shard per block (reference ``webdataset_datasink.py``).
+    Rows are WebDataset samples: ``__key__`` names the sample, every other
+    column becomes a tar member ``<key>.<column>``; bytes pass through,
+    str utf-8-encodes, anything else JSON-encodes."""
+
+    extension = ".tar"
+
+    def write_block(self, block: Block, path: str) -> Dict[str, Any]:
+        import io
+        import json
+        import tarfile
+
+        rows = self._rows(block)
+        with tarfile.open(path, "w") as tf:
+            for i, row in enumerate(rows):
+                key = str(row.get("__key__", f"{i:08d}"))
+                for col, value in row.items():
+                    if col == "__key__":
+                        continue
+                    if isinstance(value, (bytes, bytearray)):
+                        data = bytes(value)
+                    elif isinstance(value, str):
+                        data = value.encode()
+                    else:
+                        data = json.dumps(value, default=str).encode()
+                    info = tarfile.TarInfo(f"{key}.{col}")
+                    info.size = len(data)
+                    tf.addfile(info, io.BytesIO(data))
+        return {"path": path, "rows": len(rows)}
+
+
+class SQLDatasink(Datasink):
+    """INSERT every row into a DB-API table (reference
+    ``sql_datasink.py``).  ``connection_factory`` runs inside the write
+    task; one connection + one executemany per block.  ``paramstyle``
+    must match the driver ("qmark" for sqlite3, "format"/"pyformat" for
+    postgres/mysql drivers) — DB-API placeholders are per-module and
+    undiscoverable from a connection object."""
+
+    extension = ""  # no files — "path" is only a task label
+
+    def __init__(self, table: str, connection_factory,
+                 paramstyle: str = "qmark"):
+        self.table = table
+        self.factory = connection_factory
+        self.paramstyle = paramstyle
+
+    def write_block(self, block: Block, path: str) -> Dict[str, Any]:
+        rows = self._rows(block)
+        if not rows:
+            return {"path": path, "rows": 0}
+        keys = self._key_union(rows)
+        conn = self.factory()
+        try:
+            ph = {"qmark": "?", "format": "%s", "pyformat": "%s",
+                  "numeric": None}.get(self.paramstyle)
+            if ph is None:
+                raise ValueError(
+                    f"unsupported paramstyle {self.paramstyle!r} "
+                    "(use qmark/format/pyformat)"
+                )
+            placeholders = ", ".join([ph] * len(keys))
+            sql = (
+                f"INSERT INTO {self.table} ({', '.join(keys)}) "
+                f"VALUES ({placeholders})"
+            )
+            conn.cursor().executemany(
+                sql, [tuple(r.get(k) for k in keys) for r in rows]
+            )
+            conn.commit()
+        finally:
+            conn.close()
+        return {"path": path, "rows": len(rows)}
+
+
+class ImageDatasink(Datasink):
+    """One image file per row via PIL (reference ``image_datasink.py``).
+    Rows carry an HxWxC uint8 array in ``column`` (default ``image``);
+    filenames come from a ``path`` column's basename when present."""
+
+    extension = ""  # writes one file per ROW; block path becomes a prefix
+
+    def __init__(self, column: str = "image", format: str = "png"):
+        self.column = column
+        self.format = format
+
+    def write_block(self, block: Block, path: str) -> Dict[str, Any]:
+        import numpy as np
+        from PIL import Image
+
+        n = 0
+        for i, row in enumerate(self._rows(block)):
+            arr = np.asarray(row[self.column])
+            if "path" in row:
+                stem = os.path.splitext(os.path.basename(str(row["path"])))[0]
+            else:
+                stem = f"{i:06d}"
+            out = f"{path}-{stem}.{self.format}"
+            Image.fromarray(arr).save(out)
+            n += 1
+        return {"path": path, "rows": n}
+
+
 class ManifestedDatasink(Datasink):
     """Wrap any sink with a commit manifest: the output directory gains a
     ``_MANIFEST.json`` listing every part file, written LAST — readers

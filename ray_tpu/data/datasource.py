@@ -378,5 +378,241 @@ class TextDatasource(Datasource):
         return tasks
 
 
+class AvroDatasource(Datasource):
+    """Avro object-container files, dependency-free (codec in
+    ``data/avro.py``; reference ``avro_datasource.py`` uses fastavro)."""
+
+    def __init__(self, path: str):
+        self._paths = _expand_paths(path, ".avro")
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        from .avro import read_avro_file
+
+        return [
+            ReadTask(lambda p=p: read_avro_file(p), {"path": p})
+            for p in self._paths
+        ]
+
+
+def decode_wds_member(name: str, data: bytes):
+    """WebDataset per-extension auto-decode: .json → object, .txt → str,
+    .cls → int label, everything else (incl. images) raw bytes."""
+    if name.endswith(".json"):
+        import json
+
+        return json.loads(data)
+    if name.endswith((".txt", ".text")):
+        return data.decode()
+    if name.endswith(".cls"):
+        return int(data.decode().strip())
+    return data
+
+
+class WebDatasetDatasource(Datasource):
+    """POSIX-tar sample archives (reference ``webdataset_datasource.py``,
+    which wraps the ``webdataset`` package; hand-rolled on stdlib tarfile
+    here).  Members sharing a basename form one sample: ``x/y.jpg`` +
+    ``x/y.cls`` + ``x/y.json`` → one row ``{"__key__": "x/y", "jpg": …,
+    "cls": …, "json": …}``."""
+
+    def __init__(self, path: str):
+        paths = _expand_paths(path, ".tar")
+        if os.path.isdir(path):
+            paths = sorted(
+                set(paths)
+                | set(_expand_paths(path, ".tgz"))
+                | set(_expand_paths(path, ".tar.gz"))
+            )
+        self._paths = paths
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        return [
+            ReadTask(lambda p=p: self._read_tar(p), {"path": p})
+            for p in self._paths
+        ]
+
+    @staticmethod
+    def _read_tar(path: str) -> List[dict]:
+        import tarfile
+
+        rows: List[dict] = []
+        current_key: Optional[str] = None
+        row: dict = {}
+        mode = "r:gz" if path.endswith((".tgz", ".tar.gz")) else "r"
+        with tarfile.open(path, mode) as tf:
+            for member in tf:
+                if not member.isfile():
+                    continue
+                base = member.name
+                # Sample key = path up to the FIRST dot of the basename
+                # (dots in directory names don't split).
+                slash = base.rfind("/") + 1
+                dot = base.find(".", slash)
+                if dot == -1:
+                    key, ext = base, "bin"
+                else:
+                    key, ext = base[:dot], base[dot + 1 :]
+                data = tf.extractfile(member).read()
+                if key != current_key:
+                    if current_key is not None:
+                        rows.append(row)
+                    current_key, row = key, {"__key__": key}
+                row[ext] = decode_wds_member(base, data)
+            if current_key is not None:
+                rows.append(row)
+        return rows
+
+
+class AudioDatasource(Datasource):
+    """PCM WAV files → ``{"audio": [samples, channels] float32 in [-1,1],
+    "sample_rate", "path"}`` rows.  Reference ``audio_datasource.py``
+    decodes via the ``soundfile`` package (absent here); WAV framing +
+    PCM decode are stdlib (``wave``) + numpy, which covers the dominant
+    ingest format without a native audio dependency."""
+
+    SUFFIXES = (".wav", ".wave")
+
+    def __init__(self, path: str):
+        self._paths = [
+            p for p in _expand_paths(path)
+            if p.lower().endswith(self.SUFFIXES)
+        ]
+        if not self._paths:
+            # Loud failure beats a silently empty dataset on a typo'd
+            # path or a directory with no matching files.
+            raise FileNotFoundError(
+                f"no {'/'.join(self.SUFFIXES)} files at {path!r}"
+            )
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        return [
+            ReadTask(lambda p=p: [self._read_wav(p)], {"path": p})
+            for p in self._paths
+        ]
+
+    @staticmethod
+    def _read_wav(path: str) -> dict:
+        import wave
+
+        with wave.open(path, "rb") as w:
+            n_ch = w.getnchannels()
+            width = w.getsampwidth()
+            rate = w.getframerate()
+            raw = w.readframes(w.getnframes())
+        if width == 2:
+            arr = np.frombuffer(raw, np.int16).astype(np.float32) / 32768.0
+        elif width == 4:
+            arr = np.frombuffer(raw, np.int32).astype(np.float32) / 2147483648.0
+        elif width == 1:  # unsigned 8-bit PCM
+            arr = (np.frombuffer(raw, np.uint8).astype(np.float32) - 128.0) / 128.0
+        else:
+            raise ValueError(f"{path}: unsupported PCM sample width {width}")
+        return {
+            "audio": arr.reshape(-1, n_ch),
+            "sample_rate": rate,
+            "path": path,
+        }
+
+
+class VideoDatasource(Datasource):
+    """Video files → one ``{"frame": HxWx3 uint8 RGB, "frame_index",
+    "path"}`` row per frame via OpenCV (reference ``video_datasource.py``
+    uses pyav; cv2 is what this image ships).  ``stride`` subsamples
+    frames at read time (the usual ingest decimation)."""
+
+    SUFFIXES = (".mp4", ".avi", ".mkv", ".mov", ".webm")
+
+    def __init__(self, path: str, stride: int = 1):
+        self._paths = [
+            p for p in _expand_paths(path)
+            if p.lower().endswith(self.SUFFIXES)
+        ]
+        if not self._paths:
+            raise FileNotFoundError(
+                f"no {'/'.join(self.SUFFIXES)} files at {path!r}"
+            )
+        self._stride = max(1, stride)
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        stride = self._stride
+        return [
+            ReadTask(lambda p=p: self._read_video(p, stride), {"path": p})
+            for p in self._paths
+        ]
+
+    @staticmethod
+    def _read_video(path: str, stride: int) -> List[dict]:
+        import cv2
+
+        cap = cv2.VideoCapture(path)
+        if not cap.isOpened():
+            raise ValueError(f"{path}: cv2 cannot open video")
+        rows = []
+        i = 0
+        try:
+            while True:
+                ok, frame = cap.read()
+                if not ok:
+                    break
+                if i % stride == 0:
+                    rows.append({
+                        "frame": cv2.cvtColor(frame, cv2.COLOR_BGR2RGB),
+                        "frame_index": i,
+                        "path": path,
+                    })
+                i += 1
+        finally:
+            cap.release()
+        return rows
+
+
+class SQLDatasource(Datasource):
+    """Rows from any DB-API 2.0 database (reference ``sql_datasource.py``).
+
+    ``connection_factory`` must be a picklable zero-arg callable returning
+    a DB-API connection — it is invoked *inside* the read task so each
+    worker opens its own connection.  With ``shard_keys`` the query is
+    split into ``parallelism`` tasks via ``WHERE mod(hash, n) = i``-style
+    sharding on the key column (falls back to ``%`` arithmetic, which
+    every DB-API engine can evaluate)."""
+
+    def __init__(self, sql: str, connection_factory: Callable,
+                 shard_key: Optional[str] = None):
+        self._sql = sql
+        self._factory = connection_factory
+        self._shard_key = shard_key
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        factory, sql = self._factory, self._sql
+
+        def run_query(query: str) -> List[dict]:
+            conn = factory()
+            try:
+                cur = conn.cursor()
+                cur.execute(query)
+                names = [d[0] for d in cur.description]
+                return [dict(zip(names, row)) for row in cur.fetchall()]
+            finally:
+                conn.close()
+
+        if self._shard_key is None or parallelism <= 1:
+            return [ReadTask(lambda: run_query(sql), {"sql": sql})]
+        key = self._shard_key
+        tasks = []
+        for i in range(parallelism):
+            # Non-negative modulo (dividend-signed `%` maps negative keys
+            # to no shard on most engines); NULL keys land in shard 0 so
+            # no row silently vanishes.
+            pred = f"((({key} % {parallelism}) + {parallelism}) " \
+                   f"% {parallelism}) = {i}"
+            if i == 0:
+                pred = f"({pred} OR {key} IS NULL)"
+            sharded = f"SELECT * FROM ({sql}) AS __t WHERE {pred}"
+            tasks.append(
+                ReadTask(lambda q=sharded: run_query(q), {"sql": sharded})
+            )
+        return tasks
+
+
 # Writes live in datasink.py (Datasink ABC + format sinks) — every
 # Dataset.write_* funnels through Dataset.write_datasink.

@@ -71,7 +71,11 @@ def _shuffle_map(item, transforms, n_out: int, part_fn, block_idx: int):
     parts: List[Block] = [[] for _ in range(n_out)]
     for i, row in enumerate(block):
         parts[part_fn(row, i, block_idx) % n_out].append(row)
-    return parts
+    # num_returns=n_out>1 splits the returned list into one object per
+    # partition; num_returns=1 returns the value VERBATIM, so the single
+    # partition must be returned bare or every 1-reducer exchange (e.g.
+    # repartition(1)) would emit a nested [rows] block.
+    return parts if n_out > 1 else parts[0]
 
 
 @ray_tpu.remote

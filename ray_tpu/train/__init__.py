@@ -2,6 +2,7 @@ from .backend import (  # noqa: F401
     AccelerateBackend,
     Backend,
     JaxBackend,
+    TensorflowBackend,
     TorchBackend,
 )
 from .checkpoint import Checkpoint  # noqa: F401
@@ -21,5 +22,6 @@ from .session import (  # noqa: F401
 from .trainer import (  # noqa: F401
     DataParallelTrainer,
     JaxTrainer,
+    TensorflowTrainer,
     TorchTrainer,
 )

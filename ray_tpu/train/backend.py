@@ -72,6 +72,41 @@ class TorchBackend(Backend):
         )
 
 
+class TensorflowBackend(Backend):
+    """TF_CONFIG-based MultiWorkerMirroredStrategy setup (reference:
+    ray ``train/tensorflow/config.py`` ``_setup_tensorflow_environment``).
+    Each worker reserves its own port; every rank gets the same cluster
+    spec with itself as ``task.index``, so a
+    ``tf.distribute.MultiWorkerMirroredStrategy()`` constructed inside
+    ``train_loop_per_worker`` rendezvouses over gRPC without any other
+    launcher."""
+
+    def on_start(self, worker_group):
+        import json
+
+        import ray_tpu
+
+        workers = worker_group.workers
+        addrs = ray_tpu.get(
+            [w.get_coordinator_address.remote(0) for w in workers],
+            timeout=60,
+        )
+        ray_tpu.get(
+            [
+                w.set_env.remote({
+                    "TF_CONFIG": json.dumps({
+                        "cluster": {"worker": list(addrs)},
+                        "task": {"type": "worker", "index": rank},
+                    }),
+                    # Silence TF's GPU probing on CPU/TPU-host workers.
+                    "CUDA_VISIBLE_DEVICES": "-1",
+                })
+                for rank, w in enumerate(workers)
+            ],
+            timeout=60,
+        )
+
+
 class AccelerateBackend(TorchBackend):
     """HuggingFace Accelerate over the torch gloo group (reference:
     ray ``train/huggingface/accelerate`` integration).  The torch process

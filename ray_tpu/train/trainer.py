@@ -215,3 +215,16 @@ class JaxTrainer(DataParallelTrainer):
     def __init__(self, *args, jax_platform: str = "", **kwargs):
         kwargs.setdefault("backend", JaxBackend(platform=jax_platform))
         super().__init__(*args, **kwargs)
+
+
+class TensorflowTrainer(DataParallelTrainer):
+    """DataParallelTrainer with the TF_CONFIG backend (reference: ray
+    ``train/tensorflow/tensorflow_trainer.py``) — the user loop builds a
+    ``tf.distribute.MultiWorkerMirroredStrategy()`` and trains
+    data-parallel over gRPC collectives."""
+
+    def __init__(self, *args, **kwargs):
+        from .backend import TensorflowBackend
+
+        kwargs.setdefault("backend", TensorflowBackend())
+        super().__init__(*args, **kwargs)

@@ -2,7 +2,14 @@
 
 Role-equivalent of the reference's ``python/ray/util/``: ActorPool
 (``util/actor_pool.py``), distributed Queue (``util/queue.py``), user
-metrics (``util/metrics.py``), and TPU slice helpers (``util/tpu.py``).
+metrics (``util/metrics.py``), TPU slice helpers (``util/tpu.py``), a
+``multiprocessing.Pool`` shim (``util/multiprocessing/pool.py``), and a
+joblib parallel backend (``util/joblib/``).
+
+``multiprocessing`` and ``joblib_backend`` are import-on-demand
+submodules (`from ray_tpu.util.multiprocessing import Pool`) — importing
+them eagerly here would shadow the stdlib module name inside this
+package and drag joblib into every startup.
 """
 
 from .actor_pool import ActorPool  # noqa: F401

@@ -1,0 +1,56 @@
+"""joblib parallel backend over the actor Pool.
+
+Reference: ray ``python/ray/util/joblib/`` — registers a backend so
+scikit-learn-style ``Parallel(n_jobs=…)`` code fans out on the cluster
+with one line::
+
+    from ray_tpu.util.joblib_backend import register_ray_tpu
+    register_ray_tpu()
+    with joblib.parallel_backend("ray_tpu"):
+        Parallel(n_jobs=8)(delayed(f)(x) for x in xs)
+
+joblib dispatches follow-on batches from completion callbacks, which the
+Pool's ``AsyncResult`` fires from its waiter thread — no polling.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def register_ray_tpu() -> None:
+    import joblib
+    from joblib._parallel_backends import MultiprocessingBackend
+
+    from .multiprocessing import Pool
+
+    class RayTpuBackend(MultiprocessingBackend):
+        # joblib's MultiprocessingBackend drives everything through
+        # _get_pool()'s apply_async; only pool construction changes.
+        supports_timeout = True
+
+        def configure(self, n_jobs=1, parallel=None, prefer=None,
+                      require=None, **kwargs):
+            n_jobs = self.effective_n_jobs(n_jobs)
+            self.parallel = parallel
+            self._pool = Pool(processes=n_jobs)
+            return n_jobs
+
+        def effective_n_jobs(self, n_jobs):
+            if n_jobs == 0:
+                raise ValueError("n_jobs == 0 has no meaning")
+            if n_jobs is None:
+                return 1
+            if n_jobs < 0:
+                return max(1, (os.cpu_count() or 1) + 1 + n_jobs)
+            return n_jobs
+
+        def _get_pool(self):
+            return self._pool
+
+        def terminate(self):
+            if getattr(self, "_pool", None) is not None:
+                self._pool.terminate()
+                self._pool = None
+
+    joblib.register_parallel_backend("ray_tpu", RayTpuBackend)

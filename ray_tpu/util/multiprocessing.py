@@ -1,0 +1,207 @@
+"""``multiprocessing.Pool`` shim over cluster actors.
+
+Reference: ray ``python/ray/util/multiprocessing/pool.py`` — the stdlib
+Pool surface (apply/map/starmap/imap + async variants) backed by a pool
+of actors, so existing Pool code scales past one machine unchanged.
+Redesigned small: one ``PoolActor`` per slot executes pickled callables;
+chunking happens in the driver; ``AsyncResult`` wraps object refs and
+fires callbacks from a waiter thread (joblib's dispatch loop depends on
+completion callbacks — see ``ray_tpu.util.joblib``).
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+from typing import Any, Callable, Iterable, List, Optional
+
+import ray_tpu
+
+
+@ray_tpu.remote(num_cpus=1)
+class PoolActor:
+    def __init__(self, initializer=None, initargs=()):
+        if initializer is not None:
+            initializer(*initargs)
+
+    def run_call(self, func, args, kwds):
+        return func(*args, **(kwds or {}))
+
+    def run_batch(self, func, batch, star=False):
+        if star:
+            return [func(*item) for item in batch]
+        return [func(item) for item in batch]
+
+    def ping(self):
+        return "pong"
+
+
+class AsyncResult:
+    """stdlib-compatible handle over one or more pending refs."""
+
+    def __init__(self, refs: List, single: bool, callback=None,
+                 error_callback=None):
+        self._refs = refs
+        self._single = single
+        self._value = None
+        self._error: Optional[BaseException] = None
+        self._done = threading.Event()
+        self._callback = callback
+        self._error_callback = error_callback
+        # Resolve in the background so ready()/callbacks work without a
+        # .get() caller; one daemon thread per in-flight batch is bounded
+        # by the pool's dispatch depth.
+        threading.Thread(target=self._wait, daemon=True).start()
+
+    def _wait(self):
+        try:
+            chunks = ray_tpu.get(self._refs)
+            value = chunks[0] if self._single else [
+                x for chunk in chunks for x in chunk
+            ]
+            self._value = value
+            self._done.set()
+            if self._callback is not None:
+                self._callback(value)
+        except BaseException as e:  # noqa: BLE001 — surfaced via get()
+            self._error = e
+            self._done.set()
+            if self._error_callback is not None:
+                self._error_callback(e)
+
+    def wait(self, timeout: Optional[float] = None):
+        self._done.wait(timeout)
+
+    def ready(self) -> bool:
+        return self._done.is_set()
+
+    def successful(self) -> bool:
+        if not self._done.is_set():
+            raise ValueError("result is not ready")
+        return self._error is None
+
+    def get(self, timeout: Optional[float] = None):
+        if not self._done.wait(timeout):
+            # stdlib Pool raises multiprocessing.TimeoutError (a
+            # ProcessError, NOT the builtin TimeoutError) — drop-in
+            # callers catch that type.
+            import multiprocessing as _mp
+
+            raise _mp.TimeoutError("AsyncResult.get timed out")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class Pool:
+    """Actor-backed ``multiprocessing.Pool``."""
+
+    def __init__(self, processes: Optional[int] = None, initializer=None,
+                 initargs=(), ray_remote_args: Optional[dict] = None):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        self._n = processes or os.cpu_count() or 1
+        opts = ray_remote_args or {}
+        cls = PoolActor.options(**opts) if opts else PoolActor
+        self._actors = [
+            cls.remote(initializer, tuple(initargs)) for _ in range(self._n)
+        ]
+        self._rr = itertools.count()
+        self._closed = False
+
+    # ------------------------------------------------------------- dispatch
+    def _next_actor(self):
+        if self._closed:
+            raise ValueError("Pool not running")
+        return self._actors[next(self._rr) % self._n]
+
+    def _chunks(self, iterable, chunksize: Optional[int]):
+        items = list(iterable)
+        if chunksize is None:
+            chunksize = max(1, len(items) // (self._n * 4) or 1)
+        return [
+            items[i : i + chunksize] for i in range(0, len(items), chunksize)
+        ], chunksize
+
+    # --------------------------------------------------------------- apply
+    def apply(self, func: Callable, args=(), kwds=None):
+        return self.apply_async(func, args, kwds).get()
+
+    def apply_async(self, func: Callable, args=(), kwds=None, callback=None,
+                    error_callback=None) -> AsyncResult:
+        ref = self._next_actor().run_call.remote(func, tuple(args), kwds)
+        return AsyncResult([ref], True, callback, error_callback)
+
+    # ----------------------------------------------------------------- map
+    def map(self, func: Callable, iterable: Iterable,
+            chunksize: Optional[int] = None) -> List[Any]:
+        return self.map_async(func, iterable, chunksize).get()
+
+    def map_async(self, func, iterable, chunksize=None, callback=None,
+                  error_callback=None) -> AsyncResult:
+        chunks, _ = self._chunks(iterable, chunksize)
+        refs = [
+            self._next_actor().run_batch.remote(func, chunk, False)
+            for chunk in chunks
+        ]
+        return AsyncResult(refs, False, callback, error_callback)
+
+    def starmap(self, func, iterable, chunksize=None) -> List[Any]:
+        return self.starmap_async(func, iterable, chunksize).get()
+
+    def starmap_async(self, func, iterable, chunksize=None, callback=None,
+                      error_callback=None) -> AsyncResult:
+        chunks, _ = self._chunks(
+            [tuple(item) for item in iterable], chunksize
+        )
+        refs = [
+            self._next_actor().run_batch.remote(func, chunk, True)
+            for chunk in chunks
+        ]
+        return AsyncResult(refs, False, callback, error_callback)
+
+    # ---------------------------------------------------------------- imap
+    def imap(self, func, iterable, chunksize: int = 1):
+        chunks, _ = self._chunks(iterable, chunksize)
+        refs = [
+            self._next_actor().run_batch.remote(func, chunk, False)
+            for chunk in chunks
+        ]
+        for ref in refs:  # ordered: resolve in submission order
+            yield from ray_tpu.get(ref)
+
+    def imap_unordered(self, func, iterable, chunksize: int = 1):
+        chunks, _ = self._chunks(iterable, chunksize)
+        pending = [
+            self._next_actor().run_batch.remote(func, chunk, False)
+            for chunk in chunks
+        ]
+        while pending:
+            done, pending = ray_tpu.wait(pending, num_returns=1)
+            for ref in done:  # wait may return MORE than num_returns ready
+                yield from ray_tpu.get(ref)
+
+    # ------------------------------------------------------------ lifecycle
+    def close(self):
+        self._closed = True
+
+    def terminate(self):
+        self._closed = True
+        for a in self._actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:  # noqa: BLE001 — already dead
+                pass
+        self._actors = []
+
+    def join(self):
+        if not self._closed:
+            raise ValueError("Pool is still running")
+        self._actors = []
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.terminate()

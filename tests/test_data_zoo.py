@@ -1,0 +1,296 @@
+"""Datasource/datasink zoo: Avro, WebDataset, SQL, TFRecord sink, image
+sink, and pandas/torch/HuggingFace interop (reference:
+python/ray/data/_internal/datasource/{avro,webdataset,sql,tfrecords,
+image}_datasource/.._datasink + read_api.from_pandas/from_torch/
+from_huggingface).  The Avro and WebDataset codecs are dependency-free
+(data/avro.py, stdlib tarfile) so round-trips here validate the wire
+format itself, not a vendored library.
+"""
+
+import os
+import sqlite3
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+from ray_tpu.data.avro import infer_schema, read_avro_file, write_avro_file
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+# ------------------------------------------------------------------- avro
+def test_avro_codec_roundtrip(tmp_path):
+    rows = [
+        {"i": 7, "f": 1.5, "s": "hello", "b": True, "raw": b"\x00\x01",
+         "tags": ["a", "b"], "m": {"k": 2}, "opt": None},
+        {"i": -123456789012, "f": -0.25, "s": "", "b": False, "raw": b"",
+         "tags": [], "m": {}, "opt": 9},
+    ]
+    path = str(tmp_path / "t.avro")
+    write_avro_file(rows, path)
+    assert read_avro_file(path) == rows
+
+
+def test_avro_deflate_codec(tmp_path):
+    rows = [{"x": i, "pad": "z" * 100} for i in range(500)]
+    null_p = str(tmp_path / "null.avro")
+    defl_p = str(tmp_path / "defl.avro")
+    write_avro_file(rows, null_p, codec="null")
+    write_avro_file(rows, defl_p, codec="deflate")
+    assert read_avro_file(defl_p) == rows
+    assert os.path.getsize(defl_p) < os.path.getsize(null_p) / 2
+
+
+def test_avro_schema_inference_nullable():
+    schema = infer_schema([{"a": 1, "b": None}, {"a": None, "b": "x"}])
+    by_name = {f["name"]: f["type"] for f in schema["fields"]}
+    assert by_name["a"] == ["null", "long"]
+    assert by_name["b"] == ["null", "string"]
+
+
+def test_read_write_avro_dataset(cluster, tmp_path):
+    ds = rd.from_items([{"id": i, "name": f"n{i}"} for i in range(100)])
+    out = str(tmp_path / "avro_out")
+    ds.write_avro(out)
+    back = rd.read_avro(out)
+    rows = sorted(back.take_all(), key=lambda r: r["id"])
+    assert rows == [{"id": i, "name": f"n{i}"} for i in range(100)]
+
+
+# -------------------------------------------------------------- webdataset
+def test_webdataset_roundtrip(cluster, tmp_path):
+    rows = [
+        {"__key__": f"sample{i:03d}", "cls": i % 10,
+         "txt": f"caption {i}", "json": {"idx": i},
+         "jpg": bytes([i % 256]) * 16}
+        for i in range(40)
+    ]
+    out = str(tmp_path / "wds")
+    rd.from_items(rows).write_webdataset(out)
+    assert any(p.endswith(".tar") for p in os.listdir(out))
+    back = sorted(rd.read_webdataset(out).take_all(),
+                  key=lambda r: r["__key__"])
+    assert len(back) == 40
+    r7 = back[7]
+    assert r7["__key__"] == "sample007"
+    assert r7["cls"] == 7          # .cls auto-decodes to int
+    assert r7["txt"] == "caption 7"
+    assert r7["json"] == {"idx": 7}
+    assert r7["jpg"] == bytes([7]) * 16  # images stay raw bytes
+
+
+# -------------------------------------------------------------------- sql
+def test_sql_read_write(cluster, tmp_path):
+    import functools
+
+    db_path = str(tmp_path / "t.db")
+    # functools.partial of a stdlib callable pickles by reference into the
+    # worker processes (a test-module function would not import there).
+    _connect = functools.partial(sqlite3.connect, db_path)
+    conn = sqlite3.connect(db_path)
+    conn.execute("CREATE TABLE src (id INTEGER, label TEXT)")
+    conn.executemany("INSERT INTO src VALUES (?, ?)",
+                     [(i, f"L{i}") for i in range(200)])
+    conn.execute("CREATE TABLE dst (id INTEGER, label TEXT)")
+    conn.commit()
+    conn.close()
+
+    ds = rd.read_sql("SELECT id, label FROM src", _connect,
+                     parallelism=4, shard_key="id")
+    rows = sorted(ds.take_all(), key=lambda r: r["id"])
+    assert rows[:2] == [{"id": 0, "label": "L0"}, {"id": 1, "label": "L1"}]
+    assert len(rows) == 200
+
+    # sqlite allows only one writer at a time — serialize the write path.
+    n = ds.filter(lambda r: r["id"] < 50).repartition(1).write_sql(
+        "dst", _connect)
+    assert n == 50
+    conn = sqlite3.connect(db_path)
+    assert conn.execute("SELECT COUNT(*) FROM dst").fetchone()[0] == 50
+    conn.close()
+
+
+# -------------------------------------------------------- tfrecords sink
+def test_tfrecords_sink_roundtrip(cluster, tmp_path):
+    rows = [{"x": i, "name": f"r{i}".encode()} for i in range(64)]
+    out = str(tmp_path / "tfr")
+    rd.from_items(rows).write_tfrecords(out)
+    back = rd.read_tfrecords(out).take_all()
+    # single-element features unwrap to scalars on read
+    assert sorted(int(r["x"]) for r in back) == list(range(64))
+    assert back[0]["name"].startswith(b"r")
+
+
+# ------------------------------------------------------------- image sink
+def test_image_sink(cluster, tmp_path):
+    from PIL import Image
+
+    imgs = [{"image": np.full((8, 8, 3), i * 20, np.uint8)} for i in range(5)]
+    out = str(tmp_path / "imgs")
+    rd.from_items(imgs).write_images(out)
+    files = [f for f in os.listdir(out) if f.endswith(".png")]
+    assert len(files) == 5
+    arr = np.asarray(Image.open(os.path.join(out, sorted(files)[0])))
+    assert arr.shape == (8, 8, 3)
+
+
+# ---------------------------------------------------------------- interop
+def test_from_to_pandas(cluster):
+    import pandas as pd
+
+    df = pd.DataFrame({"a": np.arange(100), "b": np.arange(100) * 0.5})
+    ds = rd.from_pandas(df, parallelism=4)
+    assert ds.count() == 100
+    out = ds.map_batches(lambda b: {"a": b["a"], "b": b["b"] * 2},
+                         batch_format="numpy").to_pandas()
+    assert list(out["b"][:3]) == [0.0, 1.0, 2.0]
+    assert len(out) == 100
+
+
+def test_from_torch(cluster):
+    import torch
+    from torch.utils.data import TensorDataset
+
+    tds = TensorDataset(torch.arange(50, dtype=torch.float32))
+    ds = rd.from_torch(tds, parallelism=4)
+    items = sorted(float(r["item"][0]) for r in ds.take_all())
+    assert items == [float(i) for i in range(50)]
+
+
+def test_from_huggingface(cluster):
+    datasets = pytest.importorskip("datasets")
+
+    hf = datasets.Dataset.from_dict(
+        {"text": [f"doc {i}" for i in range(30)], "label": list(range(30))}
+    )
+    ds = rd.from_huggingface(hf, parallelism=4)
+    rows = sorted(ds.take_all(), key=lambda r: r["label"])
+    assert len(rows) == 30
+    assert rows[3]["text"] == "doc 3"
+
+
+# ------------------------------------------------------------ audio/video
+def test_read_audio_wav(cluster, tmp_path):
+    import wave
+
+    path = str(tmp_path / "tone.wav")
+    rate = 8000
+    t = np.arange(rate, dtype=np.float32) / rate
+    mono = (np.sin(2 * np.pi * 440 * t) * 0.5 * 32767).astype(np.int16)
+    stereo = np.stack([mono, -mono], axis=1)
+    with wave.open(path, "wb") as w:
+        w.setnchannels(2)
+        w.setsampwidth(2)
+        w.setframerate(rate)
+        w.writeframes(stereo.tobytes())
+
+    rows = rd.read_audio(str(tmp_path)).take_all()
+    assert len(rows) == 1
+    r = rows[0]
+    assert r["sample_rate"] == rate
+    assert r["audio"].shape == (rate, 2)
+    assert r["audio"].dtype == np.float32
+    # int16 -> [-1, 1) float decode round-trips the waveform
+    np.testing.assert_allclose(
+        r["audio"][:, 0], mono.astype(np.float32) / 32768.0, atol=1e-6
+    )
+
+
+def test_read_videos(cluster, tmp_path):
+    cv2 = pytest.importorskip("cv2")
+
+    path = str(tmp_path / "clip.avi")
+    wr = cv2.VideoWriter(
+        path, cv2.VideoWriter_fourcc(*"MJPG"), 10.0, (32, 16)
+    )
+    assert wr.isOpened()
+    for i in range(6):
+        frame = np.full((16, 32, 3), i * 40, np.uint8)
+        wr.write(frame)
+    wr.release()
+
+    rows = sorted(rd.read_videos(str(tmp_path)).take_all(),
+                  key=lambda r: r["frame_index"])
+    assert len(rows) == 6
+    assert rows[0]["frame"].shape == (16, 32, 3)
+    # MJPG is lossy; the solid-gray frames survive approximately
+    assert abs(int(rows[2]["frame"].mean()) - 80) < 12
+
+    strided = rd.read_videos(str(tmp_path), stride=2).take_all()
+    assert sorted(r["frame_index"] for r in strided) == [0, 2, 4]
+
+
+def test_repartition_to_one_flattens(cluster):
+    """Regression: a 1-reducer exchange must emit a FLAT block —
+    num_returns=1 returns the map task's value verbatim, so the single
+    partition has to be returned bare (found via write_sql after
+    repartition(1) seeing list rows)."""
+    ds = rd.from_items([{"id": i} for i in range(10)]).repartition(1)
+    blocks = list(ds.iter_blocks())
+    assert len(blocks) == 1
+    rows = list(blocks[0])
+    assert all(isinstance(r, dict) for r in rows)
+    assert sorted(r["id"] for r in rows) == list(range(10))
+
+
+def test_avro_numpy_array_columns(cluster, tmp_path):
+    """Regression: ndarray-valued fields must infer/encode as avro arrays
+    (truthiness of a multi-element array raises)."""
+    rows = [{"id": i, "vec": np.arange(4, dtype=np.int64) + i}
+            for i in range(10)]
+    out = str(tmp_path / "npavro")
+    rd.from_items(rows).write_avro(out)
+    back = sorted(rd.read_avro(out).take_all(), key=lambda r: r["id"])
+    assert back[2]["vec"] == [2, 3, 4, 5]
+
+
+def test_sql_shard_negative_and_null_keys(cluster, tmp_path):
+    """Regression: negative shard keys (dividend-signed modulo) and NULL
+    keys must not be silently dropped."""
+    import functools
+
+    db_path = str(tmp_path / "neg.db")
+    conn = sqlite3.connect(db_path)
+    conn.execute("CREATE TABLE src (id INTEGER)")
+    conn.executemany("INSERT INTO src VALUES (?)",
+                     [(i,) for i in range(-10, 10)] + [(None,)])
+    conn.commit()
+    conn.close()
+    _connect = functools.partial(sqlite3.connect, db_path)
+    rows = rd.read_sql("SELECT id FROM src", _connect,
+                       parallelism=4, shard_key="id").take_all()
+    ids = sorted((r["id"] for r in rows), key=lambda x: (x is None, x))
+    assert ids == list(range(-10, 10)) + [None]
+
+
+def test_avro_heterogeneous_rows(tmp_path):
+    """Regression: rows missing a field encode as the inferred null-union
+    (record encoding must .get, not index)."""
+    rows = [{"a": 1}, {"a": 2, "b": 3}]
+    path = str(tmp_path / "h.avro")
+    write_avro_file(rows, path)
+    assert read_avro_file(path) == [{"a": 1, "b": None}, {"a": 2, "b": 3}]
+
+
+def test_avro_numpy_scalar_union(tmp_path):
+    """Regression: numpy scalars must match union branches."""
+    rows = [{"x": np.int64(5), "f": np.float32(0.5), "b": np.bool_(True)},
+            {"x": None, "f": None, "b": None}]
+    path = str(tmp_path / "np.avro")
+    write_avro_file(rows, path)
+    back = read_avro_file(path)
+    assert back[0]["x"] == 5 and back[1]["x"] is None
+    assert abs(back[0]["f"] - 0.5) < 1e-6
+    assert back[0]["b"] is True
+
+
+def test_read_audio_bad_path_raises(cluster, tmp_path):
+    with pytest.raises(FileNotFoundError):
+        rd.read_audio(str(tmp_path / "nope"))

@@ -1,0 +1,84 @@
+"""multiprocessing.Pool shim + joblib backend (reference:
+python/ray/util/multiprocessing/pool.py, python/ray/util/joblib/)."""
+
+import operator
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.multiprocessing import Pool
+
+
+@pytest.fixture(scope="module")
+def pool():
+    # Headroom matters: the module pool holds 3 CPUs for its actors, and
+    # the initializer/joblib tests create ADDITIONAL pools beside it —
+    # undersizing the cluster deadlocks those creations.
+    ray_tpu.init(num_cpus=10)
+    p = Pool(processes=3)
+    yield p
+    p.terminate()
+    ray_tpu.shutdown()
+
+
+def test_apply_and_async(pool):
+    assert pool.apply(operator.add, (2, 3)) == 5
+    r = pool.apply_async(operator.mul, (6, 7))
+    assert r.get(timeout=30) == 42
+    assert r.ready() and r.successful()
+
+
+def test_map_and_starmap(pool):
+    assert pool.map(abs, range(-5, 5)) == [5, 4, 3, 2, 1, 0, 1, 2, 3, 4]
+    assert pool.starmap(operator.add, [(1, 2), (3, 4)]) == [3, 7]
+
+
+def test_imap_ordered_and_unordered(pool):
+    assert list(pool.imap(abs, [-3, -2, -1], chunksize=1)) == [3, 2, 1]
+    got = sorted(pool.imap_unordered(abs, [-9, -8, -7], chunksize=1))
+    assert got == [7, 8, 9]
+
+
+def test_async_error_surfaces(pool):
+    r = pool.apply_async(operator.truediv, (1, 0))
+    r.wait(30)
+    assert not r.successful()
+    with pytest.raises(Exception):
+        r.get(timeout=30)
+
+
+def test_callback_fires(pool):
+    hits = []
+    r = pool.map_async(abs, [-1, -2], callback=hits.append)
+    r.get(timeout=30)
+    deadline = time.monotonic() + 10
+    while not hits and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert hits == [[1, 2]]
+
+
+def test_initializer(pool):
+    import sys
+
+    # Initializer mutates per-actor process state; every slot must see it.
+    # (sys functions pickle by reference; the probe lambda cloudpickles.)
+    p = Pool(processes=2, initializer=sys.setrecursionlimit,
+             initargs=(31337,))
+    try:
+        assert p.map(lambda _: sys.getrecursionlimit(), [0, 0],
+                     chunksize=1) == [31337, 31337]
+    finally:
+        p.terminate()
+
+
+def test_joblib_backend(pool):
+    joblib = pytest.importorskip("joblib")
+    from ray_tpu.util.joblib_backend import register_ray_tpu
+
+    register_ray_tpu()
+    with joblib.parallel_backend("ray_tpu", n_jobs=3):
+        out = joblib.Parallel()(
+            joblib.delayed(operator.add)(i, 1) for i in range(20)
+        )
+    assert out == list(range(1, 21))

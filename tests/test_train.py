@@ -190,3 +190,77 @@ def test_accelerate_backend_data_parallel(cluster):
     # Correctness is asserted IN the workers (np.allclose(w, 3.0) — the
     # averaged-gradient SGD step); a broken backend fails fit() itself.
     assert result.error is None
+
+
+def test_tensorflow_backend_multiworker(cluster):
+    """TensorflowBackend: TF_CONFIG is laid down so a
+    MultiWorkerMirroredStrategy inside the loop rendezvouses across both
+    workers and averages gradients (reference: ray train
+    tensorflow/config.py TF_CONFIG setup)."""
+    pytest.importorskip("tensorflow")
+    from ray_tpu.train import TensorflowTrainer
+
+    def loop(config):
+        import json
+        import os
+
+        import numpy as np
+        import tensorflow as tf
+
+        import ray_tpu.train as train
+
+        tf_config = json.loads(os.environ["TF_CONFIG"])
+        rank = tf_config["task"]["index"]
+        assert len(tf_config["cluster"]["worker"]) == 2
+        strategy = tf.distribute.MultiWorkerMirroredStrategy()
+        assert strategy.num_replicas_in_sync == 2
+        with strategy.scope():
+            model = tf.keras.Sequential([
+                tf.keras.layers.Dense(
+                    1, use_bias=False, kernel_initializer="zeros",
+                    input_shape=(4,),
+                )
+            ])
+            opt = tf.keras.optimizers.SGD(learning_rate=1.0)
+
+        # Same algebra as the accelerate test, in the TF idiom: with the
+        # loss scaled by the GLOBAL batch (compute_average_loss), rank
+        # r's local gradient is -(r+1) per weight and the cross-replica
+        # all-reduce SUM is -3, so one lr=1 step lands at exactly 3.0
+        # only if gradients crossed the workers.
+        def step_fn(ctx):
+            r = ctx.replica_id_in_sync_group
+            x = tf.ones((8, 4)) * tf.cast(r + 1, tf.float32)
+            y = tf.ones((8, 1))
+            return x, y
+
+        @tf.function
+        def train_step():
+            def replica_step(inputs):
+                x, y = inputs
+                with tf.GradientTape() as tape:
+                    per_example = tf.reduce_mean((model(x) - y) ** 2, axis=1)
+                    loss = tf.nn.compute_average_loss(
+                        per_example, global_batch_size=16
+                    )
+                grads = tape.gradient(loss, model.trainable_variables)
+                opt.apply_gradients(zip(grads, model.trainable_variables))
+                return loss
+
+            inputs = strategy.experimental_distribute_values_from_function(
+                step_fn
+            )
+            return strategy.run(replica_step, args=(inputs,))
+
+        train_step()
+        w = model.get_weights()[0]
+        assert np.allclose(w, 3.0), (rank, w)
+        train.report({"rank": rank, "w0": float(np.ravel(w)[0])})
+
+    trainer = TensorflowTrainer(
+        loop,
+        train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=2),
+    )
+    result = trainer.fit()
+    assert result.error is None
