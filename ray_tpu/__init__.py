@@ -62,4 +62,15 @@ from .core.scheduler import (  # noqa: F401
     NodeLabelStrategy,
     SpreadStrategy,
 )
-from . import dag  # noqa: F401,E402
+
+def __getattr__(name):
+    # `ray_tpu.dag` loads lazily (PEP 562): it pulls numpy at import
+    # time, which costs ~0.2s of every WORKER cold start on a 1-core
+    # host (any `ray_tpu.core.*` import runs this package __init__).
+    if name == "dag":
+        import importlib
+
+        module = importlib.import_module(".dag", __name__)
+        globals()["dag"] = module
+        return module
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

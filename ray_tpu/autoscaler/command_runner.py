@@ -119,7 +119,11 @@ class ManagedVMProvider(NodeProvider):
         hosts: Dict[str, CommandRunner],
         cp_address: str,
         start_command: str,
-        stop_command: str = "pkill -f ray_tpu || true",
+        # [r] bracket trick: the pattern must not match the shell that
+        # runs the pkill itself (whose cmdline contains the pattern) —
+        # without it the stop command SIGTERMs its own shell, and with a
+        # LocalCommandRunner it would kill the driver's cluster too.
+        stop_command: str = "pkill -f '[r]ay_tpu[.]core' || true",
         setup_commands: Sequence[str] = (),
         sync_dirs: Sequence[tuple] = (),
     ):

@@ -1008,6 +1008,9 @@ def main():
     )
 
     async def run():
+        from .stack_dump import install_signal_dumpers
+
+        install_signal_dumpers(asyncio.get_running_loop())
         cp = ControlPlane(
             args.host, args.port, args.session_id, store_path=args.store_path
         )

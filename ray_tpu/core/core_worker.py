@@ -393,6 +393,7 @@ class _LeasePool:
         self.leases: Dict[int, dict] = {}  # lease_id -> {addr, client, inflight}
         self.requesting = False
         self.idle_cancel: Dict[int, asyncio.TimerHandle] = {}
+        self.pending_returns: set = set()  # in-flight return_lease RPCs
         # Per-lease pipelining cap; None = the global knob.  Recovery pools
         # pin it to 1 (see _resubmit_for_recovery).
         self.max_inflight: Optional[int] = None
@@ -592,7 +593,16 @@ class _LeasePool:
         if timer:
             timer.cancel()
         if returned:
-            self._spawn(self._return_lease_rpc(lease))
+            # Tracked: shutdown must await in-flight returns, or a lease
+            # whose return RPC hasn't flushed stays pinned on the agent
+            # for the owner-reap grace period after a clean exit.
+            try:
+                loop = asyncio.get_running_loop()
+            except RuntimeError:
+                return
+            t = loop.create_task(self._return_lease_rpc(lease))
+            self.pending_returns.add(t)
+            t.add_done_callback(self.pending_returns.discard)
 
     async def _return_lease_rpc(self, lease):
         try:
@@ -858,6 +868,35 @@ class CoreWorker:
         for t in list(self._inflight_submits):
             if not t.done():
                 t.cancel()
+        # Return every held lease NOW.  Leases are keyed to a stable owner
+        # id with a reconnect grace window (chaos hardening), so a clean
+        # exit that merely closes its sockets would pin the node's
+        # resources for the full grace period — starving whatever runs
+        # next on the cluster.  Idle-return timers are cancelled first
+        # (their _drop_lease would race this sweep), and a second pass
+        # catches leases landed by in-flight grant replies mid-shutdown.
+        pools = list(self.lease_pools.values())
+        for pool in pools:
+            for timer in pool.idle_cancel.values():
+                timer.cancel()
+            pool.idle_cancel.clear()
+        for _ in range(2):
+            returns = []
+            for pool in pools:
+                for lease in list(pool.leases.values()):
+                    pool.leases.pop(lease["lease_id"], None)
+                    returns.append(pool._return_lease_rpc(lease))
+                returns.extend(pool.pending_returns)
+                pool.pending_returns = set()
+            if returns:
+                try:
+                    await asyncio.wait_for(
+                        asyncio.gather(*returns, return_exceptions=True),
+                        timeout=2.0,
+                    )
+                except Exception:  # noqa: BLE001 — agent may be gone
+                    pass
+            await asyncio.sleep(0)
         # Ordered teardown (reference: core_worker/shutdown_coordinator.h):
         # cancel periodic loops first so nothing is left pending when the
         # event loop stops.

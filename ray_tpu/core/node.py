@@ -60,6 +60,10 @@ class ProcessGroup:
         if env:
             full_env.update(env)
         full_env.update(GlobalConfig.overrides_as_env())
+        # Log lines and `ray-tpu stack` dumps must reach the file when
+        # they happen — block-buffered stdio leaves a killed process's
+        # log empty.
+        full_env["PYTHONUNBUFFERED"] = "1"
         if self.die_with_parent:
             # System processes watch this pid and self-exit when it dies —
             # a SIGKILLed driver must not leave an orphaned cluster behind
@@ -68,9 +72,19 @@ class ProcessGroup:
         else:
             full_env.pop("RAY_TPU_PARENT_PID", None)
         out = open(log_path, "ab")
+
+        def ignore_usr1():
+            # `ray-tpu stack` uses SIGUSR1; ignored dispositions survive
+            # exec, so a signal during the child's import phase (before
+            # its loop installs the dump handler) is dropped instead of
+            # killing the starting process.
+            import signal
+
+            signal.signal(signal.SIGUSR1, signal.SIG_IGN)
+
         proc = subprocess.Popen(
             argv, stdout=out, stderr=subprocess.STDOUT, env=full_env,
-            start_new_session=True,
+            start_new_session=True, preexec_fn=ignore_usr1,
         )
         self.procs.append(proc)
         return proc

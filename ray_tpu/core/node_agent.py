@@ -36,9 +36,20 @@ from .task_spec import ActorSpec
 logger = logging.getLogger(__name__)
 
 
+def _ignore_usr1():
+    """preexec_fn: SIGUSR1 → SIG_IGN before exec.  Ignored dispositions
+    survive exec (handlers don't), so a `ray-tpu stack` signal landing
+    during the child's import phase — before the loop installs the real
+    dump handler — is dropped instead of killing the starting worker."""
+    import signal as _signal
+
+    _signal.signal(_signal.SIGUSR1, _signal.SIG_IGN)
+
+
 def _sched_idle():
     """preexec_fn: run the child under SCHED_IDLE (falls back to nice 19
     where unavailable) so prestart imports only use otherwise-idle CPU."""
+    _ignore_usr1()
     try:
         os.sched_setscheduler(0, os.SCHED_IDLE, os.sched_param(0))
     except Exception:  # noqa: BLE001
@@ -133,6 +144,9 @@ class NodeAgent:
         self._pull_futures: Dict[ObjectID, asyncio.Future] = {}
         self._prestart_task: Optional[asyncio.Task] = None
         self._last_pop = 0.0  # monotonic ts of last default-pool pop
+        self._pool_miss_at = 0.0  # monotonic ts of last EMPTY-pool pop
+        self._prestart_inflight: set = set()  # spawning prestart handles
+        self._prestart_first = True  # initial fill runs hot (see loop)
         # Pool key of a plain CPU-only lease (chip isolation applied to an
         # empty chip set) — constant per process; prestarted workers carry
         # exactly this env so they match ordinary task/actor leases.
@@ -302,7 +316,9 @@ class NodeAgent:
             # Prestarted workers import under SCHED_IDLE so pool refill
             # only uses CPU nothing else wants; _prestart_loop restores
             # SCHED_OTHER once the worker registers (before pooling).
-            preexec_fn=_sched_idle if nice else None,
+            # Both paths ignore SIGUSR1 until the real dump handler is
+            # installed (see _ignore_usr1).
+            preexec_fn=_sched_idle if nice else _ignore_usr1,
         )
         handle = WorkerHandle(worker_id, proc, env_key)
         self.isolation.attach_worker(proc.pid)
@@ -348,6 +364,13 @@ class NodeAgent:
                 self._prestart_loop()
             )
 
+    # Hot-demand window: a pop that found the pool EMPTY within this many
+    # seconds means demand is outrunning supply — refills must run at
+    # normal priority (SCHED_IDLE imports starve completely on a busy
+    # core) and in parallel, or a creation burst cold-starts every worker.
+    _PRESTART_HOT_WINDOW_S = 5.0
+    _PRESTART_HOT_BATCH = 4
+
     async def _prestart_loop(self):
         key = self._default_env_key
         while True:
@@ -360,13 +383,19 @@ class NodeAgent:
             have = len(self.idle_pool.get(key, [])) + sum(
                 1 for h in self.workers.values()
                 if h.leased and not h.is_actor and h.env_key == key
-            )
-            if self._pool_floor() - have <= 0:
+            ) + len(self._prestart_inflight)
+            deficit = self._pool_floor() - have
+            if deficit <= 0:
                 return
-            quiet = time.monotonic() - self._last_pop
-            if quiet < 0.5:
-                await asyncio.sleep(0.5 - quiet)
-                continue
+            hot = self._prestart_first or (
+                time.monotonic() - self._pool_miss_at
+                < self._PRESTART_HOT_WINDOW_S
+            )
+            if not hot:
+                quiet = time.monotonic() - self._last_pop
+                if quiet < 0.5:
+                    await asyncio.sleep(0.5 - quiet)
+                    continue
             if GlobalConfig.memory_monitor_period_s > 0:
                 # Don't refill the pool while the OOM defense is shedding
                 # memory — fresh interpreters would re-consume what the
@@ -376,28 +405,39 @@ class NodeAgent:
                 if system_memory_fraction() > GlobalConfig.memory_monitor_threshold:
                     await asyncio.sleep(1.0)
                     continue
-            handle = None
-            try:
-                handle = self._spawn_worker(
-                    dict(self._default_env), key, nice=True
+            batch = min(deficit, self._PRESTART_HOT_BATCH if hot else 1)
+            handles = []
+            for _ in range(batch):
+                h = self._spawn_worker(
+                    dict(self._default_env), key, nice=not hot
                 )
-                await self._wait_worker_ready(handle)
-                # Only the interpreter-import phase rides SCHED_IDLE; a
-                # registered idle worker must run at normal priority or a
-                # busy box starves its agent-liveness pings and the
-                # watchdog kills it.
+                self._prestart_inflight.add(h)
+                handles.append(h)
+
+            async def finish(handle):
                 try:
-                    os.sched_setscheduler(
-                        handle.proc.pid, os.SCHED_OTHER, os.sched_param(0)
-                    )
-                except Exception:  # noqa: BLE001
-                    pass
-                if handle.proc.poll() is None and not handle.leased:
-                    self.idle_pool.setdefault(key, []).append(handle)
-            except Exception:  # noqa: BLE001 — prestart is best-effort
-                if handle is not None:
+                    await self._wait_worker_ready(handle)
+                    # Only the interpreter-import phase may ride
+                    # SCHED_IDLE; a registered idle worker must run at
+                    # normal priority or a busy box starves its
+                    # agent-liveness pings and the watchdog kills it.
+                    try:
+                        os.sched_setscheduler(
+                            handle.proc.pid, os.SCHED_OTHER,
+                            os.sched_param(0),
+                        )
+                    except Exception:  # noqa: BLE001
+                        pass
+                    if handle.proc.poll() is None and not handle.leased:
+                        self.idle_pool.setdefault(key, []).append(handle)
+                except Exception:  # noqa: BLE001 — prestart is best-effort
                     self._kill_worker_proc(handle)
-                await asyncio.sleep(1.0)
+                    await asyncio.sleep(1.0)
+                finally:
+                    self._prestart_inflight.discard(handle)
+
+            await asyncio.gather(*(finish(h) for h in handles))
+            self._prestart_first = False
 
     async def _wait_worker_ready(self, handle: WorkerHandle):
         """Wait until the worker registers; fail fast if its process dies
@@ -431,6 +471,18 @@ class NodeAgent:
                 break
         if env_key == self._default_env_key:
             self._last_pop = time.monotonic()
+            if handle is None:
+                # Demand outran supply: flip the prestart loop into hot
+                # mode and promote any SCHED_IDLE spawns already in
+                # flight (a niced import never finishes on a busy core).
+                self._pool_miss_at = self._last_pop
+                for h in self._prestart_inflight:
+                    try:
+                        os.sched_setscheduler(
+                            h.proc.pid, os.SCHED_OTHER, os.sched_param(0)
+                        )
+                    except Exception:  # noqa: BLE001
+                        pass
             self._replenish_pool()
         if handle is None:
             handle = self._spawn_worker(env_extra, env_key)
@@ -1140,6 +1192,9 @@ def main():
     )
 
     async def run():
+        from .stack_dump import install_signal_dumpers
+
+        install_signal_dumpers(asyncio.get_running_loop())
         agent = NodeAgent(
             args.host,
             args.port,

@@ -246,7 +246,14 @@ class RpcServer:
 
     def _process_frame(self, conn, loop, hcache, msg_id, method, payload):
         if method == "__hello__" and msg_id == 0:
-            ver, peer_min = payload
+            try:
+                # Positional prefix only: future hellos may APPEND fields
+                # (the evolution rule applies to the handshake too), and a
+                # frame we can't parse at all is treated as incompatible —
+                # fail fast with a versioned goodbye, not a torn socket.
+                ver, peer_min = payload[0], payload[1]
+            except Exception:  # noqa: BLE001
+                ver, peer_min = -1, PROTOCOL_VERSION + 1
             if ver < MIN_COMPAT_VERSION or peer_min > PROTOCOL_VERSION:
                 conn.send_nowait(
                     (0, "__goodbye__",

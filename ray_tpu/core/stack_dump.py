@@ -1,0 +1,87 @@
+"""Live stack introspection for system processes (`ray-tpu stack`).
+
+Reference: ray's ``ray stack`` (``scripts/scripts.py:2011``) shells out to
+py-spy to dump every worker's native stack.  py-spy isn't available here,
+and thread stacks miss the interesting state anyway — a wedged asyncio
+process is *suspended at an await*, which only the coroutine chain shows.
+So every system process (control plane, node agent, worker) installs two
+handlers at startup:
+
+* ``SIGABRT`` → ``faulthandler`` thread C-stacks (stdlib).
+* ``SIGUSR1`` → this module's dump: every asyncio task's await-chain
+  (walking ``cr_await``/``gi_yieldfrom``), plus the exec-pipeline cursor
+  state for workers — the exact evidence needed for "it hangs" bugs.
+
+``ray-tpu stack`` signals the session's processes and tails their logs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import faulthandler
+import logging
+import signal
+
+
+def install_signal_dumpers(loop: asyncio.AbstractEventLoop) -> None:
+    """Register SIGUSR1 → async-task dump on ``loop``.  faulthandler is
+    enabled as a side effect so SIGABRT gives thread stacks too."""
+    faulthandler.enable()
+    try:
+        loop.add_signal_handler(signal.SIGUSR1, dump_async_tasks)
+    except (NotImplementedError, RuntimeError):  # non-main thread / wasi
+        pass
+
+
+def dump_async_tasks() -> None:
+    """Log every asyncio task's coroutine await-chain."""
+    log = logging.getLogger("stack_dump")
+    pipe = _exec_pipeline()
+    if pipe is not None:
+        # Snapshot under the pipeline's lock — the drainer thread mutates
+        # _items concurrently and a mid-resize iteration would kill this
+        # handler exactly when it's needed.
+        with pipe._cv:
+            queued = sorted(pipe._items.keys())
+            nt, ne = pipe._next_ticket, pipe._next_exec
+        log.warning(
+            "exec pipeline: next_ticket=%d next_exec=%d queued=%s",
+            nt, ne, queued,
+        )
+    tasks = asyncio.all_tasks()
+    log.warning("=== %d asyncio tasks ===", len(tasks))
+    for t in tasks:
+        log.warning("task %r:\n%s", t.get_name(), format_await_chain(t))
+
+
+def format_await_chain(task: "asyncio.Task") -> str:
+    """The task's coroutine await-chain, one frame per line.  get_stack()
+    only shows the outermost frame; nested awaits need the
+    ``cr_await``/``gi_yieldfrom`` walk."""
+    lines = []
+    obj = task.get_coro()
+    for _ in range(24):
+        if obj is None:
+            break
+        frame = getattr(obj, "cr_frame", getattr(obj, "gi_frame", None))
+        if frame is not None:
+            code = frame.f_code
+            lines.append(
+                f"  {code.co_filename}:{frame.f_lineno} {code.co_name}"
+            )
+        nxt = getattr(obj, "cr_await", getattr(obj, "gi_yieldfrom", None))
+        if nxt is None and frame is None:
+            lines.append(f"  <awaiting {obj!r}>")
+            break
+        obj = nxt
+    return "\n".join(lines) or "  <no frames>"
+
+
+def _exec_pipeline():
+    try:
+        from .core_worker import try_global_worker
+
+        w = try_global_worker()
+        return getattr(w, "_exec_pipeline", None) if w else None
+    except Exception:  # noqa: BLE001 — diagnostics must never raise
+        return None

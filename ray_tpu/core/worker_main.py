@@ -42,61 +42,9 @@ def main():
     node_id = NodeID.from_hex(os.environ["RAY_TPU_NODE_ID"])
 
     async def run():
-        # SIGUSR1 → dump every asyncio task's coroutine stack to the log.
-        # faulthandler (SIGABRT) only shows thread C-stacks; a worker
-        # wedged AWAITING something (arg fetch, object pull) is invisible
-        # there.  Registered on the loop so the dump runs loop-side.
-        import signal
-        import traceback
+        from .stack_dump import install_signal_dumpers
 
-        def _dump_async_tasks():
-            log = logging.getLogger("worker.diag")
-            from .core_worker import try_global_worker
-
-            w = try_global_worker()
-            pipe = getattr(w, "_exec_pipeline", None) if w else None
-            if pipe is not None:
-                # Snapshot under the pipeline's lock — the drainer thread
-                # mutates _items concurrently and a mid-resize iteration
-                # would kill this handler exactly when it's needed.
-                with pipe._cv:
-                    queued = sorted(pipe._items.keys())
-                    nt, ne = pipe._next_ticket, pipe._next_exec
-                log.warning(
-                    "exec pipeline: next_ticket=%d next_exec=%d queued=%s",
-                    nt, ne, queued,
-                )
-            tasks = asyncio.all_tasks()
-            log.warning("=== %d asyncio tasks ===", len(tasks))
-            for t in tasks:
-                # Walk the await chain (cr_await/gi_yieldfrom) — a task
-                # suspended deep inside nested awaits shows only its
-                # outermost frame via get_stack().
-                lines = []
-                obj = t.get_coro()
-                for _ in range(24):
-                    if obj is None:
-                        break
-                    frame = getattr(obj, "cr_frame",
-                                    getattr(obj, "gi_frame", None))
-                    if frame is not None:
-                        code = frame.f_code
-                        lines.append(
-                            f"  {code.co_filename}:{frame.f_lineno} "
-                            f"{code.co_name}"
-                        )
-                    nxt = getattr(obj, "cr_await",
-                                  getattr(obj, "gi_yieldfrom", None))
-                    if nxt is None and frame is None:
-                        lines.append(f"  <awaiting {obj!r}>")
-                        break
-                    obj = nxt
-                log.warning("task %r:\n%s", t.get_name(),
-                            "\n".join(lines) or "  <no frames>")
-
-        asyncio.get_running_loop().add_signal_handler(
-            signal.SIGUSR1, _dump_async_tasks
-        )
+        install_signal_dumpers(asyncio.get_running_loop())
         worker = CoreWorker(
             CoreWorker.WORKER,
             cp_address,

@@ -617,9 +617,13 @@ class Dataset:
 
     def write_images(self, dir_path: str, *, column: str = "image",
                      format: str = "png") -> List[str]:
+        """One image file per row; returns the files actually written
+        (one per ROW — block-label paths would name no real file)."""
         from .datasink import ImageDatasink
 
-        return self.write_datasink(ImageDatasink(column, format), dir_path)
+        metas = self.write_datasink(ImageDatasink(column, format), dir_path,
+                                    return_meta=True)
+        return [f for m in metas for f in m.get("files", [])]
 
     def to_arrow(self):
         """Materialize as ONE pyarrow.Table (zero-copy for primitive

@@ -238,17 +238,23 @@ class ImageDatasink(Datasink):
         import numpy as np
         from PIL import Image
 
-        n = 0
+        files = []
+        seen = set()
         for i, row in enumerate(self._rows(block)):
             arr = np.asarray(row[self.column])
             if "path" in row:
                 stem = os.path.splitext(os.path.basename(str(row["path"])))[0]
+                if stem in seen:  # two source dirs, same basename
+                    stem = f"{stem}-{i:06d}"
             else:
                 stem = f"{i:06d}"
+            seen.add(stem)
             out = f"{path}-{stem}.{self.format}"
             Image.fromarray(arr).save(out)
-            n += 1
-        return {"path": path, "rows": n}
+            files.append(out)
+        # "path" stays the block label (the write plumbing keys on it);
+        # the files actually written are their own field.
+        return {"path": path, "rows": len(files), "files": files}
 
 
 class ManifestedDatasink(Datasink):

@@ -272,6 +272,53 @@ def cmd_logs(args) -> int:
     return 0
 
 
+def cmd_stack(args) -> int:
+    """Dump live stacks of every local system process (reference:
+    ``ray stack``, ``scripts/scripts.py:2011`` — py-spy there; SIGUSR1 →
+    in-process asyncio await-chain dumps here, see core/stack_dump.py).
+    Signals each process, waits for the dumps to land in the session
+    logs, then prints what each log gained."""
+    import glob as _glob
+    import tempfile
+
+    base = os.path.join(tempfile.gettempdir(), "ray_tpu")
+    sessions = sorted(
+        _glob.glob(os.path.join(base, "session_*")),
+        key=os.path.getmtime,
+        reverse=True,
+    )
+    logs = (
+        sorted(_glob.glob(os.path.join(sessions[0], "*.log")))
+        if sessions else []
+    )
+    sizes = {p: os.path.getsize(p) for p in logs}
+
+    found = list(_iter_ray_tpu_pids())
+    if not found:
+        print("no ray_tpu system processes found")
+        return 1
+    for pid, cmdline in found:
+        try:
+            os.kill(pid, signal.SIGUSR1)
+            print(f"signalled {pid}: {cmdline[:80]}")
+        except OSError as e:
+            print(f"failed to signal {pid}: {e}")
+    time.sleep(args.wait)
+
+    for path in logs:
+        try:
+            new = os.path.getsize(path) - sizes.get(path, 0)
+        except OSError:
+            continue
+        if new <= 0:
+            continue
+        print(f"\n==> {os.path.basename(path)} <==")
+        with open(path, "rb") as f:
+            f.seek(sizes.get(path, 0))
+            sys.stdout.write(f.read().decode(errors="replace"))
+    return 0
+
+
 def cmd_dashboard(args) -> int:
     from ..dashboard import start_dashboard
 
@@ -337,6 +384,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="substring of the log file name (e.g. control_plane)")
     p.add_argument("--tail-bytes", type=int, default=1 << 16)
     p.set_defaults(fn=cmd_logs)
+
+    p = sub.add_parser(
+        "stack", help="dump live asyncio/thread stacks of system processes"
+    )
+    p.add_argument("--wait", type=float, default=1.0,
+                   help="seconds to wait for dumps to land in logs")
+    p.set_defaults(fn=cmd_stack)
 
     p = sub.add_parser("dashboard", help="serve cluster state + metrics over HTTP")
     p.add_argument("--address", default=None)

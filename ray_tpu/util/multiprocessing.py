@@ -41,7 +41,9 @@ class AsyncResult:
     """stdlib-compatible handle over one or more pending refs."""
 
     def __init__(self, refs: List, single: bool, callback=None,
-                 error_callback=None):
+                 error_callback=None, pool=None):
+        if pool is not None:
+            pool._outstanding.append(self)
         self._refs = refs
         self._single = single
         self._value = None
@@ -60,15 +62,24 @@ class AsyncResult:
             value = chunks[0] if self._single else [
                 x for chunk in chunks for x in chunk
             ]
-            self._value = value
-            self._done.set()
-            if self._callback is not None:
-                self._callback(value)
         except BaseException as e:  # noqa: BLE001 — surfaced via get()
             self._error = e
             self._done.set()
             if self._error_callback is not None:
-                self._error_callback(e)
+                try:
+                    self._error_callback(e)
+                except Exception:  # noqa: BLE001 — stdlib swallows these
+                    pass
+            return
+        self._value = value
+        self._done.set()
+        # Callback errors must not poison a successful result (stdlib
+        # Pool semantics: get() still returns the value).
+        if self._callback is not None:
+            try:
+                self._callback(value)
+            except Exception:  # noqa: BLE001
+                pass
 
     def wait(self, timeout: Optional[float] = None):
         self._done.wait(timeout)
@@ -109,6 +120,7 @@ class Pool:
         ]
         self._rr = itertools.count()
         self._closed = False
+        self._outstanding: List[AsyncResult] = []
 
     # ------------------------------------------------------------- dispatch
     def _next_actor(self):
@@ -131,7 +143,7 @@ class Pool:
     def apply_async(self, func: Callable, args=(), kwds=None, callback=None,
                     error_callback=None) -> AsyncResult:
         ref = self._next_actor().run_call.remote(func, tuple(args), kwds)
-        return AsyncResult([ref], True, callback, error_callback)
+        return AsyncResult([ref], True, callback, error_callback, pool=self)
 
     # ----------------------------------------------------------------- map
     def map(self, func: Callable, iterable: Iterable,
@@ -145,7 +157,7 @@ class Pool:
             self._next_actor().run_batch.remote(func, chunk, False)
             for chunk in chunks
         ]
-        return AsyncResult(refs, False, callback, error_callback)
+        return AsyncResult(refs, False, callback, error_callback, pool=self)
 
     def starmap(self, func, iterable, chunksize=None) -> List[Any]:
         return self.starmap_async(func, iterable, chunksize).get()
@@ -159,7 +171,7 @@ class Pool:
             self._next_actor().run_batch.remote(func, chunk, True)
             for chunk in chunks
         ]
-        return AsyncResult(refs, False, callback, error_callback)
+        return AsyncResult(refs, False, callback, error_callback, pool=self)
 
     # ---------------------------------------------------------------- imap
     def imap(self, func, iterable, chunksize: int = 1):
@@ -196,9 +208,17 @@ class Pool:
         self._actors = []
 
     def join(self):
+        """Wait for outstanding work, then release the actors.  stdlib
+        join() blocks until worker processes exit; the analog here is
+        draining every issued AsyncResult and killing the pool actors —
+        without the kill, close()+join() would leak one num_cpus=1 actor
+        per slot until driver shutdown."""
         if not self._closed:
             raise ValueError("Pool is still running")
-        self._actors = []
+        for res in self._outstanding:
+            res.wait(timeout=300)
+        self._outstanding = []
+        self.terminate()
 
     def __enter__(self):
         return self
