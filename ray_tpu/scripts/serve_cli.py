@@ -18,7 +18,14 @@ def cmd_serve_deploy(args) -> int:
 
     _connect(args)
     with open(args.config) as f:
-        config = json.load(f)
+        if args.config.endswith((".yaml", ".yml")):
+            # Reference serve configs are YAML (ray serve/schema.py);
+            # JSON stays the dependency-free default.
+            import yaml
+
+            config = yaml.safe_load(f)
+        else:
+            config = json.load(f)
     handles = serve.deploy_config(config)
     print(f"deployed: {sorted(handles)}")
     if args.http_port:
