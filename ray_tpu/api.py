@@ -111,6 +111,17 @@ def init(
     worker.start_threaded()
     set_global_worker(worker)
     atexit.register(shutdown)
+    # Exclude the just-built permanent heap (imported modules, framework
+    # state) from future GC traversals: the per-call garbage of a hot
+    # submit/get loop triggers collections whose cost is dominated by
+    # walking these long-lived objects — freezing them measured ~3x on
+    # sequential actor-call throughput on a 1-core box.  (The classic
+    # post-fork/post-init gc.freeze pattern; the reference leaves GC
+    # untuned but its per-call path is C++, not collectable objects.)
+    import gc
+
+    gc.collect()
+    gc.freeze()
     return ClientContext(worker)
 
 
@@ -120,6 +131,15 @@ def shutdown():
     if worker is not None:
         worker.shutdown()
         set_global_worker(None)
+        # Undo init()'s gc.freeze: without this, every init/shutdown
+        # cycle would strand the dead session's object graph (CoreWorker,
+        # tasks, tracebacks — cycle-rich) in the permanent generation,
+        # growing memory monotonically in long-lived drivers (pytest,
+        # notebooks).  Unfreeze returns it to gen2 for normal collection;
+        # the next init re-freezes whatever is genuinely permanent.
+        import gc
+
+        gc.unfreeze()
     if _local_node is not None:
         _local_node.stop()
         _local_node = None

@@ -966,8 +966,17 @@ class CoreWorker:
             self.memory_store.put(oid, value)
         else:
             # Zero-copy: pickle-5 buffers memcpy straight into the arena.
+            # The arena entry is sealed natively before this returns, so
+            # readers (local mmap or agent chunk reads, which fall back to
+            # the arena) never race it; the agent-side directory seal is
+            # only eviction bookkeeping and rides a pipelined oneway frame
+            # — FIFO on the agent connection, so any later free/pull on
+            # this conn observes it.  Skipping the awaited round trip is
+            # worth ~20% put bandwidth at 64 MiB.
             self.shm_store.create_serialized(oid, header, views)
-            await self.agent.call("seal_object", {"object_id": oid, "size": size})
+            await self.agent.notify(
+                "seal_object", {"object_id": oid, "size": size}
+            )
             obj.locations.add(self.agent_address)
             self.memory_store.put(oid, value)  # local cache for owner gets
         obj.state = READY
@@ -1422,7 +1431,14 @@ class CoreWorker:
             self._lineage_detach(obj)
             self.memory_store.free(oid)
             for agent_addr in obj.locations:
-                client = self.agent_clients.get(agent_addr)
+                # The local agent's free MUST ride the same connection as
+                # _put_async's pipelined seal notify, or the free can be
+                # processed before the seal and the late seal would
+                # re-register a deleted arena entry (directory leak).
+                if agent_addr == self.agent_address:
+                    client = self.agent
+                else:
+                    client = self.agent_clients.get(agent_addr)
                 asyncio.get_running_loop().create_task(
                     self._oneway_call_free(client, oid)
                 )
@@ -2289,7 +2305,10 @@ class CoreWorker:
         await loop.run_in_executor(
             None, self.shm_store.create_serialized, oid, header, views
         )
-        await self.agent.call(
+        # Pipelined oneway (see _put_async): the arena entry is already
+        # sealed natively; chunk reads fall back to the arena if the
+        # directory seal hasn't landed yet.
+        await self.agent.notify(
             "seal_object", {"object_id": oid, "size": size}
         )
         return ("shm", self.agent_address, size)

@@ -147,6 +147,7 @@ class NodeAgent:
         self._pool_miss_at = 0.0  # monotonic ts of last EMPTY-pool pop
         self._prestart_inflight: set = set()  # spawning prestart handles
         self._prestart_first = True  # initial fill runs hot (see loop)
+        self._prestart_hot_until = 0.0  # forced-hot deadline (prestart_pool)
         # Pool key of a plain CPU-only lease (chip isolation applied to an
         # empty chip set) — constant per process; prestarted workers carry
         # exactly this env so they match ordinary task/actor leases.
@@ -307,8 +308,17 @@ class NodeAgent:
         # A pip runtime env runs the worker under its venv's interpreter
         # (reference: per-env virtualenv workers, _private/runtime_env/pip.py).
         python = env.get("RAY_TPU_RT_VENV_PY") or sys.executable
+        argv = [python, "-m", "ray_tpu.core.worker_main"]
+        container = env.get("RAY_TPU_RT_CONTAINER")
+        if container:
+            # Container runtime env: the worker command runs inside
+            # podman/docker with host network/pid/ipc (reference:
+            # _private/runtime_env/image_uri.py).
+            from .runtime_env import container_argv
+
+            argv = container_argv(container, env, argv)
         proc = subprocess.Popen(
-            [python, "-m", "ray_tpu.core.worker_main"],
+            argv,
             env=env,
             stdout=out,
             stderr=subprocess.STDOUT,
@@ -386,10 +396,16 @@ class NodeAgent:
             ) + len(self._prestart_inflight)
             deficit = self._pool_floor() - have
             if deficit <= 0:
+                # Fill complete: close any forced-hot window so post-fill
+                # refills (e.g. during a measured creation burst) drop
+                # back to polite SCHED_IDLE mode.
+                self._prestart_hot_until = 0.0
                 return
-            hot = self._prestart_first or (
-                time.monotonic() - self._pool_miss_at
-                < self._PRESTART_HOT_WINDOW_S
+            now = time.monotonic()
+            hot = (
+                self._prestart_first
+                or now < self._prestart_hot_until
+                or now - self._pool_miss_at < self._PRESTART_HOT_WINDOW_S
             )
             if not hot:
                 quiet = time.monotonic() - self._last_pop
@@ -407,10 +423,20 @@ class NodeAgent:
                     continue
             batch = min(deficit, self._PRESTART_HOT_BATCH if hot else 1)
             handles = []
+            spawn_failed = False
             for _ in range(batch):
-                h = self._spawn_worker(
-                    dict(self._default_env), key, nice=not hot
-                )
+                # A mid-batch spawn failure (EMFILE, fork failure) must
+                # not strand the already-spawned handles in
+                # _prestart_inflight — finish() below is what discards
+                # them — or the inflated `have` count would disable
+                # refill permanently.
+                try:
+                    h = self._spawn_worker(
+                        dict(self._default_env), key, nice=not hot
+                    )
+                except Exception:  # noqa: BLE001 — spawn is best-effort
+                    spawn_failed = True
+                    break
                 self._prestart_inflight.add(h)
                 handles.append(h)
 
@@ -437,6 +463,8 @@ class NodeAgent:
                     self._prestart_inflight.discard(handle)
 
             await asyncio.gather(*(finish(h) for h in handles))
+            if spawn_failed:
+                await asyncio.sleep(1.0)  # back off before retrying spawns
             self._prestart_first = False
 
     async def _wait_worker_ready(self, handle: WorkerHandle):
@@ -1017,7 +1045,13 @@ class NodeAgent:
 
     # --------------------------------------------------------------- objects
     def handle_seal_object(self, payload, conn):
-        self.directory.seal(payload["object_id"], payload["size"])
+        # Guard against seal-after-free: seals are pipelined oneway frames
+        # and a fast owner free (different connection for task-return
+        # objects) may have already deleted the entry from the tiers.
+        # Registering a dead oid would leak directory accounting forever.
+        oid = payload["object_id"]
+        if self.shm_store.contains(oid):
+            self.directory.seal(oid, payload["size"])
         return True
 
     def handle_free_objects(self, payload, conn):
@@ -1088,6 +1122,26 @@ class NodeAgent:
     def handle_ping(self, payload, conn):
         return "pong"
 
+    def handle_prestart_pool(self, payload, conn):
+        """Force the warm pool toward its floor at normal priority NOW.
+
+        Reference analog: ``ray._private.state.prestart_workers`` /
+        ``WorkerPool::PrestartWorkers`` (raylet ``worker_pool.h:281``) —
+        callers that know a creation burst is coming (benchmarks, batch
+        drivers) warm the pool deterministically instead of relying on
+        the quiet-time background refill, whose SCHED_IDLE imports can
+        starve arbitrarily long on a contended core."""
+        # Hold hot mode open until this fill completes (the 5 s pop-miss
+        # window is too short for a full 16-worker fill on one core).
+        self._prestart_hot_until = time.monotonic() + 120.0
+        self._replenish_pool()
+        key = self._default_env_key
+        return {
+            "idle": len(self.idle_pool.get(key, [])),
+            "inflight": len(self._prestart_inflight),
+            "floor": self._pool_floor(),
+        }
+
     def handle_debug_state(self, payload, conn):
         return {
             "node_id": self.node_id.hex(),
@@ -1097,6 +1151,8 @@ class NodeAgent:
             "idle_pids": sorted(
                 h.proc.pid for v in self.idle_pool.values() for h in v
             ),
+            "prestart_inflight": len(self._prestart_inflight),
+            "pool_floor": self._pool_floor(),
             "leases": len(self.leases),
             "queued_leases": len(self._lease_queue),
             "objects": len(self.directory.object_ids()),

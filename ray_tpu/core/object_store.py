@@ -406,8 +406,12 @@ class NodeObjectDirectory:
             spilled = self._spilled.pop(object_id, None)
             if object_id in self._spilling:
                 self._freed_while_spilling.add(object_id)
-        if entry is not None or spilled is not None:
-            delete_from_tiers(self.session_id, object_id)
+        # Delete from the storage tiers even when the directory has no
+        # record: a seal whose oneway frame was lost (or is still in
+        # flight on another connection — task-return seals ride the
+        # executing worker's conn, frees the owner's) must not strand the
+        # arena entry.  delete_from_tiers is idempotent.
+        delete_from_tiers(self.session_id, object_id)
 
     def _evict(self):
         """LRU-evict unpinned sealed objects until under capacity,

@@ -454,9 +454,11 @@ class RpcClient:
     event loop.  Push messages from the server are delivered to
     ``push_handler(method, payload)`` if set."""
 
-    def __init__(self, address: Address, push_handler: Optional[Callable] = None):
+    def __init__(self, address: Address, push_handler: Optional[Callable] = None,
+                 on_disconnect: Optional[Callable] = None):
         self.address = address
         self._push_handler = push_handler
+        self._on_disconnect = on_disconnect
         self._reader = None
         self._writer = None
         self._pending: Dict[int, asyncio.Future] = {}
@@ -594,8 +596,19 @@ class RpcClient:
         except Exception:
             logger.exception("rpc client read loop error (%s)", self.address)
         finally:
+            # Distinguish peer-initiated loss from our own close(): close()
+            # flips _closed BEFORE cancelling this task, so observing it
+            # still False here means the PEER went away — the signal
+            # liveness watchers key on (a worker must exit when its agent's
+            # socket closes, reference: raylet IPC-socket death).
+            peer_lost = not self._closed
             self._closed = True  # peer gone: force reconnect on next use
             self._fail_all_pending(RpcConnectionError(f"connection to {self.address} lost"))
+            if peer_lost and self._on_disconnect is not None:
+                try:
+                    self._on_disconnect()
+                except Exception:  # noqa: BLE001 — watcher must not kill the loop
+                    logger.exception("on_disconnect callback failed")
 
     def _fail_all_pending(self, exc):
         for fut in self._pending.values():
@@ -687,9 +700,10 @@ class RetryableRpcClient:
     ``RetryableGrpcClient``.  Only retries on transport failures, never on
     remote exceptions; callers must ensure retried methods are idempotent."""
 
-    def __init__(self, address: Address, push_handler=None):
+    def __init__(self, address: Address, push_handler=None, on_disconnect=None):
         self.address = address
         self._push_handler = push_handler
+        self._on_disconnect = on_disconnect
         self._client: Optional[RpcClient] = None
         self._connect_lock = asyncio.Lock()
 
@@ -705,7 +719,10 @@ class RetryableRpcClient:
             # concurrent call's failure path nulls self._client, and
             # returning the attribute (not the local) could hand back
             # None mid-connect.
-            client = RpcClient(self.address, self._push_handler)
+            client = RpcClient(
+                self.address, self._push_handler,
+                on_disconnect=self._on_disconnect,
+            )
             await client.connect()
             self._client = client
             return client

@@ -57,24 +57,45 @@ def main():
         address = await worker.async_start()
         # Keep a dedicated registration connection open: the agent uses its
         # closure as a liveness signal in addition to process polling.
-        reg = RetryableRpcClient(agent_address)
+        reg_lost = asyncio.Event()
+        reg = RetryableRpcClient(agent_address, on_disconnect=reg_lost.set)
         reply = await reg.call(
             "register_worker",
             {"worker_id": worker_id, "address": address, "pid": os.getpid()},
         )
         if not reply.get("ok"):
             raise SystemExit("agent rejected worker registration")
+        # Freeze the startup heap (same rationale as api.init): executor
+        # GC cycles must not re-walk the interpreter's import graph on
+        # every collection triggered by per-task garbage.
+        import gc
+
+        gc.collect()
+        gc.freeze()
         # Liveness watchdog: a worker must not outlive its node agent
-        # (reference: workers die when the raylet's IPC socket closes).
+        # (reference: workers die the moment the raylet's IPC socket
+        # closes).  Primary signal is connection EOF — a SIGKILLed agent
+        # takes its workers down in milliseconds, not after 3 missed ping
+        # periods (a surviving worker can keep serving cached objects and
+        # stale leases from a "dead" node, breaking node-loss semantics).
+        # The periodic ping stays as backup for half-open connections.
         failures = 0
         while True:
-            await asyncio.sleep(2.0)
+            eof = False
             try:
+                await asyncio.wait_for(reg_lost.wait(), timeout=2.0)
+                eof = True
+                reg_lost.clear()
+            except asyncio.TimeoutError:
+                pass
+            try:
+                # After an EOF this reconnects; connection-refused fails
+                # it instantly (agent process is gone).
                 await reg.call("ping", timeout=2.0, retries=1)
                 failures = 0
             except Exception:
                 failures += 1
-                if failures >= 3:
+                if eof or failures >= 3:
                     logging.getLogger(__name__).warning(
                         "node agent unreachable; worker exiting"
                     )
