@@ -24,7 +24,15 @@ hash of the requirement list, cached and reused across tasks/actors/jobs
 ``_private/runtime_env/pip.py``).  Workers for a pip env run under the
 venv's interpreter; ``--system-site-packages`` keeps the image's baked-in
 stack (jax et al.) visible, exactly like the reference's virtualenv
-inheritance.  conda/container envs stay out of scope.
+inheritance.
+
+``conda`` envs resolve an existing named env, an ``environment.yml``
+path, or an inline spec dict to that env's interpreter (hash-cached like
+pip; ray ``_private/runtime_env/conda.py``).  ``container``/``image_uri``
+wrap the worker command in ``podman run``/``docker run`` with host
+network/pid/ipc and the session + shm dirs mounted (ray
+``_private/runtime_env/image_uri.py``); both are gated on the container
+binary being present on PATH.
 """
 
 from __future__ import annotations
@@ -41,8 +49,7 @@ from typing import Any, Dict, List, Optional
 WORKING_DIR_ENV = "RAY_TPU_RT_WORKING_DIR"
 PY_MODULES_ENV = "RAY_TPU_RT_PY_MODULES"
 VENV_PY_ENV = "RAY_TPU_RT_VENV_PY"
-
-_UNSUPPORTED = ("container", "image_uri")
+CONTAINER_ENV = "RAY_TPU_RT_CONTAINER"
 
 
 def _cache_root() -> str:
@@ -279,6 +286,109 @@ def build_conda_env(spec) -> str:
     return env_python(prefix)
 
 
+def _container_binary() -> Optional[str]:
+    for name in ("podman", "docker"):
+        path = shutil.which(name)
+        if path:
+            return path
+    return None
+
+
+def resolve_container_spec(spec) -> str:
+    """Normalize a container runtime env to the JSON shipped to the agent.
+
+    Reference: ``python/ray/_private/runtime_env/image_uri.py`` — the
+    worker command is wrapped in ``podman run`` with host network/pid/ipc
+    so the container shares the node's data plane (shm arena, TCP
+    control plane).  Accepts ``"image:tag"`` or ``{"image": ...,
+    "run_options": [...]}``.  Gated: raises when neither podman nor
+    docker is on PATH.
+    """
+    if isinstance(spec, str):
+        spec = {"image": spec}
+    image = spec.get("image")
+    if not image or not isinstance(image, str):
+        raise ValueError(
+            "runtime_env['container'] needs an 'image' (or use "
+            "runtime_env['image_uri'])"
+        )
+    unknown = set(spec) - {"image", "run_options"}
+    if unknown:
+        raise ValueError(
+            f"unknown runtime_env['container'] keys: {sorted(unknown)}"
+        )
+    # Gate on the DRIVER for an early, readable error — but ship only the
+    # binary NAME: agents on other nodes re-resolve against their own
+    # PATH in container_argv (a driver's /usr/bin/podman may be
+    # /usr/local/bin/docker on an autoscaled worker host).
+    binary = _container_binary()
+    if binary is None:
+        raise RuntimeError(
+            "runtime_env['container'] requires a podman or docker binary "
+            "on PATH; none found on this host"
+        )
+    run_options = list(spec.get("run_options") or [])
+    if not all(isinstance(o, str) for o in run_options):
+        raise ValueError("container run_options must be a list of strings")
+    return json.dumps(
+        {
+            "binary": os.path.basename(binary),
+            "image": image,
+            "run_options": run_options,
+        }
+    )
+
+
+def container_argv(container_json: str, worker_env: Dict[str, str],
+                   base_argv: List[str]) -> List[str]:
+    """Agent side: wrap a worker command in its container runtime.
+
+    Host network/pid/ipc keep the worker on the node's control plane and
+    shm arena; the session dir, /dev/shm, and the framework source are
+    mounted so the image needs python but not a baked-in ray_tpu.
+    RAY_TPU_* identity vars are forwarded explicitly (podman run strips
+    the inherited environment).
+    """
+    spec = json.loads(container_json)
+    # Re-resolve the runtime on THIS host (the spec carries the driver's
+    # preferred name; this agent may have it elsewhere on PATH, or only
+    # the other runtime).
+    binary = (
+        shutil.which(spec["binary"])
+        or _container_binary()
+    )
+    if binary is None:
+        raise RuntimeError(
+            f"container runtime {spec['binary']!r} not found on this "
+            "node's PATH (and no podman/docker fallback)"
+        )
+    log_dir = os.environ.get("RAY_TPU_LOG_DIR", "/tmp/ray_tpu")
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    argv = [
+        binary, "run", "--rm",
+        "--network=host", "--pid=host", "--ipc=host",
+        "-v", f"{log_dir}:{log_dir}",
+        "-v", "/dev/shm:/dev/shm",
+        "-v", f"{pkg_root}:{pkg_root}:ro",
+    ]
+    fwd = {
+        k: v for k, v in worker_env.items()
+        if k.startswith(("RAY_TPU", "PYTHON", "JAX_", "XLA_", "TPU"))
+    }
+    fwd["PYTHONPATH"] = (
+        pkg_root + os.pathsep + worker_env.get("PYTHONPATH", "")
+    ).rstrip(os.pathsep)
+    for k, v in sorted(fwd.items()):
+        argv += ["--env", f"{k}={v}"]
+    argv += spec["run_options"]
+    argv.append(spec["image"])
+    # Inside the image: plain `python` (the venv-interpreter override is a
+    # host path and does not exist in the container).
+    argv += ["python"] + base_argv[1:]
+    return argv
+
+
 def resolve_runtime_env(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, str]:
     """Driver side: normalize a runtime_env dict into worker env vars.
 
@@ -287,14 +397,9 @@ def resolve_runtime_env(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, str]
     """
     if not runtime_env:
         return {}
-    for key in _UNSUPPORTED:
-        if runtime_env.get(key):
-            raise ValueError(
-                f"runtime_env[{key!r}] is not supported: pre-bake these "
-                "dependencies into the image"
-            )
     unknown = set(runtime_env) - {
-        "env_vars", "working_dir", "py_modules", "pip", "uv", "conda"
+        "env_vars", "working_dir", "py_modules", "pip", "uv", "conda",
+        "container", "image_uri",
     }
     if unknown:
         raise ValueError(f"unknown runtime_env keys: {sorted(unknown)}")
@@ -324,6 +429,22 @@ def resolve_runtime_env(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, str]
                 "spec's dependencies)"
             )
         env[VENV_PY_ENV] = build_conda_env(conda_spec)
+    container_spec = runtime_env.get("container")
+    if runtime_env.get("image_uri"):
+        if container_spec:
+            raise ValueError(
+                "runtime_env cannot combine 'container' with 'image_uri' "
+                "(image_uri is shorthand for container={'image': ...})"
+            )
+        container_spec = {"image": runtime_env["image_uri"]}
+    if container_spec:
+        if pip_spec or conda_spec:
+            raise ValueError(
+                "runtime_env cannot combine 'container' with 'pip'/'uv'/"
+                "'conda' — the image owns the interpreter (bake deps into "
+                "the image)"
+            )
+        env[CONTAINER_ENV] = resolve_container_spec(container_spec)
     return env
 
 

@@ -190,12 +190,13 @@ def test_runtime_env_py_modules(cluster, tmp_path):
     assert ray_tpu.get(use_mod.remote(), timeout=60) == "from-module"
 
 
-def test_runtime_env_unsupported_key_raises(cluster):
-    # ``pip`` became a supported key in round 3; ``container`` remains
-    # explicitly unsupported (reference: python/ray/_private/runtime_env/).
+def test_runtime_env_unknown_key_raises(cluster):
+    # Every reference runtime_env mode is now supported (pip/uv r3,
+    # conda r4, container/image_uri r5) — but an unrecognized key must
+    # still fail fast, not be silently dropped.
     with pytest.raises(ValueError):
 
-        @ray_tpu.remote(runtime_env={"container": {"image": "x"}})
+        @ray_tpu.remote(runtime_env={"nonsense_key": {"image": "x"}})
         def f():
             pass
 
