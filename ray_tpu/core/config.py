@@ -104,6 +104,13 @@ _KNOBS: Dict[str, tuple] = {
     "data_memory_budget_per_op_bytes": (
         int, 256 * 1024 * 1024, "Estimated in-flight output bytes cap per op"
     ),
+    "data_memory_budget_total_bytes": (
+        int, 0, "Pipeline-wide in-flight budget split across ops "
+        "(0 = object_store_memory_bytes * data_memory_budget_fraction)"
+    ),
+    "data_memory_budget_fraction": (
+        float, 0.5, "Fraction of the shm budget the data pipeline may hold"
+    ),
     # -- usage stats --
     "usage_stats_enabled": (bool, True, "Cluster-local usage recording"),
     # -- task events / observability --

@@ -240,13 +240,16 @@ def infer_schema(rows: List[Dict[str, Any]], name: str = "Row") -> dict:
             # len() guards, not truthiness — ndarray columns raise on bool()
             items = [x for v in values
                      if v is not None and len(v) for x in v]
+            # Recurse: an array of maps/arrays needs the FULL nested
+            # schema ({"type": "map", "values": ...}), not the bare type
+            # name — _encode rejects bare "map"/"array".
             base = {"type": "array",
-                    "items": _type_name(items[0]) if items else "string"}
+                    "items": of(items, f"{field}[]") if items else "string"}
         elif base == "map":
             vals = [x for v in values
                     if v is not None and len(v) for x in v.values()]
             base = {"type": "map",
-                    "values": _type_name(vals[0]) if vals else "string"}
+                    "values": of(vals, f"{field}{{}}") if vals else "string"}
         if any(v is None for v in values) and base != "null":
             return ["null", base]
         return base

@@ -92,6 +92,39 @@ def default_policies() -> List[BackpressurePolicy]:
     return [ConcurrencyCapPolicy(), MemoryBudgetPolicy()]
 
 
+class ResourceManager:
+    """Pipeline-level budget divider (reference
+    ``resource_manager.py:47``): one shared object-store budget split
+    evenly across the plan's concurrently-running operators, so a deep
+    pipeline cannot claim N × the per-op default.  (The reference also
+    re-reserves dynamically by op demand; the even split is its starting
+    allocation and the behavior here.)"""
+
+    def __init__(self, n_ops: int, total_bytes: Optional[int] = None):
+        if total_bytes is None:
+            total_bytes = GlobalConfig.data_memory_budget_total_bytes
+        if total_bytes <= 0:  # derive from the node's shm arena budget
+            total_bytes = int(
+                GlobalConfig.object_store_memory_bytes
+                * GlobalConfig.data_memory_budget_fraction
+            )
+        self.total_bytes = total_bytes
+        self.per_op_bytes = max(1, total_bytes // max(1, n_ops))
+
+    def policies_for_op(self) -> List[BackpressurePolicy]:
+        # The explicit per-op knob stays authoritative when tighter than
+        # this pipeline's even split — the shared budget only ever
+        # SHRINKS an op's allowance (deep plan), never relaxes it.
+        per_op = self.per_op_bytes
+        knob = GlobalConfig.data_memory_budget_per_op_bytes
+        if knob > 0:
+            per_op = min(per_op, knob)
+        return [
+            ConcurrencyCapPolicy(),
+            MemoryBudgetPolicy(per_op),
+        ]
+
+
 def can_launch(op: OpResourceState, policies: List[BackpressurePolicy]) -> bool:
     return all(p.can_launch(op) for p in policies)
 

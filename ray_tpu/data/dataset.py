@@ -65,7 +65,45 @@ from .execution import (
 
 @ray_tpu.remote
 def _write_block(item, transforms, writer, path: str) -> dict:
+    from .filesystem import is_uri, resolve
+
     block = apply_chain(item, transforms)
+    if is_uri(path):
+        # Remote destination: sinks write a real local file (their codecs
+        # are path-based), then the finished parts publish to the URI —
+        # write-then-upload, the reference's remote-sink pattern.
+        import os
+        import tempfile
+
+        import shutil
+
+        fs, _ = resolve(path)
+        tmpdir = tempfile.mkdtemp(prefix="rtpu_sink_")
+        try:
+            local = os.path.join(tmpdir, os.path.basename(path.rstrip("/")))
+            meta = writer(block, local)
+            if not isinstance(meta, dict):
+                meta = {}
+            produced = meta.get("files") or (
+                [local] if os.path.exists(local) else []
+            )
+            base = path.rsplit("/", 1)[0]
+            published = []
+            for f in produced:
+                dest = (
+                    path if f == local else f"{base}/{os.path.basename(f)}"
+                )
+                fs.publish(f, dest)
+                published.append(dest)
+            if meta.get("files"):
+                meta["files"] = published
+            meta["path"] = path
+            meta.setdefault("num_rows", len(block))
+            return meta
+        finally:
+            # A failing codec or publish must not strand a full block copy
+            # in the (long-lived, pooled) worker's tmpdir.
+            shutil.rmtree(tmpdir, ignore_errors=True)
     meta = writer(block, path)
     if not isinstance(meta, dict):
         meta = {}
@@ -532,9 +570,10 @@ class Dataset:
     # ----------------------------------------------------------------- writes
     def _write(self, writer, dir_path: str, ext: str,
                return_meta: bool = False):
-        import os
+        from .filesystem import fs_join, resolve
 
-        os.makedirs(dir_path, exist_ok=True)
+        fs, _ = resolve(dir_path)
+        fs.makedirs(dir_path)
         try:
             chain = self._narrow_chain()
             items = self._frontier()
@@ -544,7 +583,7 @@ class Dataset:
         refs = [
             _write_block.remote(
                 item, chain, writer,
-                os.path.join(dir_path, f"block-{i:05d}{ext}"),
+                fs_join(dir_path, f"block-{i:05d}{ext}"),
             )
             for i, item in enumerate(items)
         ]

@@ -273,18 +273,25 @@ class ManifestedDatasink(Datasink):
     def on_write_complete(self, results: List[Dict[str, Any]]) -> None:
         import json
 
+        from .filesystem import is_uri, resolve
+
         self.inner.on_write_complete(results)
         if not results:
             return
-        out_dir = os.path.dirname(results[0]["path"])
+        first = results[0]["path"]
+        sep = "/" if is_uri(first) else os.sep
+        out_dir = first.rsplit(sep, 1)[0]
         manifest = {
-            "parts": [os.path.basename(r["path"]) for r in results],
+            "parts": [r["path"].rsplit(sep, 1)[-1] for r in results],
             # _write_block guarantees num_rows; sinks may also set rows.
             "rows": sum(
                 r.get("rows", r.get("num_rows", 0)) for r in results
             ),
         }
-        tmp = os.path.join(out_dir, "_MANIFEST.json.tmp")
-        with open(tmp, "w") as f:
-            json.dump(manifest, f)
-        os.replace(tmp, os.path.join(out_dir, "_MANIFEST.json"))
+        fs, _ = resolve(out_dir)
+        # write_bytes is atomic per-file on every backend (local: tmp +
+        # rename; KV: single put) — the manifest-last commit survives.
+        fs.write_bytes(
+            fs.join(out_dir, "_MANIFEST.json"),
+            json.dumps(manifest).encode(),
+        )

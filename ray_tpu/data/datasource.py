@@ -112,13 +112,25 @@ class NumpyDatasource(Datasource):
 
 # --------------------------------------------------------------------- files
 def _expand_paths(path: str, suffix: str = "") -> List[str]:
-    """A path may be a file, a directory, or a glob."""
-    if os.path.isdir(path):
-        return sorted(
-            _glob.glob(os.path.join(path, f"*{suffix}" if suffix else "*"))
-        )
-    matches = sorted(_glob.glob(path))
+    """A path may be a file, a directory, or a glob — local or any
+    registered URI scheme (``file://``, ``memory://``, mounted ``gs://``;
+    see ``data/filesystem.py``)."""
+    from .filesystem import resolve
+
+    fs, p = resolve(path)
+    if fs.isdir(p):
+        return fs.glob(fs.join(p, f"*{suffix}" if suffix else "*"))
+    matches = fs.glob(p)
     return matches or [path]
+
+
+def _local(path: str) -> str:
+    """Materialize a possibly-remote file as a real OS path (identity for
+    local paths).  Runs INSIDE read tasks, on whichever worker executes
+    them."""
+    from .filesystem import ensure_local
+
+    return ensure_local(path)
 
 
 def _table_to_columnar(table):
@@ -172,6 +184,7 @@ class ParquetReadTask(ReadTask):
     def _read(self):
         import pyarrow.parquet as pq
 
+        path = _local(self.path)
         if self.filters is not None:
             import pyarrow.compute as pc
             import pyarrow.dataset as pads
@@ -186,7 +199,7 @@ class ParquetReadTask(ReadTask):
                     "<": field < val, "<=": field <= val,
                 }[op]
                 expr = term if expr is None else (expr & term)
-            ds = pads.dataset(self.path)
+            ds = pads.dataset(path)
             if self.row_group is not None:
                 frag = list(ds.get_fragments())[0]
                 frag = frag.subset(row_group_ids=[self.row_group])
@@ -195,11 +208,11 @@ class ParquetReadTask(ReadTask):
                 table = ds.to_table(filter=expr, columns=self.columns)
             return _table_to_columnar(table)
         if self.row_group is not None:
-            table = pq.ParquetFile(self.path).read_row_group(
+            table = pq.ParquetFile(path).read_row_group(
                 self.row_group, columns=self.columns
             )
         else:
-            table = pq.read_table(self.path, columns=self.columns)
+            table = pq.read_table(path, columns=self.columns)
         return _table_to_columnar(table)
 
     def __reduce__(self):
@@ -224,9 +237,10 @@ class ParquetDatasource(Datasource):
             import pyarrow.parquet as pq
 
             # Split one file by row group so a single large file still
-            # parallelizes.
+            # parallelizes.  (Metadata probe localizes remote files once
+            # on the driver; the row-group reads localize per task.)
             path = self._paths[0]
-            n_groups = pq.ParquetFile(path).num_row_groups
+            n_groups = pq.ParquetFile(_local(path)).num_row_groups
             return [
                 ParquetReadTask(
                     path, g, cols, None, {"path": path, "row_group": g}
@@ -249,7 +263,7 @@ class CSVDatasource(Datasource):
             def read(p=path):
                 import csv  # noqa: PLC0415
 
-                with open(p) as f:
+                with open(_local(p)) as f:
                     return list(csv.DictReader(f))
 
             tasks.append(ReadTask(read, {"path": path}))
@@ -269,7 +283,7 @@ class JSONDatasource(Datasource):
                 import json  # noqa: PLC0415
 
                 out = []
-                with open(p) as f:
+                with open(_local(p)) as f:
                     for line in f:
                         line = line.strip()
                         if line:
@@ -285,8 +299,10 @@ class TFRecordsDatasource(Datasource):
     ``data/tfrecord.py``).  Matches both ``.tfrecord`` and ``.tfrecords``."""
 
     def __init__(self, path: str):
+        from .filesystem import resolve
+
         paths = _expand_paths(path, ".tfrecord")
-        if os.path.isdir(path):
+        if resolve(path)[0].isdir(path):
             paths = sorted(
                 set(paths) | set(_expand_paths(path, ".tfrecords"))
             )
@@ -296,7 +312,7 @@ class TFRecordsDatasource(Datasource):
         from .tfrecord import read_tfrecord_file
 
         return [
-            ReadTask(lambda p=p: read_tfrecord_file(p), {"path": p})
+            ReadTask(lambda p=p: read_tfrecord_file(_local(p)), {"path": p})
             for p in self._paths
         ]
 
@@ -327,7 +343,7 @@ class ImageFilesDatasource(Datasource):
             def read(paths=chunk):
                 out = []
                 for p in paths:
-                    with open(p, "rb") as f:
+                    with open(_local(p), "rb") as f:
                         out.append({"path": p, "bytes": f.read()})
                 return out
 
@@ -353,7 +369,7 @@ class BinaryFilesDatasource(Datasource):
             def read(paths=chunk):
                 out = []
                 for p in paths:
-                    with open(p, "rb") as f:
+                    with open(_local(p), "rb") as f:
                         out.append({"path": p, "bytes": f.read()})
                 return out
 
@@ -371,7 +387,7 @@ class TextDatasource(Datasource):
         tasks = []
         for path in self._paths:
             def read(p=path):
-                with open(p) as f:
+                with open(_local(p)) as f:
                     return [line.rstrip("\n") for line in f]
 
             tasks.append(ReadTask(read, {"path": path}))
@@ -389,7 +405,7 @@ class AvroDatasource(Datasource):
         from .avro import read_avro_file
 
         return [
-            ReadTask(lambda p=p: read_avro_file(p), {"path": p})
+            ReadTask(lambda p=p: read_avro_file(_local(p)), {"path": p})
             for p in self._paths
         ]
 
@@ -416,8 +432,10 @@ class WebDatasetDatasource(Datasource):
     "cls": …, "json": …}``."""
 
     def __init__(self, path: str):
+        from .filesystem import resolve
+
         paths = _expand_paths(path, ".tar")
-        if os.path.isdir(path):
+        if resolve(path)[0].isdir(path):
             paths = sorted(
                 set(paths)
                 | set(_expand_paths(path, ".tgz"))
@@ -439,7 +457,7 @@ class WebDatasetDatasource(Datasource):
         current_key: Optional[str] = None
         row: dict = {}
         mode = "r:gz" if path.endswith((".tgz", ".tar.gz")) else "r"
-        with tarfile.open(path, mode) as tf:
+        with tarfile.open(_local(path), mode) as tf:
             for member in tf:
                 if not member.isfile():
                     continue
@@ -494,7 +512,7 @@ class AudioDatasource(Datasource):
     def _read_wav(path: str) -> dict:
         import wave
 
-        with wave.open(path, "rb") as w:
+        with wave.open(_local(path), "rb") as w:
             n_ch = w.getnchannels()
             width = w.getsampwidth()
             rate = w.getframerate()
@@ -544,7 +562,7 @@ class VideoDatasource(Datasource):
     def _read_video(path: str, stride: int) -> List[dict]:
         import cv2
 
-        cap = cv2.VideoCapture(path)
+        cap = cv2.VideoCapture(_local(path))
         if not cap.isOpened():
             raise ValueError(f"{path}: cv2 cannot open video")
         rows = []

@@ -143,6 +143,9 @@ class MapStage:
         self.compute = compute
         self.projection: Optional[List[str]] = None
         self.predicate: Optional[list] = None
+        # Set by the executor: pipeline-level budget divider; None means
+        # standalone stage execution under the per-op default knob.
+        self.resource_manager = None
 
     @property
     def name(self) -> str:
@@ -170,7 +173,11 @@ class MapStage:
         )
 
         t0 = time.perf_counter()
-        policies = default_policies()
+        policies = (
+            self.resource_manager.policies_for_op()
+            if self.resource_manager is not None
+            else default_policies()
+        )
         op = OpResourceState(self.name)
         pending: deque = deque()
         exhausted = False
@@ -375,9 +382,17 @@ class StreamingExecutor:
         self.stats: List[OpStats] = []
 
     def run(self) -> Iterator:
+        from .backpressure import ResourceManager
+
         inputs, stages = _optimize(self.inputs, self.stages)
+        # One shared memory budget split across the plan's operators
+        # (reference ResourceManager): every stage launches under its own
+        # slice instead of each claiming the global per-op default.
+        rm = ResourceManager(n_ops=max(1, len(stages)))
         stream: Iterator = iter(inputs)
         for stage in stages:
+            if hasattr(stage, "resource_manager"):
+                stage.resource_manager = rm
             stream = stage.run(stream, self.stats)
         return stream
 

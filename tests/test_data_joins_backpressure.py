@@ -190,3 +190,36 @@ class TestBackpressure:
                 data_memory_budget_per_op_bytes=256 * 1024 * 1024,
                 data_max_tasks_per_op=8,
             )
+
+
+class TestResourceManager:
+    def test_even_split_across_ops(self):
+        from ray_tpu.data.backpressure import ResourceManager
+
+        rm = ResourceManager(n_ops=4, total_bytes=400)
+        assert rm.per_op_bytes == 100
+        pols = rm.policies_for_op()
+        mem = [p for p in pols if hasattr(p, "budget_bytes")][0]
+        assert mem.budget_bytes == 100
+
+    def test_explicit_per_op_knob_stays_authoritative(self):
+        from ray_tpu.core.config import GlobalConfig
+        from ray_tpu.data.backpressure import ResourceManager
+
+        rm = ResourceManager(n_ops=1, total_bytes=1 << 40)
+        mem = [p for p in rm.policies_for_op()
+               if hasattr(p, "budget_bytes")][0]
+        # split is huge; the 256 MiB default knob must still cap it
+        assert mem.budget_bytes == GlobalConfig.data_memory_budget_per_op_bytes
+
+    def test_default_total_derives_from_store_budget(self):
+        from ray_tpu.core.config import GlobalConfig
+        from ray_tpu.data.backpressure import ResourceManager
+
+        rm = ResourceManager(n_ops=2)
+        expect = int(
+            GlobalConfig.object_store_memory_bytes
+            * GlobalConfig.data_memory_budget_fraction
+        )
+        assert rm.total_bytes == expect
+        assert rm.per_op_bytes == expect // 2

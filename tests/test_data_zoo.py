@@ -55,6 +55,23 @@ def test_avro_schema_inference_nullable():
     assert by_name["b"] == ["null", "string"]
 
 
+def test_avro_nested_collections_roundtrip(tmp_path):
+    # An array of maps (and an array of arrays) must infer FULL nested
+    # schemas — a bare "map"/"array" items type is invalid Avro and used
+    # to surface later as a confusing _encode failure.
+    rows = [
+        {"tags": [{"k": "a"}, {"k": "b"}], "mat": [[1, 2], [3]]},
+        {"tags": [], "mat": [[4]]},
+    ]
+    schema = infer_schema(rows)
+    by_name = {f["name"]: f["type"] for f in schema["fields"]}
+    assert by_name["tags"]["items"] == {"type": "map", "values": "string"}
+    assert by_name["mat"]["items"] == {"type": "array", "items": "long"}
+    p = str(tmp_path / "nested.avro")
+    write_avro_file(rows, p)
+    assert read_avro_file(p) == rows
+
+
 def test_read_write_avro_dataset(cluster, tmp_path):
     ds = rd.from_items([{"id": i, "name": f"n{i}"} for i in range(100)])
     out = str(tmp_path / "avro_out")
