@@ -139,7 +139,13 @@ class DecodeReplica:
         return self.engine.add_request_from_kv(meta, k, v)
 
     def run(self, request_id: int, timeout_s: float = 300.0) -> dict:
-        """Decode until this request finishes; returns its result."""
+        """Decode until this request finishes; returns its result.
+
+        Deploy decode replicas with ``max_concurrency`` > 1: run() loops
+        step the shared engine, and concurrent add_from_kv admissions
+        (arriving on other lanes) join the SAME decode batch — on an
+        exclusive actor each request would decode solo, which is the
+        anti-pattern disaggregation exists to avoid."""
         deadline = time.monotonic() + timeout_s
         while True:
             with self.engine._step_lock:
@@ -152,6 +158,13 @@ class DecodeReplica:
             if time.monotonic() > deadline:
                 self.engine.cancel_request(request_id)
                 raise TimeoutError(f"decode of request {request_id} timed out")
+
+    def run_stream(self, request_id: int, timeout_s: float = 300.0):
+        """Stream an adopted request's text deltas as they decode (the
+        disaggregated analog of ``JaxLLMEngine.generate_stream``) — this
+        replica's streams are never interrupted by prefill programs, the
+        inter-token-latency property the pattern exists for."""
+        yield from self.engine.stream_request(request_id, timeout_s)
 
 
 class PrefillReplica:

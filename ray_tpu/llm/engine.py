@@ -340,7 +340,14 @@ class JaxLLMEngine:
         step for this request.  Concurrent streams (and batched generate
         calls) share the slot pool — every state access holds the engine
         lock; only the yields happen outside it."""
-        request_id = self.add_request(prompt, params)
+        yield from self.stream_request(
+            self.add_request(prompt, params), timeout_s
+        )
+
+    def stream_request(self, request_id: int, timeout_s: float = 300.0):
+        """Stream an ALREADY-QUEUED request's deltas (the disaggregated
+        streaming path: the id came from add_request_from_kv, whose prompt
+        was prefilled on another replica)."""
         emitted = 0
         deadline = time.monotonic() + timeout_s
         try:
@@ -384,11 +391,16 @@ class JaxLLMEngine:
         timeout_s: float = 300.0,
     ) -> List[dict]:
         """Blocking batch generation (requests stream through the slot pool
-        regardless of len(prompts) vs max_batch_size)."""
+        regardless of len(prompts) vs max_batch_size).  Returns as soon as
+        THIS call's requests are done — a concurrent caller's in-flight
+        work must not delay this caller's results (every caller used to
+        spin until the whole engine drained)."""
         ids = [self.add_request(p, params) for p in prompts]
         deadline = time.monotonic() + timeout_s
-        while self.has_unfinished():
+        while True:
+            with self._step_lock:
+                if all(i in self._finished for i in ids):
+                    return [self._finished.pop(i) for i in ids]
+                self.step()
             if time.monotonic() > deadline:
                 raise TimeoutError("generation exceeded timeout")
-            self.step()
-        return [self._finished.pop(i) for i in ids]

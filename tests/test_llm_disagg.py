@@ -57,3 +57,26 @@ def test_actor_disagg_2p2d_matches_monolithic(cluster):
     assert [o["text"] for o in outs] == [m["text"] for m in mono]
     for a in prefill + decode:
         ray_tpu.kill(a)
+
+
+def test_disagg_run_stream_matches_run(cluster):
+    """run_stream yields the same text run() returns, token-incremental,
+    and concurrent admissions share the decode batch (max_concurrency)."""
+    mono = _mono_outputs()
+
+    Pre = ray_tpu.remote(num_cpus=1)(PrefillReplica)
+    Dec = ray_tpu.remote(num_cpus=1, max_concurrency=4)(DecodeReplica)
+    pre = Pre.remote(_cfg())
+    dec = Dec.remote(_cfg())
+    try:
+        meta = ray_tpu.get(
+            pre.prefill.remote(PROMPTS[0], _greedy()), timeout=240
+        )
+        rid = ray_tpu.get(dec.add_from_kv.remote(meta), timeout=240)
+        gen = dec.run_stream.options(num_returns="streaming").remote(rid)
+        deltas = [ray_tpu.get(d, timeout=240) for d in gen]
+        assert len(deltas) >= 2  # incremental, not one final blob
+        assert "".join(deltas) == mono[0]["text"]
+    finally:
+        for a in (pre, dec):
+            ray_tpu.kill(a)
