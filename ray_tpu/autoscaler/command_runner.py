@@ -163,9 +163,13 @@ class ManagedVMProvider(NodeProvider):
         except Exception:
             # A timed-out start may have actually launched the node —
             # stop best-effort before releasing the host, or the next
-            # create_node double-provisions the machine.
+            # create_node double-provisions the machine.  Stop templates
+            # get the SAME placeholder set as start/setup ({address},
+            # {labels}, {resources}, {provider_id}) — formatting with
+            # provider_id alone raised KeyError on richer templates and
+            # silently skipped the cleanup.
             try:
-                runner.run(self._stop.format(provider_id=provider_id))
+                runner.run(self._stop.format(**fmt))
             except Exception:  # noqa: BLE001 — host unreachable
                 pass
             self._free.insert(0, host)
@@ -174,17 +178,24 @@ class ManagedVMProvider(NodeProvider):
         return provider_id
 
     def terminate_node(self, provider_id: str) -> None:
+        import json
+
         entry = self._nodes.pop(provider_id, None)
         if entry is None:
             return
-        _, host = entry
+        node_type, host = entry
+        fmt = {
+            "address": self._cp_address,
+            "labels": json.dumps({NODE_TYPE_LABEL: node_type,
+                                  PROVIDER_ID_LABEL: provider_id}),
+            "resources": json.dumps({}),
+            "provider_id": provider_id,
+        }
         try:
             # The node-agent's argv carries its labels JSON, so a stop
             # command of ``pkill -f {provider_id}`` finds exactly this
             # node's processes.
-            self._runners[host].run(
-                self._stop.format(provider_id=provider_id)
-            )
+            self._runners[host].run(self._stop.format(**fmt))
         finally:
             self._free.append(host)
 

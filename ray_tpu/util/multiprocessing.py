@@ -42,6 +42,7 @@ class AsyncResult:
 
     def __init__(self, refs: List, single: bool, callback=None,
                  error_callback=None, pool=None):
+        self._pool = pool
         if pool is not None:
             pool._outstanding.append(self)
         self._refs = refs
@@ -65,6 +66,7 @@ class AsyncResult:
         except BaseException as e:  # noqa: BLE001 — surfaced via get()
             self._error = e
             self._done.set()
+            self._unregister()
             if self._error_callback is not None:
                 try:
                     self._error_callback(e)
@@ -73,12 +75,28 @@ class AsyncResult:
             return
         self._value = value
         self._done.set()
+        self._unregister()
         # Callback errors must not poison a successful result (stdlib
         # Pool semantics: get() still returns the value).
         if self._callback is not None:
             try:
                 self._callback(value)
             except Exception:  # noqa: BLE001
+                pass
+
+    def _unregister(self):
+        """Drop this completed result from the pool's outstanding list.
+
+        join() is the only other place that clears it, but with-block /
+        joblib users go straight to terminate() — without this, every
+        dispatched batch's full result payload stays referenced for the
+        pool's lifetime."""
+        pool = self._pool
+        if pool is not None:
+            self._pool = None
+            try:
+                pool._outstanding.remove(self)
+            except ValueError:
                 pass
 
     def wait(self, timeout: Optional[float] = None):
@@ -215,7 +233,8 @@ class Pool:
         per slot until driver shutdown."""
         if not self._closed:
             raise ValueError("Pool is still running")
-        for res in self._outstanding:
+        # Snapshot: completed results unregister themselves concurrently.
+        for res in list(self._outstanding):
             res.wait(timeout=300)
         self._outstanding = []
         self.terminate()

@@ -106,5 +106,9 @@ def test_soak_actor_and_task_churn():
 
         state = asyncio.run(q())
         assert state["num_workers"] <= 12, state
+        # Nothing may leak across churn: every kill's lease must have
+        # been swept and every inline result's arena footprint freed.
+        assert state["leases"] == 0, state
+        assert state["objects"] <= 5, state
     finally:
         ray_tpu.shutdown()
