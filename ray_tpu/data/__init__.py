@@ -25,6 +25,15 @@ from .datasink import (  # noqa: F401
     TFRecordsDatasink,
     WebDatasetDatasink,
 )
+from .warehouse import (  # noqa: F401
+    BigQueryDatasource,
+    ClickHouseDatasource,
+    IcebergDatasource,
+    KafkaDatasink,
+    KafkaDatasource,
+    MongoDatasink,
+    MongoDatasource,
+)
 from .dataset import (  # noqa: F401
     DataIterator,
     Dataset,
@@ -35,6 +44,11 @@ from .dataset import (  # noqa: F401
     read_avro,
     read_binary_files,
     read_images,
+    read_bigquery,
+    read_clickhouse,
+    read_iceberg,
+    read_kafka,
+    read_mongo,
     read_sql,
     read_tfrecords,
     read_videos,

@@ -840,6 +840,72 @@ def read_videos(path: str, parallelism: int = 8, *,
     return read_datasource(VideoDatasource(path, stride), parallelism)
 
 
+def read_mongo(collection_factory, parallelism: int = 8, *,
+               filter: Optional[dict] = None,
+               projection: Optional[dict] = None) -> Dataset:
+    """Rows from a MongoDB collection (pymongo-duck ``collection_factory``
+    runs inside read tasks; shards by skip/limit windows)."""
+    from .warehouse import MongoDatasource
+
+    return read_datasource(
+        MongoDatasource(
+            collection_factory, filter=filter, projection=projection
+        ),
+        parallelism,
+    )
+
+
+def read_bigquery(client_factory, sql: str, parallelism: int = 8, *,
+                  shard_expr: Optional[str] = None) -> Dataset:
+    """Rows from a BigQuery query (google-cloud-bigquery-duck client)."""
+    from .warehouse import BigQueryDatasource
+
+    return read_datasource(
+        BigQueryDatasource(client_factory, sql, shard_expr=shard_expr),
+        parallelism,
+    )
+
+
+def read_clickhouse(client_factory, sql: str, parallelism: int = 8, *,
+                    shard_key: Optional[str] = None) -> Dataset:
+    """Rows from ClickHouse (clickhouse-driver-duck client)."""
+    from .warehouse import ClickHouseDatasource
+
+    return read_datasource(
+        ClickHouseDatasource(client_factory, sql, shard_key=shard_key),
+        parallelism,
+    )
+
+
+def read_kafka(consumer_factory, topic: str, parallelism: int = 8, *,
+               max_messages_per_partition: int = 1_000_000) -> Dataset:
+    """Bounded snapshot of a Kafka topic, one read task per partition."""
+    from .warehouse import KafkaDatasource
+
+    return read_datasource(
+        KafkaDatasource(
+            consumer_factory, topic,
+            max_messages_per_partition=max_messages_per_partition,
+        ),
+        parallelism,
+    )
+
+
+def read_iceberg(table_path: str, parallelism: int = 8, *,
+                 snapshot_id: Optional[int] = None,
+                 columns: Optional[List[str]] = None) -> Dataset:
+    """An Apache Iceberg table read from its on-disk metadata chain (no
+    SDK; append-only v1/v2 subset — see ``data/warehouse.py``)."""
+    from .warehouse import IcebergDatasource
+
+    return read_datasource(
+        IcebergDatasource(
+            table_path, snapshot_id=snapshot_id, columns=columns
+        ),
+        parallelism,
+    )
+
+
 def read_sql(sql: str, connection_factory, parallelism: int = 8, *,
              shard_key: Optional[str] = None) -> Dataset:
     """Rows from any DB-API 2.0 database.  ``connection_factory`` must be

@@ -1,0 +1,368 @@
+"""Warehouse / lake / stream connectors against in-memory fakes
+(reference: ray ``data/_internal/datasource/{mongo,bigquery,clickhouse,
+iceberg}_datasource.py`` — vendor SDKs absent on this box, so the duck
+contracts documented in ``data/warehouse.py`` are exercised end to end;
+the Iceberg test reads a REAL on-disk table layout built from parquet +
+the in-tree Avro codec)."""
+
+import json
+import sys
+
+import cloudpickle
+import pytest
+
+import ray_tpu
+import ray_tpu.data as rd
+
+# The fake clients below are test-module classes: workers cannot import
+# this module, so ship them by value (the factories close over them).
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ctx = ray_tpu.init(num_cpus=4)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------- fakes
+ROWS = [{"_id": i, "name": f"doc{i}", "score": i * 2} for i in range(25)]
+
+
+class FakeCursor:
+    def __init__(self, rows):
+        self._rows = rows
+        self._skip = 0
+        self._limit = None
+
+    def sort(self, key):
+        self._rows = sorted(self._rows, key=lambda r: r.get(key))
+        return self
+
+    def skip(self, n):
+        self._skip = n
+        return self
+
+    def limit(self, n):
+        self._limit = n
+        return self
+
+    def __iter__(self):
+        rows = self._rows[self._skip:]
+        if self._limit is not None:
+            rows = rows[: self._limit]
+        return iter(rows)
+
+
+class FakeMongoCollection:
+    def __init__(self, sink_path=None):
+        self._sink_path = sink_path
+
+    def count_documents(self, flt):
+        return len([r for r in ROWS if self._match(r, flt)])
+
+    def find(self, flt, projection=None):
+        rows = [dict(r) for r in ROWS if self._match(r, flt)]
+        if projection:
+            keep = {k for k, v in projection.items() if v}
+            rows = [{k: r[k] for k in keep if k in r} for r in rows]
+        return FakeCursor(rows)
+
+    def insert_many(self, rows):
+        # Sinks run inside worker processes: capture through the
+        # filesystem, not class state.
+        with open(self._sink_path, "a") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+
+    @staticmethod
+    def _match(row, flt):
+        return all(row.get(k) == v for k, v in (flt or {}).items())
+
+
+def fake_mongo():
+    return FakeMongoCollection()
+
+
+class FakeBQJob:
+    def __init__(self, rows):
+        self._rows = rows
+
+    def result(self):
+        return self._rows
+
+
+class FakeBQClient:
+    def query(self, sql):
+        # No SQL engine: unsharded passthrough returns everything; the
+        # shard wrapper's text is asserted separately.
+        if "FARM_FINGERPRINT" in sql:
+            i = int(sql.rsplit("=", 1)[1])
+            n = int(sql.rsplit("),", 1)[1].split(")")[0])
+            return FakeBQJob(
+                [r for r in ROWS if hash(str(r["_id"])) % n == i]
+            )
+        return FakeBQJob([dict(r) for r in ROWS])
+
+
+class FakeCHClient:
+    def execute(self, sql, with_column_types=False):
+        if "cityHash64" in sql:
+            n = int(sql.split("%")[1].split("=")[0])
+            i = int(sql.rsplit("=", 1)[1])
+            rows = [r for r in ROWS if r["_id"] % n == i]
+        else:
+            rows = ROWS
+        cols = [("_id", "Int64"), ("name", "String"), ("score", "Int64")]
+        data = [tuple(r[c] for c, _ in cols) for r in rows]
+        return (data, cols)
+
+
+class FakeKafkaMsg:
+    def __init__(self, partition, offset, key, value):
+        self.partition, self.offset = partition, offset
+        self.key, self.value = key, value
+
+
+class FakeKafkaConsumer:
+    TOPIC = {"events": {0: [b"a", b"b", b"c"], 1: [b"d", b"e"]}}
+
+    def __init__(self, sink_path=None):
+        self._sink_path = sink_path
+
+    def partitions_for_topic(self, topic):
+        # kafka-python returns None for unknown topics
+        parts = self.TOPIC.get(topic)
+        return set(parts) if parts is not None else None
+
+    def assign(self, tps):
+        (self._topic, self._part), = tps
+
+    def seek_to_beginning(self):
+        self._pos = 0
+
+    def __iter__(self):
+        msgs = self.TOPIC[self._topic][self._part]
+        return iter(
+            FakeKafkaMsg(self._part, i, None, v)
+            for i, v in enumerate(msgs)
+        )
+
+    # producer duck
+    def send(self, topic, key=None, value=None):
+        with open(self._sink_path, "a") as f:
+            f.write(json.dumps({
+                "topic": topic,
+                "key": key.decode("latin1") if key else None,
+                "value": value.decode("latin1"),
+            }) + "\n")
+
+    def flush(self):
+        pass
+
+
+# ---------------------------------------------------------------- tests
+def test_mongo_sink(cluster, tmp_path):
+    import functools
+
+    sink = str(tmp_path / "mongo_sink.jsonl")
+    factory = functools.partial(FakeMongoCollection, sink)
+    rd.from_items([{"a": 1}, {"a": 2}]).repartition(1).write_datasink(
+        rd.MongoDatasink(factory), str(tmp_path / "ignored")
+    )
+    got = [json.loads(x) for x in open(sink)]
+    assert sorted(got, key=lambda r: r["a"]) == [{"a": 1}, {"a": 2}]
+
+
+def test_mongo_roundtrip_sharded(cluster):
+    ds = rd.read_mongo(fake_mongo, parallelism=4)
+    got = sorted(ds.take_all(), key=lambda r: r["_id"])
+    assert got == ROWS
+    # filter + projection ride the duck contract
+    ds2 = rd.read_mongo(
+        fake_mongo, filter={"_id": 3}, projection={"name": 1}
+    )
+    assert ds2.take_all() == [{"name": "doc3"}]
+
+
+
+def test_bigquery_plain_and_sharded(cluster):
+    ds = rd.read_bigquery(FakeBQClient, "SELECT * FROM t", parallelism=1)
+    assert sorted(ds.take_all(), key=lambda r: r["_id"]) == ROWS
+    tasks = rd.BigQueryDatasource(
+        FakeBQClient, "SELECT * FROM t", shard_expr="_id"
+    ).get_read_tasks(4)
+    assert len(tasks) == 4
+    assert all("FARM_FINGERPRINT" in t.metadata["sql"] for t in tasks)
+
+
+def test_clickhouse_sharded(cluster):
+    ds = rd.read_clickhouse(
+        FakeCHClient, "SELECT * FROM t", parallelism=3, shard_key="_id"
+    )
+    got = sorted(ds.take_all(), key=lambda r: r["_id"])
+    assert got == ROWS
+
+
+def test_kafka_partitions_and_sink(cluster, tmp_path):
+    ds = rd.read_kafka(FakeKafkaConsumer, "events")
+    rows = ds.take_all()
+    assert sorted(r["value"] for r in rows) == [b"a", b"b", b"c", b"d", b"e"]
+    assert {r["partition"] for r in rows} == {0, 1}
+    import functools
+
+    sink = str(tmp_path / "kafka.jsonl")
+    factory = functools.partial(FakeKafkaConsumer, sink)
+    rd.from_items(
+        [{"key": b"k", "value": b"v"}, {"plain": 1}]
+    ).repartition(1).write_datasink(
+        rd.KafkaDatasink(factory, "out"), str(tmp_path / "ignored")
+    )
+    recs = [json.loads(x) for x in open(sink)]
+    by_key = {r["key"]: r for r in recs}
+    assert by_key["k"]["value"] == "v"
+    assert json.loads(by_key[None]["value"]) == {"plain": 1}
+
+
+# ---------------------------------------------------------------- iceberg
+MANIFEST_FILE_SCHEMA = {
+    "type": "record", "name": "manifest_file",
+    "fields": [
+        {"name": "manifest_path", "type": "string"},
+        {"name": "content", "type": "int"},
+    ],
+}
+MANIFEST_ENTRY_SCHEMA = {
+    "type": "record", "name": "manifest_entry",
+    "fields": [
+        {"name": "status", "type": "int"},
+        {"name": "data_file", "type": {
+            "type": "record", "name": "r2",
+            "fields": [
+                {"name": "content", "type": "int"},
+                {"name": "file_path", "type": "string"},
+                {"name": "file_format", "type": "string"},
+                {"name": "record_count", "type": "long"},
+            ],
+        }},
+    ],
+}
+
+
+def _build_iceberg_table(root, n_files=2, rows_per_file=10):
+    """A real Iceberg-layout table: metadata JSON + Avro manifests +
+    parquet data files, written with the ORIGINAL location different
+    from where we read it (relocation / path-mapping path)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from ray_tpu.data.avro import write_avro_file
+
+    orig = "file:///warehouse/db/events"  # location recorded at write time
+    (root / "data").mkdir(parents=True)
+    (root / "metadata").mkdir()
+    data_paths = []
+    for f in range(n_files):
+        ids = list(range(f * rows_per_file, (f + 1) * rows_per_file))
+        table = pa.table({"id": ids, "v": [i * 10 for i in ids]})
+        p = root / "data" / f"part-{f}.parquet"
+        pq.write_table(table, str(p))
+        data_paths.append(f"{orig}/data/part-{f}.parquet")
+
+    manifest = root / "metadata" / "m0.avro"
+    write_avro_file(
+        [
+            {"status": 1,
+             "data_file": {"content": 0, "file_path": dp,
+                           "file_format": "PARQUET",
+                           "record_count": rows_per_file}}
+            for dp in data_paths
+        ],
+        str(manifest), schema=MANIFEST_ENTRY_SCHEMA,
+    )
+    mlist = root / "metadata" / "snap-1.avro"
+    write_avro_file(
+        [{"manifest_path": f"{orig}/metadata/m0.avro", "content": 0}],
+        str(mlist), schema=MANIFEST_FILE_SCHEMA,
+    )
+    meta = {
+        "format-version": 2,
+        "location": orig,
+        "current-snapshot-id": 1,
+        "snapshots": [
+            {"snapshot-id": 1, "manifest-list": f"{orig}/metadata/snap-1.avro"}
+        ],
+    }
+    (root / "metadata" / "v1.metadata.json").write_text(json.dumps(meta))
+    (root / "metadata" / "version-hint.text").write_text("1")
+
+
+def test_iceberg_read_relocated_table(cluster, tmp_path):
+    table = tmp_path / "events"
+    _build_iceberg_table(table)
+    ds = rd.read_iceberg(str(table))
+    rows = sorted(ds.take_all(), key=lambda r: r["id"])
+    assert [r["id"] for r in rows] == list(range(20))
+    assert rows[7]["v"] == 70
+    # column projection rides the parquet read
+    only_v = rd.read_iceberg(str(table), columns=["v"]).take_all()
+    assert set(only_v[0].keys()) == {"v"}
+
+
+def test_iceberg_rejects_delete_manifests(cluster, tmp_path):
+    from ray_tpu.data.avro import write_avro_file
+
+    table = tmp_path / "deltable"
+    _build_iceberg_table(table)
+    # overwrite the manifest list with a delete manifest entry
+    write_avro_file(
+        [{"manifest_path": f"file://{table}/metadata/m0.avro",
+          "content": 1}],
+        str(table / "metadata" / "snap-1.avro"),
+        schema=MANIFEST_FILE_SCHEMA,
+    )
+    with pytest.raises(NotImplementedError, match="delete"):
+        rd.read_iceberg(str(table)).take_all()
+
+
+def test_mongo_empty_result_no_unlimited_window(cluster):
+    # pymongo's limit(0) means UNLIMITED — an empty match must produce NO
+    # read tasks rather than a 0-limit window query.
+    src = rd.MongoDatasource(fake_mongo, filter={"_id": -999})
+    assert src.get_read_tasks(4) == []
+
+
+def test_kafka_unknown_topic_raises(cluster):
+    with pytest.raises(ValueError, match="not found"):
+        rd.KafkaDatasource(FakeKafkaConsumer, "nope").get_read_tasks(2)
+
+
+def test_kafka_sink_keeps_key_without_value(cluster, tmp_path):
+    import functools
+
+    sink = str(tmp_path / "k.jsonl")
+    factory = functools.partial(FakeKafkaConsumer, sink)
+    rd.from_items([{"key": b"u1", "payload": 7}]).repartition(1).write_datasink(
+        rd.KafkaDatasink(factory, "out"), str(tmp_path / "ignored")
+    )
+    rec = json.loads(open(sink).read())
+    assert rec["key"] == "u1"
+    assert json.loads(rec["value"]) == {"payload": 7}
+
+
+def test_iceberg_numeric_version_sort(cluster, tmp_path):
+    table = tmp_path / "vsort"
+    _build_iceberg_table(table)
+    meta_dir = table / "metadata"
+    (meta_dir / "version-hint.text").unlink()  # force the glob path
+    # decoys: v2..v10 with v10 the real latest (lexicographic picks v9)
+    v1 = (meta_dir / "v1.metadata.json").read_text()
+    for v in range(2, 10):
+        (meta_dir / f"v{v}.metadata.json").write_text(
+            json.dumps({"format-version": 2, "location": "x",
+                        "current-snapshot-id": 0, "snapshots": []})
+        )
+    (meta_dir / "v10.metadata.json").write_text(v1)
+    rows = rd.read_iceberg(str(table)).take_all()
+    assert len(rows) == 20  # v10's (real) snapshot, not v9's empty one
