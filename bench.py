@@ -969,6 +969,47 @@ def run_control_plane_suite():
             "cycles/s", BASELINES["single_client_wait_1k_refs"],
         )
 
+        # Data exchange throughput (columnar vectorized partitioning —
+        # reference: native hash_shuffle; no published single-node number,
+        # so uncompared).  400k-row parquet -> repartition / groupby.
+        try:
+            import tempfile
+
+            import pyarrow as pa
+            import pyarrow.parquet as pq
+
+            import ray_tpu.data as rd
+
+            ddir = tempfile.mkdtemp(prefix="rtpu_bench_data_")
+            n_rows = 400_000
+            pq.write_table(
+                pa.table({
+                    "k": np.random.randint(0, 1000, n_rows),
+                    "v": np.random.rand(n_rows),
+                }),
+                ddir + "/t.parquet",
+            )
+            list(rd.read_parquet(ddir + "/t.parquet").repartition(4)
+                 .iter_blocks())  # warm (compile/import)
+            t0 = time.perf_counter()
+            list(rd.read_parquet(ddir + "/t.parquet").repartition(4)
+                 .iter_blocks())
+            emit(
+                "data_repartition_rows_per_s",
+                n_rows / (time.perf_counter() - t0), "rows/s",
+            )
+            t0 = time.perf_counter()
+            res = rd.read_parquet(ddir + "/t.parquet").groupby("k").sum(
+                "v"
+            ).take_all()
+            assert len(res) == 1000
+            emit(
+                "data_groupby_rows_per_s",
+                n_rows / (time.perf_counter() - t0), "rows/s",
+            )
+        except Exception as e:  # noqa: BLE001 — informative, not gating
+            print(f"# data exchange stage skipped: {e}", flush=True)
+
         # single-node limits probe: one wide get over thousands of refs
         refs = [ray_tpu.put(b"x") for _ in range(3000)]
         t0 = time.perf_counter()

@@ -391,6 +391,7 @@ class _LeasePool:
         self.template = template
         self.queue: asyncio.Queue = asyncio.Queue()
         self.leases: Dict[int, dict] = {}  # lease_id -> {addr, client, inflight}
+        self._tasks: set = set()  # in-flight pool coroutines (see _spawn)
         self.requesting = False
         self.idle_cancel: Dict[int, asyncio.TimerHandle] = {}
         self.pending_returns: set = set()  # in-flight return_lease RPCs
@@ -402,21 +403,24 @@ class _LeasePool:
         self.queue.put_nowait((spec, attempt))
         self._pump()
 
-    @staticmethod
-    def _spawn(coro) -> bool:
+    def _spawn(self, coro) -> bool:
         """create_task if a loop is running; else drop the coroutine.
 
         _pump/_drop_lease can fire from ``finally`` blocks while the event
         loop is tearing down (GeneratorExit during interpreter shutdown) —
         at that point there is no loop to schedule onto and the work is
-        moot anyway.
+        moot anyway.  Tasks are tracked so shutdown can cancel in-flight
+        lease requests instead of leaving "Task was destroyed but it is
+        pending" noise when the loop stops mid-grant.
         """
         try:
             loop = asyncio.get_running_loop()
         except RuntimeError:
             coro.close()
             return False
-        loop.create_task(coro)
+        t = loop.create_task(coro)
+        self._tasks.add(t)
+        t.add_done_callback(self._tasks.discard)
         return True
 
     def _pump(self):
@@ -897,6 +901,16 @@ class CoreWorker:
                 except Exception:  # noqa: BLE001 — agent may be gone
                     pass
             await asyncio.sleep(0)
+        # Only AFTER the return sweep: cancel in-flight pool coroutines so
+        # the stopping loop leaves no destroyed-pending-task noise.
+        # Cancelling BEFORE would defeat the sweep's second pass — a lease
+        # granted server-side whose reply is still in flight would never
+        # land in pool.leases and never be returned, pinning the node's
+        # resources for the reconnect-grace window.
+        for pool in pools:
+            for t in list(pool._tasks):
+                if not t.done():
+                    t.cancel()
         # Ordered teardown (reference: core_worker/shutdown_coordinator.h):
         # cancel periodic loops first so nothing is left pending when the
         # event loop stops.

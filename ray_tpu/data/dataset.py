@@ -35,7 +35,6 @@ from .block import (
     ColumnarBlock,
     from_batch,
     row_key,
-    stable_hash,
     to_batch,
 )
 from .datasource import (
@@ -248,11 +247,13 @@ class Dataset:
 
     # --------------------------------------------------------------- wide ops
     def repartition(self, num_blocks: int) -> "Dataset":
+        from .execution import RoundRobinPartition
+
         return self._with_stage(
             AllToAllStage(
                 "Repartition",
                 num_blocks,
-                part_fn=lambda row, i, bidx: (bidx * 1000003 + i) % num_blocks,
+                part_fn=RoundRobinPartition(num_blocks),
             )
         )
 
@@ -332,8 +333,9 @@ class Dataset:
         return self._with_stage(stage)
 
     def _groupby_aggregate(self, key, aggs: List[AggregateFn]) -> "Dataset":
-        def part(row, i, bidx):
-            return stable_hash(row_key(row, key))
+        from .execution import HashPartition
+
+        part = HashPartition(key)
 
         def reduce_fn(rows, ridx):
             partials = aggregate_block(rows, key, aggs)
@@ -345,8 +347,9 @@ class Dataset:
         )
 
     def _map_groups(self, key, fn: Callable[[list], list]) -> "Dataset":
-        def part(row, i, bidx):
-            return stable_hash(row_key(row, key))
+        from .execution import HashPartition
+
+        part = HashPartition(key)
 
         def reduce_fn(rows, ridx):
             groups: Dict[Any, list] = {}

@@ -25,6 +25,8 @@ import time
 from collections import deque
 from typing import Any, Callable, Iterator, List, Optional
 
+import numpy as np
+
 import ray_tpu
 
 from ..core.config import GlobalConfig
@@ -63,11 +65,71 @@ def _trim_block(block: Block, n: int) -> Block:
     return block[:n]
 
 
+class HashPartition:
+    """Hash-on-key partitioner.  As a plain callable it is the per-row
+    generic path; ``vector_parts`` is the columnar fast path _shuffle_map
+    recognizes — numeric key columns hash in a few numpy passes
+    (scalar/vector equality guaranteed by block._splitmix64) instead of a
+    per-row Python loop (reference: native hash_shuffle partitioning)."""
+
+    def __init__(self, key):
+        self.key = key
+
+    def __call__(self, row, i, bidx):
+        from .block import row_key, stable_hash
+
+        return stable_hash(row_key(row, self.key))
+
+    def vector_parts(self, block, n_out: int, bidx: int):
+        from .block import hash_column
+
+        if not isinstance(self.key, str):
+            return None
+        col = block.columns.get(self.key)
+        if col is None:
+            return None
+        hashes = hash_column(col)
+        if hashes is None:
+            return None
+        return (hashes % np.uint64(n_out)).astype(np.int64)
+
+
+class RoundRobinPartition:
+    """Deterministic row->partition striping (repartition)."""
+
+    def __init__(self, num_blocks: int):
+        self.num_blocks = num_blocks
+
+    def __call__(self, row, i, bidx):
+        return (bidx * 1000003 + i) % self.num_blocks
+
+    def vector_parts(self, block, n_out: int, bidx: int):
+        return (bidx * 1000003 + np.arange(len(block))) % n_out
+
+
 @ray_tpu.remote
 def _shuffle_map(item, transforms, n_out: int, part_fn, block_idx: int):
     """Map phase of an exchange: apply fused chain, split rows into n_out
     partitions (returned as n_out separate objects via num_returns)."""
+    from .block import ColumnarBlock
+
     block = apply_chain(item, transforms)
+    if isinstance(block, ColumnarBlock) and hasattr(part_fn, "vector_parts"):
+        pidx = part_fn.vector_parts(block, n_out, block_idx)
+        if pidx is not None:
+            # Columnar all the way: mask-slice each partition's columns —
+            # no row materialization on the map side, and reducers that
+            # do no row work (repartition) re-concatenate columnar.
+            parts = []
+            for j in range(n_out):
+                mask = pidx == j
+                parts.append(
+                    ColumnarBlock(
+                        {k: v[mask] for k, v in block.columns.items()}
+                    )
+                    if mask.any() else []
+                )
+            return parts if n_out > 1 else parts[0]
     parts: List[Block] = [[] for _ in range(n_out)]
     for i, row in enumerate(block):
         parts[part_fn(row, i, block_idx) % n_out].append(row)
@@ -80,6 +142,15 @@ def _shuffle_map(item, transforms, n_out: int, part_fn, block_idx: int):
 
 @ray_tpu.remote
 def _shuffle_reduce(reduce_fn, reducer_idx: int, *parts: Block) -> Block:
+    if reduce_fn is None:
+        # Pure concatenation exchanges (repartition) stay columnar when
+        # every non-empty part is (parquet -> repartition -> write never
+        # rowifies).
+        from .block import concat_columnar
+
+        cat = concat_columnar(parts)
+        if cat is not None:
+            return cat
     rows = [r for p in parts for r in p]
     if reduce_fn is not None:
         rows = reduce_fn(rows, reducer_idx)

@@ -22,15 +22,31 @@ from .block import Block, row_key, stable_hash
 @ray_tpu.remote
 def _join_partition_map(item, transforms, n_out: int, key) -> List[Block]:
     """Hash-partition one block's rows by join key into n_out partitions."""
-    from .execution import apply_chain
+    from .execution import HashPartition, apply_chain
+    from .block import ColumnarBlock
 
     block = apply_chain(item, transforms)
+    if isinstance(block, ColumnarBlock) and isinstance(key, str):
+        # Vectorized fast path (same scalar/vector hash equality contract
+        # as the shuffle map): numeric key columns partition in numpy.
+        pidx = HashPartition(key).vector_parts(block, n_out, 0)
+        if pidx is not None:
+            cparts = [
+                ColumnarBlock(
+                    {k: v[pidx == j] for k, v in block.columns.items()}
+                )
+                for j in range(n_out)
+            ]
+            return cparts if n_out > 1 else cparts[0]
     parts: List[Block] = [[] for _ in range(n_out)]
     for row in block:
         # stable_hash, NOT builtin hash(): str hashing is seed-randomized
         # per process, and the two sides partition in different workers.
         parts[stable_hash(row_key(row, key)) % n_out].append(row)
-    return parts
+    # num_returns=1 returns the value VERBATIM (no list splitting), so a
+    # single-partition exchange must return the bare block — the nested
+    # [rows] wrapper made every 1-partition join iterate lists as "rows".
+    return parts if n_out > 1 else parts[0]
 
 
 @ray_tpu.remote

@@ -37,8 +37,11 @@ class MongoDatasource(Datasource):
     ``find(filter, projection).sort(key).skip(n).limit(n)`` yielding
     dicts.  Shards by skip/limit windows over an ``_id``-sorted cursor —
     natural order is NOT stable across independent queries, so unsorted
-    windows could duplicate/drop rows (the reference shards by _id
-    ranges for the same reason).
+    windows could duplicate/drop rows.  Cost note: skip-based windows
+    make the server re-walk the _id index per task (~O(k*N) total); the
+    reference's _id-RANGE sharding is O(N) but needs bson ObjectId
+    arithmetic, which a duck-typed portable contract can't assume —
+    prefer modest parallelism on very large collections.
     """
 
     def __init__(self, collection_factory: Callable, *,
@@ -153,12 +156,14 @@ class ClickHouseDatasource(Datasource):
             return [ReadTask(lambda q=sql: run(q), {"sql": sql})]
         tasks = []
         for i in range(parallelism):
-            # coalesce: NULL-keyed rows land in a deterministic shard
-            # instead of matching no shard predicate at all.
+            # toString+coalesce: NULL-keyed rows land in a deterministic
+            # shard instead of matching no predicate, and String keys
+            # don't hit "no supertype for String, UInt8" (coalesce with a
+            # numeric default is a type error for non-numeric keys).
             q = (
                 f"SELECT * FROM ({self._sql}) WHERE "
-                f"{self._hash_fn}(coalesce({self._shard_key}, 0)) "
-                f"% {parallelism} = {i}"
+                f"{self._hash_fn}(coalesce(toString({self._shard_key}), "
+                f"'')) % {parallelism} = {i}"
             )
             tasks.append(ReadTask(lambda q=q: run(q), {"sql": q}))
         return tasks
@@ -293,9 +298,13 @@ class IcebergDatasource(Datasource):
                 ) from None
 
             def vnum(path: str) -> int:
-                # numeric, not lexicographic: v10 > v9
-                stem = path.rsplit("/", 1)[-1]
-                digits = "".join(c for c in stem if c.isdigit())
+                # Numeric on the LEADING sequence only ("v10..." > "v9...",
+                # "00010-<uuid>" > "00002-<uuid>"): concatenating all
+                # digits would absorb uuid hex and mis-order catalog-style
+                # names.
+                stem = path.rsplit("/", 1)[-1].lstrip("v")
+                head = stem.split("-")[0].split(".")[0]
+                digits = "".join(c for c in head if c.isdigit())
                 return int(digits) if digits else -1
 
             return max(cands, key=vnum)

@@ -198,3 +198,37 @@ class TestColumnarPipelinePerf:
         assert columnar_s * 3 < row_s, (
             f"columnar {columnar_s:.3f}s not ≥3x faster than rows {row_s:.3f}s"
         )
+
+
+class TestVectorizedExchange:
+    def test_repartition_stays_columnar(self, ray_start_regular, tmp_path):
+        import numpy as np
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        pq.write_table(
+            pa.table({"id": list(range(100)), "v": [i * 2 for i in range(100)]}),
+            str(tmp_path / "t.parquet"),
+        )
+        ds = rd.read_parquet(str(tmp_path / "t.parquet")).repartition(3)
+        from ray_tpu.data.block import ColumnarBlock
+
+        blocks = list(ds.iter_blocks())  # already materialized
+        assert all(isinstance(b, ColumnarBlock) for b in blocks)
+        ids = sorted(int(i) for b in blocks for i in b.columns["id"])
+        assert ids == list(range(100))
+
+    def test_groupby_agrees_across_columnar_and_row_blocks(self, ray_start_regular):
+        import numpy as np
+
+        # Same keys arriving via a columnar block AND a row block must
+        # meet on the same reducer (scalar/vector hash equality).
+        from ray_tpu.data.block import ColumnarBlock
+
+        col = ColumnarBlock({"k": np.array([1, 2, 3, 1]), "x": np.array([1, 1, 1, 1])})
+        rows = [{"k": 2, "x": 10}, {"k": 3, "x": 10}, {"k": 1, "x": 10}]
+        ds = rd.from_blocks([col, rows])
+        out = ds.groupby("k").sum("x").take_all()
+        got = {r["k"]: r["sum(x)"] for r in out}
+        # col contributes k1: 1+1, k2: 1, k3: 1; rows add 10 to each key
+        assert got == {1: 12, 2: 11, 3: 11}

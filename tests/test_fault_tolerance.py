@@ -136,3 +136,40 @@ def test_dead_driver_leases_reaped(ray_start_regular):
         except Exception:
             if time.monotonic() > deadline:
                 raise
+
+
+def test_workers_die_on_agent_eof(ray_start_regular):
+    """A SIGKILLed node agent must take its workers down in ~EOF time,
+    not after watchdog ping periods (reference: workers exit when the
+    raylet IPC socket closes).  A worker surviving its agent can keep
+    serving cached objects and stale leases from a 'dead' node, masking
+    object loss from lineage reconstruction."""
+    import os
+    import signal
+
+    from ray_tpu import api
+
+    @ray_tpu.remote
+    class A:
+        def pid(self):
+            return os.getpid()
+
+    a = A.remote()
+    worker_pid = ray_tpu.get(a.pid.remote(), timeout=60)
+
+    def alive(pid):
+        try:
+            os.kill(pid, 0)
+            return True
+        except ProcessLookupError:
+            return False
+
+    assert alive(worker_pid)
+    agent_proc = api._local_node.pg.procs[1]
+    os.kill(agent_proc.pid, signal.SIGKILL)
+    deadline = time.monotonic() + 4.0  # EOF + one verify ping, not 3x2s
+    while time.monotonic() < deadline and alive(worker_pid):
+        time.sleep(0.1)
+    assert not alive(worker_pid), (
+        "worker outlived its killed agent beyond the EOF window"
+    )
