@@ -42,6 +42,7 @@ class Count(AggregateFn):
             merge=lambda a, b: a + b,
             name="count()",
         )
+        self.kind, self.on = "count", None
 
 
 class Sum(AggregateFn):
@@ -53,6 +54,7 @@ class Sum(AggregateFn):
             merge=lambda a, b: a + b,
             name=f"sum({on})",
         )
+        self.kind, self.on = "sum", on
 
 
 class Min(AggregateFn):
@@ -64,6 +66,7 @@ class Min(AggregateFn):
             merge=lambda a, b: b if a is None else (a if b is None else min(a, b)),
             name=f"min({on})",
         )
+        self.kind, self.on = "min", on
 
 
 class Max(AggregateFn):
@@ -75,6 +78,7 @@ class Max(AggregateFn):
             merge=lambda a, b: b if a is None else (a if b is None else max(a, b)),
             name=f"max({on})",
         )
+        self.kind, self.on = "max", on
 
 
 class Mean(AggregateFn):
@@ -87,6 +91,7 @@ class Mean(AggregateFn):
             finalize=lambda a: a[1] / a[0] if a[0] else None,
             name=f"mean({on})",
         )
+        self.kind, self.on = "mean", on
 
 
 class Std(AggregateFn):
@@ -119,10 +124,114 @@ class Std(AggregateFn):
             ),
             name=f"std({on})",
         )
+        self.kind, self.on = "std", on
+
+
+def _aggregate_columnar(block, key, aggs):
+    """Vectorized per-block partials for the built-in aggregations over a
+    ColumnarBlock with numeric agg columns — one np.unique + a bincount
+    or ufunc.at pass per agg instead of a per-row Python loop.  Partial
+    SHAPES match the row path exactly, so reducers merge mixed
+    columnar/row partials transparently.  Returns None when anything
+    needs the generic path (custom aggs, callable keys, missing or
+    non-numeric columns)."""
+    import numpy as np
+
+    from .block import ColumnarBlock
+
+    if not isinstance(block, ColumnarBlock) or not isinstance(key, str):
+        return None
+    keys = block.columns.get(key)
+    if keys is None or len(keys) == 0:
+        return None
+    if keys.dtype.kind not in "iufSU":
+        # object/mixed key columns (None, heterogenous types) break
+        # np.unique's sort — that's the generic path's job.
+        return None
+    cols = {}
+    for a in aggs:
+        kind = getattr(a, "kind", None)
+        if kind is None:
+            return None
+        if kind != "count":
+            col = block.columns.get(a.on) if isinstance(a.on, str) else None
+            if col is None or col.dtype.kind not in "iuf":
+                return None
+            cols[a.on] = col
+    uniq, inv = np.unique(keys, return_inverse=True)
+    n_groups = len(uniq)
+    counts = np.bincount(inv, minlength=n_groups)
+    per_agg = []
+    for a in aggs:
+        kind = a.kind
+        if kind == "count":
+            per_agg.append([int(c) for c in counts])
+            continue
+        v = cols[a.on]
+        if kind == "sum":
+            if v.dtype.kind in "iu":
+                peak = int(np.abs(v.astype(np.float64)).max())
+                if peak and peak > (2**62) // max(1, len(v)):
+                    # Worst-case total could wrap int64: accumulate in
+                    # Python ints (arbitrary precision) — the row path
+                    # would wrap identically on np scalars, so this slow
+                    # branch is the EXACT one.
+                    exact = [0] * n_groups
+                    for g, x in zip(inv, v):
+                        exact[g] += int(x)
+                    per_agg.append(exact)
+                else:
+                    out = np.zeros(n_groups, np.int64)
+                    np.add.at(out, inv, v.astype(np.int64))
+                    per_agg.append([int(x) for x in out])
+            else:
+                per_agg.append(
+                    list(np.bincount(inv, weights=v, minlength=n_groups))
+                )
+        elif kind in ("min", "max"):
+            # Same-dtype extremes: casting int64 through float64 above
+            # 2^53 fabricates values that are not in the column.
+            if v.dtype.kind in "iu":
+                info = np.iinfo(v.dtype)
+                fill = info.max if kind == "min" else info.min
+            else:
+                fill = np.inf if kind == "min" else -np.inf
+            out = np.full(n_groups, fill, v.dtype)
+            (np.minimum if kind == "min" else np.maximum).at(out, inv, v)
+            per_agg.append([x.item() for x in out])
+        elif kind == "mean":
+            s = np.bincount(inv, weights=v, minlength=n_groups)
+            per_agg.append(
+                [(int(n), float(t)) for n, t in zip(counts, s)]
+            )
+        elif kind == "std":
+            # Two-pass (shifted) variance: the naive s2 - s1^2/n form
+            # catastrophically cancels for data with large means (a
+            # 1e8-mean column measured ~150% std error); subtracting the
+            # per-group mean first is stable and matches the row path's
+            # Chan-merge partial shape (n, mean, M2).
+            vf = v.astype(np.float64)
+            s1 = np.bincount(inv, weights=vf, minlength=n_groups)
+            mu = s1 / counts
+            dev = vf - mu[inv]
+            m2 = np.bincount(inv, weights=dev * dev, minlength=n_groups)
+            per_agg.append(
+                [(int(n), float(mm), float(ss))
+                 for n, mm, ss in zip(counts, mu, m2)]
+            )
+        else:
+            return None
+    return {
+        uniq[g].item(): [per_agg[i][g] for i in range(len(aggs))]
+        for g in range(n_groups)
+    }
 
 
 def aggregate_block(block, key, aggs) -> dict:
     """Per-block partial aggregation: key -> [partial per agg]."""
+    fast = _aggregate_columnar(block, key, aggs)
+    if fast is not None:
+        return fast
     partials: dict = {}
     for row in block:
         k = row_key(row, key) if key is not None else None

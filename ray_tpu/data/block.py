@@ -158,6 +158,21 @@ def hash_column(arr: np.ndarray):
         return x ^ (x >> np.uint64(31))
 
 
+def partition_columnar(block, pidx, n_out: int):
+    """Mask-slice a ColumnarBlock into n_out partition blocks; empty
+    partitions ship as cheap [] placeholders.  The one implementation of
+    the columnar exchange split (shuffle map and join map must never
+    drift on it)."""
+    parts = []
+    for j in range(n_out):
+        mask = pidx == j
+        parts.append(
+            ColumnarBlock({k: v[mask] for k, v in block.columns.items()})
+            if mask.any() else []
+        )
+    return parts
+
+
 def concat_columnar(parts):
     """Concatenate blocks column-wise, or None when any part is not a
     ColumnarBlock with the same column set (caller falls back to rows)."""

@@ -337,10 +337,19 @@ class Dataset:
 
         part = HashPartition(key)
 
-        def reduce_fn(rows, ridx):
-            partials = aggregate_block(rows, key, aggs)
-            merged = merge_partials([partials], aggs)
+        def reduce_fn(parts, ridx):
+            # Block-aware (wants_blocks): each part aggregates on its own
+            # representation — columnar parts take the vectorized path in
+            # aggregate_block — and the partials merge exactly as the
+            # distributed (init, accumulate, merge, finalize) contract
+            # prescribes.
+            partial_list = [
+                aggregate_block(p, key, aggs) for p in parts if len(p)
+            ]
+            merged = merge_partials(partial_list, aggs)
             return finalize_partials(merged, key, aggs)
+
+        reduce_fn.wants_blocks = True
 
         return self._with_stage(
             AllToAllStage(f"GroupBy({key})", None, part, reduce_fn)

@@ -117,18 +117,12 @@ def _shuffle_map(item, transforms, n_out: int, part_fn, block_idx: int):
     if isinstance(block, ColumnarBlock) and hasattr(part_fn, "vector_parts"):
         pidx = part_fn.vector_parts(block, n_out, block_idx)
         if pidx is not None:
-            # Columnar all the way: mask-slice each partition's columns —
-            # no row materialization on the map side, and reducers that
-            # do no row work (repartition) re-concatenate columnar.
-            parts = []
-            for j in range(n_out):
-                mask = pidx == j
-                parts.append(
-                    ColumnarBlock(
-                        {k: v[mask] for k, v in block.columns.items()}
-                    )
-                    if mask.any() else []
-                )
+            # Columnar all the way: no row materialization on the map
+            # side, and reducers that do no row work (repartition)
+            # re-concatenate columnar.
+            from .block import partition_columnar
+
+            parts = partition_columnar(block, pidx, n_out)
             return parts if n_out > 1 else parts[0]
     parts: List[Block] = [[] for _ in range(n_out)]
     for i, row in enumerate(block):
@@ -142,6 +136,11 @@ def _shuffle_map(item, transforms, n_out: int, part_fn, block_idx: int):
 
 @ray_tpu.remote
 def _shuffle_reduce(reduce_fn, reducer_idx: int, *parts: Block) -> Block:
+    if reduce_fn is not None and getattr(reduce_fn, "wants_blocks", False):
+        # Block-aware reducers (groupby aggregation) see the raw parts:
+        # columnar parts aggregate vectorized instead of being rowified
+        # here first.
+        return reduce_fn(list(parts), reducer_idx)
     if reduce_fn is None:
         # Pure concatenation exchanges (repartition) stay columnar when
         # every non-empty part is (parquet -> repartition -> write never

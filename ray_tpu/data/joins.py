@@ -31,12 +31,9 @@ def _join_partition_map(item, transforms, n_out: int, key) -> List[Block]:
         # as the shuffle map): numeric key columns partition in numpy.
         pidx = HashPartition(key).vector_parts(block, n_out, 0)
         if pidx is not None:
-            cparts = [
-                ColumnarBlock(
-                    {k: v[pidx == j] for k, v in block.columns.items()}
-                )
-                for j in range(n_out)
-            ]
+            from .block import partition_columnar
+
+            cparts = partition_columnar(block, pidx, n_out)
             return cparts if n_out > 1 else cparts[0]
     parts: List[Block] = [[] for _ in range(n_out)]
     for row in block:

@@ -155,15 +155,21 @@ class ClickHouseDatasource(Datasource):
             sql = self._sql
             return [ReadTask(lambda q=sql: run(q), {"sql": sql})]
         tasks = []
-        for i in range(parallelism):
+        if self._hash_fn == "cityHash64":
             # toString+coalesce: NULL-keyed rows land in a deterministic
             # shard instead of matching no predicate, and String keys
-            # don't hit "no supertype for String, UInt8" (coalesce with a
-            # numeric default is a type error for non-numeric keys).
+            # don't hit "no supertype for String, UInt8".  Only safe for
+            # the default hash (cityHash64 accepts strings).
+            key_expr = f"coalesce(toString({self._shard_key}), '')"
+        else:
+            # A custom hash_fn (e.g. intHash64) constrains its own input
+            # type; pass the key through verbatim — the caller's
+            # expression is responsible for NULL handling (ifNull(...)).
+            key_expr = self._shard_key
+        for i in range(parallelism):
             q = (
                 f"SELECT * FROM ({self._sql}) WHERE "
-                f"{self._hash_fn}(coalesce(toString({self._shard_key}), "
-                f"'')) % {parallelism} = {i}"
+                f"{self._hash_fn}({key_expr}) % {parallelism} = {i}"
             )
             tasks.append(ReadTask(lambda q=q: run(q), {"sql": q}))
         return tasks

@@ -232,3 +232,65 @@ class TestVectorizedExchange:
         got = {r["k"]: r["sum(x)"] for r in out}
         # col contributes k1: 1+1, k2: 1, k3: 1; rows add 10 to each key
         assert got == {1: 12, 2: 11, 3: 11}
+
+
+class TestVectorizedAggregation:
+    def test_all_builtin_aggs_match_row_path(self, ray_start_regular):
+        import numpy as np
+
+        from ray_tpu.data.block import ColumnarBlock
+
+        rng = np.random.default_rng(7)
+        k = rng.integers(0, 7, 500)
+        v = rng.normal(size=500)
+        col_ds = rd.from_blocks(
+            [ColumnarBlock({"k": k[:250], "v": v[:250]}),
+             ColumnarBlock({"k": k[250:], "v": v[250:]})]
+        )
+        row_ds = rd.from_items(
+            [{"k": int(kk), "v": float(vv)} for kk, vv in zip(k, v)]
+        )
+        for op in ("count", "sum", "mean", "min", "max", "std"):
+            g1 = getattr(col_ds.groupby("k"), op)
+            g2 = getattr(row_ds.groupby("k"), op)
+            a = g1() if op == "count" else g1("v")
+            b = g2() if op == "count" else g2("v")
+            ra = {int(r["k"]): list(r.values())[-1] for r in a.take_all()}
+            rb = {int(r["k"]): list(r.values())[-1] for r in b.take_all()}
+            assert ra.keys() == rb.keys(), op
+            for key in ra:
+                assert abs(float(ra[key]) - float(rb[key])) < 1e-9, (op, key)
+
+    def test_std_large_mean_stable(self, ray_start_regular):
+        import numpy as np
+
+        from ray_tpu.data.block import ColumnarBlock
+
+        rng = np.random.default_rng(3)
+        v = 1e8 + rng.normal(size=1000)
+        k = np.zeros(1000, np.int64)
+        ds = rd.from_blocks([ColumnarBlock({"k": k, "v": v})])
+        got = float(ds.groupby("k").std("v").take_all()[0]["std(v)"])
+        expect = float(np.std(v, ddof=1))
+        assert abs(got - expect) < 1e-6 * expect, (got, expect)
+
+    def test_int_extremes_exact(self, ray_start_regular):
+        import numpy as np
+
+        from ray_tpu.data.block import ColumnarBlock
+
+        big = 2**60 + 3  # float64 would round this to 2**60
+        ds = rd.from_blocks(
+            [ColumnarBlock({"k": np.array([0, 0]),
+                            "v": np.array([big, big + 2], np.int64)})]
+        )
+        out = ds.groupby("k").min("v").take_all()[0]
+        assert int(out["min(v)"]) == big
+        out = ds.groupby("k").max("v").take_all()[0]
+        assert int(out["max(v)"]) == big + 2
+        # sums that could overflow int64 must fall back to the exact path
+        ds2 = rd.from_blocks(
+            [ColumnarBlock({"k": np.array([0, 0]),
+                            "v": np.array([2**62, 2**62], np.int64)})]
+        )
+        assert int(ds2.groupby("k").sum("v").take_all()[0]["sum(v)"]) == 2**63
