@@ -1092,6 +1092,8 @@ def main():
     def run(name, fn):
         try:
             fn()
+        except (KeyboardInterrupt, SystemExit):
+            raise  # a Ctrl+C must abort the RUN (summary still prints)
         except BaseException as e:  # noqa: BLE001 — record, keep going
             import traceback
 

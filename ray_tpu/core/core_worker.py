@@ -132,7 +132,45 @@ PENDING, READY, ERROR = "PENDING", "READY", "ERROR"
 _EMPTY_ARGS_PAYLOAD: Optional[bytes] = None
 
 
-class ExecPipeline:
+class _BatchedCompleter:
+    """Shared completion-batching substrate for execution threads.
+
+    One ``call_soon_threadsafe`` loop wakeup per drain pass instead of
+    per finished call — the dominant per-call cost of run_in_executor on
+    a 1-core box (self-pipe write + epoll + futex each).  Used by both
+    ExecPipeline (exclusive drainer) and LanePool (concurrency lanes);
+    any flush-path fix lands in exactly one place.
+    """
+
+    def _init_completer(self, loop: asyncio.AbstractEventLoop):
+        self.loop = loop
+        self._done: List[tuple] = []
+        self._done_lock = threading.Lock()
+        self._done_flush_scheduled = False
+
+    def _complete(self, fut, res):
+        schedule = False
+        with self._done_lock:
+            self._done.append((fut, res))
+            if not self._done_flush_scheduled:
+                self._done_flush_scheduled = True
+                schedule = True
+        if schedule:
+            try:
+                self.loop.call_soon_threadsafe(self._flush_done)
+            except RuntimeError:  # loop closed at teardown
+                pass
+
+    def _flush_done(self):
+        with self._done_lock:
+            done, self._done = self._done, []
+            self._done_flush_scheduled = False
+        for fut, res in done:
+            if not fut.done():
+                fut.set_result(res)
+
+
+class ExecPipeline(_BatchedCompleter):
     """Sticky exclusive-execution thread for task/actor-call execution at
     max_concurrency == 1 (the default).
 
@@ -166,14 +204,11 @@ class ExecPipeline:
             self.consumed = False
 
     def __init__(self, loop: asyncio.AbstractEventLoop):
-        self.loop = loop
+        self._init_completer(loop)
         self._cv = threading.Condition()
         self._items: Dict[int, tuple] = {}
         self._next_ticket = 0
         self._next_exec = 0
-        self._done: List[tuple] = []
-        self._done_flush_scheduled = False
-        self._done_lock = threading.Lock()
         self._stopped = False
         self._thread: Optional[threading.Thread] = None
 
@@ -258,26 +293,84 @@ class ExecPipeline:
                     res = (False, e)
             self._complete(fut, res)
 
-    def _complete(self, fut, res):
-        schedule = False
-        with self._done_lock:
-            self._done.append((fut, res))
-            if not self._done_flush_scheduled:
-                self._done_flush_scheduled = True
-                schedule = True
-        if schedule:
-            try:
-                self.loop.call_soon_threadsafe(self._flush_done)
-            except RuntimeError:  # loop closed at teardown
-                pass
 
-    def _flush_done(self):
-        with self._done_lock:
-            done, self._done = self._done, []
-            self._done_flush_scheduled = False
-        for fut, res in done:
-            if not fut.done():
-                fut.set_result(res)
+
+class LanePool(_BatchedCompleter):
+    """N sticky execution threads for max_concurrency > 1 actors.
+
+    run_in_executor's per-call cost on a 1-core box is dominated by the
+    completion path: one ``call_soon_threadsafe`` loop wakeup per call
+    (self-pipe write + epoll + futex).  The lanes share ExecPipeline's
+    batched done-flush instead — a burst of overlapping calls completes
+    with one loop wakeup per drain pass.  No ordering guarantees (that is
+    the point of concurrency lanes); exclusion, when the user wants it,
+    is the actor's own locks, exactly like the reference's concurrent
+    actor threads.
+    """
+
+    def __init__(self, loop: asyncio.AbstractEventLoop, size: int):
+        import queue as _queue
+
+        self._init_completer(loop)
+        self.size = max(1, size)
+        self._q: "_queue.SimpleQueue" = _queue.SimpleQueue()
+        self._threads: List[threading.Thread] = []
+        self._stopped = False
+
+    async def run(self, fn, *args, **kwargs):
+        if self._stopped:
+            raise RuntimeError("lane pool is stopped")
+        fut = self.loop.create_future()
+        self._q.put((fn, args, kwargs, fut))
+        if len(self._threads) < self.size:
+            self._ensure_threads()
+        ok, val = await fut
+        if ok:
+            return val
+        raise val
+
+    def _ensure_threads(self):
+        self._threads = [t for t in self._threads if t.is_alive()]
+        while len(self._threads) < self.size:
+            t = threading.Thread(
+                target=self._worker, daemon=True,
+                name=f"actor-lane-{len(self._threads)}",
+            )
+            t.start()
+            self._threads.append(t)
+
+    def stop(self):
+        """Workers finish every item already queued (their futures must
+        resolve — a dropped item would hang its awaiting RPC handler
+        forever), then exit on their sentinel; stragglers enqueued in
+        the stop race are failed explicitly."""
+        self._stopped = True
+        for _ in self._threads:
+            self._q.put(None)
+        import queue as _queue
+
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except _queue.Empty:
+                break
+            if item is not None:
+                self._complete(
+                    item[3], (False, RuntimeError("lane pool stopped"))
+                )
+
+    def _worker(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return  # items queued before the sentinel were served
+            fn, args, kwargs, fut = item
+            try:
+                res = (True, fn(*args, **kwargs))
+            except BaseException as e:  # noqa: BLE001 — reported to caller
+                res = (False, e)
+            self._complete(fut, res)
+
 
 
 class _InflightReplies:
@@ -761,6 +854,7 @@ class CoreWorker:
     async def async_start(self):
         self.loop = asyncio.get_running_loop()
         self._exec_pipeline = ExecPipeline(asyncio.get_running_loop())
+        self._lane_pool = None  # created at actor init for max_concurrency>1
         self._inflight_replies = _InflightReplies()
         self.address = await self.server.start()
         self.cp = RetryableRpcClient(self.cp_address, push_handler=self._on_push)
@@ -928,6 +1022,8 @@ class CoreWorker:
                 pass
         if self._exec_pipeline is not None:
             self._exec_pipeline.stop()
+        if self._lane_pool is not None:
+            self._lane_pool.stop()
         await self.server.stop()
         for pool in (self.worker_clients, self.agent_clients):
             await pool.close_all()
@@ -2513,6 +2609,13 @@ class CoreWorker:
                     result = await self._exec_pipeline.run_sync(
                         ticket, _ctx.run, fn, *args, **kwargs
                     )
+                elif self._lane_pool is not None:
+                    # Concurrency lanes: sticky threads + batched
+                    # completion flushes (one loop wakeup per burst, not
+                    # per call).
+                    result = await self._lane_pool.run(
+                        _ctx.run, fn, *args, **kwargs
+                    )
                 else:
                     result = await loop.run_in_executor(
                         self._task_executor,
@@ -2591,9 +2694,12 @@ class CoreWorker:
             self.actor_incarnation = payload.get("incarnation", 0)
             self._actor_exec_lock = asyncio.Semaphore(max(1, spec.max_concurrency))
             if spec.max_concurrency > 1:
-                self._task_executor = ThreadPoolExecutor(
-                    max_workers=spec.max_concurrency, thread_name_prefix="actor"
-                )
+                # Overlapping sync methods run on the lane pool (sticky
+                # threads, batched completion flushes); the small default
+                # _task_executor stays for ctor/streaming/one-off
+                # run_in_executor uses — resizing it to max_concurrency
+                # would just park N idle threads next to the N lanes.
+                self._lane_pool = LanePool(loop, spec.max_concurrency)
             return {"ok": True}
         except BaseException as e:  # noqa: BLE001
             import traceback as tb
