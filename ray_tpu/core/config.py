@@ -111,6 +111,14 @@ _KNOBS: Dict[str, tuple] = {
     "data_memory_budget_fraction": (
         float, 0.5, "Fraction of the shm budget the data pipeline may hold"
     ),
+    # -- serve --
+    "serve_health_check_timeout_s": (
+        float, 10.0, "Per-sweep deadline for replica health replies"
+    ),
+    "serve_health_failure_threshold": (
+        int, 3, "Consecutive health timeouts before a replica is replaced "
+        "(a first-request jax compile can hold the GIL for tens of seconds)"
+    ),
     # -- usage stats --
     "usage_stats_enabled": (bool, True, "Cluster-local usage recording"),
     # -- task events / observability --

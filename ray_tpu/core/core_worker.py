@@ -315,29 +315,43 @@ class LanePool(_BatchedCompleter):
         self.size = max(1, size)
         self._q: "_queue.SimpleQueue" = _queue.SimpleQueue()
         self._threads: List[threading.Thread] = []
+        # Under _lane_lock: lanes parked in q.get / items enqueued but not
+        # yet claimed by a lane.  The spawn decision compares the two —
+        # `_idle` alone LAGS the queue (an idle lane stays counted until
+        # the OS schedules it), so back-to-back enqueues would under-spawn
+        # and serialize behind one lane.
+        self._idle = 0
+        self._pending = 0
+        self._lane_lock = threading.Lock()
         self._stopped = False
 
     async def run(self, fn, *args, **kwargs):
         if self._stopped:
             raise RuntimeError("lane pool is stopped")
         fut = self.loop.create_future()
+        # Lanes spawn ON DEMAND, one per uncovered item: serve replicas
+        # declare max_concurrency=1000, and eagerly spawning `size`
+        # threads was a thread storm that starved a 1-core box long
+        # enough to trip replica health checks.
+        with self._lane_lock:
+            self._pending += 1
+            spawn = (
+                self._pending > self._idle
+                and len(self._threads) < self.size
+            )
+            if spawn:
+                t = threading.Thread(
+                    target=self._worker, daemon=True,
+                    name=f"actor-lane-{len(self._threads)}",
+                )
+                self._threads.append(t)
         self._q.put((fn, args, kwargs, fut))
-        if len(self._threads) < self.size:
-            self._ensure_threads()
+        if spawn:
+            t.start()
         ok, val = await fut
         if ok:
             return val
         raise val
-
-    def _ensure_threads(self):
-        self._threads = [t for t in self._threads if t.is_alive()]
-        while len(self._threads) < self.size:
-            t = threading.Thread(
-                target=self._worker, daemon=True,
-                name=f"actor-lane-{len(self._threads)}",
-            )
-            t.start()
-            self._threads.append(t)
 
     def stop(self):
         """Workers finish every item already queued (their futures must
@@ -361,7 +375,16 @@ class LanePool(_BatchedCompleter):
 
     def _worker(self):
         while True:
-            item = self._q.get()
+            item = None
+            with self._lane_lock:
+                self._idle += 1
+            try:
+                item = self._q.get()
+            finally:
+                with self._lane_lock:
+                    self._idle -= 1
+                    if item is not None:
+                        self._pending -= 1
             if item is None:
                 return  # items queued before the sentinel were served
             fn, args, kwargs, fut = item

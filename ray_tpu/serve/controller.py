@@ -361,16 +361,44 @@ class ServeController:
         while the (slow) checks ran."""
         import time as _time
 
+        from ray_tpu.core.config import GlobalConfig
+
         replicas = list(entry["replicas"])
         refs = [(h, h.health_check.remote()) for h in replicas]
-        deadline = _time.monotonic() + 10
+        deadline = _time.monotonic() + GlobalConfig.serve_health_check_timeout_s
+        fails = entry.setdefault("_health_fails", {})
+        # Keyed by the STABLE actor id, and pruned to live replicas each
+        # sweep: an id(handle) key would leak strikes across downscales,
+        # and CPython id() reuse could charge a fresh replica with a dead
+        # predecessor's count — killing it on its first slow (tolerated)
+        # health check.
+        live = {h._actor_id.hex() for h in replicas}
+        for key in [k for k in fails if k not in live]:
+            del fails[key]
         dead = []
         for h, ref in refs:
+            hid = h._actor_id.hex()
             remaining = max(0.1, deadline - _time.monotonic())
             try:
                 ray_tpu.get(ref, timeout=remaining)
-            except Exception:  # noqa: BLE001
+                fails.pop(hid, None)
+            except Exception as e:  # noqa: BLE001
+                # Tolerate consecutive timeouts before replacing
+                # (reference: serve replica health uses a 30s+ budget):
+                # a replica compiling its first jax program holds the GIL
+                # for tens of seconds — busy-but-alive, and killing it
+                # fails the very request that triggered the compile.  An
+                # actor that is actually DEAD fails fast (dead-actor
+                # error), not by timeout — replace it immediately.
+                from ray_tpu.core.exceptions import GetTimeoutError
+
+                if isinstance(e, GetTimeoutError):
+                    n = fails.get(hid, 0) + 1
+                    fails[hid] = n
+                    if n < GlobalConfig.serve_health_failure_threshold:
+                        continue
                 dead.append(h)
+                fails.pop(hid, None)
         if not dead:
             return
         with self._lock:

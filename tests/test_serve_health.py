@@ -1,0 +1,114 @@
+"""Replica health semantics: busy-but-alive replicas are tolerated,
+stuck ones are replaced after the failure threshold, dead ones at once.
+
+A replica compiling its first jax program can hold the GIL past any
+single health deadline; round 5 found the controller killing such
+replicas MID-REQUEST (the llm_serving example 500'd with "actor is
+dead" whenever first-request compile outlasted the old 10 s one-strike
+check).  Reference: serve's replica health budget is tens of seconds
+with consecutive-failure semantics, not one strike.
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+import ray_tpu.serve as serve
+
+
+@pytest.fixture
+def health_cluster():
+    ctx = ray_tpu.init(
+        num_cpus=4,
+        _system_config={
+            "serve_health_check_timeout_s": 0.5,
+            "serve_health_failure_threshold": 3,
+        },
+    )
+    yield ctx
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _wait_for(pred, timeout=40, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.3)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def test_busy_replica_tolerated(health_cluster):
+    """Two consecutive slow health checks (below the threshold of 3) must
+    NOT get the replica replaced — its in-memory state survives."""
+
+    @serve.deployment(ray_actor_options={"num_cpus": 0})
+    class Compiling:
+        def __init__(self):
+            self.slow_checks = 2  # first checks stall past the deadline
+            self.n = 0
+
+        def check_health(self):
+            if self.slow_checks > 0:
+                self.slow_checks -= 1
+                time.sleep(1.2)  # > serve_health_check_timeout_s
+
+        def __call__(self):
+            self.n += 1
+            return self.n
+
+    handle = serve.run(Compiling.bind())
+    assert handle.remote().result(timeout=30) == 1
+    # Ride out several reconcile sweeps (0.5 s period): the two slow
+    # checks happen, then checks go fast and the counter resets.
+    time.sleep(4.0)
+    # Same instance => counter continued, not restarted.
+    assert handle.remote().result(timeout=30) == 2
+    serve.delete("Compiling")
+
+
+def test_stuck_replica_replaced_after_threshold(health_cluster):
+    """A health check that NEVER returns crosses the threshold and the
+    replica is replaced (a fresh instance reports a different pid)."""
+
+    @serve.deployment(ray_actor_options={"num_cpus": 0})
+    class Stuck:
+        def __init__(self):
+            self.born = os.getpid()
+            self.stuck = os.path.exists(STUCK_FLAG)
+
+        def check_health(self):
+            if self.stuck:
+                time.sleep(60)
+
+        def pid(self):
+            return os.getpid()
+
+    import tempfile
+
+    STUCK_FLAG = os.path.join(tempfile.gettempdir(), "serve_stuck_flag")
+    with open(STUCK_FLAG, "w") as f:
+        f.write("1")
+    try:
+        handle = serve.run(Stuck.bind())
+        first_pid = handle.pid.remote().result(timeout=30)
+        # Only the FIRST incarnation sees the flag; remove it so the
+        # replacement comes up healthy.
+        os.unlink(STUCK_FLAG)
+
+        def replaced():
+            try:
+                return serve.get_handle("Stuck").pid.remote().result(
+                    timeout=5
+                ) != first_pid
+            except Exception:
+                return False
+
+        _wait_for(replaced, timeout=40, msg="stuck replica replacement")
+    finally:
+        if os.path.exists(STUCK_FLAG):
+            os.unlink(STUCK_FLAG)
+        serve.delete("Stuck")
