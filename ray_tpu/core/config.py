@@ -75,6 +75,32 @@ _KNOBS: Dict[str, tuple] = {
     ),
     "object_chunk_bytes": (int, 5 * 1024 * 1024, "Chunk size for node-to-node transfer"),
     "memory_store_fallback_bytes": (int, 512 * 1024 * 1024, "In-process store budget"),
+    "object_spill_threshold_bytes": (
+        int, 0,
+        "Objects larger than this are written straight to the disk spill "
+        "tier instead of shm (0 = auto: anything larger than the arena, "
+        "object_store_memory_bytes — a put that can never fit shm must "
+        "not gamble on tmpfs overcommit, whose failure mode is SIGBUS)",
+    ),
+    "object_spill_max_bytes": (
+        int, 0,
+        "Disk spill-tier capacity (0 = unlimited).  A put that would "
+        "exceed it raises ObjectStoreFullError instead of filling the "
+        "disk — spill exhaustion must be a clear error, never a hang",
+    ),
+    # -- submission backpressure --
+    "task_queue_memory_cap_bytes": (
+        int, 256 * 1024 * 1024,
+        "Byte budget for pending task submissions (serialized args of "
+        "tasks not yet completed).  Submitting threads block when a new "
+        "submission would cross it, so a fast producer's queue cannot "
+        "grow driver RSS without bound (0 = unlimited)",
+    ),
+    "task_queue_block_timeout_s": (
+        float, 300.0,
+        "How long a submission may block on the queue-memory cap before "
+        "raising PendingTaskBackpressureTimeout",
+    ),
     # -- workers --
     "num_workers_soft_limit": (int, 0, "0 = num_cpus"),
     "worker_niceness": (int, 0, "Nice level for spawned workers"),

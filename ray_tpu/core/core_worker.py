@@ -326,14 +326,17 @@ class LanePool(_BatchedCompleter):
         self._stopped = False
 
     async def run(self, fn, *args, **kwargs):
-        if self._stopped:
-            raise RuntimeError("lane pool is stopped")
         fut = self.loop.create_future()
         # Lanes spawn ON DEMAND, one per uncovered item: serve replicas
         # declare max_concurrency=1000, and eagerly spawning `size`
         # threads was a thread storm that starved a 1-core box long
-        # enough to trip replica health checks.
+        # enough to trip replica health checks.  The stopped check and
+        # the enqueue share the lane lock with stop()'s drain, so no item
+        # can slip into the queue after the drain ran (it would sit
+        # behind the sentinels, unserved, hanging its awaiting handler).
         with self._lane_lock:
+            if self._stopped:
+                raise RuntimeError("lane pool is stopped")
             self._pending += 1
             spawn = (
                 self._pending > self._idle
@@ -345,7 +348,7 @@ class LanePool(_BatchedCompleter):
                     name=f"actor-lane-{len(self._threads)}",
                 )
                 self._threads.append(t)
-        self._q.put((fn, args, kwargs, fut))
+            self._q.put((fn, args, kwargs, fut))
         if spawn:
             t.start()
         ok, val = await fut
@@ -354,24 +357,34 @@ class LanePool(_BatchedCompleter):
         raise val
 
     def stop(self):
-        """Workers finish every item already queued (their futures must
-        resolve — a dropped item would hang its awaiting RPC handler
-        forever), then exit on their sentinel; stragglers enqueued in
-        the stop race are failed explicitly."""
-        self._stopped = True
-        for _ in self._threads:
-            self._q.put(None)
+        """Fail-fast shutdown.  Items a lane already claimed run to
+        completion; items still QUEUED are failed with 'lane pool
+        stopped' (their futures must resolve — a dropped item would hang
+        its awaiting RPC handler forever).  The drain runs BEFORE the
+        sentinels are pushed and under the lane lock: draining after
+        would pop the sentinels themselves, stranding busy lanes blocked
+        in q.get() forever, and an unlocked drain could race run() into
+        enqueueing an item behind the sentinels where no lane ever serves
+        it."""
         import queue as _queue
 
-        while True:
-            try:
-                item = self._q.get_nowait()
-            except _queue.Empty:
-                break
-            if item is not None:
+        with self._lane_lock:
+            if self._stopped:
+                return  # idempotent: a second drain would eat sentinels
+            self._stopped = True
+            while True:
+                try:
+                    item = self._q.get_nowait()
+                except _queue.Empty:
+                    break
+                if item is None:  # unreachable (sentinels push below);
+                    continue      # kept so a drained sentinel can't crash
+                self._pending -= 1
                 self._complete(
                     item[3], (False, RuntimeError("lane pool stopped"))
                 )
+            for _ in self._threads:
+                self._q.put(None)
 
     def _worker(self):
         while True:
@@ -394,6 +407,89 @@ class LanePool(_BatchedCompleter):
                 res = (False, e)
             self._complete(fut, res)
 
+
+
+class _SubmitBudget:
+    """Byte-budgeted submission backpressure (graceful overload
+    degradation for the queued-task plane).
+
+    Every task submission charges its serialized-args size (plus a small
+    per-task overhead) against ``task_queue_memory_cap_bytes``; the charge
+    is released when the task reaches a terminal state (reply or failure).
+    A submission that would cross the cap BLOCKS its calling user thread
+    until enough earlier work drains — so a producer loop submitting
+    faster than the cluster executes reaches a steady state instead of
+    growing driver RSS without bound (reference analog: the raylet's
+    backpressure on task submission queues).  Invariants:
+
+      - at least one submission is always admitted (a single charge larger
+        than the cap passes when nothing is queued), so the cap can never
+        deadlock a producer;
+      - only USER threads block — the protocol loop must never wait on its
+        own completions, so charges from the loop thread are
+        account-only;
+      - a block longer than ``task_queue_block_timeout_s`` raises
+        PendingTaskBackpressureTimeout — overload surfaces as a clear
+        error, not a silent hang.
+    """
+
+    # Fixed per-task cost charged on top of the args payload: spec object,
+    # queue slots, return-object records.  Keeps a flood of empty-args
+    # tasks bounded too.
+    PER_TASK_OVERHEAD = 512
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self.queued_bytes = 0
+        self.peak_bytes = 0
+        self.blocked_total = 0  # submissions that had to wait at least once
+
+    def charge(self, nbytes: int, may_block: bool):
+        cap = GlobalConfig.task_queue_memory_cap_bytes
+        with self._cv:
+            if cap > 0 and may_block:
+                deadline = None
+                blocked = False
+                while self.queued_bytes > 0 and (
+                    self.queued_bytes + nbytes > cap
+                ):
+                    if not blocked:
+                        blocked = True
+                        self.blocked_total += 1
+                    if deadline is None:
+                        deadline = (
+                            time.monotonic()
+                            + GlobalConfig.task_queue_block_timeout_s
+                        )
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        from .exceptions import (
+                            PendingTaskBackpressureTimeout,
+                        )
+
+                        raise PendingTaskBackpressureTimeout(
+                            f"submission of {nbytes} B blocked "
+                            f">{GlobalConfig.task_queue_block_timeout_s}s on "
+                            f"the task-queue memory cap ({cap} B, "
+                            f"{self.queued_bytes} B queued)"
+                        )
+                    self._cv.wait(min(remaining, 1.0))
+            self.queued_bytes += nbytes
+            if self.queued_bytes > self.peak_bytes:
+                self.peak_bytes = self.queued_bytes
+
+    def release(self, nbytes: int):
+        with self._cv:
+            self.queued_bytes -= nbytes
+            self._cv.notify_all()
+
+    def stats(self) -> dict:
+        with self._cv:
+            return {
+                "queued_bytes": self.queued_bytes,
+                "peak_bytes": self.peak_bytes,
+                "blocked_total": self.blocked_total,
+            }
 
 
 class _InflightReplies:
@@ -802,6 +898,7 @@ class CoreWorker:
 
         self.memory_store = MemoryStore()
         self.shm_store = ShmObjectStore(session_id)
+        self.submit_budget = _SubmitBudget()
         self.owned: Dict[ObjectID, OwnedObject] = {}
         self.lease_pools: Dict[tuple, _LeasePool] = {}
         self.actors: Dict[ActorID, _ActorState] = {}
@@ -1105,13 +1202,39 @@ class CoreWorker:
             # only eviction bookkeeping and rides a pipelined oneway frame
             # — FIFO on the agent connection, so any later free/pull on
             # this conn observes it.  Skipping the awaited round trip is
-            # worth ~20% put bandwidth at 64 MiB.
-            self.shm_store.create_serialized(oid, header, views)
+            # worth ~20% put bandwidth at 64 MiB.  Any DISK-bound write
+            # (arena-oversized value, or shm exhaustion discovered
+            # mid-write — NeedsSpill) moves to an executor thread: a
+            # multi-GiB disk write must not stall the protocol loop.  An
+            # exhausted spill tier raises ObjectStoreFullError — the put
+            # fails loudly instead of hanging or SIGBUS-ing on tmpfs —
+            # and a failed put must not strand its owned record.
+            try:
+                from .object_store import NeedsSpill
+
+                try:
+                    _, tier = self.shm_store.create_serialized(
+                        oid, header, views, inline_spill_ok=False
+                    )
+                except NeedsSpill:
+                    loop = asyncio.get_running_loop()
+                    _, tier = await loop.run_in_executor(
+                        None, self.shm_store.create_serialized,
+                        oid, header, views,
+                    )
+            except BaseException:
+                self.owned.pop(oid, None)
+                self.memory_store.free(oid)
+                raise
             await self.agent.notify(
-                "seal_object", {"object_id": oid, "size": size}
+                "seal_object", {"object_id": oid, "size": size, "tier": tier}
             )
             obj.locations.add(self.agent_address)
-            self.memory_store.put(oid, value)  # local cache for owner gets
+            if tier != "spill":
+                # Local cache for owner gets.  Spilled values stay on
+                # disk: caching would pin an arena-oversized value in the
+                # driver heap — exactly the RSS growth spilling avoids.
+                self.memory_store.put(oid, value)
         obj.state = READY
         obj.wake()
         ref = ObjectRef.__new__(ObjectRef)
@@ -1931,6 +2054,31 @@ class CoreWorker:
         )
         return payload, held
 
+    def _charge_submission(self, spec: TaskSpec, payload: bytes):
+        """Charge this submission against the pending-task memory budget.
+        Blocks (backpressure) only when called off the protocol loop — the
+        loop itself must stay free to drain the completions that release
+        charges."""
+        n = len(payload) + _SubmitBudget.PER_TASK_OVERHEAD
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        # Only THIS worker's protocol loop is exempt from blocking (it
+        # drains the completions that release charges).  A user's own
+        # asyncio loop is an ordinary producer thread: its completions
+        # arrive via our loop regardless, so blocking it is safe — and
+        # exempting it would let an async producer bypass the cap.
+        self.submit_budget.charge(n, may_block=running is not self.loop)
+        spec._queue_charge = n  # type: ignore[attr-defined]
+
+    def _release_queue_charge(self, spec: TaskSpec):
+        # Idempotent: reply and failure paths may both fire for one spec.
+        n = getattr(spec, "_queue_charge", 0)
+        if n:
+            spec._queue_charge = 0  # type: ignore[attr-defined]
+            self.submit_budget.release(n)
+
     def _hold_args(self, held: List[ObjectRef]):
         for r in held:
             if r.owner_address == self.address:
@@ -2011,6 +2159,7 @@ class CoreWorker:
             trace_ctx=_tracing_context(),
         )
         spec._held_refs = held  # type: ignore[attr-defined]
+        self._charge_submission(spec, payload)
         refs = []
         return_ids = spec.return_ids()
 
@@ -2056,6 +2205,7 @@ class CoreWorker:
         return refs
 
     def _handle_task_reply(self, spec: TaskSpec, reply: dict):
+        self._release_queue_charge(spec)
         done = self._recovery_waiters.get(spec.task_id)
         if done is not None:
             done.set()
@@ -2095,6 +2245,7 @@ class CoreWorker:
             self._maybe_free(oid)
 
     def _fail_task_returns(self, spec: TaskSpec, exc: BaseException):
+        self._release_queue_charge(spec)
         done = self._recovery_waiters.get(spec.task_id)
         if done is not None:
             done.set()
@@ -2243,6 +2394,7 @@ class CoreWorker:
         )
         spec.method_name = method_name  # type: ignore[attr-defined]
         spec._held_refs = held  # type: ignore[attr-defined]
+        self._charge_submission(spec, payload)
         return_ids = spec.return_ids()
 
         # Created on the calling thread so an immediate get() takes the
@@ -2407,14 +2559,42 @@ class CoreWorker:
             return [], {}
         args, kwargs = deserialize_from_bytes(payload)
 
-        async def resolve(v):
+        # Resolve all distinct markers CONCURRENTLY, one fetch per unique
+        # object.  Sequentially awaiting each arg made a wide-args task
+        # (the 10k-arg limit case) pay one full owner round trip per arg
+        # — resolution wall time scaled with count x latency instead of
+        # count / pipeline depth — and a ref passed N times fetched (and
+        # increfed) N times.
+        markers: Dict[tuple, _RefMarker] = {}
+        for v in list(args) + list(kwargs.values()):
             if isinstance(v, _RefMarker):
-                ref = ObjectRef(v.object_id, v.owner_address, _worker=self)
-                return await self._get_one(ref)
+                markers.setdefault((v.object_id, v.owner_address), v)
+        resolved: Dict[tuple, Any] = {}
+        if len(markers) == 1:
+            # Hot path (one ref arg, e.g. n:n-with-arg calls): skip the
+            # gather machinery.
+            ((key, m),) = markers.items()
+            resolved[key] = await self._get_one(
+                ObjectRef(m.object_id, m.owner_address, _worker=self)
+            )
+        elif markers:
+            values = await asyncio.gather(
+                *(
+                    self._get_one(
+                        ObjectRef(m.object_id, m.owner_address, _worker=self)
+                    )
+                    for m in markers.values()
+                )
+            )
+            resolved = dict(zip(markers.keys(), values))
+
+        def resolve(v):
+            if isinstance(v, _RefMarker):
+                return resolved[(v.object_id, v.owner_address)]
             return v
 
-        args = [await resolve(a) for a in args]
-        kwargs = {k: await resolve(v) for k, v in kwargs.items()}
+        args = [resolve(a) for a in args]
+        kwargs = {k: resolve(v) for k, v in kwargs.items()}
         return args, kwargs
 
     async def _package_value(self, spec: TaskSpec, value, index: int) -> tuple:
@@ -2435,14 +2615,16 @@ class CoreWorker:
             return ("inline", bytes(buf))
         oid = ObjectID.for_task_return(spec.task_id, index)
         loop = asyncio.get_running_loop()
-        await loop.run_in_executor(
+        _, tier = await loop.run_in_executor(
             None, self.shm_store.create_serialized, oid, header, views
         )
         # Pipelined oneway (see _put_async): the arena entry is already
         # sealed natively; chunk reads fall back to the arena if the
-        # directory seal hasn't landed yet.
+        # directory seal hasn't landed yet.  An arena-oversized return
+        # lands on the disk spill tier (tier == "spill") and is indexed
+        # there by the agent; readers fall through shm to the spill file.
         await self.agent.notify(
-            "seal_object", {"object_id": oid, "size": size}
+            "seal_object", {"object_id": oid, "size": size, "tier": tier}
         )
         return ("shm", self.agent_address, size)
 

@@ -57,5 +57,19 @@ class GetTimeoutError(RayTpuError, TimeoutError):
     pass
 
 
+class ObjectStoreFullError(RayTpuError):
+    """Every storage tier (shm arena, tmpfs segments, disk spill) is
+    exhausted or capped; the object cannot be stored anywhere.  Raised at
+    ``put``/return-packaging time — overload must surface as an error at
+    the call site, never as a hang."""
+
+
+class PendingTaskBackpressureTimeout(RayTpuError, TimeoutError):
+    """A submission blocked on the pending-task memory cap
+    (``task_queue_memory_cap_bytes``) for longer than
+    ``task_queue_block_timeout_s`` — the cluster is not draining queued
+    work fast enough for this producer."""
+
+
 class RuntimeEnvSetupError(RayTpuError):
     pass

@@ -142,6 +142,11 @@ class NodeAgent:
         self._owner_reap_timers: Dict[str, Any] = {}
         self._idle_since = None  # monotonic ts when node went fully idle
         self._pull_futures: Dict[ObjectID, asyncio.Future] = {}
+        # Frees observed while a pull of the same oid is in flight: the
+        # pull's post-await seal would otherwise re-register a dead oid
+        # (same hazard handle_seal_object guards against) and leak its
+        # directory accounting + storage forever.
+        self._freed_during_pull: set = set()
         self._prestart_task: Optional[asyncio.Task] = None
         self._last_pop = 0.0  # monotonic ts of last default-pool pop
         self._pool_miss_at = 0.0  # monotonic ts of last EMPTY-pool pop
@@ -1050,12 +1055,21 @@ class NodeAgent:
         # objects) may have already deleted the entry from the tiers.
         # Registering a dead oid would leak directory accounting forever.
         oid = payload["object_id"]
-        if self.shm_store.contains(oid):
+        if payload.get("tier") == "spill":
+            # Arena-oversized object written straight to the disk spill
+            # tier by its creator: index it as spilled (never shm-LRU'd).
+            from .object_store import spill_path
+
+            if os.path.exists(spill_path(self.session_id, oid)):
+                self.directory.register_spilled(oid, payload["size"])
+        elif self.shm_store.contains(oid):
             self.directory.seal(oid, payload["size"])
         return True
 
     def handle_free_objects(self, payload, conn):
         for oid in payload["object_ids"]:
+            if oid in self._pull_futures:
+                self._freed_during_pull.add(oid)
             self.directory.free(oid)
         return True
 
@@ -1095,6 +1109,7 @@ class NodeAgent:
             await fut
         finally:
             self._pull_futures.pop(oid, None)
+            self._freed_during_pull.discard(oid)
         return {"ok": True}
 
     async def _do_pull(self, oid: ObjectID, from_agent: str):
@@ -1116,8 +1131,23 @@ class NodeAgent:
             parts.append(part["data"])
             got += len(part["data"])
         payload = b"".join(parts)
-        size = self.shm_store.create_from_bytes(oid, payload)
-        self.directory.seal(oid, size)
+        # Executor: the store write is a full-payload copy — for an
+        # arena-oversized object, a multi-hundred-MB DISK write — and must
+        # not stall the agent loop (heartbeats, lease grants).
+        size, tier = await asyncio.get_running_loop().run_in_executor(
+            None, self.shm_store.create_from_bytes, oid, payload
+        )
+        if oid in self._freed_during_pull:
+            # Freed while the pull was in flight: sealing now would
+            # register a dead oid forever.  Delete the just-written copy
+            # instead (free is idempotent across tiers).
+            self._freed_during_pull.discard(oid)
+            self.directory.free(oid)
+            return
+        if tier == "spill":
+            self.directory.register_spilled(oid, size)
+        else:
+            self.directory.seal(oid, size)
 
     def handle_ping(self, payload, conn):
         return "pong"
@@ -1157,6 +1187,9 @@ class NodeAgent:
             "queued_leases": len(self._lease_queue),
             "objects": len(self.directory.object_ids()),
             "object_bytes": self.directory.used,
+            "spilled_objects": len(self.directory._spilled),
+            "spilled_bytes": self.directory.spilled_bytes,
+            "num_spilled_total": self.directory.num_spilled,
             "rpc_stats": dict(self.server.stats),
         }
 

@@ -107,6 +107,80 @@ def spill_object(session_id: str, object_id: ObjectID, payload) -> int:
     return len(payload)
 
 
+def spill_tier_used_bytes(session_id: str) -> int:
+    """Bytes currently occupied by this session's disk spill tier.
+    In-flight ``.tmp`` writes are excluded (they are either about to be
+    renamed — and were already capacity-checked — or about to be
+    unlinked)."""
+    try:
+        with os.scandir(spill_dir(session_id)) as it:
+            return sum(
+                e.stat().st_size
+                for e in it
+                if e.is_file() and not e.name.endswith(".tmp")
+            )
+    except OSError:
+        return 0
+
+
+def _check_spill_capacity(session_id: str, incoming: int):
+    """Enforce ``object_spill_max_bytes`` before a spill write.
+
+    The scan is one directory pass; spill writers live in several
+    processes (workers spill their own oversized puts/returns, the agent
+    spills evictions), so the filesystem is the one shared source of
+    truth — a per-process counter would drift.  The cap is a soft bound
+    under concurrency: two writers that check simultaneously can overshoot
+    by one object, which is the accepted trade for not serializing every
+    spill through the agent."""
+    from .config import GlobalConfig
+    from .exceptions import ObjectStoreFullError
+
+    cap = GlobalConfig.object_spill_max_bytes
+    if not cap:
+        return
+    used = spill_tier_used_bytes(session_id)
+    if used + incoming > cap:
+        raise ObjectStoreFullError(
+            f"spill tier exhausted: {incoming} B object would exceed the "
+            f"object_spill_max_bytes cap of {cap} B (used {used} B)"
+        )
+
+
+def spill_serialized(session_id: str, object_id: ObjectID, header: bytes,
+                     views, total: int) -> int:
+    """Write the flat serialized encoding (see serialize_to_bytes) straight
+    to a spill file — the oversized-put path.  Streams each out-of-band
+    buffer to disk without materializing the full payload in heap, and
+    converts disk exhaustion (ENOSPC, or the object_spill_max_bytes cap)
+    into a clear ObjectStoreFullError instead of a partial write."""
+    from .exceptions import ObjectStoreFullError
+
+    _check_spill_capacity(session_id, total)
+    spill_dir(session_id, create=True)
+    path = spill_path(session_id, object_id)
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(len(views).to_bytes(4, "little"))
+            f.write(len(header).to_bytes(4, "little"))
+            f.write(header)
+            for v in views:
+                b = memoryview(v).cast("B")
+                f.write(b.nbytes.to_bytes(8, "little"))
+                f.write(b)
+        os.replace(tmp, path)
+    except OSError as e:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise ObjectStoreFullError(
+            f"spill write of {total} B object failed: {e}"
+        ) from e
+    return total
+
+
 def read_spilled(session_id: str, object_id: ObjectID):
     try:
         with open(spill_path(session_id, object_id), "rb") as f:
@@ -134,6 +208,16 @@ def read_from_tiers(session_id: str, object_id: ObjectID):
     except FileNotFoundError:
         pass
     return read_spilled(session_id, object_id)
+
+
+class NeedsSpill(Exception):
+    """Internal signal: the write must go to the disk spill tier, and the
+    caller asked (``inline_spill_ok=False``) to perform disk IO off its
+    current thread.  Never user-visible — callers retry on an executor."""
+
+    def __init__(self, total: int):
+        self.total = total
+        super().__init__(total)
 
 
 class _SpilledBlob:
@@ -227,22 +311,48 @@ class ShmObjectStore:
         self._spill_cache: "OrderedDict[ObjectID, _SpilledBlob]" = OrderedDict()
 
     # -- write path ---------------------------------------------------------
-    def create(self, object_id: ObjectID, value: Any) -> int:
-        """Serialize ``value`` into the shm tier.  Returns size."""
+    @staticmethod
+    def _spill_threshold() -> int:
+        """Objects at or above this size skip shm and go straight to the
+        disk spill tier.  Auto mode (0) uses the arena capacity: an object
+        that can never fit the arena would land on a per-object tmpfs
+        segment, where exceeding /dev/shm fails as SIGBUS on first write —
+        a hard crash, not an error.  Routing it to disk up front keeps the
+        oversized-put path a clear round trip (or a clear
+        ObjectStoreFullError when the spill tier is exhausted too)."""
+        return (
+            GlobalConfig.object_spill_threshold_bytes
+            or GlobalConfig.object_store_memory_bytes
+        )
+
+    def create(self, object_id: ObjectID, value: Any) -> Tuple[int, str]:
+        """Serialize ``value`` into the shm tier.  Returns (size, tier)."""
         from .serialization import serialize
 
         header, views = serialize(value)
         return self.create_serialized(object_id, header, views)
 
     def create_serialized(self, object_id: ObjectID, header: bytes,
-                          views) -> int:
+                          views, inline_spill_ok: bool = True,
+                          ) -> Tuple[int, str]:
         """Zero-copy write: pickle-5 out-of-band buffers memcpy directly
         into the arena block (one copy per buffer — the plasma-style fast
         path; ~3x put bandwidth over flatten-then-copy on 64 MiB numpy
-        payloads)."""
+        payloads).  Returns (size, tier) where tier is "shm" or "spill" —
+        arena-oversized objects route straight to the disk spill tier.
+
+        ``inline_spill_ok=False`` makes a would-be disk write raise
+        ``NeedsSpill`` instead: a caller on a latency-critical thread (the
+        protocol loop) retries the call on an executor thread, so multi-
+        hundred-MB disk IO never runs inline there."""
         from .serialization import serialized_nbytes, write_serialized
 
         total = serialized_nbytes(header, views)
+        if total >= self._spill_threshold():
+            if not inline_spill_ok:
+                raise NeedsSpill(total)
+            spill_serialized(self.session_id, object_id, header, views, total)
+            return total, "spill"
         if self._arena is not None:
             buf = self._arena.alloc(object_id.binary(), total)
             if buf is None and self._arena.contains(object_id.binary()):
@@ -251,15 +361,28 @@ class ShmObjectStore:
             if buf is not None:
                 write_serialized(header, views, buf)
                 self._arena.seal(object_id.binary())
-                return total
-        seg = shm.ShmSegment.create(
-            shm.segment_name(self.session_id, object_id.hex()), total
-        )
+                return total, "shm"
+        try:
+            seg = shm.ShmSegment.create(
+                shm.segment_name(self.session_id, object_id.hex()), total
+            )
+        except OSError:
+            # tmpfs overflow tier unavailable (e.g. /dev/shm full):
+            # degrade to the disk spill tier rather than failing the put.
+            if not inline_spill_ok:
+                raise NeedsSpill(total)
+            spill_serialized(self.session_id, object_id, header, views, total)
+            return total, "spill"
         write_serialized(header, views, seg.view())
         self._attached[object_id] = seg
-        return total
+        return total, "shm"
 
-    def create_from_bytes(self, object_id: ObjectID, payload: bytes) -> int:
+    def create_from_bytes(self, object_id: ObjectID,
+                          payload: bytes) -> Tuple[int, str]:
+        if len(payload) >= self._spill_threshold():
+            _check_spill_capacity(self.session_id, len(payload))
+            spill_object(self.session_id, object_id, payload)
+            return len(payload), "spill"
         if self._arena is not None:
             buf = self._arena.alloc(object_id.binary(), len(payload))
             if buf is None and self._arena.contains(object_id.binary()):
@@ -270,14 +393,23 @@ class ShmObjectStore:
             if buf is not None:
                 buf[: len(payload)] = payload
                 self._arena.seal(object_id.binary())
-                return len(payload)
+                return len(payload), "shm"
             # Arena full: overflow to a per-object tmpfs file.
-        seg = shm.ShmSegment.create(
-            shm.segment_name(self.session_id, object_id.hex()), len(payload)
-        )
+        try:
+            seg = shm.ShmSegment.create(
+                shm.segment_name(self.session_id, object_id.hex()),
+                len(payload),
+            )
+        except OSError:
+            # tmpfs tier unavailable too (e.g. /dev/shm full): degrade to
+            # the disk spill tier — an inbound transfer must survive the
+            # same exhaustion a local put does.
+            _check_spill_capacity(self.session_id, len(payload))
+            spill_object(self.session_id, object_id, payload)
+            return len(payload), "spill"
         seg.view()[: len(payload)] = payload
         self._attached[object_id] = seg
-        return len(payload)
+        return len(payload), "shm"
 
     # -- read path ----------------------------------------------------------
     def contains(self, object_id: ObjectID) -> bool:
@@ -375,6 +507,17 @@ class NodeObjectDirectory:
             if self.used > self.capacity:
                 self._evict()
 
+    def register_spilled(self, object_id: ObjectID, size: int):
+        """Record an object born directly on the disk spill tier (an
+        arena-oversized put) — it never occupied shm, so it must not enter
+        the capacity-accounted LRU set (one seal would evict the whole
+        arena), only the spilled index."""
+        with self._tier_lock:
+            if object_id not in self._spilled:
+                self._spilled[object_id] = size
+                self.spilled_bytes += size
+                self.num_spilled += 1
+
     def contains(self, object_id: ObjectID) -> bool:
         return (
             object_id in self._objects
@@ -452,6 +595,12 @@ class NodeObjectDirectory:
             try:
                 payload = read_from_tiers(self.session_id, oid)
                 if payload is not None:
+                    # The spill-tier cap binds evictions too: a capped
+                    # tier must not silently fill with LRU victims.  The
+                    # raise lands in the except below — the object stays
+                    # tracked in shm (accounting restored) and the miss is
+                    # logged, exactly like a failed (ENOSPC) spill write.
+                    _check_spill_capacity(self.session_id, len(payload))
                     spill_object(self.session_id, oid, payload)
                     self.spilled_bytes += len(payload)
                     self.num_spilled += 1

@@ -39,11 +39,14 @@ from __future__ import annotations
 
 import hashlib
 import json
+import logging
 import os
 import shutil
 import subprocess
 import sys
 from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
 
 # Env vars used to ship the resolved env to the worker process.
 WORKING_DIR_ENV = "RAY_TPU_RT_WORKING_DIR"
@@ -301,8 +304,9 @@ def resolve_container_spec(spec) -> str:
     worker command is wrapped in ``podman run`` with host network/pid/ipc
     so the container shares the node's data plane (shm arena, TCP
     control plane).  Accepts ``"image:tag"`` or ``{"image": ...,
-    "run_options": [...]}``.  Gated: raises when neither podman nor
-    docker is on PATH.
+    "run_options": [...]}``.  A driver host without podman/docker only
+    WARNS — containers run on worker nodes, whose agents re-resolve the
+    runtime authoritatively (``container_argv``).
     """
     if isinstance(spec, str):
         spec = {"image": spec}
@@ -317,16 +321,23 @@ def resolve_container_spec(spec) -> str:
         raise ValueError(
             f"unknown runtime_env['container'] keys: {sorted(unknown)}"
         )
-    # Gate on the DRIVER for an early, readable error — but ship only the
+    # Probe the DRIVER's PATH for an early heads-up — but ship only the
     # binary NAME: agents on other nodes re-resolve against their own
     # PATH in container_argv (a driver's /usr/bin/podman may be
-    # /usr/local/bin/docker on an autoscaled worker host).
+    # /usr/local/bin/docker on an autoscaled worker host).  A missing
+    # driver-side runtime is a WARNING, not an error: containers only
+    # run on worker nodes, so a head node without podman/docker must not
+    # false-fail a runtime_env that every worker host can satisfy — the
+    # agent-side re-resolution stays the authoritative gate.
     binary = _container_binary()
     if binary is None:
-        raise RuntimeError(
-            "runtime_env['container'] requires a podman or docker binary "
-            "on PATH; none found on this host"
+        logger.warning(
+            "runtime_env['container']: no podman or docker on this "
+            "driver's PATH; deferring to each worker node's agent "
+            "(a worker host without a container runtime will fail the "
+            "lease there)"
         )
+        binary = "podman"
     run_options = list(spec.get("run_options") or [])
     if not all(isinstance(o, str) for o in run_options):
         raise ValueError("container run_options must be a list of strings")

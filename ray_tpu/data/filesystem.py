@@ -130,7 +130,7 @@ class MemoryFileSystem(DataFileSystem):
         return self._worker().kv_keys(self._NS, prefix=prefix)
 
     def glob(self, pattern: str) -> List[str]:
-        # Prefix scan up to the first wildcard, then fnmatch.
+        # Prefix scan up to the first wildcard, then match.
         cut = len(pattern)
         for ch in "*?[":
             i = pattern.find(ch)
@@ -142,7 +142,20 @@ class MemoryFileSystem(DataFileSystem):
                 k for k in keys
                 if k == pattern or k.startswith(pattern.rstrip("/") + "/")
             )
-        return sorted(k for k in keys if fnmatch.fnmatch(k, pattern))
+        # Segment-wise fnmatch: raw fnmatch lets '*' cross '/', so
+        # 'memory://dir/*' would also match files in nested
+        # subdirectories — diverging from LocalFileSystem/glob semantics
+        # and double-reading partitioned layouts (dir/part=0/f.parquet
+        # matched by both the dir scan and the partition scan).
+        parts = pattern.split("/")
+        return sorted(
+            k for k in keys
+            if len(k.split("/")) == len(parts)
+            and all(
+                fnmatch.fnmatch(seg, pat)
+                for seg, pat in zip(k.split("/"), parts)
+            )
+        )
 
     def isdir(self, path: str) -> bool:
         prefix = path.rstrip("/") + "/"

@@ -356,16 +356,20 @@ class ServeController:
     def _replace_dead_replicas(self, name: str, entry: dict):
         """Health check every replica; respawn the dead (reference:
         DeploymentState reconciling target vs. actual).  Checks are issued
-        concurrently with one shared deadline, and respawn revalidates the
-        entry under the lock — deploy()/delete() may have replaced it
-        while the (slow) checks ran."""
-        import time as _time
-
+        concurrently up-front; each replica then gets an INDEPENDENT
+        ``serve_health_check_timeout_s`` budget measured from its own
+        await — one stuck replica consuming its full window must not
+        starve later replicas down to a floor where a merely-slow-but-
+        healthy co-deployed replica accumulates spurious strikes and gets
+        replaced (worst-case sweep time is n_stuck x timeout, which the
+        consecutive-failure threshold already bounds in practice).
+        Respawn revalidates the entry under the lock — deploy()/delete()
+        may have replaced it while the (slow) checks ran."""
         from ray_tpu.core.config import GlobalConfig
 
         replicas = list(entry["replicas"])
         refs = [(h, h.health_check.remote()) for h in replicas]
-        deadline = _time.monotonic() + GlobalConfig.serve_health_check_timeout_s
+        per_replica_timeout = GlobalConfig.serve_health_check_timeout_s
         fails = entry.setdefault("_health_fails", {})
         # Keyed by the STABLE actor id, and pruned to live replicas each
         # sweep: an id(handle) key would leak strikes across downscales,
@@ -378,9 +382,8 @@ class ServeController:
         dead = []
         for h, ref in refs:
             hid = h._actor_id.hex()
-            remaining = max(0.1, deadline - _time.monotonic())
             try:
-                ray_tpu.get(ref, timeout=remaining)
+                ray_tpu.get(ref, timeout=per_replica_timeout)
                 fails.pop(hid, None)
             except Exception as e:  # noqa: BLE001
                 # Tolerate consecutive timeouts before replacing

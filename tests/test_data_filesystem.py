@@ -57,6 +57,15 @@ class TestMemoryFilesystem:
         assert fs.glob("memory://bkt/dir/*.csv") == [
             "memory://bkt/dir/a.csv", "memory://bkt/dir/b.csv"
         ]
+        # '*' must not cross '/' (ADVICE r5 #2): a nested partition file
+        # matching the flat pattern would be read twice by _expand_paths.
+        fs.write_bytes("memory://bkt/dir/part=0/d.csv", b"4")
+        assert fs.glob("memory://bkt/dir/*.csv") == [
+            "memory://bkt/dir/a.csv", "memory://bkt/dir/b.csv"
+        ]
+        assert fs.glob("memory://bkt/dir/*/*.csv") == [
+            "memory://bkt/dir/part=0/d.csv"
+        ]
         assert fs.isdir("memory://bkt/dir")
         assert not fs.isdir("memory://bkt/nothing")
         with pytest.raises(FileNotFoundError):

@@ -70,6 +70,87 @@ def test_busy_replica_tolerated(health_cluster):
     serve.delete("Compiling")
 
 
+def test_stuck_replica_does_not_starve_slow_sibling(health_cluster, tmp_path):
+    """Regression (ADVICE r5 #4): each replica gets an INDEPENDENT health
+    timeout.  Under the old shared-deadline sweep, a stuck replica at
+    index 0 consumed the whole window and later replicas got a 0.1 s
+    floor — a co-deployed replica whose checks land after that floor but
+    within its own full budget accumulated spurious strikes and was
+    replaced.  Here: replica 0 is stuck forever (every incarnation),
+    replica 1 is slow-but-healthy (0.85 s checks vs the 0.5 s budget —
+    ready only AFTER the old starved floor, but within its own window
+    when awaited after the stuck replica's timeout).  The slow replica
+    must survive; the stuck one must keep being replaced."""
+    root = str(tmp_path)
+
+    @serve.deployment(ray_actor_options={"num_cpus": 0})
+    class Flaky:
+        def __init__(self, root):
+            self.root = root
+            # Atomic instance-number claim: 0 = first spawn (stuck slot),
+            # 1 = second spawn (slow slot), >=2 = replacements (stuck, so
+            # the first sweep position stays consumed forever).
+            for k in range(64):
+                try:
+                    fd = os.open(
+                        os.path.join(root, f"claim-{k}"),
+                        os.O_CREAT | os.O_EXCL | os.O_WRONLY,
+                    )
+                    os.write(fd, str(os.getpid()).encode())
+                    os.close(fd)
+                    break
+                except FileExistsError:
+                    continue
+            self.k = k
+
+        def check_health(self):
+            if not os.path.exists(os.path.join(self.root, "go")):
+                return  # benign until both replicas are up and claimed
+            if self.k == 1:
+                time.sleep(0.85)  # slow but healthy
+            else:
+                time.sleep(30)  # stuck
+
+        def __call__(self):
+            return self.k
+
+    # Two-phase deploy pins list order: replica 0 spawns (claims 0), THEN
+    # the same-version redeploy appends replica 1 (claims 1).
+    serve.run(Flaky.options(num_replicas=1).bind(root))
+    _wait_for(
+        lambda: os.path.exists(os.path.join(root, "claim-0")),
+        msg="first replica claim",
+    )
+    serve.run(Flaky.options(num_replicas=2).bind(root))
+    _wait_for(
+        lambda: os.path.exists(os.path.join(root, "claim-1")),
+        msg="second replica claim",
+    )
+    pid_stuck = int(open(os.path.join(root, "claim-0")).read())
+    pid_slow = int(open(os.path.join(root, "claim-1")).read())
+    try:
+        with open(os.path.join(root, "go"), "w") as f:
+            f.write("1")
+
+        def stuck_replaced():
+            try:
+                os.kill(pid_stuck, 0)
+                return False
+            except ProcessLookupError:
+                return True
+
+        # The stuck replica crosses the threshold and is replaced...
+        _wait_for(stuck_replaced, timeout=60, msg="stuck replica replacement")
+        # ...and through several more sweeps (its replacements are stuck
+        # too, so the hazard position stays occupied) the slow sibling is
+        # never starved into strikes.
+        time.sleep(6.0)
+        os.kill(pid_slow, 0)  # raises if the slow replica was replaced
+    finally:
+        os.unlink(os.path.join(root, "go"))
+        serve.delete("Flaky")
+
+
 def test_stuck_replica_replaced_after_threshold(health_cluster):
     """A health check that NEVER returns crosses the threshold and the
     replica is replaced (a fresh instance reports a different pid)."""
