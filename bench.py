@@ -1275,6 +1275,68 @@ def run_scaling_suite():
         emit("sp_ring_ulysses_parity", 1.0 if parity_ok else 0.0, "bool")
 
 
+# --------------------------------------------------------- obs overhead
+
+def measure_obs_overhead(n_calls=300, trials=3, n_warmup=30):
+    """Task round-trip cost with the flight recorder ON vs OFF.
+
+    Two fresh clusters (same shape) so the OFF run carries zero residue of
+    the ON run's instrumentation; best-of-``trials`` per config because
+    single-shot throughput on a shared 1-core box swings with scheduler
+    noise.  Returns per-call seconds for each config and the overhead
+    fraction.  The <5% guard is the acceptance bar for all flight-recorder
+    instrumentation on the hot path."""
+    import ray_tpu
+
+    def per_call_s(flight_recorder_on: bool) -> float:
+        ray_tpu.init(
+            num_cpus=1,
+            _system_config={
+                "enable_flight_recorder": flight_recorder_on,
+                "prestart_workers": 2,
+            },
+        )
+        try:
+            @ray_tpu.remote
+            def f():
+                return b"ok"
+
+            for _ in range(n_warmup):
+                ray_tpu.get(f.remote(), timeout=60)
+            best = float("inf")
+            for _ in range(trials):
+                t0 = time.perf_counter()
+                for _ in range(n_calls):
+                    ray_tpu.get(f.remote(), timeout=60)
+                best = min(best, (time.perf_counter() - t0) / n_calls)
+            return best
+        finally:
+            ray_tpu.shutdown()
+
+    t_on = per_call_s(True)
+    t_off = per_call_s(False)
+    return {
+        "per_call_on_s": t_on,
+        "per_call_off_s": t_off,
+        "overhead_fraction": max(0.0, t_on / t_off - 1.0),
+    }
+
+
+def run_obs_overhead_suite():
+    res = measure_obs_overhead()
+    emit(
+        "obs_overhead_fraction", res["overhead_fraction"], "fraction",
+        per_call_on_us=round(res["per_call_on_s"] * 1e6, 1),
+        per_call_off_us=round(res["per_call_off_s"] * 1e6, 1),
+        guard="<0.05",
+    )
+    if res["overhead_fraction"] >= 0.05:
+        print(
+            f"# obs_overhead GUARD EXCEEDED: "
+            f"{res['overhead_fraction']:.3f} >= 0.05", flush=True,
+        )
+
+
 def main():
     only = sys.argv[1] if len(sys.argv) > 1 else "all"
 
@@ -1307,6 +1369,8 @@ def main():
             run("core", run_control_plane_suite)
         if only in ("all", "limits"):
             run("limits", run_limits_suite)
+        if only in ("all", "obs_overhead"):
+            run("obs_overhead", run_obs_overhead_suite)
         if only in ("all", "scaling"):
             run("scaling", run_scaling_suite)
         if only in ("all", "model"):

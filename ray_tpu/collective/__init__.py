@@ -4,6 +4,7 @@ from .collective import (  # noqa: F401
     alltoall,
     barrier,
     broadcast,
+    collective_stats,
     destroy_collective_group,
     get_collective_group_size,
     get_group,

@@ -134,6 +134,16 @@ def barrier(group_name: str = "default"):
     return _manager.get(group_name).barrier()
 
 
+def collective_stats() -> Dict[str, dict]:
+    """This process's per-op collective telemetry (ops, bytes, mean
+    duration) from the flight recorder — the local-process view;
+    cluster-wide aggregates live in ``metrics.snapshot()`` /
+    ``/metrics`` under the ``ray_tpu_collective_*`` names."""
+    from ..util import flight_recorder
+
+    return flight_recorder.local_collective_stats()
+
+
 def send(tensor, dst_rank: int, group_name: str = "default"):
     """Point-to-point send (reference: ``ray.util.collective.send``,
     NCCL p2p).  TPU-native path: the tensor rides the object plane —

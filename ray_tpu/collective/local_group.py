@@ -33,6 +33,11 @@ class LocalXlaGroup:
 
         self.mesh = Mesh(np.array(self.devices), ("world",))
         self._fn_cache: Dict[tuple, object] = {}
+        # Flight recorder: op/bytes/world-size/duration + achieved-bandwidth
+        # capture on every collective (no-op when disabled).
+        from ..util import flight_recorder
+
+        flight_recorder.instrument_group(self, "local")
 
     def info(self, rank: int = 0) -> GroupInfo:
         return GroupInfo(self.group_name, self.world_size, rank, Backend.LOCAL)
@@ -67,15 +72,13 @@ class LocalXlaGroup:
     def _shard_map(self, fn, out_spec_rank_axis=True):
         import jax
         from jax.sharding import PartitionSpec as P
-        from jax import shard_map
+
+        from .types import compat_shard_map
 
         in_spec = P("world")
         out_spec = P("world") if out_spec_rank_axis else P()
         return jax.jit(
-            shard_map(
-                fn, mesh=self.mesh, in_specs=(in_spec,), out_specs=out_spec, check_vma=False,
-                
-            )
+            compat_shard_map(fn, self.mesh, (in_spec,), out_spec)
         )
 
     def _cached(self, key, builder):

@@ -100,6 +100,12 @@ class XlaGroup:
             ("host", "device"),
         )
         self._fn_cache: Dict[tuple, object] = {}
+        # Flight recorder: per-op bytes/duration/bandwidth capture.  These
+        # ops materialize results to numpy (host sync), so the recorded
+        # durations reflect the real collective, ICI included.
+        from ..util import flight_recorder
+
+        flight_recorder.instrument_group(self, "xla")
 
     def info(self) -> GroupInfo:
         return GroupInfo(self.group_name, self.world_size, self.rank, Backend.XLA)
@@ -124,20 +130,14 @@ class XlaGroup:
     def _build(self, key, body, out_replicated=False):
         import jax
         from jax.sharding import PartitionSpec as P
-        from jax import shard_map
+
+        from .types import compat_shard_map
 
         fn = self._fn_cache.get(key)
         if fn is None:
             out_spec = P() if out_replicated else P(("host",))
             fn = jax.jit(
-                shard_map(
-                    body,
-                    mesh=self.mesh,
-                    in_specs=(P(("host",)),),
-                    out_specs=out_spec,
-                    check_vma=False,
-                    
-                )
+                compat_shard_map(body, self.mesh, (P(("host",)),), out_spec)
             )
             self._fn_cache[key] = fn
         return fn

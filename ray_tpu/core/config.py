@@ -149,6 +149,13 @@ _KNOBS: Dict[str, tuple] = {
     "usage_stats_enabled": (bool, True, "Cluster-local usage recording"),
     # -- task events / observability --
     "enable_task_events": (bool, True, "Record task lifecycle events"),
+    "enable_flight_recorder": (
+        bool, True,
+        "Runtime-internal telemetry: per-task phase timings, collective "
+        "op/bytes/bandwidth capture, object-store and backpressure "
+        "counters (ray_tpu_* metrics + timeline phase rows).  Guarded at "
+        "<5% round-trip overhead by `bench.py obs_overhead`",
+    ),
     "task_events_flush_period_s": (float, 0.5, "Worker buffer flush period"),
     "task_events_max_buffer": (int, 10000, "Per-worker unflushed event cap"),
     "task_events_max_stored": (int, 100000, "Control-plane stored task cap"),

@@ -109,6 +109,20 @@ def _tracing_context():
         _current_trace_context = current_context
     return _current_trace_context()
 
+
+_flight_recorder = None
+
+
+def _fr():
+    """Cached lazy import of the flight recorder (import-cycle-safe: core
+    modules load before ray_tpu.util's package init can run)."""
+    global _flight_recorder
+    if _flight_recorder is None:
+        from ray_tpu.util import flight_recorder
+
+        _flight_recorder = flight_recorder
+    return _flight_recorder
+
 _global_worker: Optional["CoreWorker"] = None
 
 
@@ -446,37 +460,46 @@ class _SubmitBudget:
 
     def charge(self, nbytes: int, may_block: bool):
         cap = GlobalConfig.task_queue_memory_cap_bytes
-        with self._cv:
-            if cap > 0 and may_block:
-                deadline = None
-                blocked = False
-                while self.queued_bytes > 0 and (
-                    self.queued_bytes + nbytes > cap
-                ):
-                    if not blocked:
-                        blocked = True
-                        self.blocked_total += 1
-                    if deadline is None:
-                        deadline = (
-                            time.monotonic()
-                            + GlobalConfig.task_queue_block_timeout_s
-                        )
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        from .exceptions import (
-                            PendingTaskBackpressureTimeout,
-                        )
+        block_start = None
+        try:
+            with self._cv:
+                if cap > 0 and may_block:
+                    deadline = None
+                    while self.queued_bytes > 0 and (
+                        self.queued_bytes + nbytes > cap
+                    ):
+                        if block_start is None:
+                            block_start = time.monotonic()
+                            self.blocked_total += 1
+                        if deadline is None:
+                            deadline = (
+                                time.monotonic()
+                                + GlobalConfig.task_queue_block_timeout_s
+                            )
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            from .exceptions import (
+                                PendingTaskBackpressureTimeout,
+                            )
 
-                        raise PendingTaskBackpressureTimeout(
-                            f"submission of {nbytes} B blocked "
-                            f">{GlobalConfig.task_queue_block_timeout_s}s on "
-                            f"the task-queue memory cap ({cap} B, "
-                            f"{self.queued_bytes} B queued)"
-                        )
-                    self._cv.wait(min(remaining, 1.0))
-            self.queued_bytes += nbytes
-            if self.queued_bytes > self.peak_bytes:
-                self.peak_bytes = self.queued_bytes
+                            raise PendingTaskBackpressureTimeout(
+                                f"submission of {nbytes} B blocked "
+                                f">{GlobalConfig.task_queue_block_timeout_s}s on "
+                                f"the task-queue memory cap ({cap} B, "
+                                f"{self.queued_bytes} B queued)"
+                            )
+                        self._cv.wait(min(remaining, 1.0))
+                self.queued_bytes += nbytes
+                if self.queued_bytes > self.peak_bytes:
+                    self.peak_bytes = self.queued_bytes
+        finally:
+            # Telemetry outside the cv (the flight recorder takes the
+            # metrics lock); runs on both the admitted and timeout paths —
+            # the wait happened either way.
+            if block_start is not None:
+                _fr().record_backpressure_wait(
+                    time.monotonic() - block_start
+                )
 
     def release(self, nbytes: int):
         with self._cv:
@@ -1140,6 +1163,12 @@ class CoreWorker:
                 await asyncio.wait_for(self.task_events.stop(), timeout=2)
             except Exception:
                 pass
+        # Final metrics push: a short-lived worker/driver must not silently
+        # lose the last _FLUSH_INTERVAL_S window of counters on exit.
+        try:
+            await asyncio.wait_for(self._flush_metrics(), timeout=2)
+        except Exception:
+            pass
         if self._exec_pipeline is not None:
             self._exec_pipeline.stop()
         if self._lane_pool is not None:
@@ -1151,6 +1180,28 @@ class CoreWorker:
             await self.cp.close()
         if self.agent:
             await self.agent.close()
+
+    async def _flush_metrics(self):
+        """Push the local metrics registry to the control plane NOW (loop
+        coroutine — bypasses the blocking kv_put bridge)."""
+        from ray_tpu.util import metrics as _metrics
+
+        payload = _metrics.payload_snapshot()
+        if payload is not None and self.cp is not None:
+            await _metrics._kv_put_async(self, payload)
+
+    async def _flush_observability(self):
+        """Flush the task-event buffer AND the metrics registry — the final
+        window must survive worker disconnect/exit."""
+        if self.task_events is not None:
+            try:
+                await asyncio.wait_for(self.task_events.flush(), timeout=2)
+            except Exception:
+                pass
+        try:
+            await asyncio.wait_for(self._flush_metrics(), timeout=2)
+        except Exception:
+            pass
 
     def shutdown(self):
         if self.loop and self._loop_thread:
@@ -2768,17 +2819,34 @@ class CoreWorker:
                 self._exec_pipeline.abandon(ticket)
 
     async def _execute_inner(self, spec: TaskSpec, fn, ev_kw, ticket=None) -> dict:
+        # Flight-recorder phase boundaries (each timestamp closes the
+        # previous phase): push arrival -> here = queue wait (function
+        # fetch + pipeline sequencing), then arg resolution, execution,
+        # return packaging.  Recorded only on success — error paths must
+        # stay lean, and a failed task's phases would skew the envelope.
+        fr_on = GlobalConfig.enable_flight_recorder
+        t_start = time.time()
         try:
             args, kwargs = await self._resolve_args(spec.args_payload)
             if self._device_transport_active():
                 args = await self._device_unwrap(list(args))
                 kwargs = await self._device_unwrap(kwargs)
+            t_args = time.time()
             self._current_task_name = spec.name
             if spec.streaming:
                 if inspect.isgeneratorfunction(fn) or inspect.isasyncgenfunction(fn):
-                    return await self._execute_streaming(
+                    reply = await self._execute_streaming(
                         spec, fn, args, kwargs, ev_kw
                     )
+                    if fr_on and reply.get("error") is None:
+                        t_end = time.time()
+                        _fr().record_task_phases(self, spec, (
+                            ("queue_wait",
+                             getattr(spec, "_recv_ts", t_start), t_start),
+                            ("arg_resolution", t_start, t_args),
+                            ("execute", t_args, t_end),
+                        ))
+                    return reply
                 # Loud failure beats a consumer hung on a stream that no
                 # code path would ever terminate.
                 err = TaskError(
@@ -2828,10 +2896,19 @@ class CoreWorker:
                     )
             if self._device_transport_active():
                 result = self._device_wrap(result)
+            t_exec = time.time()
             returns = await self._package_returns(spec, result)
             self.task_events.record(
                 spec.task_id.hex(), spec.name, "FINISHED", **ev_kw
             )
+            if fr_on:
+                _fr().record_task_phases(self, spec, (
+                    ("queue_wait",
+                     getattr(spec, "_recv_ts", t_start), t_start),
+                    ("arg_resolution", t_start, t_args),
+                    ("execute", t_args, t_exec),
+                    ("return_put", t_exec, time.time()),
+                ))
             return {"returns": returns, "error": None}
         except BaseException as e:  # noqa: BLE001
             import traceback as tb
@@ -2845,6 +2922,7 @@ class CoreWorker:
     async def handle_push_task(self, payload, conn):
         spec: TaskSpec = payload["spec"]
         spec._attempt = payload.get("attempt", 0)  # stream notify tagging
+        spec._recv_ts = time.time()  # queue-wait phase start
         # At-least-once delivery, exactly-once execution: a transport
         # retry of the same (task, attempt) awaits the original run.
         key = (spec.task_id, spec._attempt)
@@ -2915,6 +2993,7 @@ class CoreWorker:
     async def handle_actor_push_task(self, payload, conn):
         spec: TaskSpec = payload["spec"]
         spec._attempt = payload.get("attempt", 0)  # stream notify tagging
+        spec._recv_ts = time.time()  # queue-wait phase start
         # Dedup BEFORE the sequence gate: a duplicate push's seq has
         # already been consumed, so re-entering the gate would hang (or,
         # worse, re-execute); it simply awaits the original run's reply.
@@ -3084,5 +3163,21 @@ class CoreWorker:
 
     def handle_exit_worker(self, payload, conn):
         logger.info("worker exiting on request")
-        asyncio.get_running_loop().call_later(0.05, os._exit, 0)
+
+        async def _graceful_exit():
+            # Flush the final task-event/metrics window before dying — a
+            # short-lived worker must not take its last counters with it.
+            try:
+                await asyncio.wait_for(self._flush_observability(), timeout=2)
+            except BaseException:  # noqa: BLE001 — exit must proceed regardless
+                pass
+            os._exit(0)
+
+        loop = asyncio.get_running_loop()
+        # 50 ms grace so this RPC's reply reaches the wire first; the 3 s
+        # backstop timer preserves the old guarantee that exit_worker
+        # ALWAYS kills the process — even if the flush task is cancelled
+        # or the loop stops mid-flush, a timer callback still fires.
+        threading.Timer(3.0, os._exit, args=(0,)).start()
+        loop.call_later(0.05, lambda: loop.create_task(_graceful_exit()))
         return True

@@ -161,6 +161,7 @@ class NodeAgent:
         self._default_env = env
         self._default_env_key = tuple(sorted(env.items()))
         self._bg: List[asyncio.Task] = []
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
 
     # ------------------------------------------------------------- lifecycle
     async def start(self) -> str:
@@ -175,6 +176,13 @@ class NodeAgent:
         )
         assert reply["ok"]
         loop = asyncio.get_running_loop()
+        # The agent has no CoreWorker, so its flight-recorder metrics
+        # (object directory, lease waits) reach the cluster registry via a
+        # custom flush hook; the heartbeat loop forces a push each period.
+        self._loop = loop
+        from ..util import metrics as _metrics
+
+        _metrics.set_flush_hook(self._push_metrics_payload)
         self._bg.append(loop.create_task(self._heartbeat_loop()))
         self._bg.append(loop.create_task(self._monitor_workers_loop()))
         if GlobalConfig.memory_monitor_period_s > 0:
@@ -225,7 +233,38 @@ class NodeAgent:
             except Exception as e:  # noqa: BLE001
                 logger.warning("memory monitor round failed: %s", e)
 
+    def _push_metrics_payload(self, payload: dict):
+        """metrics flush hook: ship this agent process's registry to the
+        control-plane KV.  Must be callable from any thread (the directory's
+        spill thread records counters) and never raise."""
+        async def push():
+            try:
+                await self.cp_client.call(
+                    "kv_put",
+                    {"namespace": "metrics",
+                     "key": f"agent:{self.node_id.hex()}",
+                     "value": payload, "overwrite": True},
+                    retries=1,
+                )
+            except Exception:  # noqa: BLE001 — metrics are best-effort
+                pass
+
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        try:
+            if running is self._loop:
+                running.create_task(push())
+            elif self._loop is not None:
+                asyncio.run_coroutine_threadsafe(push(), self._loop)
+        except RuntimeError:
+            pass  # loop tearing down
+
     async def stop(self):
+        from ..util import metrics as _metrics
+
+        _metrics.clear_flush_hook(self._push_metrics_payload)
         if self._prestart_task is not None:
             self._prestart_task.cancel()
         for t in self._bg:
@@ -268,8 +307,24 @@ class NodeAgent:
         }
 
     async def _heartbeat_loop(self):
+        from ..util import flight_recorder as fr
+        from ..util import metrics as _metrics
+
         period = GlobalConfig.health_check_period_s
         while True:
+            try:
+                # Flight-recorder gauges ride the heartbeat cadence (off
+                # every hot path), then the registry is force-pushed
+                # through the agent's flush hook.
+                if fr.enabled():
+                    self.directory.record_telemetry()
+                    fr.gauge(
+                        "ray_tpu_lease_queue_depth", len(self._lease_queue)
+                    )
+                    fr.gauge("ray_tpu_leases_held", len(self.leases))
+                    _metrics.flush()
+            except Exception:  # noqa: BLE001 — telemetry must not kill HB
+                pass
             try:
                 reply = await self.cp_client.call(
                     "heartbeat",
@@ -608,10 +663,24 @@ class NodeAgent:
 
     async def handle_request_lease(self, payload, conn):
         """Grant a worker lease, queue it, or reply with a spillback target."""
+        t0 = time.monotonic()
         fut = asyncio.get_running_loop().create_future()
         self._lease_queue.append((payload, fut, conn))
         self._drain_lease_queue()
-        return await fut
+        reply = await fut
+        from ..util import flight_recorder as fr
+
+        if reply.get("granted"):
+            result = "granted"
+        elif reply.get("spillback"):
+            result = "spillback"
+        else:
+            result = "retry"  # infeasible right now; requester re-asks
+        fr.histogram(
+            "ray_tpu_lease_grant_wait_s", time.monotonic() - t0,
+            {"result": result},
+        )
+        return reply
 
     def _drain_lease_queue(self):
         still_waiting = []

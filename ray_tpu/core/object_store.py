@@ -25,6 +25,20 @@ from .config import GlobalConfig
 from .ids import ObjectID
 from .serialization import deserialize_from_bytes, serialize_to_bytes
 
+# Flight-recorder metric names for the object plane (recorded here in
+# whichever process hits the event — worker puts, agent evictions — and
+# merged cluster-wide through the metrics registry).
+_M_FULL_ERRORS = "ray_tpu_object_store_full_errors_total"
+_M_SPILL_WRITTEN = "ray_tpu_object_store_spill_bytes_total"
+_M_SPILL_RECLAIMED = "ray_tpu_object_store_spill_reclaimed_bytes_total"
+_M_LRU_EVICTIONS = "ray_tpu_object_store_lru_evictions_total"
+
+
+def _fr():
+    from ..util import flight_recorder
+
+    return flight_recorder
+
 # --------------------------------------------------------------------------
 # Native arena tier.  When the C++ library is available every process on the
 # node maps one shared arena (object table + allocator in shm) — the plasma
@@ -104,6 +118,7 @@ def spill_object(session_id: str, object_id: ObjectID, payload) -> int:
     with open(tmp, "wb") as f:
         f.write(payload)
     os.replace(tmp, path)
+    _fr().counter(_M_SPILL_WRITTEN, len(payload))
     return len(payload)
 
 
@@ -141,6 +156,7 @@ def _check_spill_capacity(session_id: str, incoming: int):
         return
     used = spill_tier_used_bytes(session_id)
     if used + incoming > cap:
+        _fr().counter(_M_FULL_ERRORS)
         raise ObjectStoreFullError(
             f"spill tier exhausted: {incoming} B object would exceed the "
             f"object_spill_max_bytes cap of {cap} B (used {used} B)"
@@ -175,9 +191,11 @@ def spill_serialized(session_id: str, object_id: ObjectID, header: bytes,
             os.unlink(tmp)
         except OSError:
             pass
+        _fr().counter(_M_FULL_ERRORS)
         raise ObjectStoreFullError(
             f"spill write of {total} B object failed: {e}"
         ) from e
+    _fr().counter(_M_SPILL_WRITTEN, total)
     return total
 
 
@@ -549,6 +567,10 @@ class NodeObjectDirectory:
             spilled = self._spilled.pop(object_id, None)
             if object_id in self._spilling:
                 self._freed_while_spilling.add(object_id)
+        if spilled:
+            # spilled_bytes stays CUMULATIVE (written-ever; the limits
+            # suite reads it) — reclamation is its own counter.
+            _fr().counter(_M_SPILL_RECLAIMED, spilled)
         # Delete from the storage tiers even when the directory has no
         # record: a seal whose oneway frame was lost (or is still in
         # flight on another connection — task-return seals ride the
@@ -567,12 +589,14 @@ class NodeObjectDirectory:
             (oid for oid in self._objects if oid not in self._pinned),
             key=lambda oid: self._objects[oid][1],
         )
+        n_evicted = 0
         for oid in victims:
             if self.used <= self.capacity:
                 break
             entry = self._objects.pop(oid, None)
             if entry is None:
                 continue
+            n_evicted += 1
             self.used -= entry[0]
             self._spilling[oid] = entry[0]
             if self._spill_pool is None:
@@ -582,6 +606,7 @@ class NodeObjectDirectory:
                     max_workers=1, thread_name_prefix="rtpu-spill"
                 )
             self._spill_pool.submit(self._spill_one, oid)
+        _fr().counter(_M_LRU_EVICTIONS, n_evicted)
 
     def _spill_one(self, oid: ObjectID):
         """Runs on the spill thread.  Order matters: write the spill file
@@ -632,6 +657,22 @@ class NodeObjectDirectory:
                     self._spilled.pop(oid, None)
             if freed:
                 delete_from_tiers(self.session_id, oid)
+
+    def record_telemetry(self):
+        """Set the object-plane gauges from current directory state (called
+        from the node agent's heartbeat — gauges off the seal/free hot
+        path; counters are incremented at the event sites)."""
+        fr = _fr()
+        if not fr.enabled():
+            return
+        with self._tier_lock:
+            disk_now = sum(self._spilled.values())
+            n_disk = len(self._spilled)
+        fr.gauge("ray_tpu_object_store_used_bytes", self.used)
+        fr.gauge("ray_tpu_object_store_capacity_bytes", self.capacity)
+        fr.gauge("ray_tpu_object_store_num_objects", len(self._objects))
+        fr.gauge("ray_tpu_object_store_spill_tier_bytes", disk_now)
+        fr.gauge("ray_tpu_object_store_spill_tier_objects", n_disk)
 
     def object_ids(self) -> List[ObjectID]:
         return list(self._objects)

@@ -31,7 +31,9 @@ FAILED = "FAILED"
 class TaskEventBuffer:
     """Buffers task events in-process; a background loop flushes them to the
     control plane.  Lossy by design: if the control plane is unreachable the
-    batch is dropped after one retry (events are observability, not truth)."""
+    batch is dropped after one retry (events are observability, not truth) —
+    but every drop is COUNTED (``num_dropped`` +
+    ``ray_tpu_task_events_dropped_total``), so lossiness is visible."""
 
     def __init__(self, cp_client, node_id_hex: str, worker_id_hex: str):
         self._cp = cp_client
@@ -43,6 +45,20 @@ class TaskEventBuffer:
         self._profile_events: List[dict] = []
         self._task: Optional[asyncio.Task] = None
         self._stopped = False
+        self.num_dropped = 0  # events lost to shedding or failed flushes
+
+    def _count_dropped(self, n: int) -> None:
+        if n <= 0:
+            return
+        self.num_dropped += n
+        try:
+            from ray_tpu.util import flight_recorder
+
+            flight_recorder.counter(
+                flight_recorder.TASK_EVENTS_DROPPED_TOTAL, n
+            )
+        except Exception:  # noqa: BLE001 — telemetry of the telemetry
+            pass
 
     # ------------------------------------------------------------- recording
     def record(
@@ -67,7 +83,30 @@ class TaskEventBuffer:
         )
         if len(self._events) > GlobalConfig.task_events_max_buffer:
             # Shed oldest half under backpressure.
-            del self._events[: len(self._events) // 2]
+            shed = len(self._events) // 2
+            del self._events[:shed]
+            self._count_dropped(shed)
+
+    def add_profile_row(self, name: str, start: float, end: float,
+                        extra: Optional[dict] = None) -> None:
+        """Append one profile-channel row (timeline slice) with the shared
+        overflow shed + drop accounting.  Safe from user threads under the
+        GIL (same contract as record()): an append racing the flush swap
+        lands in whichever list it read — delivered either way."""
+        self._profile_events.append(
+            {
+                "name": name,
+                "start": start,
+                "end": end,
+                "worker_id": self._worker,
+                "node_id": self._node,
+                "extra": extra,
+            }
+        )
+        if len(self._profile_events) > GlobalConfig.task_events_max_buffer:
+            shed = len(self._profile_events) // 2
+            del self._profile_events[:shed]
+            self._count_dropped(shed)
 
     @contextlib.contextmanager
     def profile(self, event_name: str, extra: Optional[dict] = None):
@@ -78,18 +117,7 @@ class TaskEventBuffer:
             yield
         finally:
             if GlobalConfig.enable_task_events:
-                self._profile_events.append(
-                    {
-                        "name": event_name,
-                        "start": start,
-                        "end": time.time(),
-                        "worker_id": self._worker,
-                        "node_id": self._node,
-                        "extra": extra,
-                    }
-                )
-                if len(self._profile_events) > GlobalConfig.task_events_max_buffer:
-                    del self._profile_events[: len(self._profile_events) // 2]
+                self.add_profile_row(event_name, start, time.time(), extra)
 
     # --------------------------------------------------------------- flushing
     def start(self) -> None:
@@ -131,6 +159,10 @@ class TaskEventBuffer:
                 retries=2,
             )
         except Exception as e:  # noqa: BLE001 — observability is best-effort
+            # Lossy by design — but visibly so: the counter flushes with
+            # the metrics registry once the control plane is reachable
+            # again, so operators can see how much history is missing.
+            self._count_dropped(len(events) + len(profiles))
             logger.debug("task-event flush dropped %d events: %s", len(events), e)
 
     async def _flush_loop(self) -> None:

@@ -62,7 +62,7 @@ def start_dashboard(
                 "endpoints": [
                     "/api/cluster", "/api/nodes", "/api/actors",
                     "/api/tasks", "/api/jobs", "/api/placement_groups",
-                    "/api/timeline", "/metrics",
+                    "/api/timeline", "/api/task_phases", "/metrics",
                 ]
             }
         )
@@ -182,6 +182,11 @@ def start_dashboard(
         reply = await run_sync(client.list_task_events, None, 100000)
         return _json(chrome_trace_events(reply))
 
+    async def task_phases(request):
+        """Flight-recorder phase percentiles (queue wait, arg resolution,
+        execute, return-put, backpressure wait)."""
+        return _json(await run_sync(state_api.summarize_task_phases))
+
     async def metrics(request):
         from .util import metrics as metrics_mod
 
@@ -203,6 +208,7 @@ def start_dashboard(
     app.router.add_delete("/api/jobs/{sid}", job_delete)
     app.router.add_get("/api/placement_groups", pgs)
     app.router.add_get("/api/timeline", timeline)
+    app.router.add_get("/api/task_phases", task_phases)
     app.router.add_get("/metrics", metrics)
 
     loop = asyncio.new_event_loop()

@@ -30,7 +30,9 @@ def pipeline_local(stage_fn: Callable, stage_params, microbatches, *,
     microbatches: [M, mb, ...] — full input, replicated across stages.
     Returns [M, mb, ...] outputs of the final stage (replicated).
     """
-    n = jax.lax.axis_size(axis_name)
+    from ..collective.types import compat_axis_size
+
+    n = compat_axis_size(axis_name)
     my_stage = jax.lax.axis_index(axis_name)
     params = jax.tree.map(lambda p: p[0], stage_params)
     m = microbatches.shape[0]
@@ -82,21 +84,17 @@ def pipelined(stage_fn: Callable, mesh, *, axis_name: str = "stage",
     stacked_params: leading dim = num stages (sharded over ``axis_name``);
     microbatches: [M, mb, ...] with the mb batch dim sharded over
     ``batch_axes``."""
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from ..collective.types import compat_shard_map
 
     inner = functools.partial(pipeline_local, stage_fn, axis_name=axis_name)
 
     def apply(stacked_params, microbatches):
         params_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
         x_spec = P(None, batch_axes)
-        return shard_map(
-            inner,
-            mesh=mesh,
-            in_specs=(params_specs, x_spec),
-            out_specs=x_spec,
-            check_vma=False,
-            
+        return compat_shard_map(
+            inner, mesh, (params_specs, x_spec), x_spec
         )(stacked_params, microbatches)
 
     return apply

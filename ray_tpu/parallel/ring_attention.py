@@ -48,7 +48,9 @@ def ring_attention_local(q, k, v, *, axis_name: str = "seq",
 
     q/k/v: [B, S_local, H, D] (this device's sequence shard).
     """
-    n = jax.lax.axis_size(axis_name)
+    from ..collective.types import compat_axis_size
+
+    n = compat_axis_size(axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
     scale = softmax_scale if softmax_scale is not None else d ** -0.5
@@ -93,14 +95,14 @@ def ring_attention(q, k, v, mesh, *, causal: bool = True,
                    head_axis: str = "model"):
     """Jit-compatible wrapper: shard_maps the ring over the mesh.
     q/k/v: [B, S, H, D] global arrays (S sharded over ``seq_axis``)."""
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from ..collective.types import compat_shard_map
 
     spec = P(batch_axes, seq_axis, head_axis, None)
     inner = functools.partial(
         ring_attention_local, axis_name=seq_axis, causal=causal
     )
-    return shard_map(
-        inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False,
-        
+    return compat_shard_map(
+        inner, mesh, (spec, spec, spec), spec
     )(q, k, v)

@@ -125,6 +125,17 @@ def run_scaling_curve(
         # Reference: same global batch, one device, no partitioning.
         step_r, params_r, opt_r = _build_step(cfg, None)
         dt_ref = _time_step(step_r, params_r, opt_r, tokens, n_steps)
+        retention = round(min(dt_ref / dt, 1.0), 4)
+        # Feed the flight recorder's ICI scaling-efficiency gauge so the
+        # measured retention is scrapeable from /metrics next to the
+        # per-op collective telemetry (best-effort: the harness also runs
+        # standalone, with no cluster to flush to).
+        try:
+            from ray_tpu.util import flight_recorder
+
+            flight_recorder.record_scaling_efficiency(n, retention)
+        except Exception:  # noqa: BLE001 — bench must not die on telemetry
+            pass
         out.append(
             {
                 "devices": n,
@@ -133,7 +144,7 @@ def run_scaling_curve(
                 "tokens_per_sec_per_device": round(
                     batch * seq_len / dt / n, 1
                 ),
-                "retention": round(min(dt_ref / dt, 1.0), 4),
+                "retention": retention,
             }
         )
     return out

@@ -26,7 +26,9 @@ def ulysses_attention_local(
 ):
     """shard_map-inner Ulysses attention.  q/k/v: [B, S_local, H, D] with H
     divisible by the axis size."""
-    n = jax.lax.axis_size(axis_name)
+    from ..collective.types import compat_axis_size
+
+    n = compat_axis_size(axis_name)
     h = q.shape[2]
     assert h % n == 0, f"heads ({h}) must divide by seq-axis size ({n})"
     attn = attn_fn or functools.partial(reference_attention, causal=causal)
@@ -52,15 +54,15 @@ def ulysses_attention(q, k, v, mesh, *, causal: bool = True,
                       attn_fn: Optional[Callable] = None):
     """Jit-compatible wrapper.  q/k/v: [B, S, H, D] global arrays (S sharded
     over ``seq_axis``; heads unsharded on that axis)."""
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from ..collective.types import compat_shard_map
 
     spec = P(batch_axes, seq_axis, None, None)
     inner = functools.partial(
         ulysses_attention_local, axis_name=seq_axis, causal=causal,
         attn_fn=attn_fn,
     )
-    return shard_map(
-        inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False,
-        
+    return compat_shard_map(
+        inner, mesh, (spec, spec, spec), spec
     )(q, k, v)

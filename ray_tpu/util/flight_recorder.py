@@ -1,0 +1,282 @@
+"""Runtime flight recorder: built-in task-phase, collective, and
+backpressure telemetry.
+
+The runtime's own observability layer (the user-facing spans/metrics live
+in ``util/tracing.py`` / ``util/metrics.py``; this module instruments the
+runtime itself).  Everything lands in the existing metrics registry under
+``ray_tpu_*`` names — so it flows through the cluster KV merge, the
+``/metrics`` Prometheus endpoint, and ``metrics.snapshot()`` — and task
+phases additionally ride the task-event profile channel so they render as
+rows in the Chrome-trace ``/api/timeline`` dump.
+
+What gets recorded (all gated on ``GlobalConfig.enable_flight_recorder``;
+``bench.py obs_overhead`` guards the cost at <5% of the task round trip):
+
+  - per-task phase timings on the executing worker — queue wait (push
+    arrival -> execution start, including function fetch and pipeline
+    sequencing), argument resolution, execution, return packaging — as
+    the ``ray_tpu_task_phase_s{phase=...}`` histogram plus one
+    ``phase:<name>`` profile row per phase;
+  - submission backpressure waits (``_SubmitBudget`` blocks) as the
+    ``ray_tpu_backpressure_wait_s`` histogram + blocked counter;
+  - every collective op (allreduce/allgather/reducescatter/broadcast/
+    alltoall/permute) with op, bytes, world size, duration, and an
+    achieved-bandwidth histogram (EQuARX-style per-op accounting);
+  - the ICI scaling-efficiency gauge fed by
+    ``parallel/scaling_bench.py``'s partition-retention measurements;
+  - object-store accounting (arena usage, spill bytes written/reclaimed,
+    LRU evictions, ``ObjectStoreFullError`` occurrences) and node-agent
+    lease-grant waits / queue depth.
+
+Percentile summaries of the phase rows are served by
+``ray_tpu.util.state.summarize_task_phases()``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Iterable, Optional, Tuple
+
+from ..core.config import GlobalConfig
+from . import metrics as _metrics
+
+# ----------------------------------------------------------- metric names
+TASK_PHASE_HIST = "ray_tpu_task_phase_s"
+BACKPRESSURE_WAIT_HIST = "ray_tpu_backpressure_wait_s"
+BACKPRESSURE_BLOCKED_TOTAL = "ray_tpu_backpressure_blocked_total"
+COLLECTIVE_OPS_TOTAL = "ray_tpu_collective_ops_total"
+COLLECTIVE_BYTES_TOTAL = "ray_tpu_collective_bytes_total"
+COLLECTIVE_DURATION_HIST = "ray_tpu_collective_duration_s"
+COLLECTIVE_BANDWIDTH_HIST = "ray_tpu_collective_bandwidth_bytes_per_s"
+ICI_SCALING_EFFICIENCY = "ray_tpu_ici_scaling_efficiency"
+TASK_EVENTS_DROPPED_TOTAL = "ray_tpu_task_events_dropped_total"
+
+# Sub-millisecond to minutes: runtime phases span five orders of magnitude.
+DURATION_BOUNDARIES = [
+    1e-5, 5e-5, 1e-4, 5e-4, 1e-3, 5e-3, 0.01, 0.05, 0.1, 0.5,
+    1.0, 5.0, 10.0, 60.0,
+]
+# Achieved bytes/s: host-loopback KB/s through multi-slice ICI TB/s.
+BANDWIDTH_BOUNDARIES = [
+    1e4, 1e5, 1e6, 1e7, 1e8, 5e8, 1e9, 5e9, 1e10, 5e10, 1e11, 1e12,
+]
+
+# Canonical executor-side phase names (timeline rows + histogram tags).
+TASK_PHASES = ("queue_wait", "arg_resolution", "execute", "return_put")
+
+
+def enabled() -> bool:
+    return GlobalConfig.enable_flight_recorder
+
+
+# ------------------------------------------------------- generic recorders
+def counter(name: str, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None) -> None:
+    if not GlobalConfig.enable_flight_recorder or value <= 0:
+        return
+    _metrics._record(name, "counter", tags or {}, float(value))
+
+
+def gauge(name: str, value: float,
+          tags: Optional[Dict[str, str]] = None) -> None:
+    if not GlobalConfig.enable_flight_recorder:
+        return
+    _metrics._record(name, "gauge", tags or {}, float(value))
+
+
+def histogram(name: str, value: float, tags: Optional[Dict[str, str]] = None,
+              boundaries=None) -> None:
+    if not GlobalConfig.enable_flight_recorder:
+        return
+    _metrics._record(name, "histogram", tags or {}, float(value),
+                     buckets=boundaries or DURATION_BOUNDARIES)
+
+
+# ----------------------------------------------------------- task phases
+def record_task_phases(worker, spec,
+                       phases: Iterable[Tuple[str, float, float]]) -> None:
+    """Record executor-side phase timings for one task: histogram samples
+    (one lock round trip for the whole set) plus ``phase:<name>`` rows on
+    the task-event profile channel so they render in the timeline.
+
+    ``phases``: (name, start, end) wall-clock tuples."""
+    if not GlobalConfig.enable_flight_recorder:
+        return
+    te = worker.task_events
+    emit_rows = te is not None and GlobalConfig.enable_task_events
+    task_id_hex = spec.task_id.hex() if emit_rows else ""
+    entries = []
+    for name, start, end in phases:
+        dur = end - start
+        if dur < 0:
+            dur = 0.0
+        entries.append((TASK_PHASE_HIST, "histogram", {"phase": name}, dur,
+                        DURATION_BOUNDARIES))
+        if emit_rows:
+            te.add_profile_row(
+                f"phase:{name}", start, end,
+                {"phase": name, "task_id": task_id_hex, "task": spec.name},
+            )
+    _metrics._record_batch(entries)
+
+
+def record_backpressure_wait(duration_s: float) -> None:
+    """One submission blocked on the task-queue memory cap for
+    ``duration_s`` (called from the blocked user thread, after the wait)."""
+    if not GlobalConfig.enable_flight_recorder:
+        return
+    _metrics._record_batch([
+        (BACKPRESSURE_WAIT_HIST, "histogram", {}, float(duration_s),
+         DURATION_BOUNDARIES),
+        (BACKPRESSURE_BLOCKED_TOTAL, "counter", {}, 1.0, None),
+    ])
+    # Phase row so backpressure stalls render on the timeline next to the
+    # task phases they delayed.
+    from ..core.core_worker import try_global_worker
+
+    w = try_global_worker()
+    te = w.task_events if w is not None else None
+    if te is not None and GlobalConfig.enable_task_events:
+        now = time.time()
+        te.add_profile_row(
+            "phase:backpressure_wait", now - duration_s, now,
+            {"phase": "backpressure_wait"},
+        )
+
+
+# ------------------------------------------------------------ collectives
+_COLLECTIVE_OPS = (
+    "allreduce", "allgather", "reducescatter", "broadcast", "alltoall",
+    "ppermute", "sendrecv_ring",
+)
+
+
+def _payload_nbytes(tensor) -> int:
+    """Bytes in one op's input: a tensor, or a per-rank list of tensors."""
+    if isinstance(tensor, (list, tuple)):
+        return sum(_payload_nbytes(t) for t in tensor)
+    n = getattr(tensor, "nbytes", None)
+    if n is not None:
+        return int(n)
+    try:
+        import numpy as np
+
+        return int(np.asarray(tensor).nbytes)
+    except Exception:  # noqa: BLE001 — telemetry must never fail an op
+        return 0
+
+
+def record_collective(op: str, backend: str, nbytes: int, world_size: int,
+                      duration_s: float, cold: bool = False) -> None:
+    if not GlobalConfig.enable_flight_recorder:
+        return
+    if duration_s <= 0:
+        duration_s = 1e-9
+    op_tags = {"op": op, "backend": backend}
+    hist_tags = {"op": op, "world_size": str(world_size)}
+    if cold:
+        # First call of an (op, shape, dtype): the duration carries jax
+        # trace+compile, not collective transfer — tagged so scrapers (and
+        # local_collective_stats) can exclude it from bandwidth math.
+        hist_tags["cold"] = "1"
+    _metrics._record_batch([
+        (COLLECTIVE_OPS_TOTAL, "counter", op_tags, 1.0, None),
+        (COLLECTIVE_BYTES_TOTAL, "counter", op_tags, float(nbytes), None),
+        (COLLECTIVE_DURATION_HIST, "histogram", hist_tags, duration_s,
+         DURATION_BOUNDARIES),
+        (COLLECTIVE_BANDWIDTH_HIST, "histogram", hist_tags,
+         nbytes / duration_s, BANDWIDTH_BOUNDARIES),
+    ])
+
+
+def _shape_sig(tensor) -> tuple:
+    if isinstance(tensor, (list, tuple)):
+        return (len(tensor),) + (
+            _shape_sig(tensor[0]) if tensor else ()
+        )
+    return (
+        tuple(getattr(tensor, "shape", ())), str(getattr(tensor, "dtype", ""))
+    )
+
+
+def _wrap_collective_op(fn, op: str, backend: str, group, seen_keys: set):
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(tensor, *args, **kwargs):
+        if not GlobalConfig.enable_flight_recorder:
+            return fn(tensor, *args, **kwargs)
+        # Mirrors the groups' compiled-fn cache keying (op + shape +
+        # dtype): the first call of a key pays trace+compile and is
+        # tagged cold.
+        key = (op, _shape_sig(tensor))
+        cold = key not in seen_keys
+        seen_keys.add(key)
+        t0 = time.perf_counter()
+        out = fn(tensor, *args, **kwargs)
+        dt = time.perf_counter() - t0
+        record_collective(
+            op, backend, _payload_nbytes(tensor),
+            getattr(group, "world_size", 0) or 1, dt, cold=cold,
+        )
+        return out
+
+    wrapped._fr_wrapped = True
+    return wrapped
+
+
+def instrument_group(group, backend: str):
+    """Wrap a collective group's ops with op/bytes/world-size/duration
+    capture (called from the group constructors).  Timing covers dispatch
+    plus whatever host sync the op itself performs — the multi-host XLA
+    backend materializes results to numpy, so its numbers reflect the real
+    collective; a purely async local dispatch reads as dispatch cost (see
+    docs/observability.md).  Always wraps (the per-call gate handles a
+    disabled recorder, so flipping the knob mid-lifetime works) and is
+    idempotent."""
+    seen_keys: set = set()
+    for op in _COLLECTIVE_OPS:
+        orig = getattr(group, op, None)
+        if orig is None or getattr(orig, "_fr_wrapped", False):
+            continue
+        setattr(group, op,
+                _wrap_collective_op(orig, op, backend, group, seen_keys))
+    return group
+
+
+# -------------------------------------------------------- scaling gauge
+def record_scaling_efficiency(devices: int, retention: float) -> None:
+    """ICI scaling-efficiency gauge, fed by scaling_bench's calibrated
+    partition-retention ratio (1.0 = partitioning machinery is free)."""
+    gauge(ICI_SCALING_EFFICIENCY, retention, {"devices": str(devices)})
+
+
+def local_collective_stats() -> Dict[str, dict]:
+    """This process's per-op collective aggregates (ops, bytes, mean
+    duration) from the local registry — no cluster round trip."""
+    _COLLECTIVE_METRICS = (
+        COLLECTIVE_OPS_TOTAL, COLLECTIVE_BYTES_TOTAL, COLLECTIVE_DURATION_HIST,
+    )
+    out: Dict[str, dict] = {}
+    with _metrics._lock:
+        for (name, tags), ent in _metrics._local.items():
+            if name not in _COLLECTIVE_METRICS:
+                continue  # user metrics may carry an "op" tag too
+            op = dict(tags).get("op")
+            if op is None:
+                continue
+            row = out.setdefault(op, {"ops": 0, "bytes": 0.0,
+                                      "duration_sum_s": 0.0, "samples": 0})
+            if name == COLLECTIVE_OPS_TOTAL:
+                row["ops"] += int(ent["value"])
+            elif name == COLLECTIVE_BYTES_TOTAL:
+                row["bytes"] += ent["value"]
+            elif dict(tags).get("cold") != "1":
+                # Warm samples only: cold ones time jax trace+compile.
+                row["duration_sum_s"] += ent["sum"]
+                row["samples"] += ent["count"]
+    for row in out.values():
+        row["mean_duration_s"] = (
+            row["duration_sum_s"] / row["samples"] if row["samples"] else 0.0
+        )
+    return out

@@ -11,9 +11,10 @@ exposes the merged view via ``snapshot()`` / the state API, and
 
 from __future__ import annotations
 
+import asyncio
 import threading
 import time
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Callable, Dict, Optional, Sequence, Tuple
 
 _REGISTRY_NS = "metrics"
 _FLUSH_INTERVAL_S = 2.0
@@ -23,64 +24,148 @@ _local: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], dict] = {}
 _dirty = False
 _last_flush = 0.0
 
+# Registered by processes that have no CoreWorker (the node agent): takes
+# the serialized payload and pushes it to the control-plane KV its own way.
+_flush_hook: Optional[Callable[[dict], None]] = None
+
 
 def _tag_key(tags: Optional[Dict[str, str]]) -> Tuple[Tuple[str, str], ...]:
     return tuple(sorted((tags or {}).items()))
 
 
+def _apply_locked(name: str, kind: str, tags, value: float, buckets=None):
+    """Apply one sample to the local registry.  ``_lock`` must be held."""
+    key = (name, _tag_key(tags))
+    ent = _local.get(key)
+    if ent is None:
+        ent = {"kind": kind, "value": 0.0, "count": 0, "sum": 0.0,
+               "buckets": list(buckets or []), "bucket_counts": None}
+        if ent["buckets"]:
+            ent["bucket_counts"] = [0] * (len(ent["buckets"]) + 1)
+        _local[key] = ent
+    if kind == "counter":
+        ent["value"] += value
+    elif kind == "gauge":
+        ent["value"] = value
+    else:  # histogram
+        ent["count"] += 1
+        ent["sum"] += value
+        for i, b in enumerate(ent["buckets"]):
+            if value <= b:
+                ent["bucket_counts"][i] += 1
+                break
+        else:
+            ent["bucket_counts"][-1] += 1
+
+
 def _record(name: str, kind: str, tags, value: float, buckets=None):
     global _dirty
-    key = (name, _tag_key(tags))
     with _lock:
-        ent = _local.get(key)
-        if ent is None:
-            ent = {"kind": kind, "value": 0.0, "count": 0, "sum": 0.0,
-                   "buckets": list(buckets or []), "bucket_counts": None}
-            if ent["buckets"]:
-                ent["bucket_counts"] = [0] * (len(ent["buckets"]) + 1)
-            _local[key] = ent
-        if kind == "counter":
-            ent["value"] += value
-        elif kind == "gauge":
-            ent["value"] = value
-        else:  # histogram
-            ent["count"] += 1
-            ent["sum"] += value
-            for i, b in enumerate(ent["buckets"]):
-                if value <= b:
-                    ent["bucket_counts"][i] += 1
-                    break
-            else:
-                ent["bucket_counts"][-1] += 1
+        _apply_locked(name, kind, tags, value, buckets)
         _dirty = True
     _maybe_flush()
 
 
-def _maybe_flush(force: bool = False):
-    """Push this worker's metric state to the control-plane KV (best effort)."""
-    global _dirty, _last_flush
-    now = time.monotonic()
-    if not force and (not _dirty or now - _last_flush < _FLUSH_INTERVAL_S):
-        return
-    from ..core.core_worker import try_global_worker
-
-    w = try_global_worker()
-    if w is None:
-        return
+def _record_batch(entries):
+    """Apply several samples under ONE lock round trip (the flight
+    recorder's per-task phase set rides this so the hot path pays the
+    lock once, not once per phase).  ``entries``: iterable of
+    (name, kind, tags, value, buckets)."""
+    global _dirty
     with _lock:
+        for name, kind, tags, value, buckets in entries:
+            _apply_locked(name, kind, tags, value, buckets)
+        _dirty = True
+    _maybe_flush()
+
+
+def set_flush_hook(fn: Optional[Callable[[dict], None]]):
+    """Install a custom payload push (processes without a CoreWorker, e.g.
+    the node agent).  The hook receives the serialized registry payload and
+    must not raise."""
+    global _flush_hook
+    _flush_hook = fn
+
+
+def clear_flush_hook(fn: Callable[[dict], None]):
+    """Remove ``fn`` if it is the installed hook (teardown-safe: a newer
+    hook installed by a different owner is left alone).  Equality, not
+    identity: bound methods are recreated per access, so ``is`` would
+    never match and a stopped owner's hook would linger forever."""
+    global _flush_hook
+    if _flush_hook == fn:
+        _flush_hook = None
+
+
+def payload_snapshot() -> Optional[dict]:
+    """Serializable view of the local registry; marks it clean.  Returns
+    None when nothing was ever recorded."""
+    global _dirty, _last_flush
+    with _lock:
+        if not _local:
+            return None
         payload = {
             f"{name}|{dict(tags)}": {
                 "name": name, "tags": dict(tags), **{
                     k: v for k, v in ent.items() if k != "bucket_counts"
                 },
-                "bucket_counts": ent["bucket_counts"],
+                # Copied under the lock: the async push serializes the
+                # payload later, and a live list would tear (bucket_counts
+                # ahead of count/sum breaks bucket monotonicity).
+                "bucket_counts": (
+                    list(ent["bucket_counts"])
+                    if ent["bucket_counts"] is not None else None
+                ),
             }
             for (name, tags), ent in _local.items()
         }
         _dirty = False
-        _last_flush = now
+        _last_flush = time.monotonic()
+    return payload
+
+
+async def _kv_put_async(w, payload: dict):
     try:
-        w.kv_put(_REGISTRY_NS, f"worker:{w.worker_id.hex()}", payload)
+        await w.cp.call(
+            "kv_put",
+            {"namespace": _REGISTRY_NS, "key": f"worker:{w.worker_id.hex()}",
+             "value": payload, "overwrite": True},
+        )
+    except Exception:  # noqa: BLE001 — metrics are best-effort
+        pass
+
+
+def _maybe_flush(force: bool = False):
+    """Push this process's metric state to the control-plane KV (best
+    effort).  Safe from ANY thread: called on the worker's protocol loop
+    (built-in runtime metrics record there) it schedules an async push —
+    a blocking ``kv_put`` would deadlock the loop on its own completion."""
+    now = time.monotonic()
+    if not force and (not _dirty or now - _last_flush < _FLUSH_INTERVAL_S):
+        return
+    hook = _flush_hook
+    w = None
+    if hook is None:
+        from ..core.core_worker import try_global_worker
+
+        w = try_global_worker()
+        if w is None:
+            return
+    payload = payload_snapshot()
+    if payload is None:
+        return
+    try:
+        if hook is not None:
+            hook(payload)
+            return
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is not None and running is w.loop:
+            running.create_task(_kv_put_async(w, payload))
+        else:
+            w.kv_put(_REGISTRY_NS, f"worker:{w.worker_id.hex()}", payload)
     except Exception:
         pass
 
@@ -187,18 +272,48 @@ def snapshot() -> Dict[str, dict]:
     return merged
 
 
+def _escape_label(v) -> str:
+    return str(v).replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+
+
+def _label_str(items) -> str:
+    """items: sequence of (key, value) pairs -> '{k="v",...}' or ''."""
+    if not items:
+        return ""
+    return "{" + ",".join(f'{k}="{_escape_label(v)}"' for k, v in items) + "}"
+
+
 def prometheus_text() -> str:
-    """Render the merged view in Prometheus exposition format."""
+    """Render the merged view in Prometheus exposition format.
+
+    Histograms emit cumulative ``_bucket`` lines with ``le`` labels
+    (including ``le="+Inf"``) so scrapers can compute quantiles, and each
+    metric name gets exactly ONE ``# TYPE`` line regardless of how many
+    tag sets it carries (strict parsers reject duplicates)."""
+    by_name: Dict[str, list] = {}
+    for _mkey, ent in sorted(snapshot().items()):
+        by_name.setdefault(ent["name"], []).append(ent)
     lines = []
-    for mkey, ent in sorted(snapshot().items()):
-        name = ent["name"]
-        labels = ",".join(f'{k}="{v}"' for k, v in sorted(ent["tags"].items()))
-        label_s = "{" + labels + "}" if labels else ""
-        if ent["kind"] == "histogram":
-            lines.append(f"# TYPE {name} histogram")
-            lines.append(f"{name}_count{label_s} {ent['count']}")
-            lines.append(f"{name}_sum{label_s} {ent['sum']}")
-        else:
-            lines.append(f"# TYPE {name} {ent['kind']}")
-            lines.append(f"{name}{label_s} {ent['value']}")
+    for name in sorted(by_name):
+        ents = by_name[name]
+        kind = ents[0]["kind"]
+        lines.append(f"# TYPE {name} {kind}")
+        for ent in ents:
+            items = sorted(ent["tags"].items())
+            label_s = _label_str(items)
+            if ent["kind"] == "histogram":
+                buckets = ent.get("buckets") or []
+                counts = ent.get("bucket_counts") or []
+                if buckets and len(counts) == len(buckets) + 1:
+                    cum = 0
+                    for b, c in zip(buckets, counts):
+                        cum += c
+                        le_s = _label_str(items + [("le", repr(float(b)))])
+                        lines.append(f"{name}_bucket{le_s} {cum}")
+                inf_s = _label_str(items + [("le", "+Inf")])
+                lines.append(f"{name}_bucket{inf_s} {ent['count']}")
+                lines.append(f"{name}_count{label_s} {ent['count']}")
+                lines.append(f"{name}_sum{label_s} {ent['sum']}")
+            else:
+                lines.append(f"{name}{label_s} {ent['value']}")
     return "\n".join(lines) + ("\n" if lines else "")

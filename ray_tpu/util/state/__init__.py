@@ -19,5 +19,6 @@ from .api import (  # noqa: F401
     list_placement_groups,
     list_tasks,
     summarize_actors,
+    summarize_task_phases,
     summarize_tasks,
 )

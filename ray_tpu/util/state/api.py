@@ -162,6 +162,53 @@ def summarize_actors(address: Optional[str] = None) -> Dict[str, Any]:
     return {"total": len(actors), "by_state": dict(states)}
 
 
+def _percentile(sorted_vals, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def summarize_task_phases(address: Optional[str] = None) -> Dict[str, Any]:
+    """Percentile summary of the flight recorder's task-phase rows
+    (queue wait, arg resolution, execute, return-put, backpressure wait):
+    per-phase count / mean / p50 / p90 / p99 / max in seconds.
+
+    Reads the same profile-event channel the Chrome-trace timeline
+    renders, so the numbers and the picture can't diverge."""
+    from ...core.core_worker import try_global_worker
+
+    worker = try_global_worker()
+    if worker is not None and worker.task_events is not None:
+        # Push this process's unflushed phase rows out before asking.
+        try:
+            worker._run_sync(worker.task_events.flush(), timeout=5)
+        except Exception:  # noqa: BLE001 — summary stays best-effort
+            pass
+    reply = StateApiClient(address).list_task_events(limit=100000)
+    by_phase: Dict[str, List[float]] = {}
+    for p in reply.get("profile_events", ()):
+        extra = p.get("extra") or {}
+        phase = extra.get("phase")
+        if not phase:
+            continue
+        by_phase.setdefault(phase, []).append(
+            max(0.0, p["end"] - p["start"])
+        )
+    out: Dict[str, Any] = {}
+    for phase, durs in sorted(by_phase.items()):
+        durs.sort()
+        out[phase] = {
+            "count": len(durs),
+            "mean_s": sum(durs) / len(durs),
+            "p50_s": _percentile(durs, 0.50),
+            "p90_s": _percentile(durs, 0.90),
+            "p99_s": _percentile(durs, 0.99),
+            "max_s": durs[-1],
+        }
+    return out
+
+
 # ------------------------------------------------------------------ timeline
 def chrome_trace_events(reply: dict) -> List[dict]:
     """Convert a ``list_task_events`` reply into Chrome-trace 'X' events

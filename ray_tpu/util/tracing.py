@@ -66,22 +66,19 @@ def _record(span: Span) -> None:
     if w is None or w.task_events is None:
         return
     # Ride the profile-event channel: same buffer, flush loop, and
-    # control-plane store as the task timeline.
-    w.task_events._profile_events.append(
+    # control-plane store as the task timeline (shared shed + drop
+    # accounting live in add_profile_row).
+    w.task_events.add_profile_row(
+        span.name,
+        span.start,
+        span.end,
         {
-            "name": span.name,
-            "start": span.start,
-            "end": span.end,
-            "worker_id": w.worker_id.hex(),
-            "node_id": w.node_id.hex(),
-            "extra": {
-                "span": True,
-                "trace_id": span.trace_id,
-                "span_id": span.span_id,
-                "parent_id": span.parent_id,
-                **span.attributes,
-            },
-        }
+            "span": True,
+            "trace_id": span.trace_id,
+            "span_id": span.span_id,
+            "parent_id": span.parent_id,
+            **span.attributes,
+        },
     )
 
 

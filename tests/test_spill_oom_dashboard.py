@@ -164,10 +164,29 @@ class TestDashboard:
             time.sleep(1.2)  # task event flush
             tasks = fetch("/api/tasks?name=tick")
             assert len(tasks) == 3
-            timeline = fetch("/api/timeline")
-            assert isinstance(timeline, list)
+            # Flight-recorder acceptance: the HTTP timeline must carry
+            # per-task phase rows for a multi-task run.
+            deadline = time.monotonic() + 30
+            phases = set()
+            while time.monotonic() < deadline:
+                timeline = fetch("/api/timeline")
+                assert isinstance(timeline, list)
+                phases = {
+                    e["args"].get("phase") for e in timeline
+                    if e.get("cat") == "profile" and e.get("args")
+                }
+                if {"queue_wait", "arg_resolution", "execute",
+                        "return_put"} <= phases:
+                    break
+                time.sleep(0.5)
+            assert {"queue_wait", "arg_resolution", "execute",
+                    "return_put"} <= phases, phases
+            summary = fetch("/api/task_phases")
+            assert summary["execute"]["count"] >= 3
             text = urllib.request.urlopen(url + "/metrics", timeout=30).read()
             assert b"dash_test_total" in text
+            assert b"ray_tpu_task_phase_s_bucket" in text
+            assert b'le="+Inf"' in text
         finally:
             stop_dashboard()
             ray_tpu.shutdown()

@@ -76,6 +76,46 @@ class TestTracing:
 
         assert ray_tpu.get(probe.remote(), timeout=60) is None
 
+    def test_trace_propagates_nested_task_to_actor(self, ray_cluster):
+        """Driver span -> task span -> actor-method span: one trace_id end
+        to end across the nested hop, with correct parent links at each
+        level (the task's auto-span parents to the driver span; the actor
+        method's auto-span parents to the task's auto-span because the
+        nested submit happens inside it)."""
+        @ray_tpu.remote
+        class Leaf:
+            def work(self):
+                with tracing.start_span("leaf-user-span"):
+                    return 1
+
+        @ray_tpu.remote
+        def mid(leaf):
+            return ray_tpu.get(leaf.work.remote(), timeout=60)
+
+        leaf = Leaf.remote()
+        with tracing.start_span("driver-nested-root") as root:
+            assert ray_tpu.get(mid.remote(leaf), timeout=120) == 1
+
+        spans = tracing.get_trace(root.trace_id, min_spans=4)
+        by_name = {s["name"]: s["extra"] for s in spans}
+        assert {
+            "driver-nested-root", "task:mid", "task:work", "leaf-user-span"
+        } <= set(by_name), sorted(by_name)
+        # One trace end to end.
+        for extra in by_name.values():
+            assert extra["trace_id"] == root.trace_id
+        # Parent chain: root -> task:mid -> task:work -> leaf-user-span.
+        assert by_name["task:mid"]["parent_id"] == root.span_id
+        assert (
+            by_name["task:work"]["parent_id"]
+            == by_name["task:mid"]["span_id"]
+        )
+        assert (
+            by_name["leaf-user-span"]["parent_id"]
+            == by_name["task:work"]["span_id"]
+        )
+        ray_tpu.kill(leaf)
+
 
 class TestClusterEvents:
     def _events(self, **filters):
