@@ -96,11 +96,18 @@ class Autoscaler:
             target=self.run, args=(period_s,), daemon=True,
             name="rtpu-autoscaler",
         )
+        self._thread = thread
         thread.start()
         return thread
 
-    def stop(self) -> None:
+    def stop(self, join_timeout_s: float = 10.0) -> None:
+        """Signal the reconcile loop and join a background thread if one
+        was started, so teardown observes the last round completing
+        instead of abandoning it mid-provider-call."""
         self._stop.set()
+        thread = getattr(self, "_thread", None)
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=join_timeout_s)
 
 
 def wait_for_nodes(n: int, cp_address: str, timeout: float = 60.0) -> None:

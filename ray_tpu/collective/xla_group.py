@@ -47,8 +47,13 @@ def _kv_rendezvous(group_name: str, rank: int, world_size: int,
 
         try:
             addr = f"{socket.gethostbyname(socket.gethostname())}:{port}"
-        except Exception:
-            pass
+        except Exception as e:
+            # Loopback fallback is correct single-host; multi-host ranks
+            # on other machines cannot reach 127.0.0.1, so say so.
+            logger.info(
+                "hostname resolution failed (%s); publishing loopback "
+                "coordinator address %s", e, addr,
+            )
         worker._run_sync(
             worker.cp.call(
                 "kv_put",

@@ -696,8 +696,8 @@ class ControlPlane:
                     await client.call(
                         "kill_worker", {"worker_address": entry.address}, retries=1
                     )
-                except Exception:
-                    pass
+                except Exception as e:
+                    logger.warning("kill_worker RPC to agent failed: %s", e)
 
     async def _kill_actor_entry(self, entry: ActorEntry, cause: str):
         await self._kill_actor_worker(entry)
@@ -753,8 +753,8 @@ class ControlPlane:
                 client = self.agent_clients.get(self.nodes[nid].agent_address)
                 try:
                     await client.call("cancel_bundles", {"pg_id": entry.pg_id})
-                except Exception:
-                    pass
+                except Exception as e:
+                    logger.warning("cancel_bundles to agent failed: %s", e)
             if entry.pg_id not in self._pending_pgs:
                 self._pending_pgs.append(entry.pg_id)
             return
@@ -780,8 +780,8 @@ class ControlPlane:
                 client = self.agent_clients.get(node.agent_address)
                 try:
                     await client.call("return_bundles", {"pg_id": entry.pg_id})
-                except Exception:
-                    pass
+                except Exception as e:
+                    logger.debug("return_bundles to agent failed: %s", e)
         entry.state = "REMOVED"
         self.events.record(PG_LIFECYCLE, entry.pg_id.hex(), "REMOVED")
         self._persist_pg(entry)

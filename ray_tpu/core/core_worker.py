@@ -68,6 +68,7 @@ from .serialization import (
     serialize_to_bytes,
 )
 from .task_spec import ActorSpec, ObjectRef, TaskSpec, _RefMarker, function_key
+from ..util.debug_locks import make_condition, make_lock
 
 logger = logging.getLogger(__name__)
 
@@ -97,7 +98,7 @@ def _maybe_dump_profile(prof, role: str):
     try:
         os.makedirs(out_dir, exist_ok=True)
         prof.dump_stats(os.path.join(out_dir, f"{role}-{os.getpid()}.prof"))
-    except Exception:  # noqa: BLE001 — profiling must never break teardown
+    except Exception:  # raylint: waive[RTL003] profiling must never break teardown
         pass
 
 
@@ -159,7 +160,7 @@ class _BatchedCompleter:
     def _init_completer(self, loop: asyncio.AbstractEventLoop):
         self.loop = loop
         self._done: List[tuple] = []
-        self._done_lock = threading.Lock()
+        self._done_lock = make_lock("core_worker.completer.done")
         self._done_flush_scheduled = False
 
     def _complete(self, fut, res):
@@ -219,7 +220,7 @@ class ExecPipeline(_BatchedCompleter):
 
     def __init__(self, loop: asyncio.AbstractEventLoop):
         self._init_completer(loop)
-        self._cv = threading.Condition()
+        self._cv = make_condition("core_worker.exec_pipeline")
         self._items: Dict[int, tuple] = {}
         self._next_ticket = 0
         self._next_exec = 0
@@ -336,7 +337,7 @@ class LanePool(_BatchedCompleter):
         # and serialize behind one lane.
         self._idle = 0
         self._pending = 0
-        self._lane_lock = threading.Lock()
+        self._lane_lock = make_lock("core_worker.lane_pool")
         self._stopped = False
 
     async def run(self, fn, *args, **kwargs):
@@ -453,7 +454,7 @@ class _SubmitBudget:
     PER_TASK_OVERHEAD = 512
 
     def __init__(self):
-        self._cv = threading.Condition()
+        self._cv = make_condition("core_worker.submit_budget")
         self.queued_bytes = 0
         self.peak_bytes = 0
         self.blocked_total = 0  # submissions that had to wait at least once
@@ -848,8 +849,8 @@ class _LeasePool:
             await lease["agent"].call(
                 "return_lease", {"lease_id": lease["lease_id"]}, retries=2
             )
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("return_lease RPC failed: %s", e)
 
 
 class ObjectRefGenerator:
@@ -883,7 +884,7 @@ class ObjectRefGenerator:
             self._closed = True
             try:
                 self._worker.cancel_stream(self._task_id)
-            except Exception:  # noqa: BLE001 — shutdown races
+            except Exception:  # raylint: waive[RTL003] shutdown races
                 pass
 
     def __del__(self):
@@ -956,7 +957,7 @@ class CoreWorker:
         self._recovery_waiters: Dict[TaskID, asyncio.Event] = {}
         # Cross-thread callback batching: a burst of submissions/ref events
         # from user threads wakes the loop once, not once per callback.
-        self._post_lock = threading.Lock()
+        self._post_lock = make_lock("core_worker.post_queue")
         self._post_queue: List = []
         # Borrowed refs this process re-serialized (lent onward): their
         # outgoing decref is grace-delayed.  See on_ref_relent.
@@ -1034,8 +1035,8 @@ class CoreWorker:
                         {"job_id": self.job_id, "driver_address": self.address},
                         retries=1,
                     )
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("driver reregister failed: %s", e)
             # Lease re-association + liveness toward EVERY agent that
             # granted this driver a lease (spillback leases live on remote
             # agents whose socket may sit idle while pushes go straight to
@@ -1052,8 +1053,8 @@ class CoreWorker:
                     await agent.notify(
                         "owner_ping", {"owner_id": self.address}
                     )
-                except Exception:
-                    pass
+                except Exception as e:
+                    logger.debug("owner_ping to agent failed: %s", e)
 
     def start_threaded(self):
         """Driver mode: run the protocol loop on a background thread."""
@@ -1082,8 +1083,8 @@ class CoreWorker:
                 _maybe_dump_profile(prof, "driver-loop")
                 try:
                     loop.close()
-                except Exception:
-                    pass
+                except Exception as e:
+                    logger.debug("loop close failed at thread exit: %s", e)
 
         self._loop_thread = threading.Thread(target=run, daemon=True, name="core-worker")
         self._loop_thread.start()
@@ -1135,7 +1136,7 @@ class CoreWorker:
                         asyncio.gather(*returns, return_exceptions=True),
                         timeout=2.0,
                     )
-                except Exception:  # noqa: BLE001 — agent may be gone
+                except Exception:  # raylint: waive[RTL003] agent may be gone
                     pass
             await asyncio.sleep(0)
         # Only AFTER the return sweep: cancel in-flight pool coroutines so
@@ -1156,19 +1157,19 @@ class CoreWorker:
             hb.cancel()
             try:
                 await hb
-            except (asyncio.CancelledError, Exception):
+            except (asyncio.CancelledError, Exception):  # raylint: waive[RTL003] awaiting a cancelled task raises by design
                 pass
         if self.task_events is not None:
             try:
                 await asyncio.wait_for(self.task_events.stop(), timeout=2)
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("task-event stop flush failed: %s", e)
         # Final metrics push: a short-lived worker/driver must not silently
         # lose the last _FLUSH_INTERVAL_S window of counters on exit.
         try:
             await asyncio.wait_for(self._flush_metrics(), timeout=2)
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("final metrics flush failed: %s", e)
         if self._exec_pipeline is not None:
             self._exec_pipeline.stop()
         if self._lane_pool is not None:
@@ -1196,19 +1197,19 @@ class CoreWorker:
         if self.task_events is not None:
             try:
                 await asyncio.wait_for(self.task_events.flush(), timeout=2)
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("task-event flush failed on disconnect: %s", e)
         try:
             await asyncio.wait_for(self._flush_metrics(), timeout=2)
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("metrics flush failed on disconnect: %s", e)
 
     def shutdown(self):
         if self.loop and self._loop_thread:
             try:
                 self._run_sync(self.async_shutdown(), timeout=5)
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("async shutdown failed: %s", e)
             try:
                 self.loop.call_soon_threadsafe(self.loop.stop)
             except RuntimeError:
@@ -1679,7 +1680,7 @@ class CoreWorker:
             handle.cancel()
             try:
                 fn()
-            except Exception:  # noqa: BLE001 — best-effort at teardown
+            except Exception:  # raylint: waive[RTL003] best-effort at teardown
                 pass
 
     def _send_incref(self, ref: ObjectRef):
@@ -1691,8 +1692,8 @@ class CoreWorker:
     async def _oneway(self, client, method, payload):
         try:
             await client.notify(method, payload)
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("oneway %s notify failed: %s", method, e)
 
     def on_ref_deleted(self, oid: ObjectID, owner_address: str):
         if self._shutdown or self.loop is None or self.loop.is_closed():
@@ -1753,8 +1754,8 @@ class CoreWorker:
     async def _oneway_call_free(self, client, oid):
         try:
             await client.call("free_objects", {"object_ids": [oid]}, retries=1)
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("oneway free_objects failed: %s", e)
 
     # ------------------------------------------------- streaming (owner side)
     def _new_stream(self, task_id: TaskID, spec: "TaskSpec" = None):
@@ -1931,7 +1932,7 @@ class CoreWorker:
                 if obj.lineage is not None:
                     try:
                         await self._reconstruct_object(oid, obj)
-                    except Exception:  # noqa: BLE001 — surfaced below
+                    except Exception:  # raylint: waive[RTL003] surfaced below
                         pass
                 else:
                     obj.state = ERROR
@@ -3169,7 +3170,7 @@ class CoreWorker:
             # short-lived worker must not take its last counters with it.
             try:
                 await asyncio.wait_for(self._flush_observability(), timeout=2)
-            except BaseException:  # noqa: BLE001 — exit must proceed regardless
+            except BaseException:  # raylint: waive[RTL003] exit must proceed regardless
                 pass
             os._exit(0)
 

@@ -12,10 +12,13 @@ operator's collector tails); the state API exposes ``list_cluster_events``.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import time
 from collections import deque
 from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
 
 # Event types (reference: observability/ray_*_event.h).
 NODE_LIFECYCLE = "NODE_LIFECYCLE"
@@ -69,8 +72,8 @@ class EventRecorder:
         if self._file is not None:
             try:
                 self._file.write(json.dumps(event, default=str) + "\n")
-            except Exception:
-                pass  # export is observability, not truth
+            except Exception as e:
+                logger.debug("event export write failed: %s", e)
 
     def list_events(self, event_type: Optional[str] = None,
                     entity_id: Optional[str] = None,
@@ -91,5 +94,5 @@ class EventRecorder:
         if self._file is not None:
             try:
                 self._file.close()
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("event export close failed: %s", e)

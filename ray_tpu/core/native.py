@@ -554,7 +554,7 @@ class NativeScheduler:
     def __del__(self):
         try:
             self._lib.rtpu_sched_destroy(self._handle)
-        except Exception:
+        except Exception:  # raylint: waive[RTL003] GC-time destroy; interpreter may be tearing down
             pass
 
 

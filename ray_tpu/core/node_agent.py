@@ -32,6 +32,11 @@ from .object_store import NodeObjectDirectory, ShmObjectStore
 from .resources import NodeResources, ResourceInstanceSet, ResourceSet
 from .rpc import ClientPool, RetryableRpcClient, RpcServer
 from .task_spec import ActorSpec
+from ..util.metric_registry import (
+    LEASE_GRANT_WAIT_HIST,
+    LEASE_QUEUE_DEPTH,
+    LEASES_HELD,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -55,7 +60,7 @@ def _sched_idle():
     except Exception:  # noqa: BLE001
         try:
             os.nice(19)
-        except Exception:  # noqa: BLE001
+        except Exception:  # raylint: waive[RTL003] no further fallback below nice(19)
             pass
 
 
@@ -246,7 +251,7 @@ class NodeAgent:
                      "value": payload, "overwrite": True},
                     retries=1,
                 )
-            except Exception:  # noqa: BLE001 — metrics are best-effort
+            except Exception:  # raylint: waive[RTL003] metrics are best-effort
                 pass
 
         try:
@@ -318,12 +323,10 @@ class NodeAgent:
                 # through the agent's flush hook.
                 if fr.enabled():
                     self.directory.record_telemetry()
-                    fr.gauge(
-                        "ray_tpu_lease_queue_depth", len(self._lease_queue)
-                    )
-                    fr.gauge("ray_tpu_leases_held", len(self.leases))
+                    fr.gauge(LEASE_QUEUE_DEPTH, len(self._lease_queue))
+                    fr.gauge(LEASES_HELD, len(self.leases))
                     _metrics.flush()
-            except Exception:  # noqa: BLE001 — telemetry must not kill HB
+            except Exception:  # raylint: waive[RTL003] telemetry must not kill heartbeat
                 pass
             try:
                 reply = await self.cp_client.call(
@@ -340,8 +343,8 @@ class NodeAgent:
                             "snapshot": self._snapshot(),
                         },
                     )
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("heartbeat send failed: %s", e)
             await asyncio.sleep(period)
 
     # --------------------------------------------------------------- workers
@@ -512,7 +515,7 @@ class NodeAgent:
                             handle.proc.pid, os.SCHED_OTHER,
                             os.sched_param(0),
                         )
-                    except Exception:  # noqa: BLE001
+                    except Exception:  # raylint: waive[RTL003] sched boost is a nicety; proc may have exited
                         pass
                     if handle.proc.poll() is None and not handle.leased:
                         self.idle_pool.setdefault(key, []).append(handle)
@@ -569,7 +572,7 @@ class NodeAgent:
                         os.sched_setscheduler(
                             h.proc.pid, os.SCHED_OTHER, os.sched_param(0)
                         )
-                    except Exception:  # noqa: BLE001
+                    except Exception:  # raylint: waive[RTL003] sched boost is a nicety; proc may have exited
                         pass
             self._replenish_pool()
         if handle is None:
@@ -596,8 +599,8 @@ class NodeAgent:
         try:
             if handle.proc.poll() is None:
                 handle.proc.terminate()
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("worker terminate failed: %s", e)
 
     async def _monitor_workers_loop(self):
         while True:
@@ -627,8 +630,8 @@ class NodeAgent:
                                 },
                                 retries=2,
                             )
-                        except Exception:
-                            pass
+                        except Exception as e:
+                            logger.warning("actor-death notify failed: %s", e)
 
     async def handle_kill_worker(self, payload, conn):
         for handle in self.workers.values():
@@ -677,7 +680,7 @@ class NodeAgent:
         else:
             result = "retry"  # infeasible right now; requester re-asks
         fr.histogram(
-            "ray_tpu_lease_grant_wait_s", time.monotonic() - t0,
+            LEASE_GRANT_WAIT_HIST, time.monotonic() - t0,
             {"result": result},
         )
         return reply

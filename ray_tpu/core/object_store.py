@@ -27,11 +27,19 @@ from .serialization import deserialize_from_bytes, serialize_to_bytes
 
 # Flight-recorder metric names for the object plane (recorded here in
 # whichever process hits the event — worker puts, agent evictions — and
-# merged cluster-wide through the metrics registry).
-_M_FULL_ERRORS = "ray_tpu_object_store_full_errors_total"
-_M_SPILL_WRITTEN = "ray_tpu_object_store_spill_bytes_total"
-_M_SPILL_RECLAIMED = "ray_tpu_object_store_spill_reclaimed_bytes_total"
-_M_LRU_EVICTIONS = "ray_tpu_object_store_lru_evictions_total"
+# merged cluster-wide through the metrics registry).  Declared once in
+# util/metric_registry.py (raylint RTL004).
+from ..util.metric_registry import (
+    OBJECT_STORE_CAPACITY_BYTES as _M_CAPACITY_BYTES,
+    OBJECT_STORE_FULL_ERRORS_TOTAL as _M_FULL_ERRORS,
+    OBJECT_STORE_LRU_EVICTIONS_TOTAL as _M_LRU_EVICTIONS,
+    OBJECT_STORE_NUM_OBJECTS as _M_NUM_OBJECTS,
+    OBJECT_STORE_SPILL_BYTES_TOTAL as _M_SPILL_WRITTEN,
+    OBJECT_STORE_SPILL_RECLAIMED_TOTAL as _M_SPILL_RECLAIMED,
+    OBJECT_STORE_SPILL_TIER_BYTES as _M_SPILL_TIER_BYTES,
+    OBJECT_STORE_SPILL_TIER_OBJECTS as _M_SPILL_TIER_OBJECTS,
+    OBJECT_STORE_USED_BYTES as _M_USED_BYTES,
+)
 
 
 def _fr():
@@ -511,12 +519,12 @@ class NodeObjectDirectory:
         # records frees that raced an in-flight spill.  _tier_lock guards
         # the spill-tier dicts against event-loop readers racing the spill
         # thread's mutations.
-        import threading as _threading
+        from ..util.debug_locks import make_lock
 
         self._spill_pool = None
         self._spilling: Dict[ObjectID, int] = {}
         self._freed_while_spilling: set = set()
-        self._tier_lock = _threading.Lock()
+        self._tier_lock = make_lock("object_store.tier")
 
     def seal(self, object_id: ObjectID, size: int):
         if object_id not in self._objects:
@@ -668,11 +676,11 @@ class NodeObjectDirectory:
         with self._tier_lock:
             disk_now = sum(self._spilled.values())
             n_disk = len(self._spilled)
-        fr.gauge("ray_tpu_object_store_used_bytes", self.used)
-        fr.gauge("ray_tpu_object_store_capacity_bytes", self.capacity)
-        fr.gauge("ray_tpu_object_store_num_objects", len(self._objects))
-        fr.gauge("ray_tpu_object_store_spill_tier_bytes", disk_now)
-        fr.gauge("ray_tpu_object_store_spill_tier_objects", n_disk)
+        fr.gauge(_M_USED_BYTES, self.used)
+        fr.gauge(_M_CAPACITY_BYTES, self.capacity)
+        fr.gauge(_M_NUM_OBJECTS, len(self._objects))
+        fr.gauge(_M_SPILL_TIER_BYTES, disk_now)
+        fr.gauge(_M_SPILL_TIER_OBJECTS, n_disk)
 
     def object_ids(self) -> List[ObjectID]:
         return list(self._objects)

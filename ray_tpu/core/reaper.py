@@ -60,7 +60,7 @@ def watch_parent_process(on_exit: Optional[Callable[[], None]] = None) -> None:
                 if on_exit is not None:
                     try:
                         on_exit()
-                    except Exception:  # noqa: BLE001 — exiting anyway
+                    except Exception:  # raylint: waive[RTL003] exiting anyway
                         pass
                 os._exit(0)
 

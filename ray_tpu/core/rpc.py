@@ -203,13 +203,13 @@ class RpcServer:
             self._server.close()
             try:
                 await asyncio.wait_for(self._server.wait_closed(), timeout=1.0)
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("server wait_closed failed: %s", e)
 
     async def _on_connection(self, reader, writer):
         try:
             writer.transport.set_write_buffer_limits(high=4 << 20)
-        except Exception:
+        except Exception:  # raylint: waive[RTL003] write-buffer limit is a transport nicety
             pass
         conn = ServerConnection(reader, writer)
         self._conns.add(conn)
@@ -391,13 +391,13 @@ class ServerConnection:
                 self._drain_task = asyncio.get_running_loop().create_task(
                     self._await_drain()
                 )
-        except Exception:  # connection torn down mid-flush
+        except Exception:  # raylint: waive[RTL003] connection torn down mid-flush
             pass
 
     async def _await_drain(self):
         try:
             await self._writer.drain()
-        except Exception:  # noqa: BLE001 — peer gone; read side closes us
+        except Exception:  # raylint: waive[RTL003] peer gone; read side closes us
             pass
         if self._wbuf and not self._flush_scheduled:
             self._flush_scheduled = True
@@ -412,7 +412,7 @@ class ServerConnection:
         if task is not None and not task.done():
             try:
                 await asyncio.shield(task)
-            except Exception:  # noqa: BLE001
+            except Exception:  # raylint: waive[RTL003] drain outcome irrelevant once pausing ends
                 pass
 
     async def send(self, frame):
@@ -427,8 +427,8 @@ class ServerConnection:
             ) > (4 << 20):
                 self._flush()
                 await self._writer.drain()
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("backpressure drain failed: %s", e)
 
     async def push(self, method: str, payload):
         """One-way server→client message (pubsub delivery)."""
@@ -438,8 +438,8 @@ class ServerConnection:
         self.closed = True
         try:
             self._writer.close()
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("server conn close failed: %s", e)
 
     @property
     def peername(self):
@@ -483,7 +483,7 @@ class RpcClient:
         self._loop = asyncio.get_running_loop()
         try:
             self._writer.transport.set_write_buffer_limits(high=4 << 20)
-        except Exception:
+        except Exception:  # raylint: waive[RTL003] write-buffer limit is a transport nicety
             pass
         self._read_task = self._loop.create_task(self._read_loop())
         # Version announcement: pipelined ahead of the first real call, so
@@ -558,8 +558,8 @@ class RpcClient:
         data, self._wbuf = self._wbuf, bytearray()
         try:
             self._writer.write(data)
-        except Exception:
-            pass  # torn down mid-flush; read loop surfaces the failure
+        except Exception:  # raylint: waive[RTL003] torn down mid-flush; read loop surfaces the failure
+            pass
 
     async def _read_loop(self):
         try:
@@ -691,8 +691,8 @@ class RpcClient:
         if self._writer:
             try:
                 self._writer.close()
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("client writer close failed: %s", e)
 
 
 class RetryableRpcClient:
@@ -756,7 +756,7 @@ class RetryableRpcClient:
                 if dropped is not None:
                     try:
                         await dropped.close()
-                    except Exception:  # noqa: BLE001
+                    except Exception:  # raylint: waive[RTL003] half-dead socket; reconnect follows
                         pass
                 await asyncio.sleep(delay)
                 delay = min(delay * 2, GlobalConfig.rpc_retry_max_delay_s)
@@ -805,13 +805,13 @@ class ClientPool:
         if client is not None:
             try:
                 await client.close()
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("client close failed: %s", e)
 
     async def close_all(self):
         for c in self._clients.values():
             try:
                 await c.close()
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("client close failed in close_all: %s", e)
         self._clients.clear()

@@ -20,9 +20,12 @@ mutation paths only, never on reads (reads hit the in-memory state that
 
 from __future__ import annotations
 
+import logging
 import os
 import sqlite3
 from typing import Dict, Iterator, Optional, Tuple
+
+logger = logging.getLogger(__name__)
 
 
 class StoreClient:
@@ -105,8 +108,8 @@ class SqliteStoreClient(StoreClient):
     def close(self) -> None:
         try:
             self._db.close()
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("store db close failed: %s", e)
 
 
 def make_store_client(path: Optional[str]) -> StoreClient:

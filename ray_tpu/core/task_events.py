@@ -57,7 +57,7 @@ class TaskEventBuffer:
             flight_recorder.counter(
                 flight_recorder.TASK_EVENTS_DROPPED_TOTAL, n
             )
-        except Exception:  # noqa: BLE001 — telemetry of the telemetry
+        except Exception:  # raylint: waive[RTL003] telemetry of the telemetry
             pass
 
     # ------------------------------------------------------------- recording

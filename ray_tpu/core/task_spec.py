@@ -127,7 +127,7 @@ class ObjectRef:
         if worker is not None:
             try:
                 worker.on_ref_deleted(self.id, self.owner_address)
-            except Exception:
+            except Exception:  # raylint: waive[RTL003] decref from __del__ races interpreter teardown
                 pass
 
     def __reduce__(self):

@@ -39,7 +39,7 @@ def record_library_usage(library: str) -> None:
         current["count"] += 1
         current["last_used"] = time.time()
         worker.kv_put(_KV_NS, key, current)
-    except Exception:  # noqa: BLE001 — usage stats must never break apps
+    except Exception:  # raylint: waive[RTL003] usage stats must never break apps
         pass
 
 

@@ -16,6 +16,7 @@ handed between actors on the same host transfer via shm once (device→host
 
 from __future__ import annotations
 
+import logging
 import os
 import secrets
 from typing import Any, Dict, List, Optional, Tuple
@@ -32,6 +33,9 @@ from .nodes import (
     MultiOutputNode,
     topological_order,
 )
+
+
+logger = logging.getLogger(__name__)
 
 
 class DAGError(RuntimeError):
@@ -228,19 +232,29 @@ class CompiledDAG:
         if self._torn_down:
             return
         self._torn_down = True
+        from ray_tpu.util import flight_recorder as _fr
+
         for ch in self._channels:
             try:
                 ch.close_channel()
             except Exception:
-                pass
+                # Best-effort: a worker that died mid-run already
+                # invalidated its channel; count it so leaks show up.
+                logger.debug("channel close failed during teardown",
+                             exc_info=True)
+                _fr.count_suppressed("dag.teardown.close_channel")
         # Loops observe the close and finish; collect their final status.
         import ray_tpu
 
         for ref in self._loop_refs:
             try:
                 ray_tpu.get(ref, timeout=10)
-            except Exception:
-                pass
+            except Exception as e:
+                logger.warning(
+                    "DAG worker loop exited abnormally during teardown: %s",
+                    e,
+                )
+                _fr.count_suppressed("dag.teardown.loop_join")
         for ch in self._channels:
             ch.detach()
             ch.unlink()
@@ -250,7 +264,13 @@ class CompiledDAG:
         try:
             self.teardown()
         except Exception:
-            pass
+            # GC-time teardown: the interpreter may be mid-shutdown, so
+            # even logging infrastructure can be gone — swallow, but not
+            # silently when the logger still works.
+            try:
+                logger.debug("teardown from __del__ failed", exc_info=True)
+            except Exception:  # raylint: waive[RTL003] interpreter shutdown
+                pass
 
 
 class CompiledDAGRef:

@@ -17,8 +17,11 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 import threading
 from typing import Any, Dict, Optional
+
+logger = logging.getLogger(__name__)
 
 _state: Dict[str, Any] = {}
 
@@ -245,7 +248,9 @@ def stop_dashboard() -> None:
         fut = asyncio.run_coroutine_threadsafe(runner.cleanup(), loop)
         try:
             fut.result(timeout=5)
-        except Exception:
-            pass
+        except Exception as e:
+            # Socket release is best-effort; a restart on this port may
+            # hit address-in-use until GC, so leave a trail.
+            logger.warning("dashboard runner cleanup failed: %s", e)
     loop.call_soon_threadsafe(loop.stop)
     _state.clear()

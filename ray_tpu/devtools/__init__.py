@@ -1,0 +1,7 @@
+"""Developer tooling for the ray_tpu runtime.
+
+``python -m ray_tpu.devtools.lint`` — ``raylint``, the runtime-invariant
+static analyzer (rules RTL001–RTL006, see ``docs/static_analysis.md``).
+Its dynamic companion, the ``RAY_TPU_DEBUG_LOCKS=1`` lock-order cycle
+detector, lives in ``ray_tpu.util.debug_locks``.
+"""

@@ -1,0 +1,675 @@
+"""raylint — runtime-invariant static analysis for the ray_tpu codebase.
+
+The runtime is a multi-threaded Python system whose concurrency and
+error-handling invariants (no blocking under a lock, no silent exception
+swallowing, bounded waits so PR-1's overload degradation can engage) were
+previously enforced only by convention.  This analyzer makes them
+mergeable-or-not, the role TSAN/clang-tidy wiring plays for the reference
+runtime's C++ core.
+
+Usage::
+
+    python -m ray_tpu.devtools.lint [paths...]
+    python -m ray_tpu.devtools.lint --list-rules
+
+With no paths, lints the ``ray_tpu`` package this module was imported
+from.  Exit status: 0 clean, 1 unwaived violations, 2 usage/parse error.
+
+Rules (stable IDs; full prose in ``docs/static_analysis.md``):
+
+  RTL001 no-blocking-under-lock   blocking calls inside ``with <lock>:``
+  RTL002 thread-hygiene           Thread() must pass daemon= and name=
+  RTL003 swallowed-exception      ``except Exception: pass`` must justify
+  RTL004 metric-name-registry     ray_tpu_* names declared once + documented
+  RTL005 async-blocking           no time.sleep / blocking get in async def
+  RTL006 untimed-wait             Condition/Event.wait() & queue get need
+                                  timeouts on runtime paths
+
+Waivers: a checked-in ``lint_waivers.toml`` next to this module
+grandfathers specific sites (each entry carries a reason and date), and
+an inline ``# raylint: waive[RTL00X] why`` comment on the flagged line
+waives one site in place.  Unwaived violations fail the run; unused
+waiver entries are reported so the file stays minimal.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+RULES: Dict[str, str] = {
+    "RTL000": "parse-error",  # not waivable: an unparseable file is never OK
+    "RTL001": "no-blocking-under-lock",
+    "RTL002": "thread-hygiene",
+    "RTL003": "swallowed-exception",
+    "RTL004": "metric-name-registry",
+    "RTL005": "async-blocking",
+    "RTL006": "untimed-wait",
+}
+
+# Rules whose scope is "runtime paths": the concurrency-sensitive layers.
+# Files outside a ray_tpu package (e.g. test fixture snippets) are treated
+# as runtime scope so every rule is exercisable on a standalone file.
+RUNTIME_SCOPE_PREFIXES = (
+    "core/", "serve/", "util/", "dag/", "collective/", "autoscaler/",
+)
+RUNTIME_SCOPE_FILES = ("dashboard.py",)
+
+_LOCK_NAME_RE = re.compile(
+    r"(^|_)(lock|locks|cv|cond|condition|mutex)(_|$)|lock$", re.IGNORECASE
+)
+_QUEUE_NAME_RE = re.compile(
+    r"(^|_)(q|queue|queues|chan|channel|inbox|mailbox)(_|$)|queue$",
+    re.IGNORECASE,
+)
+_METRIC_NAME_RE = re.compile(r"ray_tpu_[a-z0-9_]+")
+_WAIVE_COMMENT_RE = re.compile(
+    r"#\s*raylint:\s*waive\[([A-Z0-9,\s]+)\]"
+)
+
+
+class Violation:
+    __slots__ = ("rule", "path", "line", "col", "message", "waived",
+                 "waive_source")
+
+    def __init__(self, rule: str, path: str, line: int, col: int,
+                 message: str):
+        self.rule = rule
+        self.path = path
+        self.line = line
+        self.col = col
+        self.message = message
+        self.waived = False
+        self.waive_source = ""
+
+    def render(self) -> str:
+        tag = f" [waived: {self.waive_source}]" if self.waived else ""
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"{RULES[self.rule]}: {self.message}{tag}")
+
+
+# --------------------------------------------------------------- waivers
+class WaiverError(Exception):
+    pass
+
+
+class Waiver:
+    __slots__ = ("rules", "path", "contains", "line", "reason", "date",
+                 "used")
+
+    def __init__(self, rules: Sequence[str], path: str,
+                 contains: Optional[str], line: Optional[int],
+                 reason: str, date: str):
+        self.rules = tuple(rules)
+        self.path = path.replace(os.sep, "/")
+        self.contains = contains
+        self.line = line
+        self.reason = reason
+        self.date = date
+        self.used = False
+
+    def matches(self, v: Violation, source_line: str) -> bool:
+        if v.rule not in self.rules:
+            return False
+        # Suffix match with a path-component boundary: a waiver for
+        # "core/rpc.py" must not also cover "score/rpc.py".
+        vpath = v.path.replace(os.sep, "/")
+        if vpath != self.path and not vpath.endswith("/" + self.path):
+            return False
+        if self.line is not None and self.line != v.line:
+            return False
+        if self.contains is not None and self.contains not in source_line:
+            return False
+        return True
+
+
+def parse_waivers(path: str) -> List[Waiver]:
+    """Parse the waiver file: a TOML subset (``[[waiver]]`` tables of
+    string/int assignments) — parsed by hand because the runtime targets
+    interpreters without ``tomllib`` and must not grow dependencies."""
+    waivers: List[Waiver] = []
+    current: Optional[dict] = None
+
+    def finish(entry: Optional[dict], at_line: int):
+        if entry is None:
+            return
+        missing = [k for k in ("rule", "path", "reason", "date")
+                   if k not in entry]
+        if missing:
+            raise WaiverError(
+                f"{path}: waiver ending at line {at_line} is missing "
+                f"required field(s): {', '.join(missing)}"
+            )
+        rules = [r.strip() for r in entry["rule"].split(",") if r.strip()]
+        unknown = [r for r in rules if r not in RULES]
+        if unknown:
+            raise WaiverError(
+                f"{path}: waiver ending at line {at_line} names unknown "
+                f"rule(s): {', '.join(unknown)}"
+            )
+        line_no = entry.get("line")
+        if line_no is not None:
+            line_no = int(line_no)
+        waivers.append(Waiver(rules, entry["path"], entry.get("contains"),
+                              line_no, entry["reason"], entry["date"]))
+
+    with open(path, encoding="utf-8") as f:
+        for i, raw in enumerate(f, start=1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            if line == "[[waiver]]":
+                finish(current, i)
+                current = {}
+                continue
+            m = re.match(
+                r'^([A-Za-z_]+)\s*=\s*(?:"((?:[^"\\]|\\.)*)"|(\d+))\s*'
+                r"(?:#.*)?$", line,
+            )
+            if m is None or current is None:
+                raise WaiverError(
+                    f"{path}:{i}: unparseable waiver line: {line!r} "
+                    "(expected [[waiver]] tables of key = \"string\" or "
+                    "key = integer assignments)"
+                )
+            key, s_val, i_val = m.group(1), m.group(2), m.group(3)
+            current[key] = (
+                int(i_val) if i_val is not None
+                else s_val.encode().decode("unicode_escape")
+            )
+        finish(current, i if waivers or current else 0)
+    return waivers
+
+
+# ------------------------------------------------------------ AST helpers
+def _terminal_name(node: ast.AST) -> Optional[str]:
+    """`self._tier_lock` -> "_tier_lock", `lock` -> "lock"."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """`time.sleep` -> "time.sleep"; gives up on non-trivial bases."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def _is_lock_expr(node: ast.AST) -> bool:
+    name = _terminal_name(node)
+    if name is None:
+        # threading.Lock() acquired inline: `with threading.Lock():`
+        if isinstance(node, ast.Call):
+            dn = _dotted(node.func) or ""
+            return dn.split(".")[-1] in ("Lock", "RLock", "Condition")
+        return False
+    return bool(_LOCK_NAME_RE.search(name))
+
+
+def _has_kw(call: ast.Call, name: str) -> bool:
+    return any(kw.arg == name for kw in call.keywords)
+
+
+def _body_nodes_no_nested_defs(body: Sequence[ast.stmt]):
+    """Yield every node in ``body`` without descending into nested
+    function/class definitions (their execution escapes the lock/async
+    context being analyzed)."""
+    stack: List[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _blocking_call_reason(node: ast.Call) -> Optional[str]:
+    """If ``node`` is one of the calls RTL001/RTL005 forbid, say why."""
+    dn = _dotted(node.func)
+    if dn == "time.sleep":
+        return "time.sleep() blocks the holder"
+    if dn is not None and (dn.startswith("subprocess.")):
+        return f"{dn}() forks/blocks on a child process"
+    if dn in ("ray_tpu.get", "ray.get"):
+        return f"{dn}() is a distributed blocking get"
+    if isinstance(node.func, ast.Attribute) and node.func.attr == "result":
+        return ".result() blocks on a future"
+    return None
+
+
+def _is_untimed_wait(node: ast.Call) -> bool:
+    if not isinstance(node.func, ast.Attribute) or _has_kw(node, "timeout"):
+        return False
+    if node.func.attr == "wait":
+        return not node.args
+    if node.func.attr == "wait_for":
+        # Condition.wait_for(predicate) loops an untimed wait() inside;
+        # asyncio.wait_for(aw, t) carries its timeout as 2nd positional.
+        return len(node.args) <= 1
+    return False
+
+
+def _is_untimed_queue_get(node: ast.Call) -> bool:
+    if not (isinstance(node.func, ast.Attribute)
+            and node.func.attr == "get"):
+        return False
+    recv = _terminal_name(node.func.value)
+    if recv is None or not _QUEUE_NAME_RE.search(recv):
+        return False
+    if _has_kw(node, "timeout"):
+        return False
+    # Non-blocking try-gets raise Empty immediately — bounded by nature.
+    for kw in node.keywords:
+        if (kw.arg == "block" and isinstance(kw.value, ast.Constant)
+                and kw.value.value is False):
+            return False
+    if (node.args and isinstance(node.args[0], ast.Constant)
+            and node.args[0].value is False):
+        return False
+    # q.get() / q.get(True) / q.get(block=True) — all unbounded.
+    positional_timeout = len(node.args) >= 2
+    return not positional_timeout
+
+
+# ------------------------------------------------------------- the checker
+class FileChecker(ast.NodeVisitor):
+    def __init__(self, path: str, source: str, runtime_scope: bool,
+                 declared_metrics: Set[str], registry_file: bool):
+        self.path = path
+        self.source_lines = source.splitlines()
+        self.runtime_scope = runtime_scope
+        self.declared_metrics = declared_metrics
+        self.registry_file = registry_file
+        self.violations: List[Violation] = []
+        self._awaited: Set[int] = set()
+        self._async_depth = 0
+        self._thread_ctors: Set[str] = {"threading.Thread", "Thread"}
+
+    # -- plumbing ---------------------------------------------------------
+    def check(self) -> List[Violation]:
+        try:
+            tree = ast.parse("\n".join(self.source_lines), filename=self.path)
+        except SyntaxError as e:
+            self._add("RTL000", e.lineno or 1, 0,
+                      f"file does not parse: {e.msg}")
+            return self.violations
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                # `import threading as _t` -> match `_t.Thread(...)` too.
+                for alias in node.names:
+                    if alias.name == "threading" and alias.asname:
+                        self._thread_ctors.add(f"{alias.asname}.Thread")
+            elif isinstance(node, ast.ImportFrom):
+                # `from threading import Thread as Thr` -> match `Thr(...)`.
+                if node.module == "threading":
+                    for alias in node.names:
+                        if alias.name == "Thread":
+                            self._thread_ctors.add(
+                                alias.asname or alias.name
+                            )
+            elif isinstance(node, ast.Await):
+                self._awaited.add(id(node.value))
+            elif isinstance(node, ast.Call):
+                # `asyncio.wait_for(ev.wait(), timeout)` bounds the inner
+                # wait — exempt its arguments from the untimed-wait rule.
+                dn = _dotted(node.func) or ""
+                if dn.split(".")[-1] == "wait_for":
+                    for arg in node.args:
+                        self._awaited.add(id(arg))
+        self.visit(tree)
+        return self.violations
+
+    def _add(self, rule: str, line: int, col: int, message: str):
+        self.violations.append(Violation(rule, self.path, line, col, message))
+
+    def source_line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.source_lines):
+            return self.source_lines[lineno - 1]
+        return ""
+
+    # -- RTL001 -----------------------------------------------------------
+    def _check_with(self, node):
+        if not self.runtime_scope:
+            return
+        lock_items = [
+            item for item in node.items
+            if _is_lock_expr(item.context_expr)
+        ]
+        if not lock_items:
+            return
+        lock_desc = _terminal_name(lock_items[0].context_expr) or "lock"
+        for inner in _body_nodes_no_nested_defs(node.body):
+            if not isinstance(inner, ast.Call):
+                continue
+            reason = _blocking_call_reason(inner)
+            if reason is None and _is_untimed_wait(inner):
+                reason = "untimed .wait() parks the thread with the lock" \
+                         " context in scope"
+            if reason is not None:
+                self._add(
+                    "RTL001", inner.lineno, inner.col_offset,
+                    f"blocking call inside `with {lock_desc}:` — {reason}; "
+                    "move it outside the critical section",
+                )
+
+    def visit_With(self, node: ast.With):
+        self._check_with(node)
+        self.generic_visit(node)
+
+    # -- RTL002 -----------------------------------------------------------
+    def visit_Call(self, node: ast.Call):
+        dn = _dotted(node.func) or ""
+        if dn in self._thread_ctors:
+            missing = [kw for kw in ("daemon", "name")
+                       if not _has_kw(node, kw)]
+            if missing:
+                self._add(
+                    "RTL002", node.lineno, node.col_offset,
+                    "threading.Thread(...) must set "
+                    f"{' and '.join(m + '=' for m in missing)} explicitly "
+                    "(unnamed/implicit-daemon threads are undebuggable "
+                    "and can block interpreter exit)",
+                )
+        # -- RTL005 / RTL006 (call-shaped rules) --------------------------
+        if self.runtime_scope:
+            if self._async_depth > 0:
+                reason = _blocking_call_reason(node)
+                if reason is not None and not dn.startswith("subprocess."):
+                    # subprocess is RTL001's concern; async bodies care
+                    # about anything that parks the event loop thread.
+                    self._add(
+                        "RTL005", node.lineno, node.col_offset,
+                        f"blocking call in async def — {reason}; the event "
+                        "loop (and every coroutine on it) stalls. Use the "
+                        "async equivalent or run_in_executor",
+                    )
+            if id(node) not in self._awaited:
+                if _is_untimed_wait(node):
+                    self._add(
+                        "RTL006", node.lineno, node.col_offset,
+                        "untimed .wait(): a lost notify or wedged peer "
+                        "hangs this thread forever — pass a timeout and "
+                        "re-check the predicate",
+                    )
+                elif _is_untimed_queue_get(node):
+                    self._add(
+                        "RTL006", node.lineno, node.col_offset,
+                        "unbounded queue get(): pass timeout= so overload "
+                        "degrades into a timeout error instead of a hang",
+                    )
+        self.generic_visit(node)
+
+    # -- RTL003 -----------------------------------------------------------
+    def visit_ExceptHandler(self, node: ast.ExceptHandler):
+        if self.runtime_scope and self._is_broad_handler(node):
+            body = [
+                stmt for stmt in node.body
+                if not (isinstance(stmt, ast.Expr)
+                        and isinstance(stmt.value, ast.Constant)
+                        and isinstance(stmt.value.value, str))
+            ]
+            if len(body) == 1 and isinstance(body[0], ast.Pass):
+                self._add(
+                    "RTL003", node.lineno, node.col_offset,
+                    "broad except with a pass-only body swallows every "
+                    "failure silently — log it, count it via "
+                    "util/metrics.py, or waive with a justification",
+                )
+        self.generic_visit(node)
+
+    @staticmethod
+    def _is_broad_handler(node: ast.ExceptHandler) -> bool:
+        if node.type is None:
+            return True
+        types = (node.type.elts if isinstance(node.type, ast.Tuple)
+                 else [node.type])
+        for t in types:
+            name = _terminal_name(t)
+            if name in ("Exception", "BaseException"):
+                return True
+        return False
+
+    # -- RTL004 -----------------------------------------------------------
+    def visit_Constant(self, node: ast.Constant):
+        if (isinstance(node.value, str)
+                and not self.registry_file
+                and _METRIC_NAME_RE.fullmatch(node.value)
+                and node.value not in self.declared_metrics):
+            self._add(
+                "RTL004", node.lineno, node.col_offset,
+                f"metric name {node.value!r} is not declared in "
+                "ray_tpu/util/metric_registry.py — declare it there (and "
+                "document it in docs/observability.md), then import the "
+                "constant",
+            )
+        self.generic_visit(node)
+
+    # -- async tracking (RTL005) ------------------------------------------
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef):
+        self._async_depth += 1
+        self.generic_visit(node)
+        self._async_depth -= 1
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        # A sync def nested in an async def runs on its own thread/stack.
+        saved, self._async_depth = self._async_depth, 0
+        self.generic_visit(node)
+        self._async_depth = saved
+
+    def visit_Lambda(self, node: ast.Lambda):
+        # Same: a lambda handed to run_in_executor executes off-loop.
+        saved, self._async_depth = self._async_depth, 0
+        self.generic_visit(node)
+        self._async_depth = saved
+
+    def visit_AsyncWith(self, node: ast.AsyncWith):
+        # `async with lock:` is an asyncio lock — blocking calls under it
+        # stall the loop, which RTL005 already reports per call site.
+        self.generic_visit(node)
+
+
+# ---------------------------------------------------------- file discovery
+def _iter_python_files(paths: Sequence[str]):
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs
+                           if d not in ("__pycache__", ".git", "build")]
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+        else:
+            raise FileNotFoundError(p)
+
+
+def _package_relative(path: str) -> Optional[str]:
+    """Path inside the ray_tpu package ('core/foo.py'), or None if the
+    file is not under a ray_tpu directory."""
+    parts = os.path.abspath(path).replace(os.sep, "/").split("/")
+    if "ray_tpu" not in parts:
+        return None
+    idx = len(parts) - 1 - parts[::-1].index("ray_tpu")
+    rel = "/".join(parts[idx + 1:])
+    return rel or None
+
+
+def _in_runtime_scope(path: str) -> bool:
+    rel = _package_relative(path)
+    if rel is None:
+        return True  # standalone snippet (fixtures): all rules apply
+    return (rel.startswith(RUNTIME_SCOPE_PREFIXES)
+            or rel in RUNTIME_SCOPE_FILES)
+
+
+def _registry_path() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "util", "metric_registry.py")
+
+
+def load_declared_metrics(registry_path: Optional[str] = None) -> Set[str]:
+    """Metric names declared in the registry module — parsed from its AST
+    so linting never imports runtime code."""
+    registry_path = registry_path or _registry_path()
+    declared: Set[str] = set()
+    try:
+        with open(registry_path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=registry_path)
+    except (OSError, SyntaxError):
+        return declared
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Constant) and isinstance(node.value, str)
+                and _METRIC_NAME_RE.fullmatch(node.value)):
+            declared.add(node.value)
+    return declared
+
+
+def check_docs_coverage(declared: Set[str],
+                        doc_path: Optional[str] = None) -> List[Violation]:
+    """RTL004 second half: every registered name must appear in
+    docs/observability.md (skipped silently when the docs tree is not
+    present, e.g. an installed wheel)."""
+    registry = _registry_path()
+    if doc_path is None:
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        doc_path = os.path.join(repo_root, "docs", "observability.md")
+    if not os.path.isfile(doc_path):
+        return []
+    with open(doc_path, encoding="utf-8") as f:
+        doc_text = f.read()
+    out = []
+    for name in sorted(declared):
+        if name not in doc_text:
+            out.append(Violation(
+                "RTL004", registry, 1, 0,
+                f"metric {name!r} is registered but undocumented — add it "
+                f"to {os.path.relpath(doc_path)}",
+            ))
+    return out
+
+
+# ----------------------------------------------------------------- driver
+def _inline_waive_rules(line_text: str) -> Set[str]:
+    m = _WAIVE_COMMENT_RE.search(line_text)
+    if not m:
+        return set()
+    return {r.strip() for r in m.group(1).split(",") if r.strip()}
+
+
+def run(paths: Sequence[str], waiver_file: Optional[str],
+        check_docs: bool = True) -> Tuple[List[Violation], List[Waiver]]:
+    declared = load_declared_metrics()
+    registry = _registry_path()
+    waivers = parse_waivers(waiver_file) if waiver_file else []
+    violations: List[Violation] = []
+    checkers: Dict[str, FileChecker] = {}
+
+    for path in _iter_python_files(paths):
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        checker = FileChecker(
+            path, source, _in_runtime_scope(path), declared,
+            registry_file=os.path.abspath(path) == registry,
+        )
+        checkers[path] = checker
+        violations.extend(checker.check())
+
+    if check_docs:
+        violations.extend(check_docs_coverage(declared))
+
+    for v in violations:
+        if v.rule == "RTL000":
+            continue  # parse failures are never waivable
+        checker = checkers.get(v.path)
+        line_text = checker.source_line(v.line) if checker else ""
+        if v.rule in _inline_waive_rules(line_text):
+            v.waived = True
+            v.waive_source = "inline comment"
+            continue
+        for w in waivers:
+            if w.matches(v, line_text):
+                v.waived = True
+                v.waive_source = f"waiver file ({w.date}: {w.reason})"
+                w.used = True
+                break
+    return violations, waivers
+
+
+def default_waiver_file() -> Optional[str]:
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "lint_waivers.toml")
+    return path if os.path.isfile(path) else None
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m ray_tpu.devtools.lint",
+        description="raylint: runtime-invariant static analysis "
+                    "(RTL001-RTL006)",
+    )
+    parser.add_argument("paths", nargs="*",
+                        help="files/directories to lint (default: the "
+                             "ray_tpu package)")
+    parser.add_argument("--waivers", default=None,
+                        help="waiver file (default: lint_waivers.toml "
+                             "next to this module)")
+    parser.add_argument("--no-waivers", action="store_true",
+                        help="ignore the waiver file (show everything)")
+    parser.add_argument("--no-docs-check", action="store_true",
+                        help="skip the RTL004 docs-coverage pass")
+    parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("--show-waived", action="store_true",
+                        help="also print waived violations")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule_id, slug in RULES.items():
+            print(f"{rule_id}  {slug}")
+        return 0
+
+    paths = args.paths or [os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))]
+    waiver_file = None if args.no_waivers else (
+        args.waivers or default_waiver_file()
+    )
+    try:
+        violations, waivers = run(paths, waiver_file,
+                                  check_docs=not args.no_docs_check)
+    except (WaiverError, FileNotFoundError) as e:
+        print(f"raylint: error: {e}", file=sys.stderr)
+        return 2
+
+    unwaived = [v for v in violations if not v.waived]
+    shown = violations if args.show_waived else unwaived
+    for v in sorted(shown, key=lambda v: (v.path, v.line, v.rule)):
+        print(v.render())
+    # Unused-waiver nagging only makes sense for a whole-package run — a
+    # subset lint legitimately never exercises most entries.
+    if not args.paths:
+        for w in waivers:
+            if not w.used:
+                print(f"raylint: warning: unused waiver "
+                      f"({','.join(w.rules)} {w.path}) — remove it",
+                      file=sys.stderr)
+    n_waived = sum(1 for v in violations if v.waived)
+    print(f"raylint: {len(unwaived)} violation(s), {n_waived} waived",
+          file=sys.stderr)
+    return 1 if unwaived else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

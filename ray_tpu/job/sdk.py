@@ -98,7 +98,8 @@ class JobSupervisor:
             self._info.status = JobStatus.RUNNING
             self._info.start_time = time.time()
             self._publish()
-        threading.Thread(target=self._reap, daemon=True).start()
+        threading.Thread(target=self._reap, daemon=True,
+                         name="job-reaper").start()
         return self._info.status
 
     def _reap(self):
@@ -145,7 +146,8 @@ class JobSupervisor:
                 except OSError:
                     pass
 
-        threading.Thread(target=force_kill, daemon=True).start()
+        threading.Thread(target=force_kill, daemon=True,
+                         name="job-force-kill").start()
         self._publish()
         return True
 

@@ -10,6 +10,7 @@ matching the reference's proxy→router→replica design.
 from __future__ import annotations
 
 import json
+import logging
 import threading
 from typing import Any, Dict, Optional
 
@@ -19,6 +20,8 @@ from ray_tpu.core.serialization import dumps_function
 from .controller import CONTROLLER_NAME, ServeController
 from .deployment import Application, Deployment
 from .handle import DeploymentHandle
+
+logger = logging.getLogger(__name__)
 
 _http_state: Dict[str, Any] = {}
 
@@ -198,18 +201,37 @@ def start_http_proxy(host: str = "127.0.0.1", port: int = 8000) -> str:
     route_bootstrap: Dict[str, Any] = {}
     route_bootstrap_miss: Dict[str, float] = {}
 
-    def get_routes_cached():
+    async def get_routes_cached():
         pushed = lp.get(("routes",))
         if pushed is not None:
             return pushed
         # Pre-first-push: pull once and memoize even an EMPTY table (the
         # controller must stay out of the hot path for request streams
-        # against a routeless proxy).
-        if "fetched" not in route_bootstrap_miss:
-            route_bootstrap_miss["fetched"] = 1.0
-            route_bootstrap.update(
-                ray_tpu.get(controller.get_routes.remote(), timeout=30)
-            )
+        # against a routeless proxy).  Off-loop (a blocking get here would
+        # stall every in-flight request for up to the controller timeout —
+        # raylint RTL005) and memoized as ONE shared task so concurrent
+        # requests await the same pull instead of observing a
+        # claimed-but-still-empty table and 404ing valid routes.
+        fetch = route_bootstrap_miss.get("fetch")
+        if fetch is None:
+
+            async def _pull():
+                try:
+                    route_bootstrap.update(
+                        await asyncio.get_running_loop().run_in_executor(
+                            None,
+                            lambda: ray_tpu.get(
+                                controller.get_routes.remote(), timeout=30
+                            ),
+                        )
+                    )
+                except Exception as e:  # noqa: BLE001 — 404-repull recovers
+                    logger.debug("route bootstrap pull failed: %s", e)
+
+            fetch = asyncio.get_running_loop().create_task(_pull())
+            route_bootstrap_miss["fetch"] = fetch
+        # shield: one client disconnecting must not cancel the shared pull.
+        await asyncio.shield(fetch)
         return route_bootstrap
 
     def match_route(path: str, routes: Dict[str, str]):
@@ -265,7 +287,7 @@ def start_http_proxy(host: str = "127.0.0.1", port: int = 8000) -> str:
     async def handle_request(request: "web.Request"):
         import time as _time
 
-        name = match_route(request.path, get_routes_cached())
+        name = match_route(request.path, await get_routes_cached())
         if name is None:
             # Route misses are usually real 404s (routes are PUSHED, so the
             # table is fresh); the one legit race is a deploy whose first
@@ -275,14 +297,20 @@ def start_http_proxy(host: str = "127.0.0.1", port: int = 8000) -> str:
             if now - route_bootstrap_miss.get("ts", 0.0) > 1.0:
                 route_bootstrap_miss["ts"] = now
                 try:
-                    fresh = ray_tpu.get(
-                        controller.get_routes.remote(), timeout=5
+                    # Off-loop: a blocking get here would stall every
+                    # in-flight request behind one controller round trip
+                    # (raylint RTL005).
+                    fresh = await asyncio.get_running_loop().run_in_executor(
+                        None,
+                        lambda: ray_tpu.get(
+                            controller.get_routes.remote(), timeout=5
+                        ),
                     )
                     route_bootstrap.clear()
                     route_bootstrap.update(fresh)
                     name = match_route(request.path, fresh)
-                except Exception:  # noqa: BLE001 — fall through to 404
-                    pass
+                except Exception as e:  # noqa: BLE001 — fall through to 404
+                    logger.debug("route bootstrap pull failed: %s", e)
         if name is None:
             return web.json_response(
                 {"error": f"no deployment at {request.path}"}, status=404

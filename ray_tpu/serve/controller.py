@@ -18,6 +18,7 @@ from typing import Any, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu.core.serialization import loads_function
+from ray_tpu.util.debug_locks import make_lock
 
 logger = logging.getLogger(__name__)
 
@@ -46,7 +47,7 @@ class Replica:
             self.callable = obj
             self._is_class = False
         self._ongoing = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("serve.replica.stats")
         self._total = 0
         # User-request concurrency is gated HERE, not by actor-level
         # max_concurrency: system calls (queue_len / health_check) must
@@ -182,7 +183,7 @@ class ServeController:
     def __init__(self):
         # name -> {"spec": {...}, "replicas": [handles], "version": str, ...}
         self.deployments: Dict[str, dict] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("serve.controller.state")
         self._stop = threading.Event()
         # Long-poll host state (reference LongPollHost, serve/_private/
         # long_poll.py:252): per-key monotonically-increasing snapshot ids;
@@ -190,7 +191,7 @@ class ServeController:
         # Mutations happen on actor calls AND the reconcile thread, so the
         # snapshot table is lock-guarded and waiters are asyncio events
         # woken via their owning loop.
-        self._lp_lock = threading.Lock()
+        self._lp_lock = make_lock("serve.controller.long_poll")
         self._lp_snapshots: Dict[tuple, tuple] = {}  # key -> (id, value)
         self._lp_waiters: list = []  # [(loop, asyncio.Event)]
         self._reconciler = threading.Thread(
@@ -334,8 +335,8 @@ class ServeController:
     def _kill(handle) -> None:
         try:
             ray_tpu.kill(handle)
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("replica kill failed: %s", e)
 
     # --------------------------------------------------------- reconcile loop
     def _reconcile_loop(self):

@@ -20,6 +20,8 @@ import threading
 import time
 from typing import Any, Dict, Optional, Tuple
 
+from ..util.debug_locks import make_lock
+
 logger = logging.getLogger(__name__)
 
 LISTEN_TIMEOUT_S = 30.0
@@ -32,7 +34,7 @@ class LongPollClient:
         self._controller_name = controller_name
         self._known: Dict[Tuple, Tuple[int, Any]] = {}
         self._keys: set = set()
-        self._lock = threading.Lock()
+        self._lock = make_lock("serve.long_poll.client")
         self._thread: Optional[threading.Thread] = None
         self._stopped = False
 
@@ -88,7 +90,7 @@ class LongPollClient:
 
 
 _client: Optional[LongPollClient] = None
-_client_lock = threading.Lock()
+_client_lock = make_lock("serve.long_poll.singleton")
 
 
 def long_poll_client() -> LongPollClient:

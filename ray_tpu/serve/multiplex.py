@@ -13,8 +13,11 @@ from __future__ import annotations
 import asyncio
 import contextvars
 import functools
+import logging
 from collections import OrderedDict
 from typing import Any, Callable, Optional
+
+logger = logging.getLogger(__name__)
 
 _model_id_var: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
     "rtpu_serve_multiplexed_model_id", default=None
@@ -63,8 +66,8 @@ def multiplexed(_fn=None, *, max_num_models_per_replica: int = 3):
                             result = unload()
                             if asyncio.iscoroutine(result):
                                 await result
-                        except Exception:  # noqa: BLE001
-                            pass
+                        except Exception as e:
+                            logger.warning("model unload failed: %s", e)
                 return model
 
         wrapper._is_serve_multiplexed = True

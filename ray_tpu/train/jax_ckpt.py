@@ -88,7 +88,7 @@ def async_save_sharded(tree, directory: str) -> AsyncSave:
         except BaseException as e:  # noqa: BLE001 — surfaced via wait()
             handle_box["handle"].error = e
 
-    thread = threading.Thread(target=write, daemon=True)
+    thread = threading.Thread(target=write, daemon=True, name="ckpt-write")
     handle = AsyncSave(thread)
     handle_box["handle"] = handle
     thread.start()

@@ -10,10 +10,40 @@ joblib parallel backend (``util/joblib/``).
 submodules (`from ray_tpu.util.multiprocessing import Pool`) — importing
 them eagerly here would shadow the stdlib module name inside this
 package and drag joblib into every startup.
+
+Everything that pulls in the task/actor API surface is resolved lazily
+(PEP 562): core modules import leaf utilities from this package
+(``debug_locks``, ``metric_registry``, ``metrics``) at their own import
+time, and an eager ``actor_pool``/``queue``/``state``/``tpu`` import
+here would re-enter the partially initialized core package.
 """
 
-from .actor_pool import ActorPool  # noqa: F401
-from .queue import Empty, Full, Queue  # noqa: F401
-from . import metrics  # noqa: F401
-from . import state  # noqa: F401
-from . import tpu  # noqa: F401
+from . import metrics  # noqa: F401  (leaf: no core imports at load time)
+
+_LAZY_ATTRS = {
+    "ActorPool": ("actor_pool", "ActorPool"),
+    "Empty": ("queue", "Empty"),
+    "Full": ("queue", "Full"),
+    "Queue": ("queue", "Queue"),
+    # Submodules the eager imports used to bind as package attributes.
+    "actor_pool": ("actor_pool", None),
+    "queue": ("queue", None),
+    "state": ("state", None),
+    "tpu": ("tpu", None),
+}
+
+
+def __getattr__(name):
+    entry = _LAZY_ATTRS.get(name)
+    if entry is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    module = importlib.import_module(f".{entry[0]}", __name__)
+    value = module if entry[1] is None else getattr(module, entry[1])
+    globals()[name] = value
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY_ATTRS))

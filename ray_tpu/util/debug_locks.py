@@ -1,0 +1,339 @@
+"""Opt-in lock instrumentation: lock-order cycle (deadlock) detection.
+
+``raylint`` (``ray_tpu/devtools/lint.py``) proves static invariants; this
+module is its dynamic companion for the one class of bug an AST cannot
+see — **lock-ordering deadlocks** between runtime threads.  With
+``RAY_TPU_DEBUG_LOCKS=1`` the runtime's lock factories below hand out
+``DebugLock``/``DebugCondition`` wrappers that
+
+  - maintain a per-thread stack of held locks and a global directed
+    graph of acquisition edges (lock A held while acquiring lock B adds
+    the edge A→B, keyed by lock *name* so every instance of a named
+    lock shares one node);
+  - on each NEW edge, run cycle detection and report any ordering cycle
+    (a potential deadlock: two threads can interleave the cycle's edges
+    and block forever) — logged once per cycle and counted through the
+    PR-2 flight recorder as ``ray_tpu_debug_lock_cycles_total``;
+  - record blocking acquisitions made while already holding another
+    lock (the precondition for every deadlock, and a latency smell even
+    without one) in the ``ray_tpu_debug_lock_held_blocked_wait_s``
+    histogram;
+  - flag untimed ``DebugCondition.wait()`` calls (raylint RTL006's
+    dynamic twin) the first time each wait site runs.
+
+Off by default: ``make_lock``/``make_condition`` return plain
+``threading`` primitives unless the env knob is set, so the hot path
+pays nothing.  Reports are queryable in-process via
+``detected_cycles()`` / ``lock_order_report()``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+logger = logging.getLogger(__name__)
+
+_ENV_KNOB = "RAY_TPU_DEBUG_LOCKS"
+
+
+def debug_locks_enabled() -> bool:
+    """Read the env knob (checked at lock-construction time, so set it
+    before ``ray_tpu.init()``)."""
+    return os.environ.get(_ENV_KNOB, "").strip() in ("1", "true", "TRUE")
+
+
+# One registry for the whole process.  The graph is tiny (runtime lock
+# names, not instances) so a single mutex around it is fine — and it must
+# be a RAW lock, never a DebugLock, or instrumentation would recurse.
+_registry_lock = threading.Lock()
+_edges: Dict[str, Set[str]] = {}          # name -> names acquired under it
+_edge_sites: Dict[Tuple[str, str], str] = {}   # edge -> "thread" first seen
+_cycles: List[Tuple[str, ...]] = []       # reported cycles (deduped)
+_cycle_keys: Set[frozenset] = set()
+_untimed_wait_sites: Set[str] = set()
+_held = threading.local()                 # .stack: List[str] per thread
+
+_anon_seq = 0
+
+
+def _next_anon_name() -> str:
+    global _anon_seq
+    with _registry_lock:
+        _anon_seq += 1
+        return f"anon-lock-{_anon_seq}"
+
+
+def _fr():
+    from . import flight_recorder
+
+    return flight_recorder
+
+
+def _held_stack() -> List[str]:
+    stack = getattr(_held, "stack", None)
+    if stack is None:
+        stack = _held.stack = []
+    return stack
+
+
+def _find_cycle(start: str) -> Optional[Tuple[str, ...]]:
+    """DFS from ``start`` back to itself along acquisition edges."""
+    path: List[str] = [start]
+    seen: Set[str] = set()
+
+    def dfs(node: str) -> Optional[Tuple[str, ...]]:
+        for nxt in _edges.get(node, ()):
+            if nxt == start:
+                return tuple(path)
+            if nxt in seen:
+                continue
+            seen.add(nxt)
+            path.append(nxt)
+            found = dfs(nxt)
+            if found is not None:
+                return found
+            path.pop()
+        return None
+
+    return dfs(start)
+
+
+def _record_acquire_edge(name: str) -> None:
+    """Called with the acquiring thread's held-stack NOT yet including
+    ``name``.  Adds holder→name edges and reports any new cycle."""
+    stack = _held_stack()
+    if not stack:
+        return
+    holder = stack[-1]
+    if holder == name:
+        return  # re-entrant same-name acquisition (RLock-style)
+    new_cycle = None
+    with _registry_lock:
+        under = _edges.setdefault(holder, set())
+        if name in under:
+            return  # known edge, already checked
+        under.add(name)
+        _edge_sites[(holder, name)] = threading.current_thread().name
+        cycle = _find_cycle(holder)
+        if cycle is not None:
+            key = frozenset(cycle)
+            if key not in _cycle_keys:
+                _cycle_keys.add(key)
+                _cycles.append(cycle)
+                new_cycle = cycle
+    if new_cycle is not None:
+        order = " -> ".join(new_cycle + (new_cycle[0],))
+        logger.error(
+            "potential deadlock: lock-order cycle %s (threads disagree on "
+            "acquisition order; two of them can block forever)", order,
+        )
+        try:
+            from .metric_registry import DEBUG_LOCK_CYCLES_TOTAL
+
+            _fr().counter(DEBUG_LOCK_CYCLES_TOTAL, 1.0,
+                          {"cycle": order})
+        except Exception:  # noqa: BLE001 — diagnosis must not take down
+            logger.debug("flight-recorder push of lock cycle failed",
+                         exc_info=True)
+
+
+def _record_held_blocked_wait(name: str, waited_s: float) -> None:
+    try:
+        from .metric_registry import DEBUG_LOCK_HELD_WAIT_HIST
+
+        _fr().histogram(DEBUG_LOCK_HELD_WAIT_HIST, waited_s, {"lock": name})
+    except Exception:  # noqa: BLE001 — diagnosis must not take down
+        logger.debug("flight-recorder push of lock wait failed",
+                     exc_info=True)
+
+
+class DebugLock:
+    """``threading.Lock`` wrapper that feeds the ordering graph.
+
+    Always records when constructed directly (tests build them
+    explicitly); production code goes through ``make_lock`` which only
+    hands these out under ``RAY_TPU_DEBUG_LOCKS=1``.
+    """
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name or _next_anon_name()
+        self._lock = threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if blocking:
+            # Try-acquires (blocking=False) cannot deadlock — they fail
+            # instead of waiting — so they contribute no ordering edge.
+            _record_acquire_edge(self.name)
+        got = self._lock.acquire(False)
+        if got:
+            _held_stack().append(self.name)
+            return True
+        if not blocking:
+            return False
+        # Contended path: time it, and if this thread already holds a
+        # lock, record the held-blocked wait (deadlock precondition).
+        t0 = time.monotonic()
+        got = self._lock.acquire(True, timeout)
+        if got:
+            if _held_stack():
+                _record_held_blocked_wait(self.name, time.monotonic() - t0)
+            _held_stack().append(self.name)
+        return got
+
+    def release(self) -> None:
+        stack = _held_stack()
+        if self.name in stack:
+            # Remove the innermost occurrence: out-of-order releases are
+            # legal for Lock, the stack just tracks what is still held.
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i] == self.name:
+                    del stack[i]
+                    break
+        self._lock.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self) -> "DebugLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<DebugLock {self.name} locked={self._lock.locked()}>"
+
+
+class DebugCondition:
+    """``threading.Condition`` wrapper: ordering edges for the underlying
+    lock plus first-use reporting of untimed ``wait()`` calls."""
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name or _next_anon_name()
+        self._cond = threading.Condition()
+
+    # -- lock protocol ----------------------------------------------------
+    def acquire(self, *args) -> bool:
+        _record_acquire_edge(self.name)
+        got = self._cond.acquire(*args)
+        if got:
+            _held_stack().append(self.name)
+        return got
+
+    def release(self) -> None:
+        stack = _held_stack()
+        if self.name in stack:
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i] == self.name:
+                    del stack[i]
+                    break
+        self._cond.release()
+
+    def __enter__(self) -> "DebugCondition":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    # -- condition protocol -----------------------------------------------
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        if timeout is None:
+            site = self.name
+            with _registry_lock:
+                fresh = site not in _untimed_wait_sites
+                _untimed_wait_sites.add(site)
+            if fresh:
+                logger.warning(
+                    "untimed Condition.wait() on %r: an overloaded or "
+                    "wedged notifier hangs this thread forever (raylint "
+                    "RTL006)", self.name,
+                )
+        # The wait releases the lock: reflect that in the held stack so
+        # acquisitions made by OTHER code in this thread's handlers are
+        # not charged under it, then restore on wakeup.
+        stack = _held_stack()
+        popped = False
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == self.name:
+                del stack[i]
+                popped = True
+                break
+        try:
+            return self._cond.wait(timeout)
+        finally:
+            if popped:
+                _held_stack().append(self.name)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        deadline = (
+            None if timeout is None else time.monotonic() + timeout
+        )
+        result = predicate()
+        while not result:
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+            self.wait(remaining)
+            result = predicate()
+        return result
+
+    def notify(self, n: int = 1) -> None:
+        self._cond.notify(n)
+
+    def notify_all(self) -> None:
+        self._cond.notify_all()
+
+    def __repr__(self) -> str:
+        return f"<DebugCondition {self.name}>"
+
+
+# ------------------------------------------------------------- factories
+def make_lock(name: str):
+    """A named lock: ``DebugLock`` under ``RAY_TPU_DEBUG_LOCKS=1``, plain
+    ``threading.Lock`` otherwise (zero overhead when off)."""
+    if debug_locks_enabled():
+        return DebugLock(name)
+    return threading.Lock()
+
+
+def make_condition(name: str):
+    """A named condition: ``DebugCondition`` under the knob, plain
+    ``threading.Condition`` otherwise."""
+    if debug_locks_enabled():
+        return DebugCondition(name)
+    return threading.Condition()
+
+
+# -------------------------------------------------------------- reporting
+def detected_cycles() -> List[Tuple[str, ...]]:
+    """Lock-order cycles seen so far (each reported once)."""
+    with _registry_lock:
+        return list(_cycles)
+
+
+def lock_order_report() -> dict:
+    """Snapshot of the acquisition graph for dumps/tests."""
+    with _registry_lock:
+        return {
+            "edges": {k: sorted(v) for k, v in _edges.items()},
+            "cycles": [list(c) for c in _cycles],
+            "untimed_wait_sites": sorted(_untimed_wait_sites),
+        }
+
+
+def reset() -> None:
+    """Clear the global graph (tests)."""
+    with _registry_lock:
+        _edges.clear()
+        _edge_sites.clear()
+        _cycles.clear()
+        _cycle_keys.clear()
+        _untimed_wait_sites.clear()

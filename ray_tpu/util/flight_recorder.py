@@ -40,16 +40,20 @@ from typing import Dict, Iterable, Optional, Tuple
 from ..core.config import GlobalConfig
 from . import metrics as _metrics
 
-# ----------------------------------------------------------- metric names
-TASK_PHASE_HIST = "ray_tpu_task_phase_s"
-BACKPRESSURE_WAIT_HIST = "ray_tpu_backpressure_wait_s"
-BACKPRESSURE_BLOCKED_TOTAL = "ray_tpu_backpressure_blocked_total"
-COLLECTIVE_OPS_TOTAL = "ray_tpu_collective_ops_total"
-COLLECTIVE_BYTES_TOTAL = "ray_tpu_collective_bytes_total"
-COLLECTIVE_DURATION_HIST = "ray_tpu_collective_duration_s"
-COLLECTIVE_BANDWIDTH_HIST = "ray_tpu_collective_bandwidth_bytes_per_s"
-ICI_SCALING_EFFICIENCY = "ray_tpu_ici_scaling_efficiency"
-TASK_EVENTS_DROPPED_TOTAL = "ray_tpu_task_events_dropped_total"
+# Metric names live in ONE registry module (raylint RTL004); the common
+# ones are re-exported here for the recorder's callers and tests.
+from .metric_registry import (  # noqa: F401 — re-exports
+    BACKPRESSURE_BLOCKED_TOTAL,
+    BACKPRESSURE_WAIT_HIST,
+    COLLECTIVE_BANDWIDTH_HIST,
+    COLLECTIVE_BYTES_TOTAL,
+    COLLECTIVE_DURATION_HIST,
+    COLLECTIVE_OPS_TOTAL,
+    EXCEPTION_SUPPRESSED_TOTAL,
+    ICI_SCALING_EFFICIENCY,
+    TASK_EVENTS_DROPPED_TOTAL,
+    TASK_PHASE_HIST,
+)
 
 # Sub-millisecond to minutes: runtime phases span five orders of magnitude.
 DURATION_BOUNDARIES = [
@@ -90,6 +94,12 @@ def histogram(name: str, value: float, tags: Optional[Dict[str, str]] = None,
         return
     _metrics._record(name, "histogram", tags or {}, float(value),
                      buckets=boundaries or DURATION_BOUNDARIES)
+
+
+def count_suppressed(site: str) -> None:
+    """Account one intentionally swallowed exception (RTL003): cleanup
+    paths that must not raise still leave a per-site counter trail."""
+    counter(EXCEPTION_SUPPRESSED_TOTAL, 1.0, {"site": site})
 
 
 # ----------------------------------------------------------- task phases

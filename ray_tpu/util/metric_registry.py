@@ -1,0 +1,100 @@
+"""Single registry of the runtime's built-in metric names.
+
+Every ``ray_tpu_*`` metric the runtime emits is declared HERE and only
+here — runtime modules import the constants instead of spelling the
+string at the record site.  ``raylint`` rule **RTL004** enforces this:
+a ``ray_tpu_*`` string literal anywhere else in the package is a lint
+violation, and every name declared here must be documented in
+``docs/observability.md``.  One registry means the exposition surface
+(``/metrics``, ``metrics.snapshot()``) can be enumerated without
+grepping the runtime, and a renamed or deleted metric fails lint
+instead of silently orphaning its dashboard.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+# --------------------------------------------------------- task lifecycle
+TASK_PHASE_HIST = "ray_tpu_task_phase_s"
+BACKPRESSURE_WAIT_HIST = "ray_tpu_backpressure_wait_s"
+BACKPRESSURE_BLOCKED_TOTAL = "ray_tpu_backpressure_blocked_total"
+TASK_EVENTS_DROPPED_TOTAL = "ray_tpu_task_events_dropped_total"
+
+# ------------------------------------------------------------ collectives
+COLLECTIVE_OPS_TOTAL = "ray_tpu_collective_ops_total"
+COLLECTIVE_BYTES_TOTAL = "ray_tpu_collective_bytes_total"
+COLLECTIVE_DURATION_HIST = "ray_tpu_collective_duration_s"
+COLLECTIVE_BANDWIDTH_HIST = "ray_tpu_collective_bandwidth_bytes_per_s"
+ICI_SCALING_EFFICIENCY = "ray_tpu_ici_scaling_efficiency"
+
+# ----------------------------------------------------------- object store
+OBJECT_STORE_FULL_ERRORS_TOTAL = "ray_tpu_object_store_full_errors_total"
+OBJECT_STORE_SPILL_BYTES_TOTAL = "ray_tpu_object_store_spill_bytes_total"
+OBJECT_STORE_SPILL_RECLAIMED_TOTAL = (
+    "ray_tpu_object_store_spill_reclaimed_bytes_total"
+)
+OBJECT_STORE_LRU_EVICTIONS_TOTAL = "ray_tpu_object_store_lru_evictions_total"
+OBJECT_STORE_USED_BYTES = "ray_tpu_object_store_used_bytes"
+OBJECT_STORE_CAPACITY_BYTES = "ray_tpu_object_store_capacity_bytes"
+OBJECT_STORE_NUM_OBJECTS = "ray_tpu_object_store_num_objects"
+OBJECT_STORE_SPILL_TIER_BYTES = "ray_tpu_object_store_spill_tier_bytes"
+OBJECT_STORE_SPILL_TIER_OBJECTS = "ray_tpu_object_store_spill_tier_objects"
+
+# ------------------------------------------------------------- scheduling
+LEASE_GRANT_WAIT_HIST = "ray_tpu_lease_grant_wait_s"
+LEASE_QUEUE_DEPTH = "ray_tpu_lease_queue_depth"
+LEASES_HELD = "ray_tpu_leases_held"
+
+# ------------------------------------------------- runtime self-diagnosis
+EXCEPTION_SUPPRESSED_TOTAL = "ray_tpu_exception_suppressed_total"
+DEBUG_LOCK_CYCLES_TOTAL = "ray_tpu_debug_lock_cycles_total"
+DEBUG_LOCK_HELD_WAIT_HIST = "ray_tpu_debug_lock_held_blocked_wait_s"
+
+# Name -> one-line description.  ``raylint`` checks each key appears in
+# docs/observability.md; ``registered_names()`` is the enumeration API.
+METRICS: Dict[str, str] = {
+    TASK_PHASE_HIST: "executor-side task phase durations (histogram)",
+    BACKPRESSURE_WAIT_HIST: "submission backpressure block time (histogram)",
+    BACKPRESSURE_BLOCKED_TOTAL: "submissions that blocked on the task-queue "
+                                "memory cap",
+    TASK_EVENTS_DROPPED_TOTAL: "task events lost to flush failure or "
+                               "buffer shedding",
+    COLLECTIVE_OPS_TOTAL: "collective ops executed, by op/backend",
+    COLLECTIVE_BYTES_TOTAL: "collective payload bytes, by op/backend",
+    COLLECTIVE_DURATION_HIST: "collective op duration (histogram)",
+    COLLECTIVE_BANDWIDTH_HIST: "achieved collective bandwidth (histogram)",
+    ICI_SCALING_EFFICIENCY: "calibrated partition-retention ratio per mesh "
+                            "size",
+    OBJECT_STORE_FULL_ERRORS_TOTAL: "ObjectStoreFullError occurrences",
+    OBJECT_STORE_SPILL_BYTES_TOTAL: "bytes ever written to the spill tier",
+    OBJECT_STORE_SPILL_RECLAIMED_TOTAL: "spill-tier bytes reclaimed by "
+                                        "refcount frees",
+    OBJECT_STORE_LRU_EVICTIONS_TOTAL: "sealed objects LRU-evicted from the "
+                                      "arena",
+    OBJECT_STORE_USED_BYTES: "arena bytes in use (gauge)",
+    OBJECT_STORE_CAPACITY_BYTES: "arena capacity (gauge)",
+    OBJECT_STORE_NUM_OBJECTS: "sealed objects resident in the arena (gauge)",
+    OBJECT_STORE_SPILL_TIER_BYTES: "bytes currently on the disk spill tier "
+                                   "(gauge)",
+    OBJECT_STORE_SPILL_TIER_OBJECTS: "objects currently on the disk spill "
+                                     "tier (gauge)",
+    LEASE_GRANT_WAIT_HIST: "lease request wait until grant/spillback/retry "
+                           "(histogram)",
+    LEASE_QUEUE_DEPTH: "lease requests parked on the node agent (gauge)",
+    LEASES_HELD: "leases currently held by the node agent (gauge)",
+    EXCEPTION_SUPPRESSED_TOTAL: "intentionally suppressed exceptions, by "
+                                "site (RTL003 accounting)",
+    DEBUG_LOCK_CYCLES_TOTAL: "lock-order cycles detected by DebugLock "
+                             "(potential deadlocks)",
+    DEBUG_LOCK_HELD_WAIT_HIST: "time blocked acquiring a lock while already "
+                               "holding another (histogram)",
+}
+
+
+def registered_names() -> frozenset:
+    return frozenset(METRICS)
+
+
+def is_registered(name: str) -> bool:
+    return name in METRICS

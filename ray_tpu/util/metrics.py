@@ -19,6 +19,11 @@ from typing import Callable, Dict, Optional, Sequence, Tuple
 _REGISTRY_NS = "metrics"
 _FLUSH_INTERVAL_S = 2.0
 
+# Deliberately a RAW lock, never debug_locks.make_lock: DebugLock's own
+# instrumentation records histograms through _record -> `with _lock:`,
+# so an instrumented registry lock would re-enter itself and deadlock
+# the process exactly when RAY_TPU_DEBUG_LOCKS=1.  This lock is a leaf
+# by construction — nothing is acquired under it.
 _lock = threading.Lock()
 _local: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], dict] = {}
 _dirty = False
@@ -131,7 +136,7 @@ async def _kv_put_async(w, payload: dict):
             {"namespace": _REGISTRY_NS, "key": f"worker:{w.worker_id.hex()}",
              "value": payload, "overwrite": True},
         )
-    except Exception:  # noqa: BLE001 — metrics are best-effort
+    except Exception:  # raylint: waive[RTL003] metrics are best-effort
         pass
 
 
@@ -166,7 +171,7 @@ def _maybe_flush(force: bool = False):
             running.create_task(_kv_put_async(w, payload))
         else:
             w.kv_put(_REGISTRY_NS, f"worker:{w.worker_id.hex()}", payload)
-    except Exception:
+    except Exception:  # raylint: waive[RTL003] flush is best-effort and cannot count via itself
         pass
 
 

@@ -55,7 +55,8 @@ class AsyncResult:
         # Resolve in the background so ready()/callbacks work without a
         # .get() caller; one daemon thread per in-flight batch is bounded
         # by the pool's dispatch depth.
-        threading.Thread(target=self._wait, daemon=True).start()
+        threading.Thread(target=self._wait, daemon=True,
+                         name="mp-result-wait").start()
 
     def _wait(self):
         try:
@@ -70,7 +71,7 @@ class AsyncResult:
             if self._error_callback is not None:
                 try:
                     self._error_callback(e)
-                except Exception:  # noqa: BLE001 — stdlib swallows these
+                except Exception:  # raylint: waive[RTL003] stdlib Pool swallows these
                     pass
             return
         self._value = value
@@ -81,7 +82,7 @@ class AsyncResult:
         if self._callback is not None:
             try:
                 self._callback(value)
-            except Exception:  # noqa: BLE001
+            except Exception:  # raylint: waive[RTL003] callback errors must not poison the result
                 pass
 
     def _unregister(self):
@@ -221,7 +222,7 @@ class Pool:
         for a in self._actors:
             try:
                 ray_tpu.kill(a)
-            except Exception:  # noqa: BLE001 — already dead
+            except Exception:  # raylint: waive[RTL003] already dead
                 pass
         self._actors = []
 

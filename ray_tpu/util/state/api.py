@@ -183,7 +183,7 @@ def summarize_task_phases(address: Optional[str] = None) -> Dict[str, Any]:
         # Push this process's unflushed phase rows out before asking.
         try:
             worker._run_sync(worker.task_events.flush(), timeout=5)
-        except Exception:  # noqa: BLE001 — summary stays best-effort
+        except Exception:  # raylint: waive[RTL003] summary stays best-effort
             pass
     reply = StateApiClient(address).list_task_events(limit=100000)
     by_phase: Dict[str, List[float]] = {}

@@ -27,7 +27,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 # (trace_id, span_id) of the currently active span in THIS process/task.
 _current: contextvars.ContextVar[Optional[Tuple[str, str]]] = (
-    contextvars.ContextVar("ray_tpu_trace_ctx", default=None)
+    contextvars.ContextVar("rtpu_trace_ctx", default=None)
 )
 
 

@@ -208,3 +208,17 @@ def test_tpu_util_helpers(cluster):
 
     assert tpu.get_num_tpu_chips_on_node() >= 0
     assert tpu.get_current_pod_worker_count() >= 1
+
+
+def test_util_package_lazy_attrs():
+    """PEP 562 lazy init must preserve the public attribute surface the
+    eager imports used to provide, including submodule access."""
+    import ray_tpu.util as u
+
+    assert u.Queue is not None and u.ActorPool is not None
+    assert u.queue.Queue is u.Queue
+    assert u.actor_pool.ActorPool is u.ActorPool
+    assert hasattr(u.state, "summarize_task_phases")
+    assert hasattr(u.tpu, "__name__")
+    with pytest.raises(AttributeError):
+        u.no_such_attr
