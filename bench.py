@@ -478,6 +478,23 @@ def run_control_plane_suite():
             "n_n_actor_calls_100kb_payload_async",
             best_of(3, nn_with_payload), "calls/s",
         )
+
+        # Same 100 KB fanned out BY REF: one put, every call passes the
+        # ObjectRef.  Executors resolve the borrowed ref through the
+        # batched-get/location-cache path and memoize it, so this
+        # measures ref-passing fanout against the payload-copy fanout
+        # above (uncompared: no reference-Ray counterpart stage).
+        def fanout_payload(n=400):
+            xref = ray_tpu.put(arg)
+            t0 = time.perf_counter()
+            refs = [sinks[i % 4].sink.remote(xref) for i in range(n)]
+            ray_tpu.get(refs, timeout=300)
+            return n / (time.perf_counter() - t0)
+
+        emit(
+            "fanout_actor_calls_100kb_per_s", best_of(3, fanout_payload),
+            "calls/s",
+        )
         for s in sinks:
             ray_tpu.kill(s)
 
@@ -575,6 +592,28 @@ def run_control_plane_suite():
             return n / (time.perf_counter() - t0)
 
         emit("single_client_get_calls_cached", get_cached(len(refs)), "ops/s")
+
+        # Batched borrowed-ref resolution: N refs owned by ONE remote
+        # actor resolve through a single get_object_batch RPC (inline
+        # entries), not N owner round-trips.  Fresh refs per trial so the
+        # borrower memo can't serve them (uncompared: no reference-Ray
+        # counterpart stage).
+        @ray_tpu.remote
+        class RefFactory:
+            def make(self, n):
+                return [ray_tpu.put(i) for i in range(n)]
+
+        rf = RefFactory.remote()
+        ray_tpu.get(ray_tpu.get(rf.make.remote(50), timeout=120), timeout=120)
+
+        def get_batch(n=2000):
+            refs = ray_tpu.get(rf.make.remote(n), timeout=300)
+            t0 = time.perf_counter()
+            ray_tpu.get(refs, timeout=300)
+            return n / (time.perf_counter() - t0)
+
+        emit("get_batch_refs_per_s", best_of(3, get_batch), "refs/s")
+        ray_tpu.kill(rf)
 
         # put bandwidth (shared-memory store) — the reference workload:
         # one 800 MB np.zeros int64 array per put (ray_perf.py:120).

@@ -62,9 +62,15 @@ from .rpc import (
     RpcTimeoutError,
 )
 from .serialization import (
+    SerializedPayload,
     deserialize_from_bytes,
+    deserialize_payload,
     dumps_function,
+    is_plain_data,
     loads_function,
+    oob_bytes,
+    payload_nbytes,
+    serialize_payload,
     serialize_to_bytes,
 )
 from .task_spec import ActorSpec, ObjectRef, TaskSpec, _RefMarker, function_key
@@ -145,6 +151,74 @@ def set_global_worker(w: Optional["CoreWorker"]):
 PENDING, READY, ERROR = "PENDING", "READY", "ERROR"
 
 _EMPTY_ARGS_PAYLOAD: Optional[bytes] = None
+
+
+def _inline_to_bytes(payload) -> bytes:
+    """Normalize a received inline value to owned flat bytes.  Out-of-band
+    reply shapes (SerializedPayload / memoryview) reference the transport
+    read buffer — persisting them in an OwnedObject would pin the whole
+    frame for the object's lifetime."""
+    if type(payload) is SerializedPayload:
+        return payload.to_bytes()
+    if type(payload) is memoryview:
+        return bytes(payload)
+    return payload
+
+
+class _LocationCache:
+    """Per-worker ``object_id -> shm locations`` cache consulted before any
+    borrowed-ref owner round-trip, so repeated gets of stable objects skip
+    the owner entirely (the deserialized-value memo in ``memory_store``
+    only covers values this process already materialized).
+
+    Entries carry the cache *generation* at fill time: any observed fetch
+    failure bumps the generation, so a fill racing an invalidation (an
+    owner reply that was in flight when the loss was noticed) is dropped
+    instead of resurrecting dead locations.  Loop-thread only."""
+
+    __slots__ = (
+        "_entries", "capacity", "generation",
+        "hits", "misses", "invalidations",
+    )
+
+    def __init__(self, capacity: int = 4096):
+        from collections import OrderedDict
+
+        self._entries: "OrderedDict[ObjectID, list]" = OrderedDict()
+        self.capacity = capacity
+        self.generation = 0
+        self.hits = 0
+        self.misses = 0
+        self.invalidations = 0
+
+    def lookup(self, oid: ObjectID):
+        entry = self._entries.get(oid)
+        if entry is None:
+            self.misses += 1
+            return None
+        self._entries.move_to_end(oid)
+        self.hits += 1
+        return entry
+
+    def fill(self, oid: ObjectID, locations, gen: int):
+        if gen != self.generation:
+            return  # a loss was observed while this reply was in flight
+        self._entries[oid] = list(locations)
+        self._entries.move_to_end(oid)
+        while len(self._entries) > self.capacity:
+            self._entries.popitem(last=False)
+
+    def invalidate(self, oid: ObjectID):
+        """A fetch through these locations failed (or the owner pruned
+        them): drop the entry and fence in-flight fills."""
+        self.generation += 1
+        self.invalidations += 1
+        self._entries.pop(oid, None)
+
+    def drop(self, oid: ObjectID):
+        # Free-path removal — no loss observed, in-flight fills of other
+        # objects stay valid, so the generation does not move.
+        self._entries.pop(oid, None)
 
 
 class _BatchedCompleter:
@@ -965,6 +1039,11 @@ class CoreWorker:
         # token -> (timer handle, fn): grace-delayed ref ops, flushed
         # immediately at shutdown (see _delay_refop).
         self._delayed_refops: Dict[object, tuple] = {}
+        # Data-plane fast path state: borrowed-object location cache +
+        # batched-get counters (published by the flight recorder flush).
+        self._loc_cache = _LocationCache()
+        self._batch_get_calls = 0
+        self._batch_get_refs = 0
 
     def _post(self, cb) -> None:
         """Run ``cb()`` on the protocol loop; bursts coalesce into a single
@@ -1187,6 +1266,12 @@ class CoreWorker:
         coroutine — bypasses the blocking kv_put bridge)."""
         from ray_tpu.util import metrics as _metrics
 
+        try:
+            # Fold the data-plane fast-path counters (framing/batch-get/
+            # location-cache ints) into the registry before snapshotting.
+            _fr().record_data_plane(self)
+        except Exception as e:
+            logger.debug("data-plane counter publish failed: %s", e)
         payload = _metrics.payload_snapshot()
         if payload is not None and self.cp is not None:
             await _metrics._kv_put_async(self, payload)
@@ -1346,33 +1431,56 @@ class CoreWorker:
         # Borrowed object: resolve via the owner.
         if self.memory_store.contains(oid):
             return self.memory_store.peek(oid)
+        return await self._get_borrowed(ref)
+
+    async def _get_borrowed(self, ref: ObjectRef, lost: Optional[list] = None):
+        oid = ref.id
+        cache = self._loc_cache
+        if not lost:
+            # Location-cache fast path: a stable shm object fetches with
+            # zero owner round-trips after the first resolution.
+            cached = cache.lookup(oid)
+            if cached is not None:
+                try:
+                    return await self._fetch_from_locations(oid, cached)
+                except Exception as fetch_exc:  # noqa: BLE001 — any miss falls to the owner
+                    cache.invalidate(oid)
+                    lost = list(getattr(fetch_exc, "failed_locations", ()))
+        lost = lost or []
         owner = self.worker_clients.get(ref.owner_address)
-        lost: list = []
         for attempt in range(GlobalConfig.max_object_reconstructions + 1):
             # The owner's handler blocks until the producing task finishes
             # (and reconstructs lost values) — don't let the default RPC
-            # deadline fire.
+            # deadline fire.  Record the generation BEFORE the call: a
+            # loss observed while the reply is in flight must fence the
+            # fill below.
+            gen = cache.generation
             reply = await owner.call(
                 "get_object", {"object_id": oid, "lost_locations": lost},
                 timeout=UNBOUNDED,
             )
             kind = reply["kind"]
             if kind == "inline":
-                value = deserialize_from_bytes(reply["payload"])
+                value = deserialize_payload(reply["payload"])
                 self.memory_store.put(oid, value)
                 return value
             if kind == "error":
-                raise deserialize_from_bytes(reply["payload"])
+                raise deserialize_payload(reply["payload"])
+            cache.fill(oid, reply["locations"], gen)
             try:
                 # shm: fetch via local agent (zero-copy if node-local)
                 return await self._fetch_from_locations(
                     oid, reply["locations"]
                 )
             except Exception as fetch_exc:  # noqa: BLE001
-                # Report the dead copies back to the owner, which prunes
-                # them and reconstructs via lineage (borrower-observed
-                # loss; reference: ownership_object_directory + recovery).
-                lost = reply["locations"]
+                # Report ONLY the copies actually tried and failed back to
+                # the owner, which prunes them and reconstructs via
+                # lineage if none remain (borrower-observed loss;
+                # reference: ownership_object_directory + recovery).
+                # Claiming every listed copy died would trigger needless
+                # lineage reconstruction of still-healthy replicas.
+                cache.invalidate(oid)
+                lost = list(getattr(fetch_exc, "failed_locations", ()))
                 if attempt >= GlobalConfig.max_object_reconstructions:
                     raise ObjectLostError(oid.hex(), str(fetch_exc))
         raise ObjectLostError(oid.hex(), "reconstruction attempts exhausted")
@@ -1466,16 +1574,208 @@ class CoreWorker:
     async def _fetch_from_locations(self, oid: ObjectID, locations: List[str]):
         if not locations:
             raise ObjectLostError(oid.hex(), "no locations")
+        # Track which copies this attempt actually touched: on failure the
+        # exception carries them so loss reporting prunes exactly those
+        # (never the untouched replicas).
         if self.agent_address not in locations:
-            src = locations[0]
-            await self.agent.call(
-                "pull_object", {"object_id": oid, "from_agent": src},
-                timeout=GlobalConfig.rpc_call_timeout_s * 4,
-            )
-        loop = asyncio.get_running_loop()
-        value = await loop.run_in_executor(None, self.shm_store.get, oid)
+            tried = (locations[0],)
+        else:
+            tried = (self.agent_address,)
+        try:
+            if self.agent_address not in locations:
+                await self.agent.call(
+                    "pull_object",
+                    {"object_id": oid, "from_agent": locations[0]},
+                    timeout=GlobalConfig.rpc_call_timeout_s * 4,
+                )
+            loop = asyncio.get_running_loop()
+            value = await loop.run_in_executor(None, self.shm_store.get, oid)
+        except BaseException as e:
+            try:
+                e.failed_locations = tried  # type: ignore[attr-defined]
+            except Exception:  # raylint: waive[RTL003] exotic exception refuses attrs; loss report degrades
+                pass
+            raise
         self.memory_store.put(oid, value)
         return value
+
+    async def _fetch_batch(self, items: List[tuple]) -> List[Any]:
+        """Fetch ``[(oid, locations)]`` shm objects as one batch: remote
+        pulls fan in through a single ``pull_objects`` agent RPC, and the
+        local arena reads + deserialization for the whole batch ride ONE
+        executor hop instead of one per object.  Returns a value or the
+        per-object exception in each slot (callers fall back to the
+        robust per-ref path for failed slots)."""
+        pulls = [
+            (oid, locations[0])
+            for oid, locations in items
+            if locations and self.agent_address not in locations
+        ]
+        failures: Dict[ObjectID, BaseException] = {}
+        if pulls:
+            try:
+                reply = await self.agent.call(
+                    "pull_objects", {"items": pulls},
+                    timeout=GlobalConfig.rpc_call_timeout_s * 4,
+                )
+                for (oid, src), err in zip(pulls, reply["errors"]):
+                    if err is not None:
+                        e = ObjectLostError(oid.hex(), err)
+                        e.failed_locations = (src,)  # type: ignore[attr-defined]
+                        failures[oid] = e
+            except RpcRemoteError:
+                # Agent predates the batch RPC: fall back to per-object
+                # pulls (still concurrent).
+                outcomes = await asyncio.gather(
+                    *(
+                        self.agent.call(
+                            "pull_object",
+                            {"object_id": oid, "from_agent": src},
+                            timeout=GlobalConfig.rpc_call_timeout_s * 4,
+                        )
+                        for oid, src in pulls
+                    ),
+                    return_exceptions=True,
+                )
+                for (oid, src), outcome in zip(pulls, outcomes):
+                    if isinstance(outcome, BaseException):
+                        try:
+                            outcome.failed_locations = (src,)  # type: ignore[attr-defined]
+                        except Exception:  # raylint: waive[RTL003] exotic exception refuses attrs
+                            pass
+                        failures[oid] = outcome
+
+        def read_all():
+            out = []
+            for oid, locations in items:
+                failed = failures.get(oid)
+                if failed is not None:
+                    out.append(failed)
+                    continue
+                try:
+                    out.append(self.shm_store.get(oid))
+                except BaseException as e:  # noqa: BLE001 — per-slot isolation
+                    if self.agent_address in locations:
+                        tried = (self.agent_address,)
+                    else:
+                        tried = tuple(locations[:1])
+                    try:
+                        e.failed_locations = tried  # type: ignore[attr-defined]
+                    except Exception:  # raylint: waive[RTL003] exotic exception refuses attrs
+                        pass
+                    out.append(e)
+            return out
+
+        loop = asyncio.get_running_loop()
+        values = await loop.run_in_executor(None, read_all)
+        for (oid, _locations), value in zip(items, values):
+            if not isinstance(value, BaseException):
+                self.memory_store.put(oid, value)
+        return values
+
+    async def _get_batch_from_owner(
+        self, owner_address: str, refs: List[ObjectRef]
+    ) -> List[Any]:
+        """Resolve borrowed refs sharing one owner with a single
+        ``get_object_batch`` RPC (mixed inline/shm/error entries), shm
+        fetches for the batch issued as one concurrent fan-in."""
+        oids = [r.id for r in refs]
+        cache = self._loc_cache
+        self._batch_get_calls += 1
+        self._batch_get_refs += len(refs)
+        owner = self.worker_clients.get(owner_address)
+        gen = cache.generation
+        try:
+            reply = await owner.call(
+                "get_object_batch", {"object_ids": oids}, timeout=UNBOUNDED
+            )
+        except RpcRemoteError:
+            # Owner predates the batch RPC: per-ref resolution.
+            return list(
+                await asyncio.gather(*(self._get_one(r) for r in refs))
+            )
+        entries = reply["entries"]
+        results: List[Any] = [None] * len(refs)
+        fetch_items: List[tuple] = []  # (slot, locations)
+        for i, entry in enumerate(entries):
+            kind = entry["kind"]
+            if kind == "inline":
+                value = deserialize_payload(entry["payload"])
+                self.memory_store.put(oids[i], value)
+                results[i] = value
+            elif kind == "error":
+                raise deserialize_payload(entry["payload"])
+            else:
+                cache.fill(oids[i], entry["locations"], gen)
+                fetch_items.append((i, entry["locations"]))
+        if fetch_items:
+            try:
+                values = await self._fetch_batch(
+                    [(oids[i], locations) for i, locations in fetch_items]
+                )
+            except Exception as batch_exc:  # noqa: BLE001
+                # Transport-level batch failure (pull deadline over N
+                # concurrent pulls, agent reconnect): recover per-ref via
+                # the robust path — it retries, reports losses, and
+                # surfaces the documented error types instead of a raw
+                # transport error aborting the whole get.
+                logger.debug("batched fetch failed, per-ref fallback: %s",
+                             batch_exc)
+                fetched = await asyncio.gather(
+                    *(self._get_borrowed(refs[i]) for i, _ in fetch_items)
+                )
+                for (i, _locations), value in zip(fetch_items, fetched):
+                    results[i] = value
+                return results
+            for (i, _locations), value in zip(fetch_items, values):
+                if isinstance(value, BaseException):
+                    # Slot failed: retry via the robust per-ref path,
+                    # reporting exactly the copies that failed.
+                    cache.invalidate(oids[i])
+                    results[i] = await self._get_borrowed(
+                        refs[i],
+                        lost=list(getattr(value, "failed_locations", ())),
+                    )
+                else:
+                    results[i] = value
+        return results
+
+    async def _get_many(self, refs: List[ObjectRef]) -> List[Any]:
+        """Resolve many refs concurrently.  Borrowed refs are grouped by
+        owner into one vectorized ``get_object_batch`` call per owner —
+        an N-ref get costs one round-trip per owner, not N."""
+        results: List[Any] = [None] * len(refs)
+        owner_groups: Dict[str, List[int]] = {}
+        coros: List = []
+        slots: List[tuple] = []
+        for i, ref in enumerate(refs):
+            if ref.owner_address == self.address:
+                coros.append(self._get_one(ref))
+                slots.append((i,))
+            elif self.memory_store.contains(ref.id):
+                results[i] = self.memory_store.peek(ref.id)
+            else:
+                owner_groups.setdefault(ref.owner_address, []).append(i)
+        for owner_address, idxs in owner_groups.items():
+            if len(idxs) == 1:
+                coros.append(self._get_one(refs[idxs[0]]))
+                slots.append((idxs[0],))
+            else:
+                coros.append(
+                    self._get_batch_from_owner(
+                        owner_address, [refs[i] for i in idxs]
+                    )
+                )
+                slots.append(tuple(idxs))
+        if coros:
+            outs = await asyncio.gather(*coros)
+            for slot, out in zip(slots, outs):
+                if len(slot) == 1:
+                    results[slot[0]] = out
+                else:
+                    for j, i in enumerate(slot):
+                        results[i] = out[j]
+        return results
 
     _GET_MISS = object()  # sentinel: fast path can't serve, use the loop
 
@@ -1527,8 +1827,21 @@ class CoreWorker:
                 value = deserialize_from_bytes(obj.inline_payload)
                 self.memory_store.put(oid, value)
                 out.append(value)
+            elif self.agent_address in obj.locations:
+                # Locally-available shm object: read + deserialize HERE,
+                # on the user thread — no protocol-loop round trip and no
+                # executor handoff (those two wakeups dominated repeated
+                # gets of stable shm objects).  The arena is cross-process
+                # locked and acquire() pins the block, so a user-thread
+                # read is as safe as the loop's executor read.
+                try:
+                    value = self.shm_store.get(oid)
+                except Exception:  # noqa: BLE001 — evicted/spill race: full path recovers
+                    return self._GET_MISS
+                self.memory_store.put(oid, value)
+                out.append(value)
             else:
-                return self._GET_MISS  # shm / remote locations
+                return self._GET_MISS  # remote locations / reconstruction
         return out
 
     def get(self, refs, timeout: Optional[float] = None):
@@ -1546,15 +1859,16 @@ class CoreWorker:
             timeout = max(0.001, deadline - time.monotonic())
 
         async def get_all():
-            # Resolve concurrently: remote-owner round-trips and shm pulls
-            # overlap instead of summing.  One deadline timer covers the
-            # whole batch (not one per ref) — same semantics, since every
-            # ref resolves concurrently under the same timeout.
-            gathered = asyncio.gather(*(self._get_one(r) for r in refs))
+            # Resolve concurrently: borrowed refs group into one batched
+            # owner call per owner (see _get_many), and remote-owner
+            # round-trips / shm pulls overlap instead of summing.  One
+            # deadline timer covers the whole batch (not one per ref) —
+            # same semantics, since every ref resolves concurrently under
+            # the same timeout.
             if timeout is None:
-                return await gathered
+                return await self._get_many(refs)
             try:
-                return await asyncio.wait_for(gathered, timeout)
+                return await asyncio.wait_for(self._get_many(refs), timeout)
             except asyncio.TimeoutError:
                 raise GetTimeoutError(
                     f"get() timed out on {len(refs)} object(s)"
@@ -1564,21 +1878,49 @@ class CoreWorker:
         return results[0] if single else results
 
     # ----------------------------------------------------------------- wait
-    async def _ready_probe(self, ref: ObjectRef) -> bool:
-        oid = ref.id
-        if ref.owner_address == self.address:
-            obj = self.owned.get(oid)
-            if obj is None:
-                return self.memory_store.contains(oid)
-            return obj.event.is_set()
-        if self.memory_store.contains(oid):
-            return True
-        owner = self.worker_clients.get(ref.owner_address)
-        try:
-            reply = await owner.call("probe_object", {"object_id": oid})
-            return reply["ready"]
-        except Exception:
-            return True  # owner gone: surface via get()
+    async def _probe_many(self, refs: List[ObjectRef]) -> List[bool]:
+        """Readiness probes with the same owner-grouping as _get_many: one
+        probe_object_batch RPC per owner per poll pass, not one per ref."""
+        out = [False] * len(refs)
+        remote: Dict[str, List[int]] = {}
+        for i, ref in enumerate(refs):
+            oid = ref.id
+            if ref.owner_address == self.address:
+                obj = self.owned.get(oid)
+                out[i] = (
+                    self.memory_store.contains(oid)
+                    if obj is None
+                    else obj.event.is_set()
+                )
+            elif self.memory_store.contains(oid):
+                out[i] = True
+            else:
+                remote.setdefault(ref.owner_address, []).append(i)
+
+        async def probe_owner(owner_address: str, idxs: List[int]):
+            owner = self.worker_clients.get(owner_address)
+            try:
+                if len(idxs) == 1:
+                    reply = await owner.call(
+                        "probe_object", {"object_id": refs[idxs[0]].id}
+                    )
+                    flags = [reply["ready"]]
+                else:
+                    reply = await owner.call(
+                        "probe_object_batch",
+                        {"object_ids": [refs[i].id for i in idxs]},
+                    )
+                    flags = reply["ready"]
+            except Exception:  # noqa: BLE001
+                flags = [True] * len(idxs)  # owner gone: surface via get()
+            for i, flag in zip(idxs, flags):
+                out[i] = flag
+
+        if remote:
+            await asyncio.gather(
+                *(probe_owner(a, idxs) for a, idxs in remote.items())
+            )
+        return out
 
     def wait(self, refs: List[ObjectRef], num_returns=1, timeout=None):
         async def do_wait():
@@ -1586,9 +1928,10 @@ class CoreWorker:
             ready: List[ObjectRef] = []
             pending = list(refs)
             while len(ready) < num_returns:
+                flags = await self._probe_many(pending)
                 new_pending = []
-                for r in pending:
-                    if await self._ready_probe(r):
+                for r, ok in zip(pending, flags):
+                    if ok:
                         ready.append(r)
                     else:
                         new_pending.append(r)
@@ -1702,6 +2045,9 @@ class CoreWorker:
             self._post(lambda o=oid: self._decr_local(o))
         else:
             def send():
+                # Last borrowed ref gone: its cached locations are dead
+                # weight (and a recycled id must never hit stale entries).
+                self._loc_cache.drop(oid)
                 # Only refs this borrower actually RE-LENT need the grace
                 # delay (the sub-borrower's incref must reach the owner
                 # before our decref); plain borrows decref immediately so
@@ -1814,8 +2160,8 @@ class CoreWorker:
                 return
             ret = payload["ret"]
             if ret[0] == "inline":
-                obj.inline_payload = ret[1]
-                obj.size = len(ret[1])
+                obj.inline_payload = _inline_to_bytes(ret[1])
+                obj.size = len(obj.inline_payload)
             else:
                 obj.locations.add(ret[1])
                 obj.size = ret[2]
@@ -1829,8 +2175,8 @@ class CoreWorker:
             obj = self._new_owned(oid, lineage=state.get("spec"))
         ret = payload["ret"]
         if ret[0] == "inline":
-            obj.inline_payload = ret[1]
-            obj.size = len(ret[1])
+            obj.inline_payload = _inline_to_bytes(ret[1])
+            obj.size = len(obj.inline_payload)
         else:  # ("shm", agent_addr, size)
             obj.locations.add(ret[1])
             obj.size = ret[2]
@@ -1901,15 +2247,26 @@ class CoreWorker:
             self._maybe_free(payload["object_id"])
 
     # ------------------------------------------------- owner serving objects
-    async def handle_get_object(self, payload, conn):
-        oid = payload["object_id"]
+    def _serialize_inline_entry(self, value) -> dict:
+        # Out-of-band inline reply: header + buffers ride the reply frame
+        # as raw segments.  snapshot() detaches buffers aliasing the live
+        # (mutable) memory-store value before the frame flushes.
+        return {
+            "kind": "inline",
+            "payload": serialize_payload(
+                value, prefer_plain=is_plain_data(value)
+            ).snapshot(),
+        }
+
+    async def _get_object_entry(self, oid: ObjectID, lost=()) -> dict:
+        """One owner-side resolution: the per-object body of both
+        ``get_object`` and ``get_object_batch``.  Returns a reply entry —
+        kind 'inline' (payload), 'shm' (locations, size) or 'error'
+        (payload)."""
         obj = self.owned.get(oid)
         if obj is None:
             if self.memory_store.contains(oid):
-                return {
-                    "kind": "inline",
-                    "payload": serialize_to_bytes(self.memory_store.peek(oid)),
-                }
+                return self._serialize_inline_entry(self.memory_store.peek(oid))
             return {
                 "kind": "error",
                 "payload": serialize_to_bytes(
@@ -1920,7 +2277,6 @@ class CoreWorker:
         # Borrower-observed loss: prune the dead copies; reconstruct via
         # lineage if no copy remains (the borrower side of
         # object_recovery_manager.h recovery).
-        lost = payload.get("lost_locations") or ()
         if lost:
             obj.locations -= set(lost)
             if (
@@ -1942,25 +2298,54 @@ class CoreWorker:
         if obj.state == ERROR:
             return {"kind": "error", "payload": serialize_to_bytes(obj.error)}
         if obj.inline_payload is not None:
-            return {"kind": "inline", "payload": obj.inline_payload}
+            # Immutable flat bytes: ship them out of band, zero copies.
+            return {"kind": "inline", "payload": oob_bytes(obj.inline_payload)}
         if obj.locations:
             return {"kind": "shm", "locations": sorted(obj.locations), "size": obj.size}
         # Value only in local memory store (e.g. small put): serialize now.
         if self.memory_store.contains(oid):
-            return {
-                "kind": "inline",
-                "payload": serialize_to_bytes(self.memory_store.peek(oid)),
-            }
+            return self._serialize_inline_entry(self.memory_store.peek(oid))
         return {
             "kind": "error",
             "payload": serialize_to_bytes(ObjectLostError(oid.hex(), "value missing")),
         }
+
+    async def handle_get_object(self, payload, conn):
+        return await self._get_object_entry(
+            payload["object_id"], payload.get("lost_locations") or ()
+        )
+
+    async def handle_get_object_batch(self, payload, conn):
+        """Vectorized borrower resolution: one reply with an entry per
+        requested object (mixed inline/shm/error).  Entries resolve
+        concurrently — each may block on its still-running producing
+        task without holding up the rest."""
+        oids = payload["object_ids"]
+        if not oids:
+            return {"entries": []}
+        lost = payload.get("lost_locations") or {}
+        entries = await asyncio.gather(
+            *(self._get_object_entry(oid, lost.get(oid) or ()) for oid in oids)
+        )
+        return {"entries": list(entries)}
 
     def handle_probe_object(self, payload, conn):
         obj = self.owned.get(payload["object_id"])
         if obj is None:
             return {"ready": self.memory_store.contains(payload["object_id"])}
         return {"ready": obj.event.is_set()}
+
+    def handle_probe_object_batch(self, payload, conn):
+        """Vectorized readiness probes for ray_tpu.wait over many refs."""
+        ready = []
+        for oid in payload["object_ids"]:
+            obj = self.owned.get(oid)
+            ready.append(
+                self.memory_store.contains(oid)
+                if obj is None
+                else obj.event.is_set()
+            )
+        return {"ready": ready}
 
     # ------------------------------------------------------------ cluster KV
     # Public façade over the control plane's KV table (the reference's
@@ -2101,17 +2486,22 @@ class CoreWorker:
 
         for v in list(args) + list(kwargs.values()):
             scan(v, 1)
-        payload = serialize_to_bytes(
+        # Out-of-band payload: the args pickle header and its buffers ride
+        # the push frame as raw segments (rpc._encode_frame) instead of
+        # being flattened into bytes and re-pickled — two fewer
+        # full-payload copies per submission.  snapshot() preserves
+        # capture-at-call-time semantics for mutable buffers (numpy args).
+        payload = serialize_payload(
             (conv_args, conv_kwargs), prefer_plain=plain
-        )
+        ).snapshot()
         return payload, held
 
-    def _charge_submission(self, spec: TaskSpec, payload: bytes):
+    def _charge_submission(self, spec: TaskSpec, payload):
         """Charge this submission against the pending-task memory budget.
         Blocks (backpressure) only when called off the protocol loop — the
         loop itself must stay free to drain the completions that release
         charges."""
-        n = len(payload) + _SubmitBudget.PER_TASK_OVERHEAD
+        n = payload_nbytes(payload) + _SubmitBudget.PER_TASK_OVERHEAD
         try:
             running = asyncio.get_running_loop()
         except RuntimeError:
@@ -2287,8 +2677,8 @@ class CoreWorker:
             if obj is None:
                 obj = self._new_owned(oid)
             if ret[0] == "inline":
-                obj.inline_payload = ret[1]
-                obj.size = len(ret[1])
+                obj.inline_payload = _inline_to_bytes(ret[1])
+                obj.size = len(obj.inline_payload)
             else:  # ("shm", agent_addr, size)
                 obj.locations.add(ret[1])
                 obj.size = ret[2]
@@ -2603,13 +2993,13 @@ class CoreWorker:
         )
 
     # ------------------------------------------------------------ execution
-    async def _resolve_args(self, payload: bytes):
+    async def _resolve_args(self, payload):
         global _EMPTY_ARGS_PAYLOAD
         if _EMPTY_ARGS_PAYLOAD is None:
             _EMPTY_ARGS_PAYLOAD = serialize_to_bytes(([], {}))
-        if payload == _EMPTY_ARGS_PAYLOAD:
+        if type(payload) in (bytes, memoryview) and payload == _EMPTY_ARGS_PAYLOAD:
             return [], {}
-        args, kwargs = deserialize_from_bytes(payload)
+        args, kwargs = deserialize_payload(payload)
 
         # Resolve all distinct markers CONCURRENTLY, one fetch per unique
         # object.  Sequentially awaiting each arg made a wide-args task
@@ -2622,23 +3012,33 @@ class CoreWorker:
             if isinstance(v, _RefMarker):
                 markers.setdefault((v.object_id, v.owner_address), v)
         resolved: Dict[tuple, Any] = {}
-        if len(markers) == 1:
-            # Hot path (one ref arg, e.g. n:n-with-arg calls): skip the
-            # gather machinery.
-            ((key, m),) = markers.items()
+        fetch: Dict[tuple, _RefMarker] = {}
+        for key, m in markers.items():
+            # Memo short-circuit BEFORE creating a worker-bound ref: a
+            # repeatedly-passed arg (n:n-with-arg pattern) resolves from
+            # the local memo without the per-call incref/decref oneway
+            # pair that a live ObjectRef costs (the value needs no borrow
+            # — args_holds on the owner cover the in-flight task).
+            if self.memory_store.contains(m.object_id):
+                resolved[key] = self.memory_store.peek(m.object_id)
+            else:
+                fetch[key] = m
+        if len(fetch) == 1:
+            # Hot path (one ref arg): skip the gather machinery.
+            ((key, m),) = fetch.items()
             resolved[key] = await self._get_one(
                 ObjectRef(m.object_id, m.owner_address, _worker=self)
             )
-        elif markers:
-            values = await asyncio.gather(
-                *(
-                    self._get_one(
-                        ObjectRef(m.object_id, m.owner_address, _worker=self)
-                    )
-                    for m in markers.values()
-                )
+        elif fetch:
+            # Owner-grouped batch resolution: a wide-args task resolves
+            # all refs of one owner with a single get_object_batch RPC.
+            values = await self._get_many(
+                [
+                    ObjectRef(m.object_id, m.owner_address, _worker=self)
+                    for m in fetch.values()
+                ]
             )
-            resolved = dict(zip(markers.keys(), values))
+            resolved.update(zip(fetch.keys(), values))
 
         def resolve(v):
             if isinstance(v, _RefMarker):
@@ -2662,9 +3062,12 @@ class CoreWorker:
         header, views = serialize(value, prefer_plain=is_plain_data(value))
         size = serialized_nbytes(header, views)
         if size <= GlobalConfig.max_inline_object_bytes:
-            buf = bytearray(size)
-            write_serialized(header, views, buf)
-            return ("inline", bytes(buf))
+            # Out-of-band reply payload: header + buffers ride the reply
+            # frame as raw segments (no flat re-encoding, no frame-pickle
+            # copy).  snapshot() detaches buffers that alias user-owned
+            # values — an actor may mutate a returned array after we
+            # queue the reply but before the transport flushes it.
+            return ("inline", SerializedPayload(header, views).snapshot())
         oid = ObjectID.for_task_return(spec.task_id, index)
         loop = asyncio.get_running_loop()
         _, tier = await loop.run_in_executor(

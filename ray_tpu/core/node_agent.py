@@ -1164,25 +1164,65 @@ class NodeAgent:
             return {"exists": False}
         view = self.shm_store.raw_bytes(oid)
         off, length = payload["offset"], payload["length"]
-        return {"exists": True, "total": len(view), "data": bytes(view[off : off + length])}
+        # Out-of-band chunk: the pinned arena view rides the reply frame
+        # as a raw segment — no bytes() copy on the serving agent (the pin
+        # holds the block until the transport flushes the frame).
+        from .serialization import oob_bytes
 
-    async def handle_pull_object(self, payload, conn):
-        """Pull an object from a remote node into local shm (dedup'd)."""
-        oid: ObjectID = payload["object_id"]
+        return {
+            "exists": True,
+            "total": len(view),
+            "data": oob_bytes(view[off : off + length]),
+        }
+
+    async def _pull_into_local(self, oid: ObjectID, from_agent: str):
+        """Dedup'd pull of one object into local shm — the shared body of
+        the single and batch pull RPCs.  Joiners of an in-flight pull are
+        shielded (one requester's cancellation must not kill the pull for
+        the rest) and only the future's owner pops the dedup entry (a
+        cancelled joiner must not evict a still-running pull — a third
+        requester would start a duplicate)."""
         if self.directory.contains(oid):
-            return {"ok": True}
+            return
         fut = self._pull_futures.get(oid)
-        if fut is None:
+        owner_of_fut = fut is None
+        if owner_of_fut:
             fut = asyncio.get_running_loop().create_task(
-                self._do_pull(oid, payload["from_agent"])
+                self._do_pull(oid, from_agent)
             )
             self._pull_futures[oid] = fut
         try:
-            await fut
+            if owner_of_fut:
+                await fut
+            else:
+                await asyncio.shield(fut)
         finally:
-            self._pull_futures.pop(oid, None)
-            self._freed_during_pull.discard(oid)
+            if owner_of_fut:
+                self._pull_futures.pop(oid, None)
+                self._freed_during_pull.discard(oid)
+
+    async def handle_pull_object(self, payload, conn):
+        """Pull an object from a remote node into local shm (dedup'd)."""
+        await self._pull_into_local(payload["object_id"], payload["from_agent"])
         return {"ok": True}
+
+    async def handle_pull_objects(self, payload, conn):
+        """Batch fan-in for the data-plane fast path: pull many objects
+        concurrently (dedup'd against in-flight singles) with per-object
+        failure isolation — one dead source must not fail the batch.
+        Returns ``errors`` aligned with ``items`` (None on success)."""
+
+        async def pull_one(oid: ObjectID, from_agent: str):
+            try:
+                await self._pull_into_local(oid, from_agent)
+                return None
+            except Exception as e:  # noqa: BLE001 — reported per-slot
+                return f"{type(e).__name__}: {e}"
+
+        errors = await asyncio.gather(
+            *(pull_one(oid, src) for oid, src in payload["items"])
+        )
+        return {"errors": list(errors)}
 
     async def _do_pull(self, oid: ObjectID, from_agent: str):
         client = self.agent_clients.get(from_agent)

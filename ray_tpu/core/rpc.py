@@ -8,7 +8,25 @@ event loop (the analog of the reference's one-``instrumented_io_context``-per-
 process discipline), and the hot paths (lease grant, task push) are one
 round-trip with zero protobuf marshalling overhead.
 
-Wire format: [8-byte little-endian length][pickle(frame)]
+Wire format (protocol v2): [8-byte little-endian length][body] where body is
+
+  [1B 0xB2][4B header_len][4B nbufs][nbufs x 8B buf_len][header][buf0][buf1]...
+
+``header`` is the frame tuple pickled with protocol 5 and a
+``buffer_callback`` — every ``pickle.PickleBuffer`` (and buffer-protocol
+object like a numpy array) inside the payload rides *out of band* as a raw
+segment after the header instead of being copied into the pickle stream.
+The write path keeps frames as segment lists flushed with ``writelines``,
+so a large task-arg / object payload is never copied into an intermediate
+bytes object between serialization and the transport.  A body starting
+with 0xB3 is a batch container: [4B count] then ``count`` pre-encoded
+sub-frames, each [8B sub_len][sub_body] — sub-frames are encoded once at
+queue time (exact byte accounting) and never re-pickled at flush.
+Handshake frames (``__hello__``/``__goodbye__``) are always sent as a
+classic protocol-1 body (a plain pickle, first byte 0x80) so ANY peer
+version can parse the negotiation and fail with RpcVersionError instead
+of a frame-corruption crash.
+
   request frame :  (msg_id, method, payload)        msg_id > 0
   oneway frame  :  (0, method, payload)
   reply frame   :  (-msg_id, kind, payload)         kind in ('R', 'E')
@@ -51,8 +69,14 @@ Address = str  # "host:port"
 #   outside the compat window answers ``__goodbye__`` with its own range
 #   and closes, so a mixed-version cluster fails fast with a clear error
 #   instead of corrupting frames.
-PROTOCOL_VERSION = 1
-MIN_COMPAT_VERSION = 1
+#
+# v2 (out-of-band buffer-table bodies, see module docstring) is not
+# parseable by v1 peers, and hellos are pipelined ahead of real frames —
+# so MIN_COMPAT_VERSION moves with it.  The handshake itself stays in the
+# v1 body format forever (see _encode_frame_v1), which is what turns a
+# mixed-version pairing into a clean RpcVersionError on both sides.
+PROTOCOL_VERSION = 2
+MIN_COMPAT_VERSION = 2
 
 # Sentinel timeout meaning "no per-call timer": the call completes when the
 # reply arrives or the connection dies (read-loop failure fails the future).
@@ -155,18 +179,112 @@ def find_free_port(host: str = "127.0.0.1") -> int:
 
 
 _LEN = 8
+_MAGIC_FRAME = 0xB2  # v2 single frame with out-of-band buffer table
+_MAGIC_BATCH = 0xB3  # v2 batch container of pre-encoded sub-frames
+_PICKLE_PROTO = 0x80  # classic pickle body (handshake frames, v1 peers)
+
+# Data-plane frame accounting, published as ray_tpu_* counters by the
+# flight recorder's observability flush (this module stays import-leaf).
+FRAME_STATS = {
+    "oob_frames": 0,     # frames carrying >=1 out-of-band buffer
+    "oob_bytes": 0,      # payload bytes that skipped the pickle stream
+    "batch_frames": 0,   # batch containers written
+    "batched_calls": 0,  # calls multiplexed into batch containers
+}
+
+
+def _encode_frame(frame) -> Tuple[list, int]:
+    """Encode one frame as ``(segments, nbytes)``.
+
+    ``segments[0]`` is one bytearray holding the outer length prefix, the
+    fixed meta, the buffer-length table, and the pickle header; each
+    out-of-band buffer follows as its own memoryview segment, referencing
+    the caller's memory — flushed via ``writelines`` without ever being
+    copied into an intermediate frame buffer.  ``nbytes`` is the total
+    wire size including the 8-byte length prefix (exact, not estimated —
+    the batch flusher budgets with it)."""
+    bufs: list = []
+    header = pickle.dumps(frame, protocol=5, buffer_callback=bufs.append)
+    if not bufs:
+        meta = bytearray(_LEN + 9)
+        body_len = 9 + len(header)
+        meta[0:_LEN] = body_len.to_bytes(_LEN, "little")
+        meta[_LEN] = _MAGIC_FRAME
+        meta[_LEN + 1 : _LEN + 5] = len(header).to_bytes(4, "little")
+        meta += header
+        return [meta], _LEN + body_len
+    views = [b.raw().cast("B") for b in bufs]
+    nbufs = len(views)
+    meta = bytearray(_LEN + 9 + 8 * nbufs)
+    total = 0
+    off = _LEN + 9
+    for v in views:
+        n = v.nbytes
+        meta[off : off + 8] = n.to_bytes(8, "little")
+        off += 8
+        total += n
+    body_len = 9 + 8 * nbufs + len(header) + total
+    meta[0:_LEN] = body_len.to_bytes(_LEN, "little")
+    meta[_LEN] = _MAGIC_FRAME
+    meta[_LEN + 1 : _LEN + 5] = len(header).to_bytes(4, "little")
+    meta[_LEN + 5 : _LEN + 9] = nbufs.to_bytes(4, "little")
+    meta += header
+    FRAME_STATS["oob_frames"] += 1
+    FRAME_STATS["oob_bytes"] += total
+    segments = [meta]
+    segments.extend(views)
+    return segments, _LEN + body_len
+
+
+def _encode_frame_v1(frame) -> bytes:
+    """Classic body: [8B len][pickle(frame)].  Used ONLY for the
+    version handshake — any peer version can parse it."""
+    data = pickle.dumps(frame, protocol=5)
+    return len(data).to_bytes(_LEN, "little") + data
+
+
+def _decode_frame_v2(mv: memoryview):
+    hlen = int.from_bytes(mv[1:5], "little")
+    nbufs = int.from_bytes(mv[5:9], "little")
+    off = 9 + 8 * nbufs
+    header = mv[off : off + hlen]
+    off += hlen
+    buffers = []
+    for i in range(nbufs):
+        n = int.from_bytes(mv[9 + 8 * i : 17 + 8 * i], "little")
+        buffers.append(mv[off : off + n])
+        off += n
+    # Out-of-band buffers load as memoryview slices of the read buffer —
+    # zero receive-side copies; consumers deserialize straight from them.
+    return pickle.loads(header, buffers=buffers)
+
+
+def _decode_body(data: bytes):
+    tag = data[0]
+    if tag == _MAGIC_FRAME:
+        return _decode_frame_v2(memoryview(data))
+    if tag == _MAGIC_BATCH:
+        mv = memoryview(data)
+        count = int.from_bytes(mv[1:5], "little")
+        frames = []
+        off = 5
+        for _ in range(count):
+            sublen = int.from_bytes(mv[off : off + _LEN], "little")
+            off += _LEN
+            frames.append(_decode_frame_v2(mv[off : off + sublen]))
+            off += sublen
+        return (0, "__batch__", frames)
+    if tag == _PICKLE_PROTO:
+        # Handshake frames and v1 peers: a plain pickled tuple.
+        return pickle.loads(data)
+    raise RpcError(f"corrupt frame: unknown body tag {tag:#04x}")
 
 
 async def _read_frame(reader: asyncio.StreamReader):
     hdr = await reader.readexactly(_LEN)
     length = int.from_bytes(hdr, "little")
     data = await reader.readexactly(length)
-    return pickle.loads(data)
-
-
-def _encode_frame(frame) -> bytes:
-    data = pickle.dumps(frame, protocol=5)
-    return len(data).to_bytes(_LEN, "little") + data
+    return _decode_body(data)
 
 
 class RpcServer:
@@ -255,9 +373,12 @@ class RpcServer:
             except Exception:  # noqa: BLE001
                 ver, peer_min = -1, PROTOCOL_VERSION + 1
             if ver < MIN_COMPAT_VERSION or peer_min > PROTOCOL_VERSION:
+                # Legacy body: the refused peer may predate v2 framing and
+                # must still be able to parse the goodbye.
                 conn.send_nowait(
                     (0, "__goodbye__",
-                     (PROTOCOL_VERSION, MIN_COMPAT_VERSION))
+                     (PROTOCOL_VERSION, MIN_COMPAT_VERSION)),
+                    legacy=True,
                 )
                 # Close AFTER the goodbye flushes (both are call_soon'd on
                 # this loop, in order).
@@ -361,32 +482,46 @@ class ServerConnection:
     def __init__(self, reader, writer):
         self._reader = reader
         self._writer = writer
-        self._wbuf = bytearray()
+        # Write queue is a SEGMENT LIST (bytes/memoryviews), not a flat
+        # bytearray: out-of-band payload buffers ride to writelines
+        # untouched instead of being copied into a coalescing buffer.
+        self._wsegs: list = []
+        self._wbytes = 0
         self._flush_scheduled = False
         self._drain_task: Optional[asyncio.Task] = None
         self.closed = False  # set on teardown; grant paths check liveness
         self.metadata: Dict[str, Any] = {}  # handlers can stash identity here
-        self.peer_version = 1  # pre-handshake peers are assumed v1
+        self.peer_version = PROTOCOL_VERSION  # pre-handshake default
 
-    def send_nowait(self, frame):
-        """Queue a frame; flushed on the next loop pass."""
-        self._wbuf += _encode_frame(frame)
+    def send_nowait(self, frame, legacy: bool = False):
+        """Queue a frame; flushed on the next loop pass.  ``legacy`` sends
+        the v1 body format — required for ``__goodbye__``, which must be
+        parseable by the incompatible peer being refused."""
+        if legacy:
+            data = _encode_frame_v1(frame)
+            self._wsegs.append(data)
+            self._wbytes += len(data)
+        else:
+            segs, n = _encode_frame(frame)
+            self._wsegs.extend(segs)
+            self._wbytes += n
         if not self._flush_scheduled:
             self._flush_scheduled = True
             asyncio.get_running_loop().call_soon(self._flush)
 
     def _flush(self):
         self._flush_scheduled = False
-        if not self._wbuf:
+        if not self._wsegs:
             return
         if self._drain_task is not None and not self._drain_task.done():
-            # Transport backed up by a slow peer: keep frames in _wbuf
+            # Transport backed up by a slow peer: keep frames queued
             # (bounded because the server stops reading this connection —
             # see wait_writable) until the drain completes.
             return
-        data, self._wbuf = self._wbuf, bytearray()
+        segs, self._wsegs = self._wsegs, []
+        self._wbytes = 0
         try:
-            self._writer.write(data)
+            self._writer.writelines(segs)
             if self._writer.transport.get_write_buffer_size() > (4 << 20):
                 self._drain_task = asyncio.get_running_loop().create_task(
                     self._await_drain()
@@ -399,7 +534,7 @@ class ServerConnection:
             await self._writer.drain()
         except Exception:  # raylint: waive[RTL003] peer gone; read side closes us
             pass
-        if self._wbuf and not self._flush_scheduled:
+        if self._wsegs and not self._flush_scheduled:
             self._flush_scheduled = True
             asyncio.get_running_loop().call_soon(self._flush)
 
@@ -422,7 +557,7 @@ class ServerConnection:
         # the not-yet-flushed coalescing buffer and the transport's own.
         try:
             if (
-                len(self._wbuf)
+                self._wbytes
                 + self._writer.transport.get_write_buffer_size()
             ) > (4 << 20):
                 self._flush()
@@ -463,9 +598,10 @@ class RpcClient:
         self._writer = None
         self._pending: Dict[int, asyncio.Future] = {}
         self._next_id = 1
-        self._wbuf = bytearray()
+        self._wsegs: list = []
+        self._wbytes = 0
         self._flush_scheduled = False
-        self._batch_buf: list = []
+        self._batch_buf: list = []  # [(segments, nbytes)] — pre-encoded
         self._batch_bytes = 0
         self._batch_scheduled = False
         self._loop = None
@@ -487,50 +623,45 @@ class RpcClient:
             pass
         self._read_task = self._loop.create_task(self._read_loop())
         # Version announcement: pipelined ahead of the first real call, so
-        # negotiation costs zero round-trips.
-        self._write_frame(
+        # negotiation costs zero round-trips.  ALWAYS the v1 body format —
+        # a pre-v2 server must be able to parse it and answer goodbye
+        # instead of choking on a buffer-table body.
+        data = _encode_frame_v1(
             (0, "__hello__", (PROTOCOL_VERSION, MIN_COMPAT_VERSION))
         )
+        self._wsegs.append(data)
+        self._wbytes += len(data)
+        if not self._flush_scheduled:
+            self._flush_scheduled = True
+            self._loop.call_soon(self._flush_wbuf)
         return self
 
-    # Outgoing frames coalesce into one buffer flushed once per loop pass —
-    # a burst of calls (pipelined tasks, batched submissions) costs one
-    # write syscall, not one per call.
+    # Outgoing frames coalesce into one segment list flushed once per loop
+    # pass — a burst of calls (pipelined tasks, batched submissions) costs
+    # one writelines, not one write per call, and out-of-band payload
+    # buffers ride to the transport without an intermediate copy.
     def _write_frame(self, frame):
-        self._wbuf += _encode_frame(frame)
+        segs, n = _encode_frame(frame)
+        self._wsegs.extend(segs)
+        self._wbytes += n
         if not self._flush_scheduled:
             self._flush_scheduled = True
             self._loop.call_soon(self._flush_wbuf)
 
     # Transport-level call multiplexing: calls made with batch=True within
-    # one loop pass ride a single __batch__ frame (one pickle, one frame
-    # parse on the server) while keeping fully independent per-call replies
-    # — semantics identical to individual calls.
+    # one loop pass ride a single batch container (one frame parse on the
+    # server) while keeping fully independent per-call replies — semantics
+    # identical to individual calls.  Sub-frames are encoded ONCE at queue
+    # time, so the byte budget below is exact encoded size, not an
+    # estimate (a burst of near-cap frames can no longer overshoot it).
     _BATCH_MAX_FRAMES = 256  # bound un-flushed batch memory before the
     # 4 MB transport backpressure check in call() can see the bytes
-    _BATCH_MAX_BYTES = 4 << 20  # same threshold as the transport check —
-    # 256 frames of ~100KB inline args would otherwise hold ~25MB unseen
-
-    @staticmethod
-    def _approx_frame_bytes(frame) -> int:
-        """Cheap payload-size estimate: the dominant bytes in a batched
-        frame are inline args/returns (bytes) or a TaskSpec's
-        args_payload; everything else is a small envelope."""
-        payload = frame[2]
-        n = 256
-        values = payload.values() if isinstance(payload, dict) else (payload,)
-        for v in values:
-            if isinstance(v, (bytes, bytearray, memoryview)):
-                n += len(v)
-            else:
-                ap = getattr(v, "args_payload", None)
-                if isinstance(ap, (bytes, bytearray, memoryview)):
-                    n += len(ap)
-        return n
+    _BATCH_MAX_BYTES = 4 << 20  # same threshold as the transport check
 
     def _queue_batched(self, frame):
-        self._batch_buf.append(frame)
-        self._batch_bytes += self._approx_frame_bytes(frame)
+        encoded = _encode_frame(frame)
+        self._batch_buf.append(encoded)
+        self._batch_bytes += encoded[1]
         if (
             len(self._batch_buf) >= self._BATCH_MAX_FRAMES
             or self._batch_bytes >= self._BATCH_MAX_BYTES
@@ -543,21 +674,40 @@ class RpcClient:
     def _flush_batch(self):
         self._batch_scheduled = False
         items, self._batch_buf = self._batch_buf, []
-        self._batch_bytes = 0
+        nbytes, self._batch_bytes = self._batch_bytes, 0
         if not items:
             return
         if len(items) == 1:
-            self._write_frame(items[0])
+            segs, n = items[0]
+            self._wsegs.extend(segs)
+            self._wbytes += n
         else:
-            self._write_frame((0, "__batch__", items))
+            # Each pre-encoded sub-frame already starts with its own 8-byte
+            # length — exactly the batch container's sub-entry format, so
+            # flushing is pure concatenation with zero re-pickling.
+            body_len = 5 + nbytes
+            head = bytearray(_LEN + 5)
+            head[0:_LEN] = body_len.to_bytes(_LEN, "little")
+            head[_LEN] = _MAGIC_BATCH
+            head[_LEN + 1 : _LEN + 5] = len(items).to_bytes(4, "little")
+            self._wsegs.append(head)
+            for segs, _n in items:
+                self._wsegs.extend(segs)
+            self._wbytes += _LEN + body_len
+            FRAME_STATS["batch_frames"] += 1
+            FRAME_STATS["batched_calls"] += len(items)
+        if not self._flush_scheduled:
+            self._flush_scheduled = True
+            self._loop.call_soon(self._flush_wbuf)
 
     def _flush_wbuf(self):
         self._flush_scheduled = False
-        if not self._wbuf:
+        if not self._wsegs:
             return
-        data, self._wbuf = self._wbuf, bytearray()
+        segs, self._wsegs = self._wsegs, []
+        self._wbytes = 0
         try:
-            self._writer.write(data)
+            self._writer.writelines(segs)
         except Exception:  # raylint: waive[RTL003] torn down mid-flush; read loop surfaces the failure
             pass
 
@@ -646,9 +796,11 @@ class RpcClient:
         else:
             self._write_frame((msg_id, method, payload))
         if (
-            len(self._wbuf) + self._writer.transport.get_write_buffer_size()
+            self._wbytes + self._batch_bytes
+            + self._writer.transport.get_write_buffer_size()
         ) > (4 << 20):
             try:
+                self._flush_batch()
                 self._flush_wbuf()
                 await self._writer.drain()
             except (ConnectionError, RuntimeError) as e:
@@ -676,7 +828,7 @@ class RpcClient:
             raise RpcConnectionError(f"not connected to {self.address}")
         self._write_frame((0, method, payload))
         if (
-            len(self._wbuf) + self._writer.transport.get_write_buffer_size()
+            self._wbytes + self._writer.transport.get_write_buffer_size()
         ) > (4 << 20):
             try:
                 self._flush_wbuf()

@@ -29,8 +29,10 @@ class TaskSpec:
     function_id: str  # KV key of the exported function
     name: str  # human-readable, for errors/state API
     # Serialized positional/keyword args.  ObjectRefs inside are replaced by
-    # _RefMarker sentinels during serialization (see core_worker).
-    args_payload: bytes
+    # _RefMarker sentinels during serialization (see core_worker).  Either a
+    # flat bytes encoding or a serialization.SerializedPayload whose header
+    # and buffers ride the push frame out of band (framing v2 fast path).
+    args_payload: Any
     num_returns: int = 1
     # Streaming-generator task: yields push to the owner as produced and
     # num_returns is 0 (the executor streams ONLY when the owner opted in
@@ -54,6 +56,39 @@ class TaskSpec:
     # Distributed tracing: (trace_id, span_id) of the submitting span
     # (reference: tracing_helper.py injects the OTel context here).
     trace_ctx: Optional[Tuple[str, str]] = None
+    # Actor method to dispatch (actor tasks; falls back to ``name``).
+    method_name: str = ""
+
+    # Wire-pickled once per task push: tuple state instead of the default
+    # dataclass ``__dict__`` (which re-pickles every field-name string per
+    # frame) — measurably cheaper on the per-call hot path and smaller on
+    # the wire.  Owner-local bookkeeping attrs (``_held_refs``,
+    # ``_queue_charge``, ``_lineage_outstanding``, ...) deliberately do
+    # not travel; the executor re-derives what it needs (``_attempt``,
+    # ``_recv_ts``) from the push payload.  Evolution rule: only APPEND
+    # fields here (zip() tolerates a shorter peer tuple on neither side —
+    # same-version processes only, enforced by the RPC handshake).
+    def __getstate__(self):
+        return (
+            self.task_id, self.job_id, self.function_id, self.name,
+            self.args_payload, self.num_returns, self.streaming,
+            self.resources, self.strategy, self.max_retries,
+            self.retry_exceptions, self.owner_address, self.actor_id,
+            self.actor_creation, self.sequence_number,
+            self.placement_group_id, self.bundle_index, self.env_vars,
+            self.trace_ctx, self.method_name,
+        )
+
+    def __setstate__(self, state):
+        (
+            self.task_id, self.job_id, self.function_id, self.name,
+            self.args_payload, self.num_returns, self.streaming,
+            self.resources, self.strategy, self.max_retries,
+            self.retry_exceptions, self.owner_address, self.actor_id,
+            self.actor_creation, self.sequence_number,
+            self.placement_group_id, self.bundle_index, self.env_vars,
+            self.trace_ctx, self.method_name,
+        ) = state
 
     @property
     def scheduling_class(self) -> Tuple:
@@ -75,7 +110,7 @@ class ActorSpec:
     class_id: str  # KV key of exported class
     name: Optional[str]  # named actor (None = anonymous)
     namespace: str
-    ctor_args_payload: bytes
+    ctor_args_payload: Any  # bytes or serialization.SerializedPayload
     resources: Dict[str, float]
     max_restarts: int
     max_task_retries: int

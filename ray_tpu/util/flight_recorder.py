@@ -50,7 +50,16 @@ from .metric_registry import (  # noqa: F401 — re-exports
     COLLECTIVE_DURATION_HIST,
     COLLECTIVE_OPS_TOTAL,
     EXCEPTION_SUPPRESSED_TOTAL,
+    GET_BATCH_CALLS_TOTAL,
+    GET_BATCH_REFS_TOTAL,
     ICI_SCALING_EFFICIENCY,
+    LOCATION_CACHE_HITS_TOTAL,
+    LOCATION_CACHE_INVALIDATIONS_TOTAL,
+    LOCATION_CACHE_MISSES_TOTAL,
+    RPC_BATCH_FRAMES_TOTAL,
+    RPC_BATCHED_CALLS_TOTAL,
+    RPC_OOB_BYTES_TOTAL,
+    RPC_OOB_FRAMES_TOTAL,
     TASK_EVENTS_DROPPED_TOTAL,
     TASK_PHASE_HIST,
 )
@@ -100,6 +109,43 @@ def count_suppressed(site: str) -> None:
     """Account one intentionally swallowed exception (RTL003): cleanup
     paths that must not raise still leave a per-site counter trail."""
     counter(EXCEPTION_SUPPRESSED_TOTAL, 1.0, {"site": site})
+
+
+# ---------------------------------------------------- data-plane fast path
+# Published as counter DELTAS at each metrics flush (heartbeat + exit):
+# the hot paths themselves bump plain ints (rpc.FRAME_STATS, CoreWorker
+# batch/location-cache fields) so per-get/per-frame cost stays at an
+# integer increment, not a registry lock round trip.
+_dp_published: Dict[str, float] = {}
+
+
+def record_data_plane(worker) -> None:
+    """Publish data-plane fast-path counters accumulated since the last
+    flush: v2-framing out-of-band/batch frame stats plus the worker's
+    batched-get and owner-location-cache accounting."""
+    if not GlobalConfig.enable_flight_recorder:
+        return
+    from ..core.rpc import FRAME_STATS
+
+    cache = getattr(worker, "_loc_cache", None)
+    totals = {
+        RPC_OOB_FRAMES_TOTAL: FRAME_STATS["oob_frames"],
+        RPC_OOB_BYTES_TOTAL: FRAME_STATS["oob_bytes"],
+        RPC_BATCH_FRAMES_TOTAL: FRAME_STATS["batch_frames"],
+        RPC_BATCHED_CALLS_TOTAL: FRAME_STATS["batched_calls"],
+        GET_BATCH_CALLS_TOTAL: getattr(worker, "_batch_get_calls", 0),
+        GET_BATCH_REFS_TOTAL: getattr(worker, "_batch_get_refs", 0),
+        LOCATION_CACHE_HITS_TOTAL: cache.hits if cache else 0,
+        LOCATION_CACHE_MISSES_TOTAL: cache.misses if cache else 0,
+        LOCATION_CACHE_INVALIDATIONS_TOTAL: (
+            cache.invalidations if cache else 0
+        ),
+    }
+    for name, total in totals.items():
+        delta = total - _dp_published.get(name, 0)
+        if delta > 0:
+            _dp_published[name] = total
+            counter(name, delta)
 
 
 # ----------------------------------------------------------- task phases

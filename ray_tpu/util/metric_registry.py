@@ -41,6 +41,19 @@ OBJECT_STORE_NUM_OBJECTS = "ray_tpu_object_store_num_objects"
 OBJECT_STORE_SPILL_TIER_BYTES = "ray_tpu_object_store_spill_tier_bytes"
 OBJECT_STORE_SPILL_TIER_OBJECTS = "ray_tpu_object_store_spill_tier_objects"
 
+# ---------------------------------------------------- data-plane fast path
+GET_BATCH_CALLS_TOTAL = "ray_tpu_get_batch_calls_total"
+GET_BATCH_REFS_TOTAL = "ray_tpu_get_batch_refs_total"
+LOCATION_CACHE_HITS_TOTAL = "ray_tpu_object_location_cache_hits_total"
+LOCATION_CACHE_MISSES_TOTAL = "ray_tpu_object_location_cache_misses_total"
+LOCATION_CACHE_INVALIDATIONS_TOTAL = (
+    "ray_tpu_object_location_cache_invalidations_total"
+)
+RPC_OOB_FRAMES_TOTAL = "ray_tpu_rpc_oob_frames_total"
+RPC_OOB_BYTES_TOTAL = "ray_tpu_rpc_oob_bytes_total"
+RPC_BATCH_FRAMES_TOTAL = "ray_tpu_rpc_batch_frames_total"
+RPC_BATCHED_CALLS_TOTAL = "ray_tpu_rpc_batched_calls_total"
+
 # ------------------------------------------------------------- scheduling
 LEASE_GRANT_WAIT_HIST = "ray_tpu_lease_grant_wait_s"
 LEASE_QUEUE_DEPTH = "ray_tpu_lease_queue_depth"
@@ -79,6 +92,21 @@ METRICS: Dict[str, str] = {
                                    "(gauge)",
     OBJECT_STORE_SPILL_TIER_OBJECTS: "objects currently on the disk spill "
                                      "tier (gauge)",
+    GET_BATCH_CALLS_TOTAL: "vectorized get_object_batch owner RPCs issued",
+    GET_BATCH_REFS_TOTAL: "borrowed refs resolved through batched owner "
+                          "calls",
+    LOCATION_CACHE_HITS_TOTAL: "borrowed gets served from the owner-"
+                               "location cache (no owner round-trip)",
+    LOCATION_CACHE_MISSES_TOTAL: "borrowed gets that consulted the owner "
+                                 "for locations",
+    LOCATION_CACHE_INVALIDATIONS_TOTAL: "location-cache entries dropped on "
+                                        "fetch failure or owner pruning",
+    RPC_OOB_FRAMES_TOTAL: "RPC frames written with out-of-band buffer "
+                          "segments (framing v2)",
+    RPC_OOB_BYTES_TOTAL: "payload bytes that skipped the frame pickle "
+                         "stream (framing v2)",
+    RPC_BATCH_FRAMES_TOTAL: "batch container frames written",
+    RPC_BATCHED_CALLS_TOTAL: "calls multiplexed into batch containers",
     LEASE_GRANT_WAIT_HIST: "lease request wait until grant/spillback/retry "
                            "(histogram)",
     LEASE_QUEUE_DEPTH: "lease requests parked on the node agent (gauge)",

@@ -1,0 +1,248 @@
+"""Data-plane fast path: batched multi-ref get resolution, owner-location
+caching, and the out-of-band payload plumbing (docs/performance.md).
+
+Framing-level v2 tests (buffer-table round trip, batch container byte
+accounting, version handshake) live in tests/test_rpc.py; these cover
+the object-plane semantics on a live single-node cluster.
+"""
+
+import pickle
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core.core_worker import _LocationCache, try_global_worker
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ctx = ray_tpu.init(num_cpus=4)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class Owner:
+    """Remote owner of objects the driver will borrow."""
+
+    def make_small(self, n):
+        return [ray_tpu.put(i) for i in range(n)]
+
+    def make_blob(self, nbytes):
+        return ray_tpu.put(np.zeros(nbytes, np.uint8))
+
+    def make_failed(self):
+        @ray_tpu.remote
+        def boom():
+            raise ValueError("intentional")
+
+        return boom.remote()
+
+    def ping(self):
+        return "ok"
+
+
+def test_get_object_batch_mixed_inline_and_shm(cluster):
+    """One get over many borrowed refs of one owner: inline and shm
+    entries resolve through a single vectorized owner call."""
+    w = try_global_worker()
+    owner = Owner.remote()
+    small_refs = ray_tpu.get(owner.make_small.remote(20), timeout=60)
+    blob_ref = ray_tpu.get(
+        owner.make_blob.remote(256 * 1024), timeout=60  # > inline cap
+    )
+    calls_before = w._batch_get_calls
+    refs_before = w._batch_get_refs
+    values = ray_tpu.get(small_refs + [blob_ref], timeout=60)
+    assert values[:20] == list(range(20))
+    assert values[20].nbytes == 256 * 1024 and not values[20].any()
+    assert w._batch_get_calls == calls_before + 1
+    assert w._batch_get_refs == refs_before + 21
+    ray_tpu.kill(owner)
+
+
+def test_get_object_batch_error_entry_raises(cluster):
+    """A batch containing a failed task's ref surfaces the task error."""
+    owner = Owner.remote()
+    good = ray_tpu.get(owner.make_small.remote(3), timeout=60)
+    bad = ray_tpu.get(owner.make_failed.remote(), timeout=60)
+    with pytest.raises(Exception, match="intentional"):
+        ray_tpu.get(good + [bad], timeout=60)
+    ray_tpu.kill(owner)
+
+
+def test_get_object_batch_empty_and_rpc_shape(cluster):
+    """The owner RPC itself: empty batch returns no entries; mixed oids
+    return per-entry kinds."""
+    w = try_global_worker()
+    owner = Owner.remote()
+    refs = ray_tpu.get(owner.make_small.remote(2), timeout=60)
+    owner_addr = refs[0].owner_address
+    client = w.worker_clients.get(owner_addr)
+    assert w._run_sync(client.call("get_object_batch", {"object_ids": []})) == {
+        "entries": []
+    }
+    reply = w._run_sync(
+        client.call(
+            "get_object_batch",
+            {"object_ids": [refs[0].id, refs[1].id]},
+        )
+    )
+    assert [e["kind"] for e in reply["entries"]] == ["inline", "inline"]
+    ray_tpu.kill(owner)
+
+
+def test_owner_death_mid_batch_surfaces_error(cluster):
+    """Killing the owner between ref creation and the batched get fails
+    the get loudly instead of hanging."""
+    from ray_tpu.core.exceptions import ObjectLostError
+    from ray_tpu.core.rpc import RpcConnectionError
+
+    owner = Owner.remote()
+    refs = ray_tpu.get(owner.make_small.remote(5), timeout=60)
+    ray_tpu.kill(owner)
+    with pytest.raises(
+        (ObjectLostError, RpcConnectionError, ray_tpu.GetTimeoutError, Exception)
+    ):
+        ray_tpu.get(refs, timeout=30)
+
+
+def test_location_cache_hit_and_invalidation_on_loss(cluster):
+    """Repeated borrowed gets of a stable shm object skip the owner via
+    the location cache; a fetch failure invalidates the entry and the
+    robust path reports ONLY the tried locations (the owner then serves
+    its memoized value inline)."""
+    w = try_global_worker()
+    owner = Owner.remote()
+    ref = ray_tpu.get(owner.make_blob.remote(300 * 1024), timeout=60)
+    oid = ref.id
+
+    # First get: owner round-trip fills the cache.
+    v1 = ray_tpu.get(ref, timeout=60)
+    assert v1.nbytes == 300 * 1024
+    assert w._loc_cache.lookup(oid) is not None
+    hits_before = w._loc_cache.hits
+
+    # Second get with the borrower memo dropped: cache hit, no owner call.
+    w.memory_store.free(oid)
+    v2 = ray_tpu.get(ref, timeout=60)
+    assert v2.nbytes == 300 * 1024
+    assert w._loc_cache.hits > hits_before
+
+    # Simulate copy loss: delete the shm copy, drop the memo.  The cached
+    # locations now point at a dead copy — the fetch fails, the entry is
+    # invalidated, and the owner (which memoizes its put values) serves
+    # the value inline after pruning the reported location.
+    w.memory_store.free(oid)
+    w.shm_store.delete(oid)
+    inval_before = w._loc_cache.invalidations
+    v3 = ray_tpu.get(ref, timeout=60)
+    assert v3.nbytes == 300 * 1024
+    assert w._loc_cache.invalidations > inval_before
+    ray_tpu.kill(owner)
+
+
+def test_location_cache_generation_fences_stale_fills():
+    """A fill recorded against a pre-invalidation generation is dropped —
+    an owner reply in flight while a loss was observed cannot resurrect
+    dead locations."""
+    cache = _LocationCache(capacity=4)
+    gen = cache.generation
+    cache.fill("oid1", ["a:1"], gen)
+    assert cache.lookup("oid1") == ["a:1"]
+    cache.invalidate("oid1")
+    assert cache.lookup("oid1") is None
+    cache.fill("oid1", ["a:1"], gen)  # stale: raced the invalidation
+    assert cache.lookup("oid1") is None
+    cache.fill("oid1", ["b:2"], cache.generation)  # fresh fill lands
+    assert cache.lookup("oid1") == ["b:2"]
+    # Bounded: the LRU entry falls out at capacity.
+    for i in range(5):
+        cache.fill(f"x{i}", ["c:3"], cache.generation)
+    assert len(cache._entries) == 4
+
+
+def test_wait_batched_probes_split_ready_pending(cluster):
+    """wait() over many borrowed refs probes per-owner in one batch and
+    still reports the ready/pending split correctly."""
+    import time as _time
+
+    @ray_tpu.remote
+    class Slow:
+        def make(self):
+            @ray_tpu.remote
+            def sleepy():
+                _time.sleep(30)
+                return 1
+
+            return sleepy.remote()
+
+    owner = Owner.remote()
+    slow = Slow.remote()
+    ready_refs = ray_tpu.get(owner.make_small.remote(8), timeout=60)
+    pending_ref = ray_tpu.get(slow.make.remote(), timeout=60)
+    ready, pending = ray_tpu.wait(
+        ready_refs + [pending_ref], num_returns=8, timeout=30
+    )
+    assert set(r.id for r in ready) == set(r.id for r in ready_refs)
+    assert [r.id for r in pending] == [pending_ref.id]
+    ray_tpu.kill(owner)
+    ray_tpu.kill(slow)
+
+
+def test_serialized_payload_roundtrip_shapes():
+    """SerializedPayload survives both pickle paths: protocol 5 with
+    out-of-band buffers (the frame path) and a plain protocol-5 dump
+    (in-band fallback)."""
+    from ray_tpu.core.serialization import (
+        SerializedPayload,
+        deserialize_payload,
+        serialize_payload,
+    )
+
+    value = {"a": np.arange(64 * 1024, dtype=np.uint8), "b": [1, "x"]}
+    sp = serialize_payload(value, prefer_plain=True)
+    assert sp.nbytes > 64 * 1024
+
+    # Frame path: buffers extracted out of band.
+    bufs = []
+    header = pickle.dumps(sp, protocol=5, buffer_callback=bufs.append)
+    assert bufs  # header + views traveled out of band
+    sp2 = pickle.loads(header, buffers=[b.raw() for b in bufs])
+    out = deserialize_payload(sp2)
+    assert np.array_equal(out["a"], value["a"]) and out["b"] == [1, "x"]
+
+    # In-band fallback (no buffer_callback): still round-trips.
+    sp3 = pickle.loads(pickle.dumps(sp, protocol=5))
+    out3 = deserialize_payload(sp3)
+    assert np.array_equal(out3["a"], value["a"])
+
+    # snapshot() detaches mutable views: later source mutation invisible.
+    arr = np.zeros(8192, np.uint8)
+    sp4 = serialize_payload({"arr": arr}, prefer_plain=True).snapshot()
+    arr[:] = 7
+    assert not deserialize_payload(sp4)["arr"].any()
+
+
+def test_data_plane_counters_publish(cluster):
+    """The flight-recorder flush folds the fast-path ints into registered
+    ray_tpu_* counters without touching the hot paths."""
+    from ray_tpu.util import flight_recorder, metric_registry
+    from ray_tpu.util import metrics as _metrics
+
+    w = try_global_worker()
+    owner = Owner.remote()
+    refs = ray_tpu.get(owner.make_small.remote(10), timeout=60)
+    ray_tpu.get(refs, timeout=60)
+    flight_recorder.record_data_plane(w)
+    snap = _metrics.snapshot()
+    names = {ent["name"] for ent in snap.values()}
+    # Batch-get definitely fired above; its counter must be registered
+    # and present after the publish.
+    assert metric_registry.is_registered(
+        metric_registry.GET_BATCH_CALLS_TOTAL
+    )
+    if flight_recorder.enabled():
+        assert metric_registry.GET_BATCH_CALLS_TOTAL in names
+    ray_tpu.kill(owner)

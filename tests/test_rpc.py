@@ -183,6 +183,7 @@ def test_version_handshake_compatible():
 def test_version_handshake_rejects_incompatible():
     """A client announcing a future min-compat version is refused with a
     clear RpcVersionError instead of corrupting frames mid-stream."""
+    from ray_tpu.core import rpc as rpc_mod
     from ray_tpu.core.rpc import RpcVersionError
 
     async def main():
@@ -199,7 +200,113 @@ def test_version_handshake_rejects_incompatible():
         # connection is down and, when the race is won, the error names
         # the server's version window.
         if isinstance(ei.value, RpcVersionError):
-            assert "speaks protocol 1" in str(ei.value)
+            assert f"speaks protocol {rpc_mod.PROTOCOL_VERSION}" in str(ei.value)
         await server.stop()
 
     run(main())
+
+
+def test_v1_peer_refused_with_versioned_goodbye():
+    """A v1 peer (pre-buffer-table framing) announcing itself is refused
+    through the handshake — it receives a __goodbye__ it can parse with
+    its classic pickle reader and surfaces RpcVersionError, never a
+    frame-corruption crash from a v2 body."""
+    import pickle
+
+    from ray_tpu.core import rpc as rpc_mod
+    from ray_tpu.core.rpc import RpcVersionError
+
+    async def main():
+        server = RpcServer(EchoHandler())
+        addr = await server.start()
+        host, port = rpc_mod.parse_address(addr)
+        reader, writer = await asyncio.open_connection(host, port)
+        # Hand-rolled v1 peer: classic [8B len][pickle(frame)] bodies only.
+        hello = pickle.dumps((0, "__hello__", (1, 1)), protocol=5)
+        writer.write(len(hello).to_bytes(8, "little") + hello)
+        await writer.drain()
+        # The goodbye must arrive as a v1 body a v1 peer can parse.
+        hdr = await asyncio.wait_for(reader.readexactly(8), timeout=5)
+        body = await asyncio.wait_for(
+            reader.readexactly(int.from_bytes(hdr, "little")), timeout=5
+        )
+        assert body[0] == 0x80  # classic pickle, not a buffer-table body
+        msg_id, kind, payload = pickle.loads(body)
+        assert kind == "__goodbye__"
+        assert payload == (rpc_mod.PROTOCOL_VERSION, rpc_mod.MIN_COMPAT_VERSION)
+        # ...and the server closes the connection afterwards.
+        assert await asyncio.wait_for(reader.read(8), timeout=5) == b""
+        writer.close()
+        # A real RpcClient forging a v1 announcement gets RpcVersionError.
+        client = await RpcClient(addr).connect()
+        client._wsegs.append(
+            rpc_mod._encode_frame_v1((0, "__hello__", (1, 1)))
+        )
+        client._wbytes += 1
+        with pytest.raises((RpcVersionError, RpcConnectionError)):
+            await client.call("echo", 1, timeout=5)
+        await client.close()
+        await server.stop()
+
+    run(main())
+
+
+def test_v2_framing_oob_buffers_roundtrip_no_copy():
+    """Frames carrying buffer-protocol payloads >= 64 KiB ride out of
+    band: the encoder's segments alias the caller's memory (no
+    intermediate copy — mutating the source after encode is visible in
+    the segment), and the decoder hands back views into the read buffer."""
+    import numpy as np
+
+    from ray_tpu.core import rpc as rpc_mod
+
+    arr = np.arange(128 * 1024, dtype=np.uint8)  # 128 KiB, contiguous
+    segs, nbytes = rpc_mod._encode_frame((7, "echo", {"blob": arr}))
+    assert nbytes == sum(
+        s.nbytes if isinstance(s, memoryview) else len(s) for s in segs
+    )
+    # Exactly one out-of-band segment, aliasing arr's memory.
+    views = [s for s in segs if isinstance(s, memoryview)]
+    assert len(views) == 1 and views[0].nbytes == arr.nbytes
+    arr[0] = 123  # mutation after encode proves the segment is no copy
+    assert views[0][0] == 123
+    wire = b"".join(segs)
+    body = wire[8:]
+    assert body[0] == rpc_mod._MAGIC_FRAME
+    msg_id, method, payload = rpc_mod._decode_body(body)
+    assert (msg_id, method) == (7, "echo")
+    out = payload["blob"]
+    assert out.dtype == np.uint8 and out[0] == 123
+    assert np.array_equal(out, arr)
+    # Zero receive-side copy: the decoded array is backed by the read
+    # buffer, not an owned allocation.
+    assert not out.flags.owndata
+
+
+def test_v2_batch_container_exact_bytes_and_roundtrip():
+    """Batch sub-frames are encoded once at queue time with exact byte
+    accounting, and the container decodes back to the same calls."""
+    from ray_tpu.core import rpc as rpc_mod
+
+    async def main():
+        server = RpcServer(EchoHandler())
+        addr = await server.start()
+        client = await RpcClient(addr).connect()
+        payload = b"x" * (200 * 1024)
+        # Batched calls within one loop pass ride one container frame.
+        results = await asyncio.gather(
+            *[client.call("echo", (i, payload), batch=True) for i in range(8)]
+        )
+        for i, (j, blob) in enumerate(results):
+            assert j == i and bytes(blob) == payload
+        await client.close()
+        await server.stop()
+
+    run(main())
+
+    # Queue-time accounting equals real encoded size (the old estimator
+    # drifted on near-cap frames).
+    encoded = rpc_mod._encode_frame((1, "m", {"payload": b"y" * 1000}))
+    assert encoded[1] == sum(
+        s.nbytes if isinstance(s, memoryview) else len(s) for s in encoded[0]
+    )
