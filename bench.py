@@ -1361,6 +1361,103 @@ def measure_obs_overhead(n_calls=300, trials=3, n_warmup=30):
     }
 
 
+# ------------------------------------------------------ data streaming
+def _data_straggler_walls(rd, n_blocks=10, straggler_s=1.8, per_block_s=0.18):
+    """Ordered-vs-unordered wall time on a straggler-skewed pipeline.
+
+    One slow map task at the head of the stream feeds a consumer that
+    does fixed work per block (a simulated train step — ingest on the
+    step's critical path, the JaxTrainer scenario).  Ordered emission
+    parks the consumer until the straggler lands (wall ~= straggler +
+    n*per_block); unordered keeps it fed (wall ~= max(straggler,
+    n*per_block) + per_block).  Returns both walls and checks the result
+    SETS are identical — the out-of-order win must never change the
+    answer.
+    """
+    import time as _t
+
+    def skew_map(x):
+        _t.sleep(straggler_s if x == 0 else 0.01)
+        return x
+
+    def run(preserve_order):
+        ds = (
+            rd.from_items(list(range(n_blocks)), parallelism=n_blocks)
+            .map(skew_map)
+            .execution_options(preserve_order=preserve_order)
+        )
+        got = []
+        t0 = _t.perf_counter()
+        for block in ds.iter_blocks():
+            _t.sleep(per_block_s)  # simulated per-batch train step
+            got.extend(block)
+        return _t.perf_counter() - t0, sorted(got)
+
+    walls = {}
+    for label, preserve in (("unordered", False), ("ordered", True)):
+        samples = []
+        for _ in range(2):
+            dt, got = run(preserve)
+            assert got == list(range(n_blocks)), got
+            samples.append(dt)
+        walls[label] = min(samples)
+    return walls
+
+
+def run_data_suite():
+    """Streaming data-plane scheduler benchmarks.
+
+    ``data_streaming_rows_per_s`` is the smoke-scale throughput of a
+    fused two-transform task pipeline end to end (read -> map -> filter
+    -> driver consume).  The straggler-skew stage records ordered vs
+    unordered wall time so the out-of-order streaming win is a recorded
+    artifact; the machinery is regression-pinned in
+    tests/test_data_streaming_scheduler.py.
+    """
+    import ray_tpu
+    import ray_tpu.data as rd
+
+    ray_tpu.init(
+        num_cpus=8,
+        _system_config={
+            "prestart_workers": 8,
+            "worker_startup_timeout_s": 240.0,
+        },
+    )
+    try:
+        # Warm the worker pool so the throughput stage measures the
+        # scheduler, not process spawn.
+        rd.range_dataset(16, parallelism=16).map(lambda x: x).take_all()
+
+        n_rows, blocks = 200_000, 16
+        t0 = time.perf_counter()
+        out = (
+            rd.range_dataset(n_rows, parallelism=blocks)
+            .map(lambda x: x + 1)
+            .filter(lambda x: x % 2 == 0)
+            .take_all()
+        )
+        dt = time.perf_counter() - t0
+        assert len(out) == n_rows // 2
+        emit(
+            "data_streaming_rows_per_s", n_rows / dt, "rows/s",
+            blocks=blocks, rows=n_rows,
+        )
+
+        walls = _data_straggler_walls(rd)
+        emit("data_straggler_ordered_s", walls["ordered"], "s")
+        emit("data_straggler_unordered_s", walls["unordered"], "s")
+        speedup = walls["ordered"] / walls["unordered"]
+        emit("data_unordered_speedup", speedup, "x", guard=">=1.5")
+        if speedup < 1.5:
+            print(
+                f"# data_unordered_speedup GUARD MISSED: "
+                f"{speedup:.2f} < 1.5", flush=True,
+            )
+    finally:
+        ray_tpu.shutdown()
+
+
 def run_obs_overhead_suite():
     res = measure_obs_overhead()
     emit(
@@ -1410,6 +1507,8 @@ def main():
             run("limits", run_limits_suite)
         if only in ("all", "obs_overhead"):
             run("obs_overhead", run_obs_overhead_suite)
+        if only in ("all", "data"):
+            run("data", run_data_suite)
         if only in ("all", "scaling"):
             run("scaling", run_scaling_suite)
         if only in ("all", "model"):

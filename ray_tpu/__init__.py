@@ -29,6 +29,7 @@ from .api import (  # noqa: F401
     RemoteFunction,
     SlicePlacementGroup,
     available_resources,
+    cancel,
     cluster_resources,
     get,
     get_actor,
@@ -53,6 +54,7 @@ from .core.exceptions import (  # noqa: F401
     GetTimeoutError,
     ObjectLostError,
     RayTpuError,
+    TaskCancelledError,
     TaskError,
     WorkerCrashedError,
 )

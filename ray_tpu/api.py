@@ -211,6 +211,20 @@ def kill(actor: ActorHandle, *, no_restart: bool = True):
     global_worker().kill_actor(actor._actor_id, no_restart=no_restart)
 
 
+def cancel(refs: Union[ObjectRef, Sequence[ObjectRef]]):
+    """Best-effort cancel of the task(s) producing the given ref(s).
+
+    A task still queued (owner-side lease queue or executor-side pipeline
+    wait) is skipped and its return refs resolve to ``TaskCancelledError``;
+    a task already executing runs to completion and resolves normally; a
+    ref from ``put`` or an actor call is ignored.  Returns immediately —
+    observe the outcome by getting the refs.
+    """
+    if isinstance(refs, ObjectRef):
+        refs = [refs]
+    global_worker().cancel_tasks(list(refs))
+
+
 def get_actor(name: str, namespace: str = "") -> ActorHandle:
     info = global_worker().get_actor_by_name(name, namespace)
     if info is None or info["state"] == "DEAD":

@@ -140,6 +140,7 @@ class RemoteFunction:
             bundle_index=norm["bundle_index"],
             env_vars=norm["env_vars"],
             function_id=function_id,
+            pipeline_depth=self._opts.get("pipeline_depth", 0),
         )
         if num_returns == "streaming":
             return refs  # an ObjectRefGenerator

@@ -137,6 +137,26 @@ _KNOBS: Dict[str, tuple] = {
     "data_memory_budget_fraction": (
         float, 0.5, "Fraction of the shm budget the data pipeline may hold"
     ),
+    "data_output_queue_depth": (
+        int, 16, "Completed-but-unconsumed blocks buffered per streaming "
+        "op before its launches stall (scheduler output bound)"
+    ),
+    "data_target_block_size_bytes": (
+        int, 0, "Dynamic block shaping target: map outputs above it are "
+        "split, undersized runs coalesced before the next exchange "
+        "(0 = shaping off; ExecutionOptions can override per-plan)"
+    ),
+    "data_autoscale_interval_s": (
+        float, 0.1, "Min seconds between actor-pool autoscale decisions"
+    ),
+    "data_autoscale_idle_s": (
+        float, 0.5, "Sustained starvation (idle actor, empty input queue) "
+        "before an autoscaling pool kills an actor above min_size"
+    ),
+    "data_straggler_wait_slice_s": (
+        float, 5.0, "Per-pass bound on the scheduler's blocking "
+        "completion wait (straggler harvest loops, never parks unbounded)"
+    ),
     # -- serve --
     "serve_health_check_timeout_s": (
         float, 10.0, "Per-sweep deadline for replica health replies"

@@ -38,6 +38,7 @@ from .exceptions import (
     ActorDiedError,
     GetTimeoutError,
     ObjectLostError,
+    TaskCancelledError,
     TaskError,
     WorkerCrashedError,
 )
@@ -706,8 +707,12 @@ class _LeasePool:
         self.idle_cancel: Dict[int, asyncio.TimerHandle] = {}
         self.pending_returns: set = set()  # in-flight return_lease RPCs
         # Per-lease pipelining cap; None = the global knob.  Recovery pools
-        # pin it to 1 (see _resubmit_for_recovery).
-        self.max_inflight: Optional[int] = None
+        # pin it to 1 (see _resubmit_for_recovery); tasks submitted with
+        # pipeline_depth carry their own (scheduling_class includes it, so
+        # one pool never mixes depths).
+        self.max_inflight: Optional[int] = (
+            template.pipeline_depth or None
+        )
 
     def submit(self, spec: TaskSpec, attempt: int = 0):
         self.queue.put_nowait((spec, attempt))
@@ -760,11 +765,35 @@ class _LeasePool:
                 self._maybe_request_lease()
                 return
             spec, attempt = self.queue.get_nowait()
+            if getattr(spec, "_cancelled", False):
+                # ray_tpu.cancel: never push it.  A queued-path cancel
+                # already failed the returns, but a pushed-then-resubmitted
+                # spec (worker died after the cancel notify) has not — its
+                # returns still sit in _task_of_return and would hang any
+                # get() forever if dropped silently here.
+                if any(
+                    oid in self.worker._task_of_return
+                    for oid in spec.return_ids()
+                ):
+                    self.worker._fail_task_returns(
+                        spec, TaskCancelledError(spec.name)
+                    )
+                continue
             lease["inflight"] += 1
+            # Recorded synchronously at dispatch (same loop thread as
+            # cancel_tasks): a spec either has a push address or is still
+            # queued — cancel never misses the window in between.
+            spec._pushed_addr = lease["addr"]  # type: ignore[attr-defined]
             timer = self.idle_cancel.pop(lease["lease_id"], None)
             if timer:
                 timer.cancel()
             self._spawn(self._push(lease, spec, attempt))
+        # The queue can drain without a single push (every spec was
+        # cancelled): any lease left idle must still get its idle-return
+        # timer, or it holds a cluster worker slot for the driver's life.
+        for l in self.leases.values():
+            if l["inflight"] == 0 and not l["dead"]:
+                self._arm_idle(l)
 
     def _maybe_request_lease(self):
         if self.requesting:
@@ -875,6 +904,7 @@ class _LeasePool:
                     # A retried generator replays from scratch; drop the
                     # dead attempt's undelivered items + stragglers.
                     self.worker._reset_stream_for_retry(spec.task_id)
+                spec._pushed_addr = None  # re-queued: cancellable again
                 self.submit(spec, attempt + 1)
             else:
                 self.worker._fail_task_returns(
@@ -1044,6 +1074,21 @@ class CoreWorker:
         self._loc_cache = _LocationCache()
         self._batch_get_calls = 0
         self._batch_get_refs = 0
+        # Best-effort task cancellation (ray_tpu.cancel).  Owner side:
+        # return-object id -> live TaskSpec for normal tasks, pruned when
+        # the task reply lands or its returns fail.  Executor side:
+        # _pending_exec_tasks holds ids of pushed-but-not-replied normal
+        # tasks; a cancel notify is recorded in _cancelled_tasks only for
+        # a pending task (push and cancel share one ordered connection,
+        # so an absent id means the task already replied) and is dropped
+        # again when the reply goes out — a stale entry would wrongly
+        # skip a later re-execution of the same task id (retry / lineage
+        # reconstruction).  _cancelled_order bounds the set as a backstop.
+        self._task_of_return: Dict[ObjectID, TaskSpec] = {}
+        self._pending_exec_tasks: Set[TaskID] = set()
+        self._cancelled_tasks: Set[TaskID] = set()
+        self._cancelled_order: deque = deque()
+        self._tasks_cancelled = 0  # owner-side accepted cancels
 
     def _post(self, cb) -> None:
         """Run ``cb()`` on the protocol loop; bursts coalesce into a single
@@ -2579,6 +2624,7 @@ class CoreWorker:
         bundle_index: int = -1,
         env_vars: Optional[Dict[str, str]] = None,
         function_id: Optional[str] = None,
+        pipeline_depth: int = 0,
     ) -> List[ObjectRef]:
         streaming = num_returns == "streaming"
         function_id = function_id or self._export_function(fn)
@@ -2599,6 +2645,7 @@ class CoreWorker:
             bundle_index=bundle_index,
             env_vars=env_vars or {},
             trace_ctx=_tracing_context(),
+            pipeline_depth=pipeline_depth,
         )
         spec._held_refs = held  # type: ignore[attr-defined]
         self._charge_submission(spec, payload)
@@ -2617,6 +2664,9 @@ class CoreWorker:
         for oid in return_ids:
             obj = self._new_owned(oid, lineage=lineage)
             obj.local_refs += 1
+            # Cancellation index (ray_tpu.cancel maps a return ref back to
+            # its producing task); pruned when the task reply lands.
+            self._task_of_return[oid] = spec
 
         def setup():
             self._hold_args(held)
@@ -2647,6 +2697,8 @@ class CoreWorker:
         return refs
 
     def _handle_task_reply(self, spec: TaskSpec, reply: dict):
+        for oid in spec.return_ids():
+            self._task_of_return.pop(oid, None)
         self._release_queue_charge(spec)
         done = self._recovery_waiters.get(spec.task_id)
         if done is not None:
@@ -2687,6 +2739,8 @@ class CoreWorker:
             self._maybe_free(oid)
 
     def _fail_task_returns(self, spec: TaskSpec, exc: BaseException):
+        for oid in spec.return_ids():
+            self._task_of_return.pop(oid, None)
         self._release_queue_charge(spec)
         done = self._recovery_waiters.get(spec.task_id)
         if done is not None:
@@ -2992,6 +3046,84 @@ class CoreWorker:
             )
         )
 
+    # --------------------------------------------------------- cancellation
+    def cancel_tasks(self, refs: List[ObjectRef]) -> None:
+        """Best-effort cancel of the normal tasks producing ``refs``.
+
+        A task still queued owner-side is dequeued and its returns fail
+        with ``TaskCancelledError`` immediately.  A task already pushed
+        gets a one-way cancel notify to its executor, which skips it if it
+        has not started (exec-pipeline / lane queue wait) — the executor's
+        cancelled reply then fails the returns.  A task that already
+        finished (or an actor task / a ref from ``put``) is left alone.
+        Fire-and-forget: completion is observed through the refs
+        themselves.
+        """
+        ids = [ref.id for ref in refs]
+
+        def do():
+            n_accepted = 0
+            by_addr: Dict[str, List[TaskID]] = {}
+            for oid in ids:
+                spec = self._task_of_return.get(oid)
+                if spec is None or getattr(spec, "_cancelled", False):
+                    continue  # finished, unknown, or already cancelled
+                spec._cancelled = True  # type: ignore[attr-defined]
+                n_accepted += 1
+                addr = getattr(spec, "_pushed_addr", None)
+                if addr is None:
+                    # Still queued in a lease pool: fail returns now; the
+                    # pool's dequeue skips cancelled specs.
+                    self._fail_task_returns(
+                        spec, TaskCancelledError(spec.name)
+                    )
+                else:
+                    by_addr.setdefault(addr, []).append(spec.task_id)
+            for addr, tids in by_addr.items():
+                client = self.worker_clients.get(addr)
+                self._spawn_inflight(
+                    self._oneway(client, "cancel_task", {"task_ids": tids})
+                )
+            if n_accepted:
+                self._tasks_cancelled += n_accepted
+                _fr().counter(
+                    _fr().TASKS_CANCELLED_TOTAL, float(n_accepted)
+                )
+
+        self._post(do)
+
+    def _spawn_inflight(self, coro):
+        """Track a fire-and-forget coroutine so shutdown can cancel it."""
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            coro.close()
+            return
+        t = loop.create_task(coro)
+        self._inflight_submits.add(t)
+        t.add_done_callback(self._inflight_submits.discard)
+
+    def handle_cancel_task(self, payload, conn):
+        """Executor side: mark tasks to be skipped if not yet started.
+
+        Only PENDING tasks are recorded: the cancel rides the same ordered
+        connection as the push, so an id absent from _pending_exec_tasks
+        means the task already replied — recording it anyway would leave a
+        stale entry that silently fails a later re-execution of the same
+        task id (retry / lineage reconstruction) with TaskCancelledError.
+        """
+        for tid in payload["task_ids"]:
+            if (
+                tid in self._pending_exec_tasks
+                and tid not in self._cancelled_tasks
+            ):
+                self._cancelled_tasks.add(tid)
+                self._cancelled_order.append(tid)
+        # Backstop bound (entries are normally dropped at task reply).
+        while len(self._cancelled_order) > 4096:
+            self._cancelled_tasks.discard(self._cancelled_order.popleft())
+        return {"ok": True}
+
     # ------------------------------------------------------------ execution
     async def _resolve_args(self, payload):
         global _EMPTY_ARGS_PAYLOAD
@@ -3230,6 +3362,19 @@ class CoreWorker:
         # stay lean, and a failed task's phases would skew the envelope.
         fr_on = GlobalConfig.enable_flight_recorder
         t_start = time.time()
+        if spec.actor_id is None and spec.task_id in self._cancelled_tasks:
+            # Owner cancelled while this task sat in the executor queue:
+            # skip the run, reply with the cancellation (serialized bare —
+            # get() raises TaskCancelledError, not a TaskError wrapper).
+            self._cancelled_tasks.discard(spec.task_id)
+            self.task_events.record(
+                spec.task_id.hex(), spec.name, "FAILED",
+                error="cancelled", **ev_kw,
+            )
+            return {
+                "returns": None,
+                "error": serialize_to_bytes(TaskCancelledError(spec.name)),
+            }
         try:
             args, kwargs = await self._resolve_args(spec.args_payload)
             if self._device_transport_active():
@@ -3282,21 +3427,34 @@ class CoreWorker:
                 import contextvars as _cv
 
                 _ctx = _cv.copy_context()
+
+                def _guarded_run(*a, **kw):
+                    # Re-checked at actual execution start: a cancel that
+                    # landed while this task waited behind others in the
+                    # pipeline/lane queue still skips the user function.
+                    if (
+                        spec.actor_id is None
+                        and spec.task_id in self._cancelled_tasks
+                    ):
+                        self._cancelled_tasks.discard(spec.task_id)
+                        raise TaskCancelledError(spec.name)
+                    return _ctx.run(fn, *a, **kw)
+
                 if ticket is not None:
                     result = await self._exec_pipeline.run_sync(
-                        ticket, _ctx.run, fn, *args, **kwargs
+                        ticket, _guarded_run, *args, **kwargs
                     )
                 elif self._lane_pool is not None:
                     # Concurrency lanes: sticky threads + batched
                     # completion flushes (one loop wakeup per burst, not
                     # per call).
                     result = await self._lane_pool.run(
-                        _ctx.run, fn, *args, **kwargs
+                        _guarded_run, *args, **kwargs
                     )
                 else:
                     result = await loop.run_in_executor(
                         self._task_executor,
-                        lambda: _ctx.run(fn, *args, **kwargs),
+                        lambda: _guarded_run(*args, **kwargs),
                     )
             if self._device_transport_active():
                 result = self._device_wrap(result)
@@ -3320,6 +3478,10 @@ class CoreWorker:
             self.task_events.record(
                 spec.task_id.hex(), spec.name, "FAILED", error=repr(e), **ev_kw
             )
+            if isinstance(e, TaskCancelledError):
+                # Not a user-code failure: ship bare so get() raises
+                # TaskCancelledError, not a TaskError wrapper.
+                return {"returns": None, "error": serialize_to_bytes(e)}
             err = TaskError(e, tb.format_exc(), spec.name)
             return {"returns": None, "error": serialize_to_bytes(err)}
 
@@ -3335,6 +3497,7 @@ class CoreWorker:
         )
         if not owner:
             return await asyncio.shield(fut)
+        self._pending_exec_tasks.add(spec.task_id)
         try:
             reply = await self._handle_push_task_once(spec)
         except BaseException as e:  # noqa: BLE001
@@ -3342,6 +3505,12 @@ class CoreWorker:
                 fut.set_exception(e)
                 fut.exception()  # consumed here; mark retrieved
             raise
+        finally:
+            # Reply (or failure) ends this execution: clear the pending
+            # mark AND any unconsumed cancel mark so a re-push of the same
+            # task id starts from a clean slate.
+            self._pending_exec_tasks.discard(spec.task_id)
+            self._cancelled_tasks.discard(spec.task_id)
         if not fut.done():
             fut.set_result(reply)
         return reply

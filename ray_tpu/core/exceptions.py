@@ -71,5 +71,20 @@ class PendingTaskBackpressureTimeout(RayTpuError, TimeoutError):
     work fast enough for this producer."""
 
 
+class TaskCancelledError(RayTpuError):
+    """The task producing this object was cancelled via ``ray_tpu.cancel``
+    before it ran (owner-side dequeue or executor-side skip).  Raised at
+    ``get`` on the cancelled task's return refs.  Cancellation is
+    best-effort: a task already executing runs to completion and its
+    returns resolve normally."""
+
+    def __init__(self, task_name: str = ""):
+        self.task_name = task_name
+        super().__init__(f"task {task_name!r} was cancelled before execution")
+
+    def __reduce__(self):
+        return (TaskCancelledError, (self.task_name,))
+
+
 class RuntimeEnvSetupError(RayTpuError):
     pass

@@ -124,7 +124,9 @@ class NodeAgent:
         self.directory = NodeObjectDirectory(
             session_id, GlobalConfig.object_store_memory_bytes
         )
-        self.shm_store = ShmObjectStore(session_id)
+        # The agent is the session arena's creator; every other process
+        # (workers, drivers) attaches only — see get_arena's leak note.
+        self.shm_store = ShmObjectStore(session_id, create_arena=True)
         self.workers: Dict[WorkerID, WorkerHandle] = {}
         self.idle_pool: Dict[tuple, List[WorkerHandle]] = {}
         # cgroup-v2 isolation of application workers (no-op unless

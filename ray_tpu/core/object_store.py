@@ -61,18 +61,31 @@ def arena_path(session_id: str) -> str:
     return os.path.join(shm.SHM_DIR, f"{shm._PREFIX}_{session_id}_arena")
 
 
-def get_arena(session_id: str) -> Optional["native.NativeArena"]:
+def get_arena(
+    session_id: str, create: bool = False
+) -> Optional["native.NativeArena"]:
     """Per-process handle to the session's shared arena (None if the native
-    library is unavailable)."""
+    library is unavailable).
+
+    Only node agents pass ``create=True`` — they are the arena's sole
+    creators (and the head agent its sole unlinker).  Everyone else
+    attaches: a missing arena means the session is tearing down, and a
+    late-booting worker that re-created it would leave an ownerless 2 GiB
+    file in /dev/shm past session cleanup (no owner stamp, so the next
+    session's orphan sweep must leave it forever)."""
     if session_id in _arena_cache:
         return _arena_cache[session_id]
     if not native.available():
         _arena_cache[session_id] = None
         return None
     try:
-        a = native.NativeArena.open_shared(
-            arena_path(session_id), GlobalConfig.object_store_memory_bytes
-        )
+        if create:
+            a = native.NativeArena.open_shared(
+                arena_path(session_id),
+                GlobalConfig.object_store_memory_bytes,
+            )
+        else:
+            a = native.NativeArena.attach(arena_path(session_id))
     except OSError:
         a = None
     _arena_cache[session_id] = a
@@ -325,12 +338,12 @@ class ShmObjectStore:
     read path, matching plasma's mmap fast path.
     """
 
-    def __init__(self, session_id: str):
+    def __init__(self, session_id: str, create_arena: bool = False):
         self.session_id = session_id
         # Attachments are cached for the life of the process: numpy views
         # returned to user code borrow the mapping.
         self._attached: Dict[ObjectID, shm.ShmSegment] = {}
-        self._arena = get_arena(session_id)
+        self._arena = get_arena(session_id, create=create_arena)
         # Bounded LRU cache for spilled-object reads (see raw_bytes).
         from collections import OrderedDict
 

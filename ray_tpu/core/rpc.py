@@ -75,8 +75,13 @@ Address = str  # "host:port"
 # so MIN_COMPAT_VERSION moves with it.  The handshake itself stays in the
 # v1 body format forever (see _encode_frame_v1), which is what turns a
 # mixed-version pairing into a clean RpcVersionError on both sides.
-PROTOCOL_VERSION = 2
-MIN_COMPAT_VERSION = 2
+#
+# v3: TaskSpec wire tuple grew ``pipeline_depth`` (appended).  The tuple
+# __setstate__ is exact-arity, so a v2 peer would fail at unpickle, not
+# at handshake — hence the bump; nothing else changed, so the compat
+# floor moves with it.
+PROTOCOL_VERSION = 3
+MIN_COMPAT_VERSION = 3
 
 # Sentinel timeout meaning "no per-call timer": the call completes when the
 # reply arrives or the connection dies (read-loop failure fails the future).

@@ -58,6 +58,12 @@ class TaskSpec:
     trace_ctx: Optional[Tuple[str, str]] = None
     # Actor method to dispatch (actor tasks; falls back to ``name``).
     method_name: str = ""
+    # Per-worker push pipelining cap for this task's lease pool (0 = the
+    # max_tasks_in_flight_per_worker knob).  Coarse-grained tasks (data
+    # block transforms) set 1: a straggler pipelined ahead of them on a
+    # shared worker would serialize execution at the worker — exactly the
+    # head-of-line blocking the streaming scheduler exists to avoid.
+    pipeline_depth: int = 0
 
     # Wire-pickled once per task push: tuple state instead of the default
     # dataclass ``__dict__`` (which re-pickles every field-name string per
@@ -76,7 +82,7 @@ class TaskSpec:
             self.retry_exceptions, self.owner_address, self.actor_id,
             self.actor_creation, self.sequence_number,
             self.placement_group_id, self.bundle_index, self.env_vars,
-            self.trace_ctx, self.method_name,
+            self.trace_ctx, self.method_name, self.pipeline_depth,
         )
 
     def __setstate__(self, state):
@@ -87,7 +93,7 @@ class TaskSpec:
             self.retry_exceptions, self.owner_address, self.actor_id,
             self.actor_creation, self.sequence_number,
             self.placement_group_id, self.bundle_index, self.env_vars,
-            self.trace_ctx, self.method_name,
+            self.trace_ctx, self.method_name, self.pipeline_depth,
         ) = state
 
     @property
@@ -97,6 +103,7 @@ class TaskSpec:
             tuple(sorted(self.resources.items())),
             self.placement_group_id,
             tuple(sorted(self.env_vars.items())),
+            self.pipeline_depth,
         )
 
     def return_ids(self) -> List[ObjectID]:

@@ -10,6 +10,7 @@ from .aggregate import (  # noqa: F401
 )
 from .datasource import Datasource, ReadTask  # noqa: F401
 from .execution import ActorPoolStrategy  # noqa: F401
+from .streaming import ExecutionOptions  # noqa: F401
 from .arrow import from_arrow  # noqa: F401
 from .interop import from_huggingface, from_pandas, from_torch  # noqa: F401
 from .datasink import (  # noqa: F401

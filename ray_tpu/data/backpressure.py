@@ -4,12 +4,18 @@ Reference: ray ``python/ray/data/_internal/execution/resource_manager.py:47``
 (per-operator memory budgets from the shared object-store budget) and
 ``backpressure_policy/backpressure_policy.py:14`` (pluggable launch gates).
 
-Here each streaming stage consults its ``OpResourceState`` before
+Here each streaming operator node consults its ``OpResourceState`` before
 launching a task: the concurrency-cap policy is the round-1 behavior, and
 the memory-budget policy bounds *estimated object-store bytes in flight*
 (average completed output size × outstanding tasks) so a stage producing
 huge blocks throttles instead of flooding /dev/shm — which matters more
 here than in the reference because the node arena is a fixed-size mmap.
+
+Under the operator-graph scheduler (``streaming.py``),
+``on_output_consumed`` fires at task COMPLETION (harvest), not at
+downstream consume: RUNNING tasks are the memory model's in-flight set,
+while completed-but-unconsumed blocks are bounded separately by the
+per-op output queue depth (``data_output_queue_depth``).
 """
 
 from __future__ import annotations

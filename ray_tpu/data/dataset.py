@@ -15,6 +15,7 @@ loop so host→HBM transfers overlap compute.
 
 from __future__ import annotations
 
+import dataclasses
 import random as _random
 from bisect import bisect_left
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Union
@@ -60,6 +61,7 @@ from .execution import (
     _run_item,
     apply_chain,
 )
+from .streaming import ExecutionOptions
 
 
 @ray_tpu.remote
@@ -114,14 +116,38 @@ def _write_block(item, transforms, writer, path: str) -> dict:
 class Dataset:
     """A lazy, distributed collection of rows."""
 
-    def __init__(self, inputs: List[Any], stages: Optional[List[Any]] = None):
+    def __init__(self, inputs: List[Any], stages: Optional[List[Any]] = None,
+                 options: Optional[ExecutionOptions] = None):
         self._inputs = list(inputs)  # ObjectRefs and/or ReadTasks
         self._stages = list(stages or [])
+        self._options = options
         self._last_stats: List[OpStats] = []
 
     # ---------------------------------------------------------- plan builder
     def _with_stage(self, stage) -> "Dataset":
-        return Dataset(self._inputs, self._stages + [stage])
+        return Dataset(self._inputs, self._stages + [stage], self._options)
+
+    def execution_options(self, options: Optional[ExecutionOptions] = None,
+                          **kwargs) -> "Dataset":
+        """Return a copy of this dataset executing under the given
+        ``ExecutionOptions`` (or keyword fields thereof) — e.g.
+        ``ds.execution_options(preserve_order=False)`` opts into
+        out-of-order streaming, ``target_block_size_bytes=...`` enables
+        dynamic block shaping for this plan.  Keyword fields MERGE into
+        the options already set on this dataset, so chained calls
+        compose instead of silently resetting earlier choices."""
+        if options is not None:
+            if kwargs:
+                raise ValueError(
+                    "pass either an ExecutionOptions object or keyword "
+                    "fields, not both"
+                )
+            opts = options
+        else:
+            opts = dataclasses.replace(
+                self._options or ExecutionOptions(), **kwargs
+            )
+        return Dataset(self._inputs, self._stages, opts)
 
     def _narrow(self, name: str, fn: Callable[[Block], Block],
                 compute=None) -> "Dataset":
@@ -442,8 +468,8 @@ class Dataset:
 
     # -------------------------------------------------------------- execution
     def _execute(self) -> Iterator:
-        """Stream block refs out of the plan."""
-        ex = StreamingExecutor(self._inputs, self._stages)
+        """Stream block refs out of the plan (operator-graph scheduler)."""
+        ex = StreamingExecutor(self._inputs, self._stages, self._options)
         stream = ex.run()
         self._last_stats = ex.stats
         return stream
@@ -464,14 +490,17 @@ class Dataset:
     def materialize(self) -> "Dataset":
         """Execute the full plan; the result holds only block refs."""
         refs = list(self._execute())
-        ds = Dataset(refs, [])
+        ds = Dataset(refs, [], self._options)
         ds._last_stats = self._last_stats
         return ds
 
     def stats(self) -> str:
+        """Formatted per-operator summary of the last execution: tasks,
+        wall (operator work, not downstream consume time), queue-wait
+        percentiles, blocks split/coalesced, autoscale events."""
         if not self._last_stats:
             return "(not executed yet)"
-        return "\n".join(repr(s) for s in self._last_stats)
+        return "\n".join(s.summary() for s in self._last_stats)
 
     # ------------------------------------------------------------- consumers
     def iter_blocks(self) -> Iterator[Block]:
@@ -706,7 +735,7 @@ class Dataset:
         groups: List[List] = [[] for _ in range(n)]
         for i, ref in enumerate(refs):
             groups[i % n].append(ref)
-        return [Dataset(g, stages) for g in groups]
+        return [Dataset(g, stages, self._options) for g in groups]
 
     def streaming_split(self, n: int) -> List["DataIterator"]:
         """Per-trainer shards (reference: ray ``data/dataset.py:1881``)."""

@@ -1,35 +1,39 @@
-"""Pull-based streaming execution of dataset plans.
+"""Dataset plan nodes + the plan optimizer.
 
 Reference architecture: ray ``python/ray/data/_internal/execution/
 streaming_executor.py:67`` + physical operators (``operators/map_operator.py``,
-``actor_pool_map_operator.py``, ``hash_shuffle.py``) — a pipeline of
-operators with bounded in-flight tasks per operator so blocks *stream*
-through the plan under backpressure instead of materializing between stages.
+``actor_pool_map_operator.py``, ``hash_shuffle.py``).  This module holds the
+LOGICAL plan pieces — stage descriptions (``MapStage`` / ``AllToAllStage`` /
+``LimitStage``), the rewrite rules (fusion, pushdown, repartition elision),
+the exchange substrate (``_shuffle_map`` / ``_shuffle_reduce``), and per-op
+stats.  The PHYSICAL execution lives in ``streaming.py``: an operator-graph
+scheduler that drives these stages as nodes with bounded input/output
+queues, out-of-order completion harvesting, actor-pool autoscaling, and
+dynamic block shaping.
 
-TPU-native simplifications kept deliberate:
-  - order is preserved (head-of-line emission per stage), so ``take`` and
-    train ingest are deterministic;
+Deliberate TPU-native semantics:
+  - ordered emission is the default (``take`` and train ingest stay
+    deterministic); out-of-order streaming is opt-in via
+    ``ExecutionOptions(preserve_order=False)``;
   - narrow transforms are fused into a single stage (the reference's
     OperatorFusionRule) and also fused into the map phase of a following
     shuffle;
   - wide ops (shuffle/sort/groupby/repartition) are an internal barrier: a
     distributed map/reduce exchange over ``num_returns=n`` tasks.
 
-The executor runs in whatever process iterates the dataset; blocks live in
+The scheduler runs in whatever process iterates the dataset; blocks live in
 the object store and move node-to-node only when a consumer pulls them.
 """
 
 from __future__ import annotations
 
 import time
-from collections import deque
 from typing import Any, Callable, Iterator, List, Optional
 
 import numpy as np
 
 import ray_tpu
 
-from ..core.config import GlobalConfig
 from .block import Block
 from .datasource import ReadTask
 
@@ -49,20 +53,6 @@ def apply_chain(item, transforms: List[Transform]) -> Block:
 @ray_tpu.remote
 def _run_item(item, transforms: List[Transform]) -> Block:
     return apply_chain(item, transforms)
-
-
-def _run_item_ref(item):
-    return _run_item.remote(item, [])
-
-
-@ray_tpu.remote
-def _block_len(block: Block) -> int:
-    return len(block)
-
-
-@ray_tpu.remote
-def _trim_block(block: Block, n: int) -> Block:
-    return block[:n]
 
 
 class HashPartition:
@@ -169,7 +159,13 @@ class _MapWorker:
 
 class ActorPoolStrategy:
     """``map_batches(..., compute=ActorPoolStrategy(size=4))`` (reference
-    ``python/ray/data/_internal/compute.py``)."""
+    ``python/ray/data/_internal/compute.py``).
+
+    ``min_size``/``max_size`` turn the pool into an autoscaling one under
+    the streaming scheduler: it grows toward ``max_size`` on sustained
+    input-queue pressure and shrinks back to ``min_size`` when actors
+    starve.  Plain ``size`` pins both bounds (fixed pool, the round-1
+    behavior)."""
 
     def __init__(
         self,
@@ -177,8 +173,19 @@ class ActorPoolStrategy:
         max_tasks_in_flight_per_actor: int = 2,
         num_tpus: float = 0,
         num_cpus: Optional[float] = None,
+        min_size: Optional[int] = None,
+        max_size: Optional[int] = None,
     ):
         self.size = size
+        self.min_size = min_size if min_size is not None else size
+        self.max_size = max_size if max_size is not None else max(
+            self.min_size, size
+        )
+        if self.min_size < 1 or self.max_size < self.min_size:
+            raise ValueError(
+                f"invalid pool bounds: min_size={self.min_size} "
+                f"max_size={self.max_size}"
+            )
         self.max_tasks_in_flight_per_actor = max_tasks_in_flight_per_actor
         self.num_tpus = num_tpus
         self.num_cpus = num_cpus
@@ -186,10 +193,73 @@ class ActorPoolStrategy:
 
 # ------------------------------------------------------------------- stages
 class OpStats:
+    """Per-operator execution accounting.
+
+    ``wall_s`` measures OPERATOR time — first input/launch to last output
+    *produced* (completion harvested by the scheduler), not to last output
+    consumed downstream.  (The former generator chain folded downstream
+    consume time into every upstream ``yield``; the operator-graph
+    scheduler closes the interval at production.)"""
+
+    QUEUE_WAIT_SAMPLE_CAP = 4096
+
     def __init__(self, name: str):
         self.name = name
         self.num_tasks = 0
         self.wall_s = 0.0
+        # Streaming-scheduler extensions (zeros under barrier stages).
+        self.queue_wait_s: List[float] = []  # per-block input-queue waits
+        self.straggler_wait_s = 0.0  # scheduler blocked on this op's tasks
+        self.blocks_emitted = 0
+        self.blocks_split = 0
+        self.blocks_coalesced = 0
+        self.autoscale_up_events = 0
+        self.autoscale_down_events = 0
+        # Autoscaling pools: TARGET size (actor handles held).  Actor
+        # creation is async, so a just-spawned entry may still be starting.
+        self.pool_size = 0
+        self.pool_size_peak = 0
+        # Every pool-size change in order (ends with 0 at teardown):
+        # lets tests/stats assert "reached max_size, returned to min_size"
+        # without sampling races.
+        self.pool_size_timeline: List[int] = []
+        # Cancel REQUESTS issued for this op's in-flight tasks on early
+        # exit.  ray_tpu.cancel is best-effort: an already-executing task
+        # runs to completion, so this is not a count of tasks killed.
+        self.tasks_cancel_requested = 0
+
+    def add_queue_wait(self, dt: float):
+        if len(self.queue_wait_s) < self.QUEUE_WAIT_SAMPLE_CAP:
+            self.queue_wait_s.append(dt)
+
+    def queue_wait_pct(self, q: float) -> float:
+        if not self.queue_wait_s:
+            return 0.0
+        s = sorted(self.queue_wait_s)
+        return s[min(len(s) - 1, int(q * len(s)))]
+
+    def summary(self) -> str:
+        parts = [
+            f"{self.name}: {self.num_tasks} tasks, {self.wall_s:.3f}s wall",
+            f"queue wait p50/p95 {self.queue_wait_pct(0.5) * 1e3:.1f}/"
+            f"{self.queue_wait_pct(0.95) * 1e3:.1f}ms",
+            f"{self.blocks_emitted} blocks out",
+        ]
+        if self.straggler_wait_s:
+            parts.append(f"straggler wait {self.straggler_wait_s:.3f}s")
+        if self.blocks_split or self.blocks_coalesced:
+            parts.append(
+                f"split/coalesced {self.blocks_split}/{self.blocks_coalesced}"
+            )
+        if self.autoscale_up_events or self.autoscale_down_events:
+            parts.append(
+                f"autoscale +{self.autoscale_up_events}/"
+                f"-{self.autoscale_down_events} "
+                f"(peak {self.pool_size_peak})"
+            )
+        if self.tasks_cancel_requested:
+            parts.append(f"{self.tasks_cancel_requested} cancel requested")
+        return ", ".join(parts)
 
     def __repr__(self):
         return f"{self.name}: {self.num_tasks} tasks, {self.wall_s:.3f}s"
@@ -213,9 +283,6 @@ class MapStage:
         self.compute = compute
         self.projection: Optional[List[str]] = None
         self.predicate: Optional[list] = None
-        # Set by the executor: pipeline-level budget divider; None means
-        # standalone stage execution under the per-op default knob.
-        self.resource_manager = None
 
     @property
     def name(self) -> str:
@@ -228,87 +295,6 @@ class MapStage:
         return MapStage(
             self.transforms + other.transforms, self.names + other.names
         )
-
-    def run(self, upstream: Iterator, stats: List[OpStats]) -> Iterator:
-        st = OpStats(self.name)
-        stats.append(st)
-        if self.compute is None:
-            yield from self._run_tasks(upstream, st)
-        else:
-            yield from self._run_actor_pool(upstream, st)
-
-    def _run_tasks(self, upstream, st):
-        from .backpressure import (
-            OpResourceState, can_launch, default_policies, ref_size_if_known,
-        )
-
-        t0 = time.perf_counter()
-        policies = (
-            self.resource_manager.policies_for_op()
-            if self.resource_manager is not None
-            else default_policies()
-        )
-        op = OpResourceState(self.name)
-        pending: deque = deque()
-        exhausted = False
-        while True:
-            while not exhausted and can_launch(op, policies):
-                item = next(upstream, _SENTINEL)
-                if item is _SENTINEL:
-                    exhausted = True
-                    break
-                st.num_tasks += 1
-                op.on_launch()
-                pending.append(_run_item.remote(item, self.transforms))
-            if not pending:
-                break
-            st.wall_s = time.perf_counter() - t0
-            head = pending.popleft()
-            yield head
-            # Downstream pulled the block: account its (now usually known)
-            # size into the op's memory model.
-            op.on_output_consumed(ref_size_if_known(head))
-        st.wall_s = time.perf_counter() - t0
-
-    def _run_actor_pool(self, upstream, st):
-        t0 = time.perf_counter()
-        strat = self.compute
-        worker_cls = ray_tpu.remote(_MapWorker).options(
-            num_cpus=strat.num_cpus if strat.num_cpus is not None else 1,
-            num_tpus=strat.num_tpus or None,
-        )
-        actors = [worker_cls.remote(self.transforms) for _ in range(strat.size)]
-        cap = strat.size * strat.max_tasks_in_flight_per_actor
-        pending: deque = deque()
-        exhausted = False
-        rr = 0
-        try:
-            while True:
-                while not exhausted and len(pending) < cap:
-                    item = next(upstream, _SENTINEL)
-                    if item is _SENTINEL:
-                        exhausted = True
-                        break
-                    actor = actors[rr % len(actors)]
-                    rr += 1
-                    st.num_tasks += 1
-                    pending.append(actor.apply.remote(item))
-                if not pending:
-                    break
-                head = pending.popleft()
-                # Ensure completion before exposing the ref: the pool is
-                # destroyed when the stage drains, which must not race
-                # in-flight calls.
-                ray_tpu.wait([head], num_returns=1)
-                st.wall_s = time.perf_counter() - t0
-                yield head
-        finally:
-            for a in actors:
-                try:
-                    ray_tpu.kill(a)
-                except Exception:
-                    pass
-        st.wall_s = time.perf_counter() - t0
 
 
 class AllToAllStage:
@@ -390,9 +376,10 @@ class AllToAllStage:
 
 
 class LimitStage:
-    """Global row limit.  Driver-side trim: the pull-based executor means
-    upstream work stops as soon as n rows have been emitted, so only
-    ~in-flight-cap extra blocks are ever computed."""
+    """Global row limit (plan node; executed by the scheduler's limit
+    operator).  When the limit is satisfied the scheduler cancels every
+    still-in-flight upstream task and tears down actor pools — early-exit
+    cancellation, not just launch-stoppage."""
 
     def __init__(self, n: int):
         self.n = n
@@ -400,35 +387,6 @@ class LimitStage:
     @property
     def name(self) -> str:
         return f"Limit[{self.n}]"
-
-    def run(self, upstream: Iterator, stats: List[OpStats]) -> Iterator:
-        st = OpStats(self.name)
-        stats.append(st)
-        t0 = time.perf_counter()
-        remaining = self.n
-        for item in upstream:
-            if remaining <= 0:
-                break
-            ref = (
-                item
-                if isinstance(item, ray_tpu.ObjectRef)
-                else _run_item_ref(item)
-            )
-            # Only the row *count* comes back to the driver; whole blocks
-            # pass through by ref and at most one block is trimmed remotely.
-            n_rows = ray_tpu.get(_block_len.remote(ref), timeout=600)
-            st.num_tasks += 1
-            st.wall_s = time.perf_counter() - t0
-            if n_rows <= remaining:
-                remaining -= n_rows
-                yield ref
-            else:
-                yield _trim_block.remote(ref, remaining)
-                remaining = 0
-        st.wall_s = time.perf_counter() - t0
-
-
-_SENTINEL = object()
 
 
 def _ensure_refs(items: List[Any], transforms: List[Transform]) -> List:
@@ -444,27 +402,25 @@ def _ensure_refs(items: List[Any], transforms: List[Transform]) -> List:
 
 
 class StreamingExecutor:
-    """Composes stage generators into one pull-based stream of block refs."""
+    """Facade over the operator-graph scheduler (``streaming.py``): the
+    optimized plan's stages become operator nodes with bounded input/
+    output queues, driven by one completion-harvesting scheduler loop
+    instead of a chain of head-of-line-blocking generators."""
 
-    def __init__(self, inputs: List[Any], stages: List[Any]):
+    def __init__(self, inputs: List[Any], stages: List[Any], options=None):
         self.inputs = list(inputs)
         self.stages = list(stages)
+        self.options = options
         self.stats: List[OpStats] = []
 
     def run(self) -> Iterator:
-        from .backpressure import ResourceManager
+        from .streaming import StreamingScheduler
 
         inputs, stages = _optimize(self.inputs, self.stages)
-        # One shared memory budget split across the plan's operators
-        # (reference ResourceManager): every stage launches under its own
-        # slice instead of each claiming the global per-op default.
-        rm = ResourceManager(n_ops=max(1, len(stages)))
-        stream: Iterator = iter(inputs)
-        for stage in stages:
-            if hasattr(stage, "resource_manager"):
-                stage.resource_manager = rm
-            stream = stage.run(stream, self.stats)
-        return stream
+        sched = StreamingScheduler(
+            inputs, stages, self.stats, options=self.options
+        )
+        return sched.run_stream()
 
 
 def _pushdown_rules(inputs: List[Any], stages: List[Any]):

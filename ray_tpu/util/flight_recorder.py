@@ -49,6 +49,13 @@ from .metric_registry import (  # noqa: F401 — re-exports
     COLLECTIVE_BYTES_TOTAL,
     COLLECTIVE_DURATION_HIST,
     COLLECTIVE_OPS_TOTAL,
+    DATA_AUTOSCALE_EVENTS_TOTAL,
+    DATA_BLOCKS_COALESCED_TOTAL,
+    DATA_BLOCKS_EMITTED_TOTAL,
+    DATA_BLOCKS_SPLIT_TOTAL,
+    DATA_POOL_SIZE,
+    DATA_QUEUE_DEPTH,
+    DATA_STRAGGLER_WAIT_HIST,
     EXCEPTION_SUPPRESSED_TOTAL,
     GET_BATCH_CALLS_TOTAL,
     GET_BATCH_REFS_TOTAL,
@@ -62,6 +69,7 @@ from .metric_registry import (  # noqa: F401 — re-exports
     RPC_OOB_FRAMES_TOTAL,
     TASK_EVENTS_DROPPED_TOTAL,
     TASK_PHASE_HIST,
+    TASKS_CANCELLED_TOTAL,
 )
 
 # Sub-millisecond to minutes: runtime phases span five orders of magnitude.

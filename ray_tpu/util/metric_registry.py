@@ -54,6 +54,16 @@ RPC_OOB_BYTES_TOTAL = "ray_tpu_rpc_oob_bytes_total"
 RPC_BATCH_FRAMES_TOTAL = "ray_tpu_rpc_batch_frames_total"
 RPC_BATCHED_CALLS_TOTAL = "ray_tpu_rpc_batched_calls_total"
 
+# ------------------------------------------------- data streaming scheduler
+DATA_QUEUE_DEPTH = "ray_tpu_data_queue_depth"
+DATA_STRAGGLER_WAIT_HIST = "ray_tpu_data_straggler_wait_s"
+DATA_AUTOSCALE_EVENTS_TOTAL = "ray_tpu_data_autoscale_events_total"
+DATA_POOL_SIZE = "ray_tpu_data_pool_size"
+DATA_BLOCKS_SPLIT_TOTAL = "ray_tpu_data_blocks_split_total"
+DATA_BLOCKS_COALESCED_TOTAL = "ray_tpu_data_blocks_coalesced_total"
+DATA_BLOCKS_EMITTED_TOTAL = "ray_tpu_data_blocks_emitted_total"
+TASKS_CANCELLED_TOTAL = "ray_tpu_tasks_cancelled_total"
+
 # ------------------------------------------------------------- scheduling
 LEASE_GRANT_WAIT_HIST = "ray_tpu_lease_grant_wait_s"
 LEASE_QUEUE_DEPTH = "ray_tpu_lease_queue_depth"
@@ -107,6 +117,23 @@ METRICS: Dict[str, str] = {
                          "stream (framing v2)",
     RPC_BATCH_FRAMES_TOTAL: "batch container frames written",
     RPC_BATCHED_CALLS_TOTAL: "calls multiplexed into batch containers",
+    DATA_QUEUE_DEPTH: "blocks parked in a streaming op's input queue "
+                      "(gauge, by op)",
+    DATA_STRAGGLER_WAIT_HIST: "scheduler time blocked waiting for ANY "
+                              "in-flight block to complete (histogram)",
+    DATA_AUTOSCALE_EVENTS_TOTAL: "actor-pool autoscale decisions, by "
+                                 "op/direction",
+    DATA_POOL_SIZE: "target size of an autoscaling pool op — actor "
+                    "handles held, creation is async (gauge, by op)",
+    DATA_BLOCKS_SPLIT_TOTAL: "oversized map outputs split by dynamic "
+                             "block shaping",
+    DATA_BLOCKS_COALESCED_TOTAL: "undersized blocks merged by dynamic "
+                                 "block shaping",
+    DATA_BLOCKS_EMITTED_TOTAL: "blocks emitted downstream by streaming "
+                               "ops, by op",
+    TASKS_CANCELLED_TOTAL: "cancel requests accepted owner-side via "
+                           "ray_tpu.cancel (best-effort; an executing "
+                           "task still completes)",
     LEASE_GRANT_WAIT_HIST: "lease request wait until grant/spillback/retry "
                            "(histogram)",
     LEASE_QUEUE_DEPTH: "lease requests parked on the node agent (gauge)",

@@ -27,6 +27,35 @@ def oid(i: int) -> bytes:
     return i.to_bytes(16, "big")
 
 
+class TestArenaOwnership:
+    def test_get_arena_attach_only_never_creates(self):
+        """Non-agent processes must not (re)create the session arena: a
+        worker booting during shutdown would otherwise resurrect the file
+        the head agent just unlinked, leaking an ownerless arena in
+        /dev/shm forever (the orphan sweep skips unstamped files)."""
+        from ray_tpu.core import object_store as osm
+
+        sid = "ffff0000"  # no session ever uses this id
+        path = osm.arena_path(sid)
+        assert not os.path.exists(path)
+        try:
+            assert osm.get_arena(sid) is None  # attach-only: no file
+            assert not os.path.exists(path)
+            osm.drop_arena(sid)
+            # The agent path (create=True) does create it...
+            assert osm.get_arena(sid, create=True) is not None
+            assert os.path.exists(path)
+            osm.drop_arena(sid)
+            # ...and attachers then find it.
+            assert osm.get_arena(sid) is not None
+        finally:
+            osm.drop_arena(sid)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+
 class TestArena:
     def test_alloc_seal_lookup(self, tmp_path):
         a = native.NativeArena.create(_arena_path(tmp_path), 1 << 20)
