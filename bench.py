@@ -43,6 +43,11 @@ BASELINES = {  # reference release/perf_metrics/microbenchmark.json
     "n_n_actor_calls_with_arg_async": 3263.0,
     "single_client_wait_1k_refs": 4.72,
     "multi_client_tasks_async": 20114.0,
+    # Self-baseline (no reference-Ray counterpart stage): pinned at the
+    # BENCH_r05 driver artifact so payload-path regressions show up in the
+    # ``vs`` map instead of hiding in the summary (records carry
+    # baseline_source="self_r05").
+    "n_n_actor_calls_100kb_payload_async": 1102.6,
     "many_actors_launch_per_s": 404.0,
     "many_tasks_per_s": 583.0,
     "many_pgs_per_s": 18.9,
@@ -65,8 +70,63 @@ FLEET_BASELINE_METRICS = {
 
 _ALL_RECORDS = []  # every emitted record, re-printed in the final summary
 
+# Filled by quiesce()/best_of() and attached to the NEXT emit() so every
+# timed record carries its own measurement-defense evidence (trial spread
+# + load snapshot) without threading extras through every call site.
+_STAGE_EXTRA = {}
+
+
+def _load1():
+    try:
+        with open("/proc/loadavg") as f:
+            return float(f.read().split()[0])
+    except Exception:  # noqa: BLE001 — non-Linux fallback
+        return -1.0
+
+
+def quiesce(settle_s=0.25, timeout=60.0):
+    """Pre-stage drain, pinned in the harness (not in hand-run
+    validation): block until the cluster is quiet — no queued lease
+    requests, no in-flight prestart spawns, no queued submission bytes —
+    then a fixed settle sleep so scheduler run-queues drain.  Records the
+    post-quiesce 1-min load in the next emitted record."""
+    from ray_tpu.core.core_worker import try_global_worker
+
+    w = try_global_worker()
+    if w is not None:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                st = w._run_sync(w.agent.call("debug_state"), timeout=10)
+            except Exception:  # noqa: BLE001 — agent racing shutdown
+                break
+            if (
+                not st["queued_leases"]
+                and not st["prestart_inflight"]
+                and w.submit_budget.stats()["queued_bytes"] == 0
+            ):
+                break
+            time.sleep(0.1)
+    time.sleep(settle_s)
+    _STAGE_EXTRA["load1_at_start"] = _load1()
+
+
+def best_of(trials, fn):
+    """Best-of-N timed windows with a pinned pre-stage quiesce; the trial
+    spread rides the record so a contended window is visible in the
+    artifact instead of masquerading as a slow runtime."""
+    quiesce()
+    vals = [fn() for _ in range(trials)]
+    best = max(vals)
+    if best:
+        _STAGE_EXTRA["spread"] = round((best - min(vals)) / best, 3)
+    return best
+
 
 def emit(metric, value, unit, baseline=None, **extra):
+    if _STAGE_EXTRA:
+        extra = {**_STAGE_EXTRA, **extra}
+        _STAGE_EXTRA.clear()
     rec = {
         "metric": metric,
         "value": round(float(value), 4),
@@ -351,11 +411,11 @@ def run_control_plane_suite():
             def ping(self):
                 return b"ok"
 
-        # Best-of-3 per stage: single-shot throughput on a shared 1-core
-        # box swings +-40% with scheduler noise; max-of-3 is the standard
-        # way the reference's perf harness stabilizes (ray_perf multi-trial).
-        def best_of(trials, fn):
-            return max(fn() for _ in range(trials))
+        # Best-of-3 per stage (module-level best_of): single-shot
+        # throughput on a shared small box swings +-40% with scheduler
+        # noise; max-of-N is how the reference's perf harness stabilizes
+        # (ray_perf multi-trial), and the pinned quiesce + recorded
+        # spread/load make the driver-captured number defend itself.
 
         # tasks sync
         for _ in range(20):
@@ -477,6 +537,8 @@ def run_control_plane_suite():
         emit(
             "n_n_actor_calls_100kb_payload_async",
             best_of(3, nn_with_payload), "calls/s",
+            BASELINES["n_n_actor_calls_100kb_payload_async"],
+            baseline_source="self_r05",
         )
 
         # Same 100 KB fanned out BY REF: one put, every call passes the
@@ -551,7 +613,13 @@ def run_control_plane_suite():
         # fine, the measurement was contended).
         wait_pool_warm()
 
-        # put / get small objects
+        # put / get small objects.  Fixed warmup + quiesce like every
+        # timed stage: the first puts of a fresh driver pay allocator and
+        # adaptive-interpreter ramp that the reference's long timeit
+        # windows amortize.
+        for _ in range(50):
+            ray_tpu.put(b"w" * 100)
+        quiesce()
         t0 = time.perf_counter()
         n = 1000
         refs = [ray_tpu.put(b"x" * 100) for _ in range(n)]
@@ -640,6 +708,7 @@ def run_control_plane_suite():
         assert wpg.ready(timeout=60)
         remove_placement_group(wpg)
 
+        quiesce()
         t0 = time.perf_counter()
         n = 50
         for _ in range(n):
@@ -722,6 +791,7 @@ def run_control_plane_suite():
         for a in tiny:
             ray_tpu.kill(a)
 
+        quiesce()
         t0 = time.perf_counter()
         n = 2000
         ray_tpu.get([f.remote() for _ in range(n)], timeout=600)
@@ -730,6 +800,7 @@ def run_control_plane_suite():
             "tasks/s", BASELINES["many_tasks_per_s"],
         )
 
+        quiesce()
         t0 = time.perf_counter()
         n = 60
         pgs = [placement_group([{"CPU": 0.01}]) for _ in range(n)]
@@ -1067,6 +1138,11 @@ REFERENCE_LIMITS = {
     "limits_wide_get_10k_s": 10_000,   # shm-store refs in ONE get (23.3 s)
     "limits_queued_tasks_s": 1_000_000,  # queued tasks (220 s)
     "limits_spill_roundtrip_s": 100 * 1024**3,  # bytes through spill (28.7 s)
+    # Many-client envelope: concurrent driver processes hammering one
+    # node's control plane (tasks + puts/gets + PG churn).  Scale = client
+    # count; the reference's multi-client tests run 1 driver per core on a
+    # fleet box, so 32 concurrent clients is the single-node analog.
+    "limits_many_clients_s": 32,
 }
 
 
@@ -1198,6 +1274,101 @@ def run_limits_suite():
             queued_bytes_peak=stats["peak_bytes"],
         )
         del qrefs
+
+        # 5. many-client envelope: >=32 concurrent client drivers hammer
+        # this node's control plane with tasks, puts/gets, and PG
+        # create/remove churn.  The record carries per-lane frame counts
+        # and saturation (share of the busiest lane) from the node agent
+        # and control plane, plus the PG group-commit accounting — the
+        # sharded-control-plane win measured, not asserted.
+        import subprocess
+
+        n_clients = int(os.environ.get("RAY_TPU_LIMITS_CLIENTS", 32))
+        client_code = (
+            "import sys, time\n"
+            "import ray_tpu\n"
+            "ray_tpu.init(address=sys.argv[1], num_cpus=0)\n"
+            "@ray_tpu.remote\n"
+            "def f(): return b'ok'\n"
+            "t0 = time.perf_counter()\n"
+            "ray_tpu.get([f.remote() for _ in range(40)], timeout=900)\n"
+            "refs = [ray_tpu.put(b'x' * 2048) for _ in range(10)]\n"
+            "for r in refs:\n"
+            "    ray_tpu.get(r, timeout=900)\n"
+            "from ray_tpu import placement_group, remove_placement_group\n"
+            "for _ in range(2):\n"
+            "    pg = placement_group([{'CPU': 0.01}])\n"
+            "    assert pg.ready(timeout=900)\n"
+            "    remove_placement_group(pg)\n"
+            "print('OPS', 40 + 20 + 2, time.perf_counter() - t0)\n"
+            "ray_tpu.shutdown()\n"
+        )
+        cp_addr = ray_tpu.api._local_node.cp_address
+        client_env = dict(os.environ)
+        client_env["PALLAS_AXON_POOL_IPS"] = ""
+        if "axon" in client_env.get("JAX_PLATFORMS", ""):
+            client_env["JAX_PLATFORMS"] = "cpu"
+
+        def lane_frames(rows):
+            return {r["lane"]: r["frames_total"] for r in rows}
+
+        agent_before = lane_frames(
+            w._run_sync(w.agent.call("debug_state"))["rpc_lanes"]
+        )
+        cp_before = lane_frames(
+            w._run_sync(w.cp.call("debug_control_plane"))["rpc_lanes"]
+        )
+        t0 = time.perf_counter()
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", client_code, cp_addr],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                text=True, env=client_env,
+            )
+            for _ in range(n_clients)
+        ]
+        total_ops = 0
+        completed = 0
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=1200)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                continue
+            for line in out.splitlines():
+                if line.startswith("OPS"):
+                    total_ops += int(line.split()[1])
+                    completed += 1
+        wall = time.perf_counter() - t0
+        agent_after = lane_frames(
+            w._run_sync(w.agent.call("debug_state"))["rpc_lanes"]
+        )
+        cp_debug = w._run_sync(w.cp.call("debug_control_plane"))
+        cp_after = lane_frames(cp_debug["rpc_lanes"])
+
+        def saturation(before, after):
+            deltas = [
+                max(0, after.get(lane, 0) - before.get(lane, 0))
+                for lane in after
+            ]
+            total = sum(deltas)
+            return (
+                {"per_lane_frames": deltas,
+                 "max_lane_share": round(max(deltas) / total, 3)}
+                if total else {"per_lane_frames": deltas, "max_lane_share": 0.0}
+            )
+
+        pg_stats = cp_debug["pg_batch_stats"]
+        _limits_emit(
+            "limits_many_clients_s", wall, completed,
+            clients_launched=n_clients,
+            aggregate_ops_per_s=round(total_ops / wall, 1) if wall else 0.0,
+            agent_lanes=saturation(agent_before, agent_after),
+            cp_lanes=saturation(cp_before, cp_after),
+            pg_commit_batches=pg_stats["batches"],
+            pg_batched_creates=pg_stats["batched_creates"],
+            pg_fused_commits=pg_stats["fused_commits"],
+        )
     finally:
         ray_tpu.shutdown()
 

@@ -32,6 +32,27 @@ _KNOBS: Dict[str, tuple] = {
     "rpc_retry_base_delay_s": (float, 0.05, "Exponential backoff base"),
     "rpc_retry_max_delay_s": (float, 2.0, "Backoff cap"),
     "rpc_max_retries": (int, 8, "Retryable RPC attempts"),
+    "rpc_service_lanes": (
+        int, 0,
+        "Event-loop lanes per RPC service (0 = auto: min(4, cpus) for the "
+        "many-client servers — control plane, node agent, driver owner "
+        "service — and 1 for worker executors).  Connections pin to a "
+        "lane at accept time, preserving per-connection ordering; "
+        "handlers outside LANE_SAFE_METHODS forward to the primary loop",
+    ),
+    "owner_table_shards": (
+        int, 16,
+        "Shards of the per-worker owned-object table (power of two).  "
+        "Lane-side get/probe resolution indexes shards independently so "
+        "many borrower connections resolve concurrently",
+    ),
+    "pg_commit_batch_max": (
+        int, 64,
+        "Max placement groups per control-plane group-commit sweep: "
+        "concurrent create/remove requests arriving while a sweep is in "
+        "flight coalesce into the next one (single bundle-reservation "
+        "sweep + one prepare/commit RPC pass per node per batch)",
+    ),
     "testing_rpc_failure": (str, "", "Chaos spec: 'method:prob_req:prob_resp,…'"),
     "testing_network_delay": (
         str, "",

@@ -30,12 +30,13 @@ import os
 import logging
 import pickle
 import time
+from collections import deque
 from typing import Dict, List, Optional, Set
 
 from .config import GlobalConfig
 from .ids import ActorID, JobID, NodeID, PlacementGroupID
 from .resources import ResourceSet
-from .rpc import ClientPool, RpcServer, ServerConnection
+from .rpc import ClientPool, RpcServer, ServerConnection, resolve_service_lanes
 from .scheduler import ClusterScheduler, InfeasibleError
 from .event_export import (
     ACTOR_DEFINITION,
@@ -109,10 +110,29 @@ class PlacementGroupEntry:
 
 
 class ControlPlane:
+    # Read-only SINGLE-KEY lookups the multi-lane RPC server may serve
+    # directly on a lane thread: individual dict get/contains are
+    # GIL-atomic and every mutation happens on the primary loop (see
+    # rpc.RpcServer).  job_heartbeat's single timestamp store is likewise
+    # atomic.  Handlers that ITERATE shared dicts (list_actors, kv_keys,
+    # get_cluster_view, ...) are deliberately NOT here — iteration racing
+    # a primary-loop insert raises "dict changed size during iteration" —
+    # and everything stateful (node/actor/PG machines, KV writes, pubsub)
+    # forwards to the primary loop.
+    LANE_SAFE_METHODS = frozenset({
+        "kv_get",
+        "kv_exists",
+        "get_actor_info",
+        "get_named_actor",
+        "get_placement_group",
+        "job_heartbeat",
+        "ping",
+    })
+
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  session_id: str = "", store_path: Optional[str] = None):
         self.session_id = session_id
-        self.server = RpcServer(self, host, port)
+        self.server = RpcServer(self, host, port, lanes=resolve_service_lanes())
         self.scheduler = ClusterScheduler()
         self.nodes: Dict[NodeID, NodeEntry] = {}
         self.agent_clients = ClientPool()
@@ -126,6 +146,17 @@ class ControlPlane:
         self._pending_actors: List[ActorID] = []
         self._schedule_tasks: set = set()
         self._pending_pgs: List[PlacementGroupID] = []
+        # Placement-group group-commit queue (see the placement-group
+        # section): (kind, entry, future) ops drained by one sweep task.
+        self._pg_ops: deque = deque()
+        self._pg_drain_task: Optional[asyncio.Task] = None
+        self.pg_batch_stats = {
+            "batches": 0,          # drain sweeps executed
+            "batched_creates": 0,  # creates that shared a sweep with others
+            "batched_removes": 0,  # removes that shared a sweep with others
+            "fused_commits": 0,    # single-node groups committed in one RPC
+            "rollbacks": 0,        # whole-group rollbacks on partial failure
+        }
         self._bg_tasks: List[asyncio.Task] = []
         self.task_event_store = TaskEventStore()
         self._requested_resources: List[dict] = []
@@ -272,6 +303,8 @@ class ControlPlane:
     async def stop(self):
         for t in self._bg_tasks:
             t.cancel()
+        if self._pg_drain_task is not None and not self._pg_drain_task.done():
+            self._pg_drain_task.cancel()
         await self.server.stop()
         await self.agent_clients.close_all()
         self.store.close()
@@ -356,11 +389,31 @@ class ControlPlane:
             }
         }
 
+    def _publish_own_metrics(self):
+        """The control plane has no CoreWorker to push its registry
+        through — it IS the KV server: record lane/PG-batch telemetry
+        and drop the snapshot straight into the metrics namespace (not
+        via handle_kv_put: metric payloads need no sqlite persistence)."""
+        try:
+            from ray_tpu.util import flight_recorder
+            from ray_tpu.util import metrics as _m
+
+            flight_recorder.record_rpc_lanes(self.server, role="control_plane")
+            flight_recorder.record_pg_batches(self.pg_batch_stats)
+            payload = _m.payload_snapshot()
+            if payload is not None:
+                self._kv.setdefault(_m._REGISTRY_NS, {})["controlplane"] = (
+                    payload
+                )
+        except Exception as e:  # noqa: BLE001 — telemetry is best-effort
+            logger.debug("control-plane metrics publish failed: %s", e)
+
     async def _health_check_loop(self):
         period = GlobalConfig.health_check_period_s
         timeout = GlobalConfig.health_check_timeout_s
         while True:
             await asyncio.sleep(period)
+            self._publish_own_metrics()
             now = time.monotonic()
             for node_id, entry in list(self.nodes.items()):
                 if entry.alive and now - entry.last_heartbeat > timeout:
@@ -707,6 +760,21 @@ class ControlPlane:
         self._publish_actor(entry)
 
     # ------------------------------------------------------- placement groups
+    #
+    # Group commit: create/remove requests enqueue on one ops queue and a
+    # single drain task sweeps it.  A lone request drains immediately (no
+    # batching timer — serial latency is untouched), while requests
+    # arriving during an in-flight sweep coalesce into the next one: ONE
+    # bundle-reservation sweep and one batched RPC per node per batch
+    # instead of a prepare+commit round-trip pair per group.  Single-node
+    # groups fuse prepare+commit into one ``reserve_bundles_batch`` agent
+    # RPC (two-phase commit only pays for itself across nodes); multi-node
+    # groups keep the classic two-phase protocol with per-node batched
+    # prepare/commit/cancel.  Atomicity is per placement group: a group
+    # whose bundles can't all be reserved rolls back every node it touched
+    # and re-queues as PENDING; other groups in the same sweep are
+    # unaffected (independent clients must not fate-share a batch).
+
     async def handle_create_placement_group(self, payload, conn):
         pg_id = payload["pg_id"]
         entry = PlacementGroupEntry(
@@ -715,80 +783,292 @@ class ControlPlane:
         self.placement_groups[pg_id] = entry
         self.events.record(PG_LIFECYCLE, pg_id.hex(), "PENDING")
         self._persist_pg(entry)
-        await self._try_schedule_pg(entry)
+        await self._enqueue_pg_op("create", entry)
+        # The reply carries the post-sweep state: CREATED in the common
+        # case, so the client's ready() needs no follow-up poll.
         return entry.public_info()
 
+    async def handle_remove_placement_group(self, payload, conn):
+        entry = self.placement_groups.get(payload["pg_id"])
+        if entry is None:
+            return False
+        # Through the ops queue so a remove can never overtake the create
+        # sweep that is still reserving this group's bundles.
+        await self._enqueue_pg_op("remove", entry)
+        return True
+
+    def _enqueue_pg_op(self, kind: str, entry: PlacementGroupEntry):
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self._pg_ops.append((kind, entry, fut))
+        if self._pg_drain_task is None or self._pg_drain_task.done():
+            self._pg_drain_task = loop.create_task(self._drain_pg_ops())
+        return fut
+
+    async def _drain_pg_ops(self):
+        while self._pg_ops:
+            batch = []
+            cap = max(1, GlobalConfig.pg_commit_batch_max)
+            while self._pg_ops and len(batch) < cap:
+                batch.append(self._pg_ops.popleft())
+            creates = [(e, f) for k, e, f in batch if k == "create"]
+            removes = [(e, f) for k, e, f in batch if k == "remove"]
+            self.pg_batch_stats["batches"] += 1
+            if len(creates) > 1:
+                self.pg_batch_stats["batched_creates"] += len(creates)
+            if len(removes) > 1:
+                self.pg_batch_stats["batched_removes"] += len(removes)
+            if creates:
+                try:
+                    await self._schedule_pg_batch([e for e, _f in creates])
+                except Exception:  # noqa: BLE001 — a sweep bug fails its waiters, not the drain loop
+                    logger.exception("placement-group commit sweep failed")
+                for _e, fut in creates:
+                    if not fut.done():
+                        fut.set_result(None)
+            if removes:
+                try:
+                    await self._remove_pg_batch([e for e, _f in removes])
+                except Exception:  # noqa: BLE001
+                    logger.exception("placement-group removal sweep failed")
+                for _e, fut in removes:
+                    if not fut.done():
+                        fut.set_result(None)
+
     async def _try_schedule_pg(self, entry: PlacementGroupEntry):
-        bundles = [ResourceSet(b) for b in entry.bundles]
-        assignment = self.scheduler.pick_nodes_for_bundles(bundles, entry.strategy)
-        if assignment is None:
-            if entry.pg_id not in self._pending_pgs:
-                self._pending_pgs.append(entry.pg_id)
+        await self._schedule_pg_batch([entry])
+
+    async def _schedule_pg_batch(self, entries: List[PlacementGroupEntry]):
+        """One reservation sweep over a batch of pending groups.
+
+        Node picks within a sweep don't see each other's reservations (the
+        scheduler view is heartbeat-synced; agents are authoritative), so
+        an over-packed pick simply fails its reservation and re-queues —
+        the same convergence the serial path had."""
+        placeable: List[tuple] = []  # (entry, assignment)
+        for entry in entries:
+            if entry.state != "PENDING":
+                continue
+            bundles = [ResourceSet(b) for b in entry.bundles]
+            assignment = self.scheduler.pick_nodes_for_bundles(
+                bundles, entry.strategy
+            )
+            if assignment is None:
+                self._pg_requeue(entry)
+                continue
+            placeable.append((entry, assignment))
+        if not placeable:
             return
-        # Phase 1: prepare on each involved agent.
-        by_node: Dict[NodeID, List[int]] = {}
-        for idx, nid in enumerate(assignment):
-            by_node.setdefault(nid, []).append(idx)
-        prepared: List[NodeID] = []
-        ok = True
-        for nid, idxs in by_node.items():
-            client = self.agent_clients.get(self.nodes[nid].agent_address)
+        single_by_node: Dict[NodeID, List[tuple]] = {}
+        multi: List[tuple] = []
+        for entry, assignment in placeable:
+            if len(set(assignment)) == 1:
+                single_by_node.setdefault(assignment[0], []).append(
+                    (entry, assignment)
+                )
+            else:
+                multi.append((entry, assignment))
+        tasks = [
+            self._reserve_single_node(nid, items)
+            for nid, items in single_by_node.items()
+        ]
+        if multi:
+            tasks.append(self._two_phase_multi(multi))
+        await asyncio.gather(*tasks)
+
+    async def _reserve_single_node(self, nid: NodeID, items: List[tuple]):
+        """Fused prepare+commit for groups placed wholly on one node —
+        one agent round trip for the whole sub-batch."""
+        node = self.nodes.get(nid)
+        if node is None or not node.alive:
+            for entry, _a in items:
+                self._pg_requeue(entry)
+            return
+        client = self.agent_clients.get(node.agent_address)
+        groups = [
+            {
+                "pg_id": entry.pg_id,
+                "bundles": {i: b for i, b in enumerate(entry.bundles)},
+            }
+            for entry, _a in items
+        ]
+        try:
+            res = await client.call("reserve_bundles_batch", {"groups": groups})
+            results = res["results"]
+        except Exception as e:  # noqa: BLE001 — agent racing shutdown/death
+            logger.warning("reserve_bundles_batch to agent failed: %s", e)
+            for entry, _a in items:
+                self._pg_requeue(entry)
+            return
+        for entry, assignment in items:
+            if results.get(entry.pg_id):
+                self.pg_batch_stats["fused_commits"] += 1
+                self._pg_created(entry, assignment)
+            else:
+                self._pg_requeue(entry)
+
+    async def _two_phase_multi(self, multi: List[tuple]):
+        """Classic two-phase commit for groups spanning nodes, with the
+        per-node prepare/commit/cancel RPCs batched across groups."""
+        # node -> pg_id -> {bundle_index: spec}
+        by_node: Dict[NodeID, Dict] = {}
+        for entry, assignment in multi:
+            for idx, nid in enumerate(assignment):
+                by_node.setdefault(nid, {}).setdefault(entry.pg_id, {})[idx] = (
+                    entry.bundles[idx]
+                )
+        prepare_ok: Dict[NodeID, Dict] = {}
+
+        async def prepare(nid):
+            node = self.nodes.get(nid)
+            if node is None or not node.alive:
+                prepare_ok[nid] = {}
+                return
+            client = self.agent_clients.get(node.agent_address)
+            groups = [
+                {"pg_id": pg_id, "bundles": bundles}
+                for pg_id, bundles in by_node[nid].items()
+            ]
             try:
                 res = await client.call(
-                    "prepare_bundles",
-                    {
-                        "pg_id": entry.pg_id,
-                        "bundles": {i: entry.bundles[i] for i in idxs},
-                    },
+                    "prepare_bundles_batch", {"groups": groups}
                 )
-                if not res["ok"]:
-                    ok = False
-                    break
-                prepared.append(nid)
-            except Exception:
-                ok = False
-                break
-        if not ok:
-            for nid in prepared:
-                client = self.agent_clients.get(self.nodes[nid].agent_address)
+                prepare_ok[nid] = res["results"]
+            except Exception as e:  # noqa: BLE001
+                logger.warning("prepare_bundles_batch to agent failed: %s", e)
+                prepare_ok[nid] = {}
+
+        await asyncio.gather(*(prepare(nid) for nid in by_node))
+        committed: List[tuple] = []
+        cancels: Dict[NodeID, List] = {}
+        for entry, assignment in multi:
+            nodes = set(assignment)
+            if all(prepare_ok.get(nid, {}).get(entry.pg_id) for nid in nodes):
+                committed.append((entry, assignment))
+            else:
+                # Whole-group rollback: every node that DID reserve this
+                # group's bundles releases them before the group re-queues.
+                self.pg_batch_stats["rollbacks"] += 1
+                for nid in nodes:
+                    if prepare_ok.get(nid, {}).get(entry.pg_id):
+                        cancels.setdefault(nid, []).append(entry.pg_id)
+                self._pg_requeue(entry)
+
+        async def cancel(nid, pg_ids):
+            node = self.nodes.get(nid)
+            if node is None or not node.alive:
+                return
+            client = self.agent_clients.get(node.agent_address)
+            try:
+                await client.call("cancel_bundles_batch", {"pg_ids": pg_ids})
+            except Exception as e:  # noqa: BLE001
+                logger.warning("cancel_bundles_batch to agent failed: %s", e)
+
+        commit_ok: Dict[NodeID, bool] = {}
+
+        async def commit(nid, pg_ids):
+            node = self.nodes.get(nid)
+            if node is None or not node.alive:
+                commit_ok[nid] = False
+                return
+            client = self.agent_clients.get(node.agent_address)
+            try:
+                await client.call("commit_bundles_batch", {"pg_ids": pg_ids})
+                commit_ok[nid] = True
+            except Exception as e:  # noqa: BLE001
+                logger.warning("commit_bundles_batch to agent failed: %s", e)
+                commit_ok[nid] = False
+
+        commit_by_node: Dict[NodeID, List] = {}
+        for entry, assignment in committed:
+            for nid in set(assignment):
+                commit_by_node.setdefault(nid, []).append(entry.pg_id)
+        await asyncio.gather(
+            *(cancel(nid, pg_ids) for nid, pg_ids in cancels.items()),
+            *(commit(nid, pg_ids) for nid, pg_ids in commit_by_node.items()),
+        )
+        for entry, assignment in committed:
+            nodes = set(assignment)
+            if all(commit_ok.get(nid) for nid in nodes):
+                self._pg_created(entry, assignment)
+            else:
+                # A node died (or its commit RPC failed) between prepare
+                # and commit: the group must NOT claim CREATED with only
+                # part of its bundles live.  Release whatever this group
+                # holds on its surviving nodes and re-queue it.
+                self.pg_batch_stats["rollbacks"] += 1
+                self._release_bundles(entry.pg_id, nodes)
+                self._pg_requeue(entry)
+
+    def _release_bundles(self, pg_id: PlacementGroupID, node_ids):
+        """Best-effort fire-and-forget release of one group's bundles on
+        the given (surviving) nodes — the rollback half of a partial
+        commit or a reservation whose group was removed mid-flight."""
+
+        async def release():
+            for nid in node_ids:
+                node = self.nodes.get(nid)
+                if node is None or not node.alive:
+                    continue
+                client = self.agent_clients.get(node.agent_address)
                 try:
-                    await client.call("cancel_bundles", {"pg_id": entry.pg_id})
-                except Exception as e:
-                    logger.warning("cancel_bundles to agent failed: %s", e)
-            if entry.pg_id not in self._pending_pgs:
-                self._pending_pgs.append(entry.pg_id)
+                    await client.call(
+                        "return_bundles_batch", {"pg_ids": [pg_id]}
+                    )
+                except Exception as e:  # noqa: BLE001 — node racing death
+                    logger.debug("rollback return_bundles failed: %s", e)
+
+        task = asyncio.get_running_loop().create_task(release())
+        self._bg_tasks.append(task)
+        task.add_done_callback(self._bg_tasks.remove)
+
+    def _pg_created(self, entry: PlacementGroupEntry, assignment):
+        if entry.state != "PENDING":
+            # A remove raced this group's reservation sweep: the group
+            # stays REMOVED — release what the sweep just reserved
+            # instead of resurrecting it.
+            self._release_bundles(entry.pg_id, set(assignment))
             return
-        # Phase 2: commit.
-        for nid in by_node:
-            client = self.agent_clients.get(self.nodes[nid].agent_address)
-            await client.call("commit_bundles", {"pg_id": entry.pg_id})
         entry.bundle_nodes = list(assignment)
         entry.state = "CREATED"
         self.events.record(PG_LIFECYCLE, entry.pg_id.hex(), "CREATED")
         self._persist_pg(entry)
         self._publish("pg:" + entry.pg_id.hex(), entry.public_info())
 
-    async def handle_remove_placement_group(self, payload, conn):
-        entry = self.placement_groups.get(payload["pg_id"])
-        if entry is None:
-            return False
-        if entry.bundle_nodes:
-            for nid in set(entry.bundle_nodes):
+    def _pg_requeue(self, entry: PlacementGroupEntry):
+        if entry.state == "PENDING" and entry.pg_id not in self._pending_pgs:
+            self._pending_pgs.append(entry.pg_id)
+
+    async def _remove_pg_batch(self, entries: List[PlacementGroupEntry]):
+        by_node: Dict[NodeID, List] = {}
+        for entry in entries:
+            if entry.state == "REMOVED":
+                continue
+            for nid in set(entry.bundle_nodes or ()):
                 node = self.nodes.get(nid)
                 if node is None or not node.alive:
                     continue
-                client = self.agent_clients.get(node.agent_address)
-                try:
-                    await client.call("return_bundles", {"pg_id": entry.pg_id})
-                except Exception as e:
-                    logger.debug("return_bundles to agent failed: %s", e)
-        entry.state = "REMOVED"
-        self.events.record(PG_LIFECYCLE, entry.pg_id.hex(), "REMOVED")
-        self._persist_pg(entry)
-        if payload["pg_id"] in self._pending_pgs:
-            self._pending_pgs.remove(payload["pg_id"])
-        self._publish("pg:" + entry.pg_id.hex(), entry.public_info())
-        return True
+                by_node.setdefault(nid, []).append(entry.pg_id)
+
+        async def return_node(nid, pg_ids):
+            client = self.agent_clients.get(self.nodes[nid].agent_address)
+            try:
+                await client.call("return_bundles_batch", {"pg_ids": pg_ids})
+            except Exception as e:  # noqa: BLE001
+                logger.debug("return_bundles_batch to agent failed: %s", e)
+
+        await asyncio.gather(
+            *(return_node(nid, pg_ids) for nid, pg_ids in by_node.items())
+        )
+        for entry in entries:
+            if entry.state == "REMOVED":
+                continue
+            entry.state = "REMOVED"
+            self.events.record(PG_LIFECYCLE, entry.pg_id.hex(), "REMOVED")
+            self._persist_pg(entry)
+            if entry.pg_id in self._pending_pgs:
+                self._pending_pgs.remove(entry.pg_id)
+            self._publish("pg:" + entry.pg_id.hex(), entry.public_info())
 
     def handle_get_placement_group(self, payload, conn):
         entry = self.placement_groups.get(payload["pg_id"])
@@ -809,10 +1089,19 @@ class ControlPlane:
             if entry is not None and entry.state in (PENDING_CREATION, RESTARTING):
                 await self._try_schedule_actor(entry)
         pending_pgs, self._pending_pgs = self._pending_pgs, []
-        for pg_id in pending_pgs:
-            entry = self.placement_groups.get(pg_id)
-            if entry is not None and entry.state == "PENDING":
-                await self._try_schedule_pg(entry)
+        retry = [
+            entry
+            for entry in (self.placement_groups.get(p) for p in pending_pgs)
+            if entry is not None and entry.state == "PENDING"
+        ]
+        if retry:
+            # Through the ops queue, not a direct sweep: retries must
+            # serialize with concurrent removes exactly like fresh
+            # creates (a direct sweep racing a remove could resurrect a
+            # REMOVED group with leaked bundles).
+            await asyncio.gather(
+                *(self._enqueue_pg_op("create", e) for e in retry)
+            )
 
     # -------------------------------------------------------------- lookups
     def handle_pick_node_for_lease(self, payload, conn):
@@ -975,6 +1264,16 @@ class ControlPlane:
 
     def handle_ping(self, payload, conn):
         return "pong"
+
+    def handle_debug_control_plane(self, payload, conn):
+        """Control-plane self-diagnosis: group-commit accounting + per-lane
+        RPC dispatch stats (tests and the many-client limits stage)."""
+        return {
+            "pg_batch_stats": dict(self.pg_batch_stats),
+            "rpc_lanes": self.server.lane_stats(),
+            "nodes": len(self.nodes),
+            "placement_groups": len(self.placement_groups),
+        }
 
     def handle_get_state(self, payload, conn):
         """State-API snapshot (reference: ray.util.state / StateAggregator)."""

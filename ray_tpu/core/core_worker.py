@@ -53,14 +53,17 @@ from .ids import (
     new_task_id,
 )
 from .object_store import MemoryStore, ShmObjectStore
+from .owner_table import OwnerTable
 from .rpc import (
     UNBOUNDED,
     ClientPool,
+    ForwardToPrimary,
     RetryableRpcClient,
     RpcConnectionError,
     RpcRemoteError,
     RpcServer,
     RpcTimeoutError,
+    resolve_service_lanes,
 )
 from .serialization import (
     SerializedPayload,
@@ -999,6 +1002,21 @@ class CoreWorker:
     DRIVER = "driver"
     WORKER = "worker"
 
+    # Owner-service methods the multi-lane RPC server may run directly on
+    # a lane thread (see rpc.RpcServer): read-only resolution against the
+    # sharded owner table + memory store, with ``ForwardToPrimary`` punts
+    # for anything that must wait or mutate (unset events, loss reports,
+    # reconstruction).  Everything NOT named here — task pushes, ref
+    # counting, streams, cancels — transparently forwards to the primary
+    # loop and keeps its single-threaded semantics.
+    LANE_SAFE_METHODS = frozenset({
+        "get_object",
+        "get_object_batch",
+        "probe_object",
+        "probe_object_batch",
+        "ping",
+    })
+
     def __init__(
         self,
         mode: str,
@@ -1017,7 +1035,12 @@ class CoreWorker:
         self.job_id = job_id or JobID.from_random()
         self.worker_id = worker_id or WorkerID.from_random()
 
-        self.server = RpcServer(self, "127.0.0.1", 0)
+        self.server = RpcServer(
+            self, "127.0.0.1", 0,
+            lanes=resolve_service_lanes(
+                "worker" if mode == self.WORKER else "driver"
+            ),
+        )
         self.address: str = ""
         self.cp: Optional[RetryableRpcClient] = None
         self.agent: Optional[RetryableRpcClient] = None
@@ -1027,7 +1050,10 @@ class CoreWorker:
         self.memory_store = MemoryStore()
         self.shm_store = ShmObjectStore(session_id)
         self.submit_budget = _SubmitBudget()
-        self.owned: Dict[ObjectID, OwnedObject] = {}
+        # Sharded ownership table: lane threads resolve READY objects
+        # against shards directly (see LANE_SAFE_METHODS); all mutation
+        # stays on the protocol loop.
+        self.owned: OwnerTable = OwnerTable(GlobalConfig.owner_table_shards)
         self.lease_pools: Dict[tuple, _LeasePool] = {}
         self.actors: Dict[ActorID, _ActorState] = {}
 
@@ -1074,6 +1100,10 @@ class CoreWorker:
         self._loc_cache = _LocationCache()
         self._batch_get_calls = 0
         self._batch_get_refs = 0
+        # Owner-service shard accounting: entries served by the lock-free
+        # READY fast path (any lane) vs punted to the primary loop.
+        self._shard_fast_entries = 0
+        self._shard_forwarded_entries = 0
         # Best-effort task cancellation (ray_tpu.cancel).  Owner side:
         # return-object id -> live TaskSpec for normal tasks, pruned when
         # the task reply lands or its returns fail.  Executor side:
@@ -2355,24 +2385,122 @@ class CoreWorker:
             "payload": serialize_to_bytes(ObjectLostError(oid.hex(), "value missing")),
         }
 
-    async def handle_get_object(self, payload, conn):
-        return await self._get_object_entry(
-            payload["object_id"], payload.get("lost_locations") or ()
-        )
+    def _owner_entry_fast(self, oid: ObjectID):
+        """Owner-side resolution of a READY object — pure reads against
+        the sharded owner table + memory store, valid on any thread (the
+        multi-lane fast path; also the no-task-allocation fast path on the
+        primary loop).  Returns a reply entry, or None when the call needs
+        the primary loop (event not yet set — the producing task is still
+        running, or a reconstruction is in flight).
 
-    async def handle_get_object_batch(self, payload, conn):
+        Lane threads race primary-loop mutation (location pruning,
+        reconstruction resets, frees): every ambiguous read punts to the
+        primary instead of guessing.  The reconstruction reset writes
+        ``state`` FIRST and swaps ``event`` LAST, so re-reading both after
+        building the reply closes the torn-read window — a reset that
+        cleared fields mid-read has already flipped ``state`` off READY
+        by the time the post-check runs."""
+        obj = self.owned.get(oid)
+        if obj is None:
+            if self.memory_store.contains(oid):
+                try:
+                    return self._serialize_inline_entry(
+                        self.memory_store.peek(oid)
+                    )
+                except KeyError:  # contains/peek raced a free
+                    return None
+            return {
+                "kind": "error",
+                "payload": serialize_to_bytes(
+                    ObjectLostError(oid.hex(), "not owned by this worker")
+                ),
+            }
+        ev = obj.event
+        state = obj.state
+        if not ev.is_set() or state == PENDING:
+            return None
+        try:
+            if state == ERROR:
+                err = obj.error
+                if err is None:  # reset raced between state/error writes
+                    return None
+                entry = {"kind": "error", "payload": serialize_to_bytes(err)}
+            elif obj.inline_payload is not None:
+                entry = {
+                    "kind": "inline", "payload": oob_bytes(obj.inline_payload)
+                }
+            elif obj.locations:
+                entry = {
+                    "kind": "shm", "locations": sorted(obj.locations),
+                    "size": obj.size,
+                }
+            elif self.memory_store.contains(oid):
+                entry = self._serialize_inline_entry(self.memory_store.peek(oid))
+            else:
+                entry = {
+                    "kind": "error",
+                    "payload": serialize_to_bytes(
+                        ObjectLostError(oid.hex(), "value missing")
+                    ),
+                }
+        except (RuntimeError, KeyError):
+            # Set/dict mutated mid-iteration or memo freed mid-peek by
+            # the primary loop: resolve there instead.
+            return None
+        if obj.event is not ev or obj.state != state:
+            return None  # reconstruction reset raced the reads above
+        return entry
+
+    def handle_get_object(self, payload, conn):
+        oid = payload["object_id"]
+        lost = payload.get("lost_locations") or ()
+        if not lost:
+            entry = self._owner_entry_fast(oid)
+            if entry is not None:
+                self._shard_fast_entries += 1
+                return entry
+        self._shard_forwarded_entries += 1
+        return ForwardToPrimary(lambda: self._get_object_entry(oid, lost))
+
+    def handle_get_object_batch(self, payload, conn):
         """Vectorized borrower resolution: one reply with an entry per
-        requested object (mixed inline/shm/error).  Entries resolve
-        concurrently — each may block on its still-running producing
-        task without holding up the rest."""
+        requested object (mixed inline/shm/error).  READY entries resolve
+        on the receiving lane (or inline on the primary) without a task
+        allocation; only the unresolved remainder rides to the primary
+        loop, where entries resolve concurrently — each may block on its
+        still-running producing task without holding up the rest."""
         oids = payload["object_ids"]
         if not oids:
             return {"entries": []}
         lost = payload.get("lost_locations") or {}
-        entries = await asyncio.gather(
-            *(self._get_object_entry(oid, lost.get(oid) or ()) for oid in oids)
-        )
-        return {"entries": list(entries)}
+        entries: List[Optional[dict]] = [None] * len(oids)
+        missing: List[int] = []
+        for i, oid in enumerate(oids):
+            if lost.get(oid):
+                missing.append(i)
+                continue
+            entry = self._owner_entry_fast(oid)
+            if entry is None:
+                missing.append(i)
+            else:
+                entries[i] = entry
+        self._shard_fast_entries += len(oids) - len(missing)
+        if not missing:
+            return {"entries": entries}
+        self._shard_forwarded_entries += len(missing)
+
+        async def resolve_missing():
+            resolved = await asyncio.gather(
+                *(
+                    self._get_object_entry(oids[i], lost.get(oids[i]) or ())
+                    for i in missing
+                )
+            )
+            for i, entry in zip(missing, resolved):
+                entries[i] = entry
+            return {"entries": entries}
+
+        return ForwardToPrimary(resolve_missing)
 
     def handle_probe_object(self, payload, conn):
         obj = self.owned.get(payload["object_id"])

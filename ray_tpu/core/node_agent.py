@@ -30,7 +30,7 @@ from .config import GlobalConfig
 from .ids import ActorID, NodeID, ObjectID, PlacementGroupID, WorkerID
 from .object_store import NodeObjectDirectory, ShmObjectStore
 from .resources import NodeResources, ResourceInstanceSet, ResourceSet
-from .rpc import ClientPool, RetryableRpcClient, RpcServer
+from .rpc import ClientPool, RetryableRpcClient, RpcServer, resolve_service_lanes
 from .task_spec import ActorSpec
 from ..util.metric_registry import (
     LEASE_GRANT_WAIT_HIST,
@@ -102,6 +102,12 @@ class BundlePool:
 
 
 class NodeAgent:
+    # Read-only probes the multi-lane RPC server may run on a lane thread
+    # (see rpc.RpcServer).  The agent's stateful paths — leases, bundle
+    # pools, worker lifecycle, pulls — keep their single-loop semantics by
+    # forwarding; lanes still isolate per-connection framing/serialization.
+    LANE_SAFE_METHODS = frozenset({"ping", "object_info"})
+
     def __init__(
         self,
         host: str,
@@ -115,7 +121,7 @@ class NodeAgent:
         self.node_id = node_id or NodeID.from_random()
         self.session_id = session_id
         self.cp_address = cp_address
-        self.server = RpcServer(self, host, port)
+        self.server = RpcServer(self, host, port, lanes=resolve_service_lanes())
         self.cp_client = RetryableRpcClient(cp_address)
         self.agent_clients = ClientPool()  # peers, for remote pulls
         self.worker_clients = ClientPool()  # local workers (actor_init etc.)
@@ -327,6 +333,7 @@ class NodeAgent:
                     self.directory.record_telemetry()
                     fr.gauge(LEASE_QUEUE_DEPTH, len(self._lease_queue))
                     fr.gauge(LEASES_HELD, len(self.leases))
+                    fr.record_rpc_lanes(self.server, role="node_agent")
                     _metrics.flush()
             except Exception:  # raylint: waive[RTL003] telemetry must not kill heartbeat
                 pass
@@ -1088,38 +1095,85 @@ class NodeAgent:
         return {"worker_address": worker.address, "worker_id": worker.worker_id}
 
     # ---------------------------------------------------- placement bundles
-    def handle_prepare_bundles(self, payload, conn):
-        pg_id: PlacementGroupID = payload["pg_id"]
+    def _prepare_pg(self, pg_id: PlacementGroupID, bundles: dict) -> bool:
+        """Reserve one group's bundles; atomic per group — on any bundle
+        not fitting, every bundle already reserved HERE rolls back."""
         reserved = []
-        for idx, spec in payload["bundles"].items():
+        for idx, spec in bundles.items():
             rs = ResourceSet(spec)
             if not self.resources.acquire(rs):
                 for i in reserved:
                     pool = self.bundles.pop((pg_id, i))
                     self.resources.release(pool.total)
-                return {"ok": False}
+                return False
             self.bundles[(pg_id, idx)] = BundlePool(spec)
             reserved.append(idx)
-        return {"ok": True}
+        return True
+
+    def handle_prepare_bundles(self, payload, conn):
+        return {"ok": self._prepare_pg(payload["pg_id"], payload["bundles"])}
+
+    def handle_prepare_bundles_batch(self, payload, conn):
+        """Phase-1 reservation for SEVERAL placement groups in one RPC.
+        Per-group atomic: a group that doesn't fit rolls back its own
+        bundles and reports ok=False without affecting batch siblings."""
+        return {
+            "results": {
+                g["pg_id"]: self._prepare_pg(g["pg_id"], g["bundles"])
+                for g in payload["groups"]
+            }
+        }
 
     def handle_commit_bundles(self, payload, conn):
-        pg_id = payload["pg_id"]
+        self._commit_pg(payload["pg_id"])
+        return True
+
+    def _commit_pg(self, pg_id):
         for key, pool in self.bundles.items():
             if key[0] == pg_id:
                 pool.committed = True
+
+    def handle_commit_bundles_batch(self, payload, conn):
+        for pg_id in payload["pg_ids"]:
+            self._commit_pg(pg_id)
         return True
+
+    def handle_reserve_bundles_batch(self, payload, conn):
+        """Fused prepare+commit for groups placed wholly on this node —
+        the control plane's single-node fast path (two-phase commit only
+        pays for itself when a group spans agents)."""
+        results = {}
+        for g in payload["groups"]:
+            ok = self._prepare_pg(g["pg_id"], g["bundles"])
+            if ok:
+                self._commit_pg(g["pg_id"])
+            results[g["pg_id"]] = ok
+        return {"results": results}
 
     def handle_cancel_bundles(self, payload, conn):
         return self._drop_bundles(payload["pg_id"])
 
+    def handle_cancel_bundles_batch(self, payload, conn):
+        for pg_id in payload["pg_ids"]:
+            self._drop_bundles(pg_id, drain=False)
+        self._drain_lease_queue()
+        return True
+
     def handle_return_bundles(self, payload, conn):
         return self._drop_bundles(payload["pg_id"])
 
-    def _drop_bundles(self, pg_id):
+    def handle_return_bundles_batch(self, payload, conn):
+        for pg_id in payload["pg_ids"]:
+            self._drop_bundles(pg_id, drain=False)
+        self._drain_lease_queue()
+        return True
+
+    def _drop_bundles(self, pg_id, drain: bool = True):
         for key in [k for k in self.bundles if k[0] == pg_id]:
             pool = self.bundles.pop(key)
             self.resources.release(pool.total)
-        self._drain_lease_queue()
+        if drain:
+            self._drain_lease_queue()
         return True
 
     # --------------------------------------------------------------- objects
@@ -1305,6 +1359,7 @@ class NodeAgent:
             "spilled_bytes": self.directory.spilled_bytes,
             "num_spilled_total": self.directory.num_spilled,
             "rpc_stats": dict(self.server.stats),
+            "rpc_lanes": self.server.lane_stats(),
         }
 
 

@@ -19,12 +19,20 @@ VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
 
 
 class PlacementGroup:
-    def __init__(self, pg_id: PlacementGroupID, bundles: List[Dict[str, float]]):
+    def __init__(self, pg_id: PlacementGroupID, bundles: List[Dict[str, float]],
+                 created: bool = False):
         self.id = pg_id
         self.bundles = bundles
+        # Creation-reply fast path: the control plane's group-commit sweep
+        # runs BEFORE the create RPC replies, so in the common case the
+        # reply already says CREATED and ready() never needs a poll.
+        # Only a positive CREATED is cached — PENDING always re-polls.
+        self._created = created
 
     def ready(self, timeout: Optional[float] = None) -> bool:
         """Block until the group is created (2-phase commit finished)."""
+        if self._created:
+            return True
         worker = global_worker()
         deadline = None if timeout is None else time.monotonic() + timeout
 
@@ -35,6 +43,7 @@ class PlacementGroup:
             if info is None:
                 raise ValueError(f"placement group {self.id} unknown")
             if info["state"] == "CREATED":
+                self._created = True
                 return True
             if info["state"] == "REMOVED":
                 raise ValueError(f"placement group {self.id} was removed")
@@ -50,6 +59,8 @@ class PlacementGroup:
         return list(self.bundles)
 
     def __reduce__(self):
+        # The cached CREATED flag deliberately does not travel: a
+        # deserialized handle re-verifies against the control plane.
         return (PlacementGroup, (self.id, self.bundles))
 
 
@@ -64,13 +75,14 @@ def placement_group(
         raise ValueError("bundles must be a non-empty list of non-empty dicts")
     worker = global_worker()
     pg_id = PlacementGroupID.from_random()
-    worker._run_sync(
+    info = worker._run_sync(
         worker.cp.call(
             "create_placement_group",
             {"pg_id": pg_id, "bundles": bundles, "strategy": strategy, "name": name},
         )
     )
-    return PlacementGroup(pg_id, bundles)
+    created = bool(info) and info.get("state") == "CREATED"
+    return PlacementGroup(pg_id, bundles, created=created)
 
 
 def remove_placement_group(pg: PlacementGroup):

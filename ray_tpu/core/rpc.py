@@ -41,12 +41,14 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import pickle
 import random
 import socket
+import threading
 import time
 import traceback
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .config import GlobalConfig
 
@@ -292,17 +294,150 @@ async def _read_frame(reader: asyncio.StreamReader):
     return _decode_body(data)
 
 
+class ForwardToPrimary:
+    """Sentinel a *lane-safe* sync handler returns to punt one call to the
+    server's primary event loop.
+
+    Lane-safe handlers (named in the handler object's ``LANE_SAFE_METHODS``
+    frozenset) run directly on whichever lane owns the connection.  When a
+    particular call needs loop-affine state (an unresolved object, a
+    reconstruction, a mutation of primary-loop structures), the handler
+    returns ``ForwardToPrimary(coro_factory)``: the lane schedules
+    ``coro_factory()`` on the primary loop, awaits the result without
+    blocking the lane, and sends the reply from the lane (the connection's
+    transport never leaves its owning loop)."""
+
+    __slots__ = ("factory",)
+
+    def __init__(self, factory: Callable):
+        self.factory = factory
+
+
+class _LaneStats:
+    """Per-lane dispatch accounting.  Written by the owning lane thread
+    (plain int/float ops — no locks on the per-frame path); read by the
+    metrics flush on another thread, which tolerates torn windows."""
+
+    __slots__ = (
+        "index", "connections", "frames_total", "forwarded_total",
+        "inflight", "wait_sum", "wait_count", "wait_max",
+    )
+
+    def __init__(self, index: int):
+        self.index = index
+        self.connections = 0
+        self.frames_total = 0
+        self.forwarded_total = 0
+        self.inflight = 0      # frames read whose handler hasn't finished
+        self.wait_sum = 0.0    # read-complete -> handler-start latency
+        self.wait_count = 0
+        self.wait_max = 0.0
+
+    def note_wait(self, wait_s: float):
+        self.wait_sum += wait_s
+        self.wait_count += 1
+        if wait_s > self.wait_max:
+            self.wait_max = wait_s
+
+    def snapshot(self) -> dict:
+        return {
+            "lane": self.index,
+            "connections": self.connections,
+            "frames_total": self.frames_total,
+            "forwarded_total": self.forwarded_total,
+            "inflight": self.inflight,
+            "dispatch_wait_sum_s": self.wait_sum,
+            "dispatch_wait_count": self.wait_count,
+            "dispatch_wait_max_s": self.wait_max,
+        }
+
+
+class _RpcLane:
+    """One extra service lane: a daemon thread running its own event loop.
+    Connections are pinned to a lane at accept time, so per-connection
+    frame ordering is exactly the single-loop ordering."""
+
+    def __init__(self, index: int):
+        self.index = index
+        self.stats = _LaneStats(index)
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(
+            target=self._run, daemon=True, name=f"rpc-lane-{index}"
+        )
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        try:
+            self.loop.run_forever()
+        finally:
+            try:
+                self.loop.close()
+            except Exception as e:
+                logger.debug("lane %d loop close failed: %s", self.index, e)
+
+    def start(self):
+        self.thread.start()
+
+    def stop(self, timeout: float = 2.0):
+        def _halt():
+            # Cancel in-flight dispatches, then stop on the NEXT pass so
+            # the cancellations get one loop iteration to unwind.
+            for task in asyncio.all_tasks(self.loop):
+                task.cancel()
+            self.loop.call_soon(self.loop.stop)
+
+        try:
+            self.loop.call_soon_threadsafe(_halt)
+        except RuntimeError:
+            pass  # loop already stopped/closed
+        self.thread.join(timeout)
+
+
+def resolve_service_lanes(role: str = "") -> int:
+    """Lane count for an RPC service.  ``rpc_service_lanes`` > 0 wins;
+    0 = auto: min(4, cpu count) for the many-client servers (control
+    plane, node agent, driver owner service), 1 for worker executors —
+    a worker's hot path is ordered task pushes from one or two peers,
+    which gain nothing from cross-lane forwarding."""
+    n = GlobalConfig.rpc_service_lanes
+    if n > 0:
+        return int(n)
+    if role == "worker":
+        return 1
+    return max(1, min(4, os.cpu_count() or 1))
+
+
 class RpcServer:
     """Serves a handler object: each RPC method ``m`` dispatches to
     ``handler.handle_m(payload, ctx)`` (async or sync).  ``ctx`` exposes the
-    peer connection for server-push (pubsub)."""
+    peer connection for server-push (pubsub).
 
-    def __init__(self, handler, host: str = "127.0.0.1", port: int = 0):
+    Lanes (``lanes > 1``): the service runs N event loops — the primary
+    (lane 0, the loop ``start()`` ran on) plus N-1 ``_RpcLane`` threads.
+    Each accepted connection is pinned to the least-loaded lane for its
+    lifetime, so per-connection ordering is preserved.  Handler methods
+    named in ``handler.LANE_SAFE_METHODS`` execute directly on the lane
+    (they must be sync and touch only thread-safe state, returning
+    ``ForwardToPrimary`` for calls they can't serve); every other method
+    transparently forwards to the primary loop, preserving the
+    single-loop threading model for stateful handlers."""
+
+    def __init__(self, handler, host: str = "127.0.0.1", port: int = 0,
+                 lanes: int = 1):
         self._handler = handler
         self._host = host
         self._port = port
+        self.lanes = max(1, int(lanes))
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: set = set()
+        self._primary_loop: Optional[asyncio.AbstractEventLoop] = None
+        self._lane_workers: List[_RpcLane] = []
+        self._lane0_stats = _LaneStats(0)
+        self._accept_task: Optional[asyncio.Task] = None
+        self._lsock: Optional[socket.socket] = None
+        self._lane_safe: frozenset = frozenset(
+            getattr(handler, "LANE_SAFE_METHODS", ())
+        )
         # Per-handler latency stats (analog of event_stats.h).
         self.stats: Dict[str, list] = {}
 
@@ -310,16 +445,44 @@ class RpcServer:
     def address(self) -> Address:
         return f"{self._host}:{self._port}"
 
+    def lane_stats(self) -> List[dict]:
+        """Per-lane dispatch/queue accounting (lane 0 = primary loop)."""
+        out = [self._lane0_stats.snapshot()]
+        out.extend(lane.stats.snapshot() for lane in self._lane_workers)
+        return out
+
     async def start(self):
-        self._server = await asyncio.start_server(
-            self._on_connection, self._host, self._port
-        )
-        self._port = self._server.sockets[0].getsockname()[1]
+        self._primary_loop = asyncio.get_running_loop()
+        if self.lanes <= 1:
+            self._server = await asyncio.start_server(
+                self._on_connection, self._host, self._port
+            )
+            self._port = self._server.sockets[0].getsockname()[1]
+            return self.address
+        lsock = socket.socket()
+        lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        lsock.bind((self._host, self._port))
+        lsock.listen(512)
+        lsock.setblocking(False)
+        self._lsock = lsock
+        self._port = lsock.getsockname()[1]
+        for i in range(1, self.lanes):
+            lane = _RpcLane(i)
+            lane.start()
+            self._lane_workers.append(lane)
+        self._accept_task = self._primary_loop.create_task(self._accept_loop())
         return self.address
 
     async def stop(self):
         # Close live connections first: in py3.12 Server.wait_closed() blocks
         # until every connection handler returns.
+        if self._accept_task is not None:
+            self._accept_task.cancel()
+        if self._lsock is not None:
+            try:
+                self._lsock.close()
+            except OSError as e:
+                logger.debug("listen socket close failed: %s", e)
         for conn in list(self._conns):
             conn.close()
         if self._server:
@@ -328,15 +491,69 @@ class RpcServer:
                 await asyncio.wait_for(self._server.wait_closed(), timeout=1.0)
             except Exception as e:
                 logger.debug("server wait_closed failed: %s", e)
+        for lane in self._lane_workers:
+            lane.stop()
 
-    async def _on_connection(self, reader, writer):
+    # ------------------------------------------------------------ lane accept
+    def _pick_lane(self) -> Optional[_RpcLane]:
+        """Least-connections pin, primary loop (lane 0) included; ties go
+        to the lowest lane so light load stays on the primary."""
+        best = None  # None = primary
+        best_count = self._lane0_stats.connections
+        for lane in self._lane_workers:
+            if lane.stats.connections < best_count:
+                best = lane
+                best_count = lane.stats.connections
+        return best
+
+    async def _accept_loop(self):
+        loop = asyncio.get_running_loop()
+        while True:
+            try:
+                sock, _addr = await loop.sock_accept(self._lsock)
+            except asyncio.CancelledError:
+                raise
+            except OSError:
+                break  # listen socket closed (stop())
+            sock.setblocking(False)
+            lane = self._pick_lane()
+            if lane is None:
+                loop.create_task(self._adopt(sock, None))
+            else:
+                asyncio.run_coroutine_threadsafe(
+                    self._adopt(sock, lane), lane.loop
+                )
+
+    async def _adopt(self, sock, lane: Optional[_RpcLane]):
+        """Wrap an accepted socket in streams ON THE OWNING LANE's loop and
+        run the standard connection handler there."""
+        loop = asyncio.get_running_loop()
+        reader = asyncio.StreamReader(loop=loop)
+        proto = asyncio.StreamReaderProtocol(reader, loop=loop)
+        try:
+            transport, _ = await loop.connect_accepted_socket(
+                lambda: proto, sock
+            )
+        except Exception as e:  # noqa: BLE001 — peer may already be gone
+            logger.debug("accepted-socket adoption failed: %s", e)
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return
+        writer = asyncio.StreamWriter(transport, proto, reader, loop)
+        await self._on_connection(reader, writer, lane)
+
+    async def _on_connection(self, reader, writer, lane: Optional[_RpcLane] = None):
         try:
             writer.transport.set_write_buffer_limits(high=4 << 20)
         except Exception:  # raylint: waive[RTL003] write-buffer limit is a transport nicety
             pass
-        conn = ServerConnection(reader, writer)
+        conn = ServerConnection(reader, writer, cross_thread=self.lanes > 1)
         self._conns.add(conn)
         loop = asyncio.get_running_loop()
+        stats = lane.stats if lane is not None else self._lane0_stats
+        stats.connections += 1
         # Per-connection handler cache: (fn, is_coroutine_fn).  Sync handlers
         # dispatch inline — no task allocation, reply coalesced into the
         # connection's write buffer.
@@ -352,45 +569,74 @@ class RpcServer:
                 if method == "__batch__" and msg_id == 0:
                     # Multiplexed frame: each sub-call dispatches and
                     # replies independently, exactly as if sent alone.
-                    for sub in payload:
-                        self._process_frame(conn, loop, hcache, *sub)
+                    if lane is None:
+                        for sub in payload:
+                            self._process_frame(conn, loop, hcache, *sub)
+                    else:
+                        for sub in payload:
+                            self._process_frame_lane(conn, lane, hcache, *sub)
                     continue
-                self._process_frame(conn, loop, hcache, msg_id, method, payload)
+                if lane is None:
+                    self._process_frame(conn, loop, hcache, msg_id, method, payload)
+                else:
+                    self._process_frame_lane(
+                        conn, lane, hcache, msg_id, method, payload
+                    )
         finally:
             self._conns.discard(conn)
+            stats.connections -= 1
             conn.close()
             if hasattr(self._handler, "on_connection_closed"):
                 try:
-                    res = self._handler.on_connection_closed(conn)
-                    if asyncio.iscoroutine(res):
-                        await res
+                    if lane is None:
+                        res = self._handler.on_connection_closed(conn)
+                        if asyncio.iscoroutine(res):
+                            await res
+                    else:
+                        # Teardown hooks touch primary-loop state (pubsub
+                        # tables, lease sweeps): run them there.
+                        await asyncio.wrap_future(
+                            asyncio.run_coroutine_threadsafe(
+                                self._closed_on_primary(conn),
+                                self._primary_loop,
+                            )
+                        )
                 except Exception:
                     logger.exception("on_connection_closed failed")
 
+    async def _closed_on_primary(self, conn):
+        res = self._handler.on_connection_closed(conn)
+        if asyncio.iscoroutine(res):
+            await res
+
+    def _handshake(self, conn, loop, payload):
+        try:
+            # Positional prefix only: future hellos may APPEND fields
+            # (the evolution rule applies to the handshake too), and a
+            # frame we can't parse at all is treated as incompatible —
+            # fail fast with a versioned goodbye, not a torn socket.
+            ver, peer_min = payload[0], payload[1]
+        except Exception:  # noqa: BLE001
+            ver, peer_min = -1, PROTOCOL_VERSION + 1
+        if ver < MIN_COMPAT_VERSION or peer_min > PROTOCOL_VERSION:
+            # Legacy body: the refused peer may predate v2 framing and
+            # must still be able to parse the goodbye.
+            conn.send_nowait(
+                (0, "__goodbye__",
+                 (PROTOCOL_VERSION, MIN_COMPAT_VERSION)),
+                legacy=True,
+            )
+            # Close AFTER the goodbye flushes (both are call_soon'd on
+            # this loop, in order).
+            loop.call_soon(conn.close)
+        else:
+            conn.peer_version = ver
+
     def _process_frame(self, conn, loop, hcache, msg_id, method, payload):
         if method == "__hello__" and msg_id == 0:
-            try:
-                # Positional prefix only: future hellos may APPEND fields
-                # (the evolution rule applies to the handshake too), and a
-                # frame we can't parse at all is treated as incompatible —
-                # fail fast with a versioned goodbye, not a torn socket.
-                ver, peer_min = payload[0], payload[1]
-            except Exception:  # noqa: BLE001
-                ver, peer_min = -1, PROTOCOL_VERSION + 1
-            if ver < MIN_COMPAT_VERSION or peer_min > PROTOCOL_VERSION:
-                # Legacy body: the refused peer may predate v2 framing and
-                # must still be able to parse the goodbye.
-                conn.send_nowait(
-                    (0, "__goodbye__",
-                     (PROTOCOL_VERSION, MIN_COMPAT_VERSION)),
-                    legacy=True,
-                )
-                # Close AFTER the goodbye flushes (both are call_soon'd on
-                # this loop, in order).
-                loop.call_soon(conn.close)
-            else:
-                conn.peer_version = ver
+            self._handshake(conn, loop, payload)
             return
+        self._lane0_stats.frames_total += 1
         entry = hcache.get(method)
         if entry is None:
             fn = getattr(self._handler, "handle_" + method, None)
@@ -408,7 +654,12 @@ class RpcServer:
         start = time.perf_counter()
         try:
             result = fn(payload, conn)
-            if asyncio.iscoroutine(result):
+            if type(result) is ForwardToPrimary:
+                # On the primary already: just run the slow-path coroutine.
+                loop.create_task(
+                    self._finish_async(conn, msg_id, method, result.factory())
+                )
+            elif asyncio.iscoroutine(result):
                 # Sync wrapper returning a coroutine: await in a task.
                 loop.create_task(
                     self._finish_async(conn, msg_id, method, result)
@@ -434,6 +685,118 @@ class RpcServer:
             s = self.stats[method] = [0, 0.0]
         s[0] += 1
         s[1] += time.perf_counter() - start
+
+    # -------------------------------------------------------- lane dispatch
+    def _process_frame_lane(self, conn, lane, hcache, msg_id, method, payload):
+        """Frame dispatch on a lane thread.  Lane-safe sync handlers run
+        inline (reply coalesced into the lane connection's write buffer);
+        everything else forwards to the primary loop, with the reply sent
+        from the lane so the transport never crosses threads."""
+        loop = lane.loop
+        if method == "__hello__" and msg_id == 0:
+            self._handshake(conn, loop, payload)
+            return
+        stats = lane.stats
+        stats.frames_total += 1
+        entry = hcache.get(method)
+        if entry is None:
+            fn = getattr(self._handler, "handle_" + method, None)
+            lane_ok = (
+                method in self._lane_safe
+                and fn is not None
+                and not asyncio.iscoroutinefunction(fn)
+            )
+            entry = (fn, lane_ok)
+            hcache[method] = entry
+        fn, lane_ok = entry
+        if not lane_ok:
+            stats.forwarded_total += 1
+            loop.create_task(
+                self._forward_call(conn, msg_id, method, payload, fn, lane,
+                                   time.perf_counter())
+            )
+            return
+        stats.note_wait(0.0)
+        try:
+            result = fn(payload, conn)
+            if type(result) is ForwardToPrimary:
+                stats.forwarded_total += 1
+                loop.create_task(
+                    self._forward_factory(conn, msg_id, method,
+                                          result.factory, lane)
+                )
+            elif asyncio.iscoroutine(result):
+                # A lane-safe handler opting into lane-local async work.
+                loop.create_task(
+                    self._finish_async(conn, msg_id, method, result)
+                )
+            elif msg_id > 0:
+                conn.send_nowait((-msg_id, "R", result))
+        except Exception as e:  # noqa: BLE001
+            if msg_id > 0:
+                try:
+                    conn.send_nowait((-msg_id, "E", (e, traceback.format_exc())))
+                except Exception:
+                    logger.exception("failed to send error reply for %s", method)
+            else:
+                logger.exception("oneway lane handler %s failed", method)
+
+    async def _forward_call(self, conn, msg_id, method, payload, fn, lane, t0):
+        """Run a non-lane-safe handler on the primary loop; reply from the
+        lane.  ``wrap_future`` bridges the cross-loop completion without
+        blocking the lane's read loop."""
+        stats = lane.stats
+        stats.inflight += 1
+        try:
+            cfut = asyncio.run_coroutine_threadsafe(
+                self._run_on_primary(method, payload, conn, fn, stats, t0),
+                self._primary_loop,
+            )
+            result = await asyncio.wrap_future(cfut)
+            if msg_id > 0:
+                await conn.send((-msg_id, "R", result))
+        except Exception as e:  # noqa: BLE001 — serialize any handler error
+            if msg_id > 0:
+                try:
+                    await conn.send((-msg_id, "E", (e, traceback.format_exc())))
+                except Exception:
+                    logger.exception("failed to send error reply for %s", method)
+            else:
+                logger.exception("oneway handler %s failed", method)
+        finally:
+            stats.inflight -= 1
+
+    async def _run_on_primary(self, method, payload, conn, fn, stats, t0):
+        stats.note_wait(time.perf_counter() - t0)
+        if fn is None:
+            raise RpcError(f"no handler for method {method!r}")
+        result = fn(payload, conn)
+        if asyncio.iscoroutine(result):
+            result = await result
+        return result
+
+    async def _forward_factory(self, conn, msg_id, method, factory, lane):
+        """A lane-safe handler punted this call: run its slow-path
+        coroutine on the primary loop, reply from the lane."""
+        stats = lane.stats
+        stats.inflight += 1
+        try:
+            cfut = asyncio.run_coroutine_threadsafe(
+                factory(), self._primary_loop
+            )
+            result = await asyncio.wrap_future(cfut)
+            if msg_id > 0:
+                await conn.send((-msg_id, "R", result))
+        except Exception as e:  # noqa: BLE001
+            if msg_id > 0:
+                try:
+                    await conn.send((-msg_id, "E", (e, traceback.format_exc())))
+                except Exception:
+                    logger.exception("failed to send error reply for %s", method)
+            else:
+                logger.exception("oneway handler %s failed", method)
+        finally:
+            stats.inflight -= 1
 
     async def _finish_async(self, conn, msg_id, method, coro):
         try:
@@ -484,9 +847,15 @@ class ServerConnection:
     per reply).  Single-threaded event loop ⇒ no lock needed; each frame is
     appended atomically so frames never interleave."""
 
-    def __init__(self, reader, writer):
+    def __init__(self, reader, writer, cross_thread: bool = False):
         self._reader = reader
         self._writer = writer
+        # Owning event loop: with a multi-lane server the connection's
+        # transport lives on ITS lane's loop, while pubsub publishes and
+        # forwarded-handler teardown run on the primary — cross-thread
+        # sends route through call_soon_threadsafe under a small lock.
+        self._loop = asyncio.get_running_loop()
+        self._xlock = threading.Lock() if cross_thread else None
         # Write queue is a SEGMENT LIST (bytes/memoryviews), not a flat
         # bytearray: out-of-band payload buffers ride to writelines
         # untouched instead of being copied into a coalescing buffer.
@@ -498,33 +867,66 @@ class ServerConnection:
         self.metadata: Dict[str, Any] = {}  # handlers can stash identity here
         self.peer_version = PROTOCOL_VERSION  # pre-handshake default
 
+    def _on_owner_loop(self) -> bool:
+        try:
+            return asyncio.get_running_loop() is self._loop
+        except RuntimeError:
+            return False
+
     def send_nowait(self, frame, legacy: bool = False):
         """Queue a frame; flushed on the next loop pass.  ``legacy`` sends
         the v1 body format — required for ``__goodbye__``, which must be
-        parseable by the incompatible peer being refused."""
+        parseable by the incompatible peer being refused.  Thread-safe on
+        multi-lane servers (callers off the owning loop schedule the flush
+        with call_soon_threadsafe)."""
         if legacy:
-            data = _encode_frame_v1(frame)
-            self._wsegs.append(data)
-            self._wbytes += len(data)
+            segs = [_encode_frame_v1(frame)]
+            n = len(segs[0])
         else:
             segs, n = _encode_frame(frame)
+        if self._xlock is None:
             self._wsegs.extend(segs)
             self._wbytes += n
-        if not self._flush_scheduled:
-            self._flush_scheduled = True
-            asyncio.get_running_loop().call_soon(self._flush)
+            if not self._flush_scheduled:
+                self._flush_scheduled = True
+                asyncio.get_running_loop().call_soon(self._flush)
+            return
+        with self._xlock:
+            self._wsegs.extend(segs)
+            self._wbytes += n
+            schedule = not self._flush_scheduled
+            if schedule:
+                self._flush_scheduled = True
+        if schedule:
+            if self._on_owner_loop():
+                self._loop.call_soon(self._flush)
+            else:
+                try:
+                    self._loop.call_soon_threadsafe(self._flush)
+                except RuntimeError:
+                    pass  # owning lane already stopped at teardown
 
     def _flush(self):
-        self._flush_scheduled = False
-        if not self._wsegs:
-            return
-        if self._drain_task is not None and not self._drain_task.done():
-            # Transport backed up by a slow peer: keep frames queued
-            # (bounded because the server stops reading this connection —
-            # see wait_writable) until the drain completes.
-            return
-        segs, self._wsegs = self._wsegs, []
-        self._wbytes = 0
+        if self._xlock is None:
+            self._flush_scheduled = False
+            if not self._wsegs:
+                return
+            if self._drain_task is not None and not self._drain_task.done():
+                # Transport backed up by a slow peer: keep frames queued
+                # (bounded because the server stops reading this connection —
+                # see wait_writable) until the drain completes.
+                return
+            segs, self._wsegs = self._wsegs, []
+            self._wbytes = 0
+        else:
+            with self._xlock:
+                self._flush_scheduled = False
+                if not self._wsegs:
+                    return
+                if self._drain_task is not None and not self._drain_task.done():
+                    return
+                segs, self._wsegs = self._wsegs, []
+                self._wbytes = 0
         try:
             self._writer.writelines(segs)
             if self._writer.transport.get_write_buffer_size() > (4 << 20):
@@ -571,15 +973,33 @@ class ServerConnection:
             logger.debug("backpressure drain failed: %s", e)
 
     async def push(self, method: str, payload):
-        """One-way server→client message (pubsub delivery)."""
-        await self.send((0, method, payload))
+        """One-way server→client message (pubsub delivery).  From off the
+        owning loop (primary-loop publish to a lane-pinned subscriber) the
+        frame is queued thread-safely without awaiting transport drain."""
+        if self._on_owner_loop():
+            await self.send((0, method, payload))
+        else:
+            self.send_nowait((0, method, payload))
 
     def close(self):
         self.closed = True
-        try:
-            self._writer.close()
-        except Exception as e:
-            logger.debug("server conn close failed: %s", e)
+        if self._on_owner_loop():
+            try:
+                self._writer.close()
+            except Exception as e:
+                logger.debug("server conn close failed: %s", e)
+        else:
+            # Lane-owned transport: close must run on its loop.
+            def _do_close():
+                try:
+                    self._writer.close()
+                except Exception as e:
+                    logger.debug("server conn close failed: %s", e)
+
+            try:
+                self._loop.call_soon_threadsafe(_do_close)
+            except RuntimeError:
+                pass  # lane loop already stopped
 
     @property
     def peername(self):

@@ -63,8 +63,21 @@ from .metric_registry import (  # noqa: F401 — re-exports
     LOCATION_CACHE_HITS_TOTAL,
     LOCATION_CACHE_INVALIDATIONS_TOTAL,
     LOCATION_CACHE_MISSES_TOTAL,
+    OWNER_SHARD_FAST_ENTRIES_TOTAL,
+    OWNER_SHARD_FORWARDED_ENTRIES_TOTAL,
+    OWNER_SHARD_LOOKUPS_TOTAL,
+    OWNER_SHARD_OBJECTS_MAX,
+    PG_COMMIT_BATCHED_GROUPS_TOTAL,
+    PG_COMMIT_BATCHES_TOTAL,
+    PG_COMMIT_FUSED_TOTAL,
+    PG_COMMIT_ROLLBACKS_TOTAL,
     RPC_BATCH_FRAMES_TOTAL,
     RPC_BATCHED_CALLS_TOTAL,
+    RPC_LANE_CONNECTIONS,
+    RPC_LANE_DISPATCH_WAIT_HIST,
+    RPC_LANE_FORWARDED_TOTAL,
+    RPC_LANE_FRAMES_TOTAL,
+    RPC_LANE_QUEUE_DEPTH,
     RPC_OOB_BYTES_TOTAL,
     RPC_OOB_FRAMES_TOTAL,
     TASK_EVENTS_DROPPED_TOTAL,
@@ -136,6 +149,7 @@ def record_data_plane(worker) -> None:
     from ..core.rpc import FRAME_STATS
 
     cache = getattr(worker, "_loc_cache", None)
+    owned = getattr(worker, "owned", None)
     totals = {
         RPC_OOB_FRAMES_TOTAL: FRAME_STATS["oob_frames"],
         RPC_OOB_BYTES_TOTAL: FRAME_STATS["oob_bytes"],
@@ -148,11 +162,93 @@ def record_data_plane(worker) -> None:
         LOCATION_CACHE_INVALIDATIONS_TOTAL: (
             cache.invalidations if cache else 0
         ),
+        OWNER_SHARD_LOOKUPS_TOTAL: (
+            sum(owned.lookups) if hasattr(owned, "lookups") else 0
+        ),
+        OWNER_SHARD_FAST_ENTRIES_TOTAL: getattr(
+            worker, "_shard_fast_entries", 0
+        ),
+        OWNER_SHARD_FORWARDED_ENTRIES_TOTAL: getattr(
+            worker, "_shard_forwarded_entries", 0
+        ),
     }
     for name, total in totals.items():
         delta = total - _dp_published.get(name, 0)
         if delta > 0:
             _dp_published[name] = total
+            counter(name, delta)
+    if hasattr(owned, "shard_sizes"):
+        sizes = owned.shard_sizes()
+        gauge(OWNER_SHARD_OBJECTS_MAX, max(sizes) if sizes else 0)
+    record_rpc_lanes(getattr(worker, "server", None), role=worker.mode)
+
+
+# ------------------------------------------------ multi-lane RPC services
+# Same delta-publication pattern: lanes bump plain per-lane accumulators
+# on the frame path; the metrics flush turns them into registry samples.
+_lane_published: Dict[tuple, dict] = {}
+
+
+def record_rpc_lanes(server, role: str = "") -> None:
+    """Publish per-lane dispatch telemetry for one RpcServer: frame and
+    forward counters (deltas), connection/queue-depth gauges, and a
+    dispatch-wait histogram fed one window-mean sample per flush."""
+    if not GlobalConfig.enable_flight_recorder or server is None:
+        return
+    lane_stats = getattr(server, "lane_stats", None)
+    if lane_stats is None:
+        return
+    for snap in lane_stats():
+        lane = str(snap["lane"])
+        tags = {"role": role or "server", "lane": lane}
+        prev = _lane_published.setdefault(
+            (role, lane), {"frames": 0, "forwarded": 0, "wait_sum": 0.0,
+                           "wait_count": 0},
+        )
+        frames = snap["frames_total"]
+        forwarded = snap["forwarded_total"]
+        if frames < prev["frames"]:
+            # A fresh RpcServer under the same role/lane (in-process
+            # init/shutdown cycle): totals restarted at zero — reset the
+            # baseline so the counter stays monotonic.
+            prev.update(frames=0, forwarded=0, wait_sum=0.0, wait_count=0)
+        df = frames - prev["frames"]
+        dfw = forwarded - prev["forwarded"]
+        if df > 0:
+            counter(RPC_LANE_FRAMES_TOTAL, df, tags)
+        if dfw > 0:
+            counter(RPC_LANE_FORWARDED_TOTAL, dfw, tags)
+        gauge(RPC_LANE_CONNECTIONS, snap["connections"], tags)
+        gauge(RPC_LANE_QUEUE_DEPTH, snap["inflight"], tags)
+        dc = snap["dispatch_wait_count"] - prev["wait_count"]
+        ds = snap["dispatch_wait_sum_s"] - prev["wait_sum"]
+        if dc > 0:
+            histogram(RPC_LANE_DISPATCH_WAIT_HIST, max(0.0, ds / dc), tags)
+        prev["frames"] = frames
+        prev["forwarded"] = forwarded
+        prev["wait_sum"] = snap["dispatch_wait_sum_s"]
+        prev["wait_count"] = snap["dispatch_wait_count"]
+
+
+_pg_published: Dict[str, float] = {}
+
+
+def record_pg_batches(stats: Dict[str, int]) -> None:
+    """Publish placement-group group-commit counters (control plane)."""
+    if not GlobalConfig.enable_flight_recorder:
+        return
+    totals = {
+        PG_COMMIT_BATCHES_TOTAL: stats.get("batches", 0),
+        PG_COMMIT_BATCHED_GROUPS_TOTAL: (
+            stats.get("batched_creates", 0) + stats.get("batched_removes", 0)
+        ),
+        PG_COMMIT_FUSED_TOTAL: stats.get("fused_commits", 0),
+        PG_COMMIT_ROLLBACKS_TOTAL: stats.get("rollbacks", 0),
+    }
+    for name, total in totals.items():
+        delta = total - _pg_published.get(name, 0)
+        if delta > 0:
+            _pg_published[name] = total
             counter(name, delta)
 
 

@@ -64,6 +64,23 @@ DATA_BLOCKS_COALESCED_TOTAL = "ray_tpu_data_blocks_coalesced_total"
 DATA_BLOCKS_EMITTED_TOTAL = "ray_tpu_data_blocks_emitted_total"
 TASKS_CANCELLED_TOTAL = "ray_tpu_tasks_cancelled_total"
 
+# ------------------------------------------------- sharded control plane
+RPC_LANE_FRAMES_TOTAL = "ray_tpu_rpc_lane_frames_total"
+RPC_LANE_FORWARDED_TOTAL = "ray_tpu_rpc_lane_forwarded_total"
+RPC_LANE_CONNECTIONS = "ray_tpu_rpc_lane_connections"
+RPC_LANE_QUEUE_DEPTH = "ray_tpu_rpc_lane_queue_depth"
+RPC_LANE_DISPATCH_WAIT_HIST = "ray_tpu_rpc_lane_dispatch_wait_s"
+OWNER_SHARD_LOOKUPS_TOTAL = "ray_tpu_owner_shard_lookups_total"
+OWNER_SHARD_FAST_ENTRIES_TOTAL = "ray_tpu_owner_shard_fast_entries_total"
+OWNER_SHARD_FORWARDED_ENTRIES_TOTAL = (
+    "ray_tpu_owner_shard_forwarded_entries_total"
+)
+OWNER_SHARD_OBJECTS_MAX = "ray_tpu_owner_shard_objects_max"
+PG_COMMIT_BATCHES_TOTAL = "ray_tpu_pg_commit_batches_total"
+PG_COMMIT_BATCHED_GROUPS_TOTAL = "ray_tpu_pg_commit_batched_groups_total"
+PG_COMMIT_FUSED_TOTAL = "ray_tpu_pg_commit_fused_total"
+PG_COMMIT_ROLLBACKS_TOTAL = "ray_tpu_pg_commit_rollbacks_total"
+
 # ------------------------------------------------------------- scheduling
 LEASE_GRANT_WAIT_HIST = "ray_tpu_lease_grant_wait_s"
 LEASE_QUEUE_DEPTH = "ray_tpu_lease_queue_depth"
@@ -134,6 +151,32 @@ METRICS: Dict[str, str] = {
     TASKS_CANCELLED_TOTAL: "cancel requests accepted owner-side via "
                            "ray_tpu.cancel (best-effort; an executing "
                            "task still completes)",
+    RPC_LANE_FRAMES_TOTAL: "frames dispatched per RPC service lane, by "
+                           "role/lane",
+    RPC_LANE_FORWARDED_TOTAL: "lane frames forwarded to the primary loop "
+                              "(non-lane-safe handlers + slow-path punts)",
+    RPC_LANE_CONNECTIONS: "connections currently pinned to a lane (gauge)",
+    RPC_LANE_QUEUE_DEPTH: "frames read but not yet fully handled on a "
+                          "lane (gauge)",
+    RPC_LANE_DISPATCH_WAIT_HIST: "frame-read to handler-start latency per "
+                                 "lane (histogram; one window-mean sample "
+                                 "per metrics flush)",
+    OWNER_SHARD_LOOKUPS_TOTAL: "owner-table shard lookups (all shards "
+                               "summed)",
+    OWNER_SHARD_FAST_ENTRIES_TOTAL: "owner get/probe entries served by the "
+                                    "lock-free READY fast path (any lane)",
+    OWNER_SHARD_FORWARDED_ENTRIES_TOTAL: "owner get entries that needed the "
+                                         "primary loop (unset event, loss "
+                                         "report, reconstruction)",
+    OWNER_SHARD_OBJECTS_MAX: "objects in the largest owner-table shard "
+                             "(gauge; balance indicator)",
+    PG_COMMIT_BATCHES_TOTAL: "placement-group group-commit sweeps executed",
+    PG_COMMIT_BATCHED_GROUPS_TOTAL: "PG create/remove ops that shared a "
+                                    "sweep with at least one other op",
+    PG_COMMIT_FUSED_TOTAL: "single-node PGs committed via the fused "
+                           "prepare+commit agent RPC",
+    PG_COMMIT_ROLLBACKS_TOTAL: "whole-group rollbacks after a partial "
+                               "bundle-reservation failure",
     LEASE_GRANT_WAIT_HIST: "lease request wait until grant/spillback/retry "
                            "(histogram)",
     LEASE_QUEUE_DEPTH: "lease requests parked on the node agent (gauge)",
