@@ -1629,6 +1629,92 @@ def run_data_suite():
         ray_tpu.shutdown()
 
 
+def run_pipeline_suite():
+    """Pipeline-parallel trainer: a 2-stage pipelined gpt2 step `vs` the
+    sequential 1-stage self-baseline (same chunked math, same microbatch
+    accumulation, measured in THIS run — ROADMAP item 2's gate shape).
+
+    Records steady-state tokens/s for both runs, the measured
+    ``pipeline_bubble_fraction`` (stall/wall summed over stages, with
+    the theoretical (S-1)/(S-1+M) bound alongside), and
+    ``pipeline_loss_divergence`` — the max relative per-step loss
+    divergence between the two runs (parity gate: <= 1e-5)."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.models.gpt2 import GPT2Config
+    from ray_tpu.train import PipelineConfig, PipelinedTrainer
+    from ray_tpu.train.pipeline import (
+        gpt2_stage_modules,
+        reference_run,
+        theoretical_bubble_fraction,
+    )
+
+    cfg = GPT2Config.tiny()
+    B, S, M, steps, warm = 8, 64, 4, 6, 2
+    builder = gpt2_stage_modules(cfg, 2)
+
+    def data(step):
+        rng = np.random.RandomState(step)
+        toks = rng.randint(0, cfg.vocab_size, (B, S + 1)).astype(np.int32)
+        return toks[:, :-1], toks[:, 1:]
+
+    # Sequential self-baseline first (no cluster needed): same two model
+    # chunks, same per-microbatch grad accumulation, one process.
+    ref_losses, _ = reference_run(
+        builder, 2, data, steps, num_microbatches=M, learning_rate=1e-3
+    )
+    base_dt = sum(ref_losses.step_walls[warm:]) / (steps - warm)
+    base_toks = B * S / base_dt
+    emit("pipeline_1stage_tokens_per_s", base_toks, "tokens/s",
+         batch=B, seq=S, microbatches=M)
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        trainer = PipelinedTrainer(
+            builder,
+            pipeline_config=PipelineConfig(
+                num_stages=2, num_microbatches=M, recv_timeout_s=120.0
+            ),
+            data_per_step=data,
+            num_steps=steps,
+            learning_rate=1e-3,
+        )
+        try:
+            res = trainer.fit()
+        finally:
+            trainer.shutdown()
+    finally:
+        ray_tpu.shutdown()
+    assert res.error is None, res.error
+    hist = res.metrics_history
+    pipe_dt = sum(m["step_wall_s"] for m in hist[warm:]) / (steps - warm)
+    pipe_toks = B * S / pipe_dt
+    bubble = sum(m["bubble_fraction"] for m in hist[warm:]) / (steps - warm)
+    emit(
+        "pipeline_tokens_per_s", pipe_toks, "tokens/s", baseline=base_toks,
+        stages=2, microbatches=M, batch=B, seq=S,
+        baseline_source="self_1stage",
+    )
+    emit(
+        "pipeline_bubble_fraction", bubble, "fraction",
+        theoretical=round(theoretical_bubble_fraction(2, M), 4),
+    )
+    divergence = max(
+        abs(a - b["loss"]) / max(abs(a), 1e-9)
+        for a, b in zip(ref_losses, hist)
+    )
+    emit(
+        "pipeline_loss_divergence", divergence, "max_rel", guard="<=1e-5",
+        steps=steps,
+    )
+    if divergence > 1e-5:
+        print(
+            f"# pipeline_loss_divergence GUARD EXCEEDED: "
+            f"{divergence:.2e} > 1e-5", flush=True,
+        )
+
+
 def run_obs_overhead_suite():
     res = measure_obs_overhead()
     emit(
@@ -1680,6 +1766,8 @@ def main():
             run("obs_overhead", run_obs_overhead_suite)
         if only in ("all", "data"):
             run("data", run_data_suite)
+        if only in ("all", "pipeline"):
+            run("pipeline", run_pipeline_suite)
         if only in ("all", "scaling"):
             run("scaling", run_scaling_suite)
         if only in ("all", "model"):

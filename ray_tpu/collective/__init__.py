@@ -17,6 +17,7 @@ from .collective import (  # noqa: F401
     send,
 )
 from .device_objects import DeviceObjectStore, DeviceRef, device_object_store  # noqa: F401
+from .p2p import Mailbox, StageChannel, local_mailbox  # noqa: F401
 from .types import Backend, GroupInfo, ReduceOp  # noqa: F401
 from .experimental import (  # noqa: F401
     RemoteCommunicatorManager,

@@ -1015,6 +1015,10 @@ class CoreWorker:
         "probe_object",
         "probe_object_batch",
         "ping",
+        # Pipeline microbatch pushes deposit into the process-local p2p
+        # mailbox (own lock, no owner-table access) — lane execution keeps
+        # activation streaming off the primary control loop entirely.
+        "pipeline_push",
     })
 
     def __init__(
@@ -3812,9 +3816,24 @@ class CoreWorker:
             ),
         }
 
+    def handle_pipeline_push(self, payload, conn):
+        """Stage-boundary p2p delivery (train.pipeline activations/grads):
+        park the still-serialized payload in the local mailbox for the
+        consuming actor thread.  Lane-safe — one dict insert + notify."""
+        from ..collective.p2p import local_mailbox
+
+        local_mailbox().deposit(payload["edge"], payload["seq"],
+                                payload["data"])
+        return True
+
     def handle_device_fetch(self, payload, conn):
         """Point-to-point DeviceRef resolution (RDT analog): serialize the
-        resident array to the requester (one host hop)."""
+        resident array to the requester (one host hop).  The reply rides
+        the zero-copy path: the host view of the array goes out as an
+        out-of-band frame segment (no ``tobytes()`` flat copy), and the
+        requester's ``np.frombuffer`` reads straight from the receive
+        buffer — this is the prefill→decode KV-cache handoff, so the two
+        copies this saves are per KV block."""
         import numpy as np
 
         from ..collective.device_objects import device_object_store
@@ -3823,7 +3842,11 @@ class CoreWorker:
         arr = store._objects.get(payload["object_id"])
         if arr is None:
             return {"found": False}
-        return {"found": True, "data": np.asarray(arr).tobytes()}
+        host = np.ascontiguousarray(np.asarray(arr))
+        # Raw-byte view (uint8) rather than memoryview(host): custom
+        # dtypes (ml_dtypes bfloat16) don't export a buffer format.
+        raw = memoryview(host.reshape(-1).view(np.uint8))
+        return {"found": True, "data": oob_bytes(raw)}
 
     def handle_device_free(self, payload, conn):
         """Owner-side release of one reference (refcounted residency)."""

@@ -97,6 +97,45 @@ def placement_group_strategy(
     return PlacementGroupStrategy(pg.id.hex(), bundle_index)
 
 
+def pipeline_stage_placement_group(
+    num_stages: int,
+    resources_per_stage: Optional[Dict[str, float]] = None,
+    chips_per_stage: int = 0,
+    accelerator_version: str = "",
+    name: str = "",
+) -> PlacementGroup:
+    """One bundle per pipeline stage — the MPMD trainer's placement shape.
+
+    Each stage actor pins to its own bundle so adjacent stages land on
+    distinct slices (SPREAD; STRICT_SPREAD when TPU chips are requested,
+    matching ``SlicePlacementGroup``'s whole-slice ownership semantics:
+    a stage's ICI mesh is never shared with its neighbor).  On a CPU
+    cluster the bundles degrade to per-host/per-process CPU bundles,
+    which is what the tier-1 tests exercise.
+    """
+    if num_stages < 1:
+        raise ValueError("num_stages must be >= 1")
+    if resources_per_stage:
+        bundle = dict(resources_per_stage)
+    else:
+        bundle = {"CPU": 1.0}
+    if chips_per_stage:
+        bundle["TPU"] = float(chips_per_stage)
+        if accelerator_version:
+            bundle[f"TPU-{accelerator_version}"] = float(chips_per_stage)
+    if num_stages == 1:
+        strategy = "PACK"
+    elif "TPU" in bundle:
+        strategy = "STRICT_SPREAD"
+    else:
+        strategy = "SPREAD"
+    return placement_group(
+        [dict(bundle) for _ in range(num_stages)],
+        strategy=strategy,
+        name=name,
+    )
+
+
 class SlicePlacementGroup:
     """Reserve a whole TPU slice (all hosts of a pod) as one gang unit.
 

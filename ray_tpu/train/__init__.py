@@ -9,9 +9,16 @@ from .checkpoint import Checkpoint  # noqa: F401
 from .config import (  # noqa: F401
     CheckpointConfig,
     FailureConfig,
+    PipelineConfig,
     Result,
     RunConfig,
     ScalingConfig,
+)
+from .pipeline import (  # noqa: F401
+    PipelinedTrainer,
+    StageModule,
+    build_1f1b_schedule,
+    gpt2_stage_modules,
 )
 from .session import (  # noqa: F401
     get_checkpoint,

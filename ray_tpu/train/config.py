@@ -38,6 +38,57 @@ class FailureConfig:
 
 
 @dataclasses.dataclass
+class PipelineConfig:
+    """Pipeline-parallel execution knobs (``ray_tpu.train.pipeline``).
+
+    ``num_stages`` long-lived stage actors are placed one per
+    placement-group bundle (one bundle per TPU slice); each training
+    step splits the global batch into ``num_microbatches`` microbatches
+    streamed through the stages under an interleaved 1F1B schedule.
+    ``interleave`` > 1 gives every stage actor that many
+    non-contiguous model chunks (virtual stages), shrinking the
+    pipeline bubble from (S-1)/(S-1+M) toward (S-1)/(S-1+M·V) at the
+    cost of more activation traffic; it requires ``num_microbatches``
+    to be a multiple of ``num_stages``.
+    """
+
+    num_stages: int = 2
+    num_microbatches: int = 4
+    interleave: int = 1
+    # DP within a stage: shard every microbatch over this many of the
+    # stage process's local devices (XLA SPMD inserts the grad psum) —
+    # the MPMD-paper composition: PP across slices, DP/TP inside one.
+    dp_devices_per_stage: int = 1
+    # Synchronized checkpoint cadence (steps); 0 = only the initial one.
+    checkpoint_every_n_steps: int = 0
+    # How long a stage blocks waiting for a neighbor's tensor before the
+    # step is declared failed (drives failure detection latency).
+    recv_timeout_s: float = 120.0
+    # Per-step driver-side deadline; 0 = derive from recv_timeout_s.
+    step_timeout_s: float = 0.0
+    # Test hook: {"stage": int, "step": int, "marker": path} — the stage
+    # hard-exits at that step unless the marker file already exists
+    # (created just before dying, so the restarted actor runs through).
+    debug_fail: Optional[Dict[str, Any]] = None
+
+    def __post_init__(self):
+        if self.num_stages < 1 or self.num_microbatches < 1:
+            raise ValueError("num_stages and num_microbatches must be >= 1")
+        if self.interleave < 1:
+            raise ValueError("interleave must be >= 1")
+        if self.interleave > 1 and self.num_microbatches % self.num_stages:
+            raise ValueError(
+                "interleaved 1F1B needs num_microbatches divisible by "
+                f"num_stages (got {self.num_microbatches} over "
+                f"{self.num_stages})"
+            )
+
+    @property
+    def total_virtual_stages(self) -> int:
+        return self.num_stages * self.interleave
+
+
+@dataclasses.dataclass
 class CheckpointConfig:
     num_to_keep: Optional[int] = None
 

@@ -67,6 +67,14 @@ from .metric_registry import (  # noqa: F401 — re-exports
     OWNER_SHARD_FORWARDED_ENTRIES_TOTAL,
     OWNER_SHARD_LOOKUPS_TOTAL,
     OWNER_SHARD_OBJECTS_MAX,
+    PIPELINE_ACTIVATION_BANDWIDTH_HIST,
+    PIPELINE_ACTIVATION_BYTES_TOTAL,
+    PIPELINE_BUBBLE_FRACTION,
+    PIPELINE_MICROBATCHES_TOTAL,
+    PIPELINE_STAGE_BWD_HIST,
+    PIPELINE_STAGE_FWD_HIST,
+    PIPELINE_STAGE_RESTARTS_TOTAL,
+    PIPELINE_STAGE_STALL_HIST,
     PG_COMMIT_BATCHED_GROUPS_TOTAL,
     PG_COMMIT_BATCHES_TOTAL,
     PG_COMMIT_FUSED_TOTAL,
@@ -402,6 +410,55 @@ def instrument_group(group, backend: str):
         setattr(group, op,
                 _wrap_collective_op(orig, op, backend, group, seen_keys))
     return group
+
+
+# ----------------------------------------------------- pipeline trainer
+def record_pipeline_op(kind: str, stage: int, duration_s: float) -> None:
+    """One pipeline-stage op (``"F"``/``"B"``) of ``duration_s`` on
+    ``stage`` — stage actors call this per microbatch op."""
+    if not GlobalConfig.enable_flight_recorder:
+        return
+    name = PIPELINE_STAGE_FWD_HIST if kind == "F" else PIPELINE_STAGE_BWD_HIST
+    histogram(name, duration_s, {"stage": str(stage)})
+
+
+def record_pipeline_step(stage: int, stall_s: float, wall_s: float,
+                         microbatches: int) -> None:
+    """End-of-step accounting on a stage actor: total neighbor-wait time,
+    step wall, and per-stage bubble (stall/wall)."""
+    if not GlobalConfig.enable_flight_recorder:
+        return
+    tags = {"stage": str(stage)}
+    _metrics._record_batch([
+        (PIPELINE_STAGE_STALL_HIST, "histogram", tags, float(stall_s),
+         DURATION_BOUNDARIES),
+        (PIPELINE_MICROBATCHES_TOTAL, "counter", tags, float(microbatches),
+         None),
+        (PIPELINE_BUBBLE_FRACTION, "gauge", tags,
+         float(stall_s / wall_s) if wall_s > 0 else 0.0, None),
+    ])
+
+
+def record_pipeline_transfer(nbytes: int, duration_s: float) -> None:
+    """One acknowledged inter-stage push (activation or gradient)."""
+    if not GlobalConfig.enable_flight_recorder:
+        return
+    _metrics._record_batch([
+        (PIPELINE_ACTIVATION_BYTES_TOTAL, "counter", {}, float(nbytes), None),
+        (PIPELINE_ACTIVATION_BANDWIDTH_HIST, "histogram", {},
+         nbytes / max(duration_s, 1e-9), BANDWIDTH_BOUNDARIES),
+    ])
+
+
+def record_pipeline_bubble(overall: float, per_stage=None) -> None:
+    """Driver-side computed bubble fraction for one step (gauge)."""
+    gauge(PIPELINE_BUBBLE_FRACTION, overall, {"stage": "all"})
+    for stage, frac in (per_stage or {}).items():
+        gauge(PIPELINE_BUBBLE_FRACTION, frac, {"stage": str(stage)})
+
+
+def record_pipeline_restart(stage: int) -> None:
+    counter(PIPELINE_STAGE_RESTARTS_TOTAL, 1.0, {"stage": str(stage)})
 
 
 # -------------------------------------------------------- scaling gauge

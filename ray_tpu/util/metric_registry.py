@@ -81,6 +81,18 @@ PG_COMMIT_BATCHED_GROUPS_TOTAL = "ray_tpu_pg_commit_batched_groups_total"
 PG_COMMIT_FUSED_TOTAL = "ray_tpu_pg_commit_fused_total"
 PG_COMMIT_ROLLBACKS_TOTAL = "ray_tpu_pg_commit_rollbacks_total"
 
+# ------------------------------------------------- pipeline parallelism
+PIPELINE_STAGE_FWD_HIST = "ray_tpu_pipeline_stage_fwd_s"
+PIPELINE_STAGE_BWD_HIST = "ray_tpu_pipeline_stage_bwd_s"
+PIPELINE_STAGE_STALL_HIST = "ray_tpu_pipeline_stage_stall_s"
+PIPELINE_BUBBLE_FRACTION = "ray_tpu_pipeline_bubble_fraction"
+PIPELINE_ACTIVATION_BYTES_TOTAL = "ray_tpu_pipeline_activation_bytes_total"
+PIPELINE_ACTIVATION_BANDWIDTH_HIST = (
+    "ray_tpu_pipeline_activation_bandwidth_bytes_per_s"
+)
+PIPELINE_MICROBATCHES_TOTAL = "ray_tpu_pipeline_microbatches_total"
+PIPELINE_STAGE_RESTARTS_TOTAL = "ray_tpu_pipeline_stage_restarts_total"
+
 # ------------------------------------------------------------- scheduling
 LEASE_GRANT_WAIT_HIST = "ray_tpu_lease_grant_wait_s"
 LEASE_QUEUE_DEPTH = "ray_tpu_lease_queue_depth"
@@ -177,6 +189,22 @@ METRICS: Dict[str, str] = {
                            "prepare+commit agent RPC",
     PG_COMMIT_ROLLBACKS_TOTAL: "whole-group rollbacks after a partial "
                                "bundle-reservation failure",
+    PIPELINE_STAGE_FWD_HIST: "pipeline-stage forward-op duration, by stage "
+                             "(histogram)",
+    PIPELINE_STAGE_BWD_HIST: "pipeline-stage backward-op duration, by stage "
+                             "(histogram)",
+    PIPELINE_STAGE_STALL_HIST: "per-step time a stage spent blocked waiting "
+                               "for a neighbor's tensor (histogram)",
+    PIPELINE_BUBBLE_FRACTION: "measured pipeline bubble: stall over wall "
+                              "per step (gauge, overall + by stage)",
+    PIPELINE_ACTIVATION_BYTES_TOTAL: "bytes streamed between adjacent "
+                                     "pipeline stages (activations + grads)",
+    PIPELINE_ACTIVATION_BANDWIDTH_HIST: "achieved per-push inter-stage "
+                                        "transfer bandwidth (histogram)",
+    PIPELINE_MICROBATCHES_TOTAL: "microbatches executed by pipeline stages "
+                                 "(forward+backward pairs)",
+    PIPELINE_STAGE_RESTARTS_TOTAL: "stage actors restarted from the last "
+                                   "synchronized checkpoint",
     LEASE_GRANT_WAIT_HIST: "lease request wait until grant/spillback/retry "
                            "(histogram)",
     LEASE_QUEUE_DEPTH: "lease requests parked on the node agent (gauge)",

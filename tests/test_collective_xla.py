@@ -107,6 +107,18 @@ def test_xla_group_two_processes(cluster, tmp_path):
         for r in range(2)
     ]
     logs = [p.communicate(timeout=240)[0] for p in procs]
+    # Deterministic environment gate: jaxlib's CPU backend does not
+    # implement multiprocess collectives everywhere (the member process
+    # fails with a stable XlaRuntimeError signature).  Skip — with the
+    # reason — instead of failing on such jaxlib builds; the test still
+    # runs fully wherever cpu multiprocess IS supported.
+    unsupported = "Multiprocess computations aren't implemented on the CPU"
+    if any(p.returncode != 0 and unsupported in log
+           for p, log in zip(procs, logs)):
+        pytest.skip(
+            "jax-cpu multiprocess collectives unsupported by this jaxlib "
+            f"build ({unsupported!r})"
+        )
     for p, log in zip(procs, logs):
         assert p.returncode == 0, log[-3000:]
     results = [json.loads(p.read_text()) for p in outs]

@@ -223,6 +223,8 @@ class TestTokenStreaming:
 
     def test_openai_sse_streaming(self, cluster):
         import json
+        import time
+        import urllib.error
         import urllib.request
 
         import ray_tpu.serve as serve
@@ -236,7 +238,21 @@ class TestTokenStreaming:
             ).encode(),
             headers={"Content-Type": "application/json"},
         )
-        raw = urllib.request.urlopen(req, timeout=180).read().decode()
+        # Bounded retry on the connect: the proxy's listening socket comes
+        # up asynchronously, so the first request can race the bind — a
+        # refused connection within the deadline is retried, never slept
+        # through blindly.
+        deadline = time.monotonic() + 60.0
+        while True:
+            try:
+                raw = urllib.request.urlopen(req, timeout=180).read().decode()
+                break
+            except urllib.error.HTTPError:
+                raise  # the proxy answered: a real 4xx/5xx, never retried
+            except (urllib.error.URLError, ConnectionError):
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.2)
         frames = [
             l[len("data: "):]
             for l in raw.splitlines()
@@ -244,8 +260,12 @@ class TestTokenStreaming:
         ]
         assert frames[-1] == "[DONE]"
         chunks = [json.loads(f) for f in frames[:-1]]
+        # The stream always carries at least the terminal finish_reason
+        # chunk — even when every sampled token decodes to empty text
+        # (tiny-vocab models can greedily emit undecodable ids).
         assert len(chunks) >= 1
         assert chunks[0]["object"] == "text_completion"
         assert all("text" in c["choices"][0] for c in chunks)
+        assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
         serve.stop_http_proxy()
         serve.delete("LLMServer")
