@@ -1485,6 +1485,78 @@ def run_scaling_suite():
         emit("sp_ring_ulysses_parity", 1.0 if parity_ok else 0.0, "bool")
 
 
+# -------------------------------------------------------- collective suite
+
+def run_collective_suite(quick=False):
+    """Topology-aware collective selection A/B (ray_tpu.collective.
+    bench_collective).  Runs in a subprocess so the 8-virtual-device
+    flags bind before jax imports; the mesh is treated as 2 slices of 4
+    (the inter-slice axis standing in for DCN, same methodology as the
+    scaling suite).  Emits the per-algorithm device-side A/B, the
+    tuner's committed choice with a same-window tuned-vs-flat ratio, the
+    opt-in quantized-allreduce row, and the user-facing group path."""
+    import os
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    if not quick:
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    cmd = [sys.executable, "-m", "ray_tpu.collective.bench_collective"]
+    if quick:
+        cmd.append("--quick")
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=600, env=env,
+        )
+    except subprocess.TimeoutExpired as e:
+        # This suite is the PR's acceptance surface — a hung stage must
+        # fail loudly, not vanish from the summary.
+        raise RuntimeError(
+            "bench_collective timed out after 600s; partial stdout: "
+            f"{(e.stdout or b'')[-500:]!r}"
+        ) from None
+    for line in proc.stdout.splitlines():
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if "collective" not in rec:
+            continue
+        row = dict(rec["collective"])
+        metric = row.pop("metric")
+        if metric == "collective_allreduce_algo_ab":
+            bws = row.pop("bandwidth_bytes_per_s")
+            for algo, bw in bws.items():
+                emit(f"collective_ab_{algo}_bytes_per_s", bw, "bytes/s",
+                     **row)
+        elif "value" in row:
+            value = row.pop("value")
+            baseline = row.pop("baseline", None)
+            decisions = row.pop("decisions", None)
+            if decisions:
+                # Compact per-bucket decision table in the record: the
+                # acceptance surface for "chosen algorithm per bucket".
+                row["decisions"] = {
+                    k: {"chosen": v["chosen"],
+                        "samples": {a: d["samples"]
+                                    for a, d in v["algorithms"].items()}}
+                    for k, v in decisions.items()
+                }
+            emit(metric, value, "bytes/s"
+                 if metric.endswith("bytes_per_s") else "count",
+                 baseline=baseline, **row)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"bench_collective exited {proc.returncode}: "
+            f"{proc.stderr[-2000:]}"
+        )
+
+
 # --------------------------------------------------------- obs overhead
 
 def measure_obs_overhead(n_calls=300, trials=3, n_warmup=30):
@@ -1732,6 +1804,7 @@ def run_obs_overhead_suite():
 
 def main():
     only = sys.argv[1] if len(sys.argv) > 1 else "all"
+    quick = "--quick" in sys.argv[1:]
 
     # Suites are isolated: one suite failing loudly (wait_pool_warm's
     # deliberate RuntimeError, a stage assert) must not cost the other
@@ -1768,6 +1841,8 @@ def main():
             run("data", run_data_suite)
         if only in ("all", "pipeline"):
             run("pipeline", run_pipeline_suite)
+        if only in ("all", "collective"):
+            run("collective", lambda: run_collective_suite(quick=quick))
         if only in ("all", "scaling"):
             run("scaling", run_scaling_suite)
         if only in ("all", "model"):

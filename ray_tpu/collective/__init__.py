@@ -18,7 +18,8 @@ from .collective import (  # noqa: F401
 )
 from .device_objects import DeviceObjectStore, DeviceRef, device_object_store  # noqa: F401
 from .p2p import Mailbox, StageChannel, local_mailbox  # noqa: F401
-from .types import Backend, GroupInfo, ReduceOp  # noqa: F401
+from .tuner import get_tuner, reset_tuner  # noqa: F401
+from .types import Backend, GroupInfo, ReduceOp, Topology  # noqa: F401
 from .experimental import (  # noqa: F401
     RemoteCommunicatorManager,
     create_collective_group,

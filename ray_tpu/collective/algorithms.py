@@ -1,0 +1,391 @@
+"""Collective algorithm library — the lowering targets of the selection
+layer (``tuner.py``).
+
+Every ``allreduce``/``allgather``/``reducescatter`` used to lower to a
+single flat XLA collective (``psum``/``all_gather``/``psum_scatter``)
+regardless of message size, world size, or whether the hop rides
+intra-slice ICI or cross-slice DCN.  TACCL (arxiv 2111.04867) shows no
+single algorithm wins across that space; this module provides the
+alternatives, each as a pure function usable inside a ``shard_map`` body
+(and therefore inside any jitted user step):
+
+  ``flat``       one fused XLA collective — latency-optimal for small
+                 messages (the compiler schedules the ring itself).
+  ``ring``       chunked ``ppermute`` pipeline: 2(n-1) steps moving
+                 ``size/n`` bytes each — bandwidth-optimal for large
+                 messages, and the stages overlap.
+  ``tree``       recursive halving-doubling: 2·log2(n) steps — fewer
+                 rounds than ring for latency-bound mid sizes; requires a
+                 power-of-two world.
+  ``two_level``  hierarchical decomposition for multi-slice topologies:
+                 reduce-scatter over the intra-slice (ICI) axis, exchange
+                 only ``size/n_ici`` bytes over the inter-slice (DCN)
+                 axis, all-gather back over ICI — the DCN hop, the
+                 bottleneck, carries 1/n_ici of the payload.
+  ``*_q8``       EQuARX-style block-quantized variants (arxiv
+                 2506.17615): int8 blocks with per-block fp32 scales cut
+                 wire bytes ~4x on bandwidth-bound gradient exchange with
+                 a bounded per-block error (see ``docs/collective.md``).
+                 Opt-in only — SUM is exact when quantization is off.
+
+All non-flat algorithms are SUM-only; other reduce ops keep the flat
+lowering.  Numerical note: ring/tree/two_level reassociate the sum, so
+float results can differ from flat psum by normal rounding — integer-
+valued payloads reduce exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .types import ReduceOp
+
+# Per-block quantization width (elements).  128-1024 trades scale
+# overhead (4 bytes per block) against outlier blast radius; EQuARX uses
+# comparable block shapes.
+DEFAULT_QUANT_BLOCK = 256
+
+# Algorithm names (the tuner's candidate vocabulary).
+FLAT = "flat"
+RING = "ring"
+TREE = "tree"
+TWO_LEVEL = "two_level"
+FLAT_Q8 = "flat_q8"
+TWO_LEVEL_Q8 = "two_level_q8"
+
+_QUANT_DTYPES = ("float32", "bfloat16", "float16")
+
+
+def is_pow2(n: int) -> bool:
+    return n > 0 and (n & (n - 1)) == 0
+
+
+def quantizable_dtype(dtype) -> bool:
+    return str(dtype) in _QUANT_DTYPES
+
+
+def allreduce_candidates(world_size: int, topology,
+                         quantized: bool = False) -> Tuple[str, ...]:
+    """Eligible allreduce algorithms for (world, topology, quantized) —
+    deterministic order; the first entry is the safe default."""
+    if world_size <= 1:
+        return (FLAT,)
+    if quantized:
+        # Quantization targets the bandwidth-bound exchange: the DCN hop
+        # of a two-level decomposition when the topology has one, else
+        # the gather-based one-shot.
+        if topology is not None and topology.is_two_level:
+            return (TWO_LEVEL_Q8, FLAT_Q8)
+        return (FLAT_Q8,)
+    cands = [FLAT, RING]
+    if is_pow2(world_size):
+        cands.append(TREE)
+    if topology is not None and topology.is_two_level:
+        cands.append(TWO_LEVEL)
+    return tuple(cands)
+
+
+def allgather_candidates(world_size: int, topology) -> Tuple[str, ...]:
+    if world_size <= 1:
+        return (FLAT,)
+    return (FLAT, RING)
+
+
+def reducescatter_candidates(world_size: int, topology) -> Tuple[str, ...]:
+    if world_size <= 1:
+        return (FLAT,)
+    return (FLAT, RING)
+
+
+def candidates_for(op: str, world_size: int, topology,
+                   quantized: bool = False) -> Tuple[str, ...]:
+    if op == "allreduce":
+        return allreduce_candidates(world_size, topology, quantized)
+    if op == "allgather":
+        return allgather_candidates(world_size, topology)
+    if op == "reducescatter":
+        return reducescatter_candidates(world_size, topology)
+    return (FLAT,)
+
+
+def resolve_quantized(op: ReduceOp, dtype, quantized) -> bool:
+    """Resolve a per-call ``quantized`` flag (None = process default)
+    and validate eligibility.  The blanket process opt-in silently skips
+    ineligible payloads (int, non-SUM); an EXPLICIT ``quantized=True``
+    on an ineligible call raises.  Shared by both group backends."""
+    if quantized is None:
+        from ..core.config import GlobalConfig
+
+        quantized = GlobalConfig.collective_quantized_allreduce
+        if quantized and not (
+            op == ReduceOp.SUM and quantizable_dtype(dtype)
+        ):
+            return False
+    if quantized:
+        if op != ReduceOp.SUM:
+            raise ValueError(
+                f"quantized allreduce supports SUM only (got {op})"
+            )
+        if not quantizable_dtype(dtype):
+            raise ValueError(
+                f"quantized allreduce needs a float payload, got {dtype}"
+            )
+    return bool(quantized)
+
+
+# ------------------------------------------------------------ shape plumbing
+def _pad_flat(x, multiple):
+    """Flatten ``x`` and zero-pad to a multiple; returns (flat, orig_size)."""
+    import jax.numpy as jnp
+
+    flat = x.reshape(-1)
+    pad = (-flat.size) % multiple
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    return flat, x.size
+
+
+def _unpad(flat, size, shape):
+    return flat[:size].reshape(shape)
+
+
+# --------------------------------------------------------------- ring family
+def ring_allreduce(x, axis: str, n: int):
+    """Bandwidth-optimal ring: n-1 reduce-scatter steps + n-1 all-gather
+    steps, each moving one 1/n chunk over ``ppermute``."""
+    import jax
+    import jax.numpy as jnp
+
+    if n <= 1:
+        return x
+    flat, size = _pad_flat(x, n)
+    chunks = flat.reshape(n, -1)
+    csize = chunks.shape[1]
+    r = jax.lax.axis_index(axis)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def rs_step(s, send):
+        recv = jax.lax.ppermute(send, axis, perm)
+        idx = jnp.mod(r - s - 1, n)
+        return recv + jax.lax.dynamic_slice(chunks, (idx, 0), (1, csize))[0]
+
+    send = jax.lax.dynamic_slice(chunks, (jnp.mod(r, n), 0), (1, csize))[0]
+    send = jax.lax.fori_loop(0, n - 1, rs_step, send)
+    # ``send`` now holds the fully reduced chunk (r+1) mod n.
+    out = jnp.zeros_like(chunks)
+    out = jax.lax.dynamic_update_slice(
+        out, send[None], (jnp.mod(r + 1, n), 0)
+    )
+
+    def ag_step(s, carry):
+        out, buf = carry
+        buf = jax.lax.ppermute(buf, axis, perm)
+        idx = jnp.mod(r - s, n)
+        out = jax.lax.dynamic_update_slice(out, buf[None], (idx, 0))
+        return out, buf
+
+    out, _ = jax.lax.fori_loop(0, n - 1, ag_step, (out, send))
+    return _unpad(out.reshape(-1), size, x.shape)
+
+
+def ring_reducescatter(x, axis: str, n: int):
+    """Rank r keeps chunk r (axis-0 split) of the elementwise sum — the
+    reduce-scatter half of the ring.  ``x.shape[0]`` must divide by n."""
+    import jax
+    import jax.numpy as jnp
+
+    if n <= 1:
+        return x
+    rows = x.shape[0] // n
+    chunks = x.reshape(n, rows, *x.shape[1:])
+    r = jax.lax.axis_index(axis)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(s, send):
+        recv = jax.lax.ppermute(send, axis, perm)
+        idx = jnp.mod(r - s - 1, n)
+        return recv + jax.lax.dynamic_index_in_dim(
+            chunks, idx, keepdims=False
+        )
+
+    send = jax.lax.dynamic_index_in_dim(chunks, jnp.mod(r, n), keepdims=False)
+    send = jax.lax.fori_loop(0, n - 1, step, send)
+    # After n-1 steps rank r holds reduced chunk (r+1)%n; one final shift
+    # aligns chunk r with rank r (matching psum_scatter's layout).
+    return jax.lax.ppermute(send, axis, perm)
+
+
+def ring_allgather(x, axis: str, n: int):
+    """All ranks end with the (n, *shape) stack of every rank's tensor,
+    built by circulating tensors n-1 hops around the ring."""
+    import jax
+    import jax.numpy as jnp
+
+    if n <= 1:
+        return x[None]
+    r = jax.lax.axis_index(axis)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    out = jnp.zeros((n, *x.shape), x.dtype)
+    out = jax.lax.dynamic_update_index_in_dim(out, x, r, 0)
+
+    def step(s, carry):
+        out, buf = carry
+        buf = jax.lax.ppermute(buf, axis, perm)
+        idx = jnp.mod(r - s - 1, n)
+        out = jax.lax.dynamic_update_index_in_dim(out, buf, idx, 0)
+        return out, buf
+
+    out, _ = jax.lax.fori_loop(0, n - 1, step, (out, x))
+    return out
+
+
+# ---------------------------------------------------------------- tree family
+def tree_allreduce(x, axis: str, n: int):
+    """Recursive halving-doubling (a butterfly over rank-XOR partners):
+    log2(n) halving-reduce steps then log2(n) doubling-gather steps.
+    Requires power-of-two ``n``; the Python-level loop keeps every
+    intermediate shape static."""
+    import jax
+    import jax.numpy as jnp
+
+    if n <= 1:
+        return x
+    assert is_pow2(n), f"tree allreduce needs a power-of-two world, got {n}"
+    flat, size = _pad_flat(x, n)
+    r = jax.lax.axis_index(axis)
+    buf = flat
+    d = n // 2
+    while d >= 1:
+        perm = [(i, i ^ d) for i in range(n)]
+        half = buf.shape[0] // 2
+        low, high = buf[:half], buf[half:]
+        bit = jnp.asarray(r & d, bool)
+        # Bit clear -> this rank owns the LOW half after the step: it
+        # sends the high half and reduces into the low.  Bit set: mirror.
+        send = jnp.where(bit, low, high)
+        keep = jnp.where(bit, high, low)
+        recv = jax.lax.ppermute(send, axis, perm)
+        buf = keep + recv
+        d //= 2
+    # buf is the reduced 1/n chunk starting at r*chunk; gather back.
+    d = 1
+    while d < n:
+        perm = [(i, i ^ d) for i in range(n)]
+        recv = jax.lax.ppermute(buf, axis, perm)
+        bit = jnp.asarray(r & d, bool)
+        # Bit clear: my chunk precedes the partner's.
+        buf = jnp.where(
+            bit,
+            jnp.concatenate([recv, buf]),
+            jnp.concatenate([buf, recv]),
+        )
+        d *= 2
+    return _unpad(buf, size, x.shape)
+
+
+# --------------------------------------------------------- two-level family
+def two_level_allreduce(x, ici_axis: str, dcn_axis: str, n_ici: int,
+                        quantized: bool = False,
+                        block_size: int = DEFAULT_QUANT_BLOCK):
+    """Hierarchical allreduce for multi-slice topologies: reduce-scatter
+    over ICI, allreduce the 1/n_ici chunk over DCN (optionally block-
+    quantized — the DCN hop is the bandwidth bottleneck), all-gather
+    over ICI."""
+    import jax
+
+    flat, size = _pad_flat(x, n_ici)
+    chunk = jax.lax.psum_scatter(flat, ici_axis, scatter_dimension=0,
+                                 tiled=True)
+    if quantized:
+        chunk = quantized_allreduce(chunk, dcn_axis, block_size=block_size)
+    else:
+        chunk = jax.lax.psum(chunk, dcn_axis)
+    full = jax.lax.all_gather(chunk, ici_axis, tiled=True)
+    return _unpad(full, size, x.shape)
+
+
+# ------------------------------------------------------------- quantization
+def _safe_scales(amax):
+    """Per-block scale ``amax/127`` with all-zero blocks mapped to scale 1
+    (their quantized payload is exactly zero either way — no div-by-zero,
+    no NaN)."""
+    import jax.numpy as jnp
+
+    scale = amax / 127.0
+    return jnp.where(amax > 0, scale, jnp.ones_like(scale))
+
+
+def quantize_blocks(x, block_size: int = DEFAULT_QUANT_BLOCK):
+    """Block-quantize a tensor: int8 payload + per-block fp32 scales.
+    Returns ``(q, scales, orig_size)``; blocks are ``block_size`` flat
+    elements, zero-padded at the tail."""
+    import jax.numpy as jnp
+
+    flat, size = _pad_flat(x, block_size)
+    blocks = flat.reshape(-1, block_size).astype(jnp.float32)
+    amax = jnp.max(jnp.abs(blocks), axis=1)
+    scales = _safe_scales(amax)
+    q = jnp.clip(jnp.round(blocks / scales[:, None]), -127, 127).astype(
+        jnp.int8
+    )
+    return q, scales, size
+
+
+def dequantize_blocks(q, scales, size, shape, dtype):
+    import jax.numpy as jnp
+
+    deq = q.astype(jnp.float32) * scales[:, None]
+    return _unpad(deq.reshape(-1), size, shape).astype(dtype)
+
+
+def quantized_allreduce(x, axis: str, block_size: int = DEFAULT_QUANT_BLOCK):
+    """One-shot block-quantized allreduce: quantize locally, all-gather
+    the int8 payload + scales (~4x fewer wire bytes than fp32), then
+    dequantize-and-sum in fp32.  Per-element error is bounded by
+    ``sum_r amax_block_r / 254`` (round-to-nearest of each rank's
+    contribution; see docs/collective.md)."""
+    import jax
+    import jax.numpy as jnp
+
+    q, scales, size = quantize_blocks(x, block_size)
+    qg = jax.lax.all_gather(q, axis)          # (n, nblocks, B) int8
+    sg = jax.lax.all_gather(scales, axis)     # (n, nblocks) f32
+    deq = qg.astype(jnp.float32) * sg[:, :, None]
+    total = deq.sum(axis=0)
+    return _unpad(total.reshape(-1), size, x.shape).astype(x.dtype)
+
+
+def quantized_wire_bytes(nbytes: int, dtype, block_size: int =
+                         DEFAULT_QUANT_BLOCK) -> int:
+    """Bytes actually exchanged per rank for a quantized payload of
+    ``nbytes`` logical bytes: int8 payload + one fp32 scale per block."""
+    itemsize = max(1, np.dtype(str(dtype)).itemsize if str(dtype) !=
+                   "bfloat16" else 2)
+    elems = nbytes // itemsize
+    nblocks = -(-elems // block_size)
+    return elems + 4 * nblocks
+
+
+# ------------------------------------------------- host-side (numpy) variant
+# The pipeline trainer quantizes inter-stage gradient pushes on the host
+# (the payload is already a host view at that point); same block format.
+def quantize_blocks_np(arr: np.ndarray,
+                       block_size: int = DEFAULT_QUANT_BLOCK):
+    flat = np.asarray(arr, np.float32).reshape(-1)
+    size = flat.size
+    pad = (-size) % block_size
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, np.float32)])
+    blocks = flat.reshape(-1, block_size)
+    amax = np.abs(blocks).max(axis=1)
+    scales = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.round(blocks / scales[:, None]), -127, 127).astype(np.int8)
+    return q, scales, size
+
+
+def dequantize_blocks_np(q: np.ndarray, scales: np.ndarray, size: int,
+                         shape, dtype) -> np.ndarray:
+    deq = q.astype(np.float32) * scales[:, None]
+    return deq.reshape(-1)[:size].reshape(shape).astype(dtype)

@@ -31,7 +31,8 @@ class GroupManager:
         if backend == Backend.LOCAL:
             from .local_group import LocalXlaGroup
 
-            group = LocalXlaGroup(group_name, kwargs.get("devices"))
+            group = LocalXlaGroup(group_name, kwargs.get("devices"),
+                                  slice_size=kwargs.get("slice_size"))
         else:
             from .xla_group import XlaGroup
 
@@ -77,10 +78,15 @@ def init_collective_group(
     return _manager.create(b, group_name, world_size, rank, **kwargs)
 
 
-def init_local_group(group_name: str = "default", devices=None):
+def init_local_group(group_name: str = "default", devices=None,
+                     slice_size: int = None):
     """Single-controller group over this process's local devices (all ranks
-    live here; ops take per-rank tensor lists)."""
-    return _manager.create(Backend.LOCAL, group_name, 0, 0, devices=devices)
+    live here; ops take per-rank tensor lists).  ``slice_size`` declares
+    devices-per-ICI-slice for algorithm selection: a multi-slice group
+    unlocks the two-level (ICI reduce-scatter / DCN exchange / ICI
+    all-gather) decomposition — see docs/collective.md."""
+    return _manager.create(Backend.LOCAL, group_name, 0, 0, devices=devices,
+                           slice_size=slice_size)
 
 
 def is_group_initialized(group_name: str = "default") -> bool:
@@ -110,8 +116,14 @@ def get_collective_group_size(group_name: str = "default") -> int:
 
 
 # ---------------------------------------------------------------------- ops
-def allreduce(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
-    return _manager.get(group_name).allreduce(tensor, op)
+def allreduce(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM,
+              quantized: bool = None):
+    """SUM allreduce routes through the topology-aware algorithm
+    selection layer (docs/collective.md).  ``quantized=True`` opts this
+    call into the EQuARX-style block-quantized exchange (float payloads,
+    SUM only; bounded per-block error); ``None`` defers to the
+    ``collective_quantized_allreduce`` process default (off)."""
+    return _manager.get(group_name).allreduce(tensor, op, quantized=quantized)
 
 
 def allgather(tensor, group_name: str = "default"):
@@ -134,14 +146,29 @@ def barrier(group_name: str = "default"):
     return _manager.get(group_name).barrier()
 
 
-def collective_stats() -> Dict[str, dict]:
-    """This process's per-op collective telemetry (ops, bytes, mean
-    duration) from the flight recorder — the local-process view;
-    cluster-wide aggregates live in ``metrics.snapshot()`` /
-    ``/metrics`` under the ``ray_tpu_collective_*`` names."""
+def collective_stats(cluster: bool = False) -> Dict[str, dict]:
+    """Collective telemetry from the flight recorder.
+
+    Local view (default): per-op aggregates (ops, bytes, mean warm
+    duration) keyed by op name, plus a ``"tuner"`` entry with the
+    algorithm-selection table — per (op, size bucket, world size,
+    topology): the chosen algorithm, call/exploration counts, and
+    per-algorithm attempts/samples/mean achieved bandwidth.
+
+    ``cluster=True``: the per-group merge over all workers via the
+    owner-service metrics registry (each worker flushes its registry to
+    the control-plane KV; the driver reads them all back) — see
+    ``flight_recorder.cluster_collective_stats()``.  Requires a running
+    cluster; the tuner decision counters appear under ``"algorithms"``."""
     from ..util import flight_recorder
 
-    return flight_recorder.local_collective_stats()
+    if cluster:
+        return flight_recorder.cluster_collective_stats()
+    from .tuner import get_tuner
+
+    stats: Dict[str, dict] = dict(flight_recorder.local_collective_stats())
+    stats["tuner"] = get_tuner().stats()
+    return stats
 
 
 def send(tensor, dst_rank: int, group_name: str = "default"):

@@ -23,7 +23,8 @@ from .types import Backend, GroupInfo, ReduceOp
 class LocalXlaGroup:
     """Collective group whose ranks are this process's local devices."""
 
-    def __init__(self, group_name: str, devices: Sequence = None):
+    def __init__(self, group_name: str, devices: Sequence = None,
+                 slice_size: int = None):
         import jax
 
         self.group_name = group_name
@@ -31,8 +32,18 @@ class LocalXlaGroup:
         self.world_size = len(self.devices)
         from jax.sharding import Mesh
 
+        from .types import Topology
+
+        # ``slice_size``: devices per ICI slice.  Default: every device in
+        # one slice (pure-ICI topology).  A multi-slice local group (e.g.
+        # megascale hosts, or a CPU mesh standing in for a 2-slice DCN
+        # fabric in tests/bench) unlocks the two-level algorithms.
+        self.topology = Topology(self.world_size,
+                                 slice_size or self.world_size)
         self.mesh = Mesh(np.array(self.devices), ("world",))
+        self._mesh2 = None  # (dcn, ici) view, built on first two-level op
         self._fn_cache: Dict[tuple, object] = {}
+        self._last_decision = None  # tuner decision of the most recent op
         # Flight recorder: op/bytes/world-size/duration + achieved-bandwidth
         # capture on every collective (no-op when disabled).
         from ..util import flight_recorder
@@ -88,44 +99,133 @@ class LocalXlaGroup:
             self._fn_cache[key] = fn
         return fn
 
+    def _shard_map2(self, fn):
+        """shard_map over the (dcn, ici) two-level view of the same
+        devices — row-major reshape keeps device order, so resharding
+        from the 1-D mesh is layout-only."""
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from .types import compat_shard_map
+
+        if self._mesh2 is None:
+            topo = self.topology
+            self._mesh2 = Mesh(
+                np.array(self.devices).reshape(topo.dcn_size, topo.ici_size),
+                ("dcn", "ici"),
+            )
+        spec = P(("dcn", "ici"))
+        return jax.jit(compat_shard_map(fn, self._mesh2, (spec,), spec))
+
+    def _select(self, op: str, per_rank_nbytes: int, quantized: bool) -> str:
+        """Tuner decision for one op call (single-controller group:
+        every rank lives in this process, so the tuner's measurement
+        table needs no cross-member sync)."""
+        from .tuner import select_for_group
+
+        return select_for_group(self, op, per_rank_nbytes, quantized)
+
+    def _resolve_quantized(self, op: ReduceOp, dtype, quantized) -> bool:
+        from .algorithms import resolve_quantized
+
+        return resolve_quantized(op, dtype, quantized)
+
+    @staticmethod
+    def _quant_block() -> int:
+        from ..core.config import GlobalConfig
+
+        return GlobalConfig.collective_quant_block_size
+
     # ------------------------------------------------------------------ ops
-    def allreduce(self, tensors: List, op: ReduceOp = ReduceOp.SUM) -> List:
+    def allreduce(self, tensors: List, op: ReduceOp = ReduceOp.SUM,
+                  quantized: bool = None) -> List:
         import jax
         import jax.numpy as jnp
 
+        from . import algorithms as alg
+
         g = self._stack(tensors)
+        quantized = self._resolve_quantized(op, g.dtype, quantized)
+        self._last_decision = None
+
+        if op != ReduceOp.SUM:
+            # Non-SUM reductions keep the flat lowering (no algorithm
+            # family implements reassociation-safe MAX/MIN/MEAN/PRODUCT).
+            def build():
+                def body(x):  # x: (1, *shape) per rank
+                    if op == ReduceOp.PRODUCT:
+                        # No pprod primitive: reduce via allgather.
+                        gathered = jax.lax.all_gather(x[0], "world")
+                        return jnp.prod(gathered, axis=0)[None]
+                    red = {
+                        ReduceOp.MAX: jax.lax.pmax,
+                        ReduceOp.MIN: jax.lax.pmin,
+                        ReduceOp.MEAN: jax.lax.pmean,
+                    }[op]
+                    return red(x, "world")
+
+                return self._shard_map(body)
+
+            out = self._cached(("ar", op, g.shape, str(g.dtype)), build)(g)
+            return self._unstack(out)
+
+        per_rank_nbytes = g.nbytes // max(1, self.world_size)
+        algo = self._select("allreduce", per_rank_nbytes, quantized)
+        n = self.world_size
+        topo = self.topology
+        block = self._quant_block()
 
         def build():
-            def body(x):  # x: (1, *shape) per rank
-                if op == ReduceOp.PRODUCT:
-                    # No pprod primitive: reduce via log/exp-free allgather.
-                    gathered = jax.lax.all_gather(x[0], "world")
-                    return jnp.prod(gathered, axis=0)[None]
-                red = {
-                    ReduceOp.SUM: jax.lax.psum,
-                    ReduceOp.MAX: jax.lax.pmax,
-                    ReduceOp.MIN: jax.lax.pmin,
-                    ReduceOp.MEAN: jax.lax.pmean,
-                }[op]
-                return red(x, "world")
+            if algo in (alg.TWO_LEVEL, alg.TWO_LEVEL_Q8):
+                def body(x):
+                    return alg.two_level_allreduce(
+                        x[0], "ici", "dcn", topo.ici_size,
+                        quantized=(algo == alg.TWO_LEVEL_Q8),
+                        block_size=block,
+                    )[None]
+
+                return self._shard_map2(body)
+
+            def body(x):
+                if algo == alg.RING:
+                    return alg.ring_allreduce(x[0], "world", n)[None]
+                if algo == alg.TREE:
+                    return alg.tree_allreduce(x[0], "world", n)[None]
+                if algo == alg.FLAT_Q8:
+                    return alg.quantized_allreduce(
+                        x[0], "world", block_size=block
+                    )[None]
+                return jax.lax.psum(x, "world")
 
             return self._shard_map(body)
 
-        out = self._cached(("ar", op, g.shape, str(g.dtype)), build)(g)
+        out = self._cached(
+            ("ar", op, algo, block if quantized else 0, g.shape,
+             str(g.dtype)),
+            build,
+        )(g)
         return self._unstack(out)
 
     def allgather(self, tensors: List) -> List[List]:
         import jax
 
+        from . import algorithms as alg
+
         g = self._stack(tensors)
+        self._last_decision = None
+        per_rank_nbytes = g.nbytes // max(1, self.world_size)
+        algo = self._select("allgather", per_rank_nbytes, False)
+        n = self.world_size
 
         def build():
             def body(x):
+                if algo == alg.RING:
+                    return alg.ring_allgather(x[0], "world", n)[None]
                 return jax.lax.all_gather(x[0], "world")[None]
 
             return self._shard_map(body)
 
-        out = self._cached(("ag", g.shape, str(g.dtype)), build)(g)
+        out = self._cached(("ag", algo, g.shape, str(g.dtype)), build)(g)
         per_rank = self._unstack(out)
         return [[r[i] for i in range(self.world_size)] for r in per_rank]
 
@@ -135,12 +235,21 @@ class LocalXlaGroup:
         import jax
         import jax.numpy as jnp
 
+        from . import algorithms as alg
+
         g = self._stack(tensors)
         n = self.world_size
+        self._last_decision = None
+        algo = alg.FLAT
+        if op == ReduceOp.SUM:
+            per_rank_nbytes = g.nbytes // max(1, n)
+            algo = self._select("reducescatter", per_rank_nbytes, False)
 
         def build():
             def body(x):
                 if op == ReduceOp.SUM:
+                    if algo == alg.RING:
+                        return alg.ring_reducescatter(x[0], "world", n)[None]
                     # The fast path: one XLA reduce-scatter over ICI.
                     return jax.lax.psum_scatter(
                         x[0], "world", scatter_dimension=0, tiled=True
@@ -159,7 +268,7 @@ class LocalXlaGroup:
 
             return self._shard_map(body)
 
-        out = self._cached(("rs", op, g.shape, str(g.dtype)), build)(g)
+        out = self._cached(("rs", op, algo, g.shape, str(g.dtype)), build)(g)
         return self._unstack(out)
 
     def broadcast(self, tensors: List, src_rank: int = 0) -> List:

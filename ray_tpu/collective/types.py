@@ -70,6 +70,41 @@ def compat_axis_size(axis_name):
         return jax.lax.psum(1, axis_name)
 
 
+@dataclass(frozen=True)
+class Topology:
+    """Physical shape of a collective group for algorithm selection:
+    ``world_size`` members arranged as slices of ``ici_size`` members
+    each.  One slice (``ici_size == world_size``) means every hop rides
+    ICI; multiple slices mean cross-slice hops ride DCN and a two-level
+    decomposition (intra-slice reduce-scatter, inter-slice exchange,
+    intra-slice all-gather) becomes eligible."""
+
+    world_size: int
+    ici_size: int
+
+    def __post_init__(self):
+        if self.ici_size < 1 or self.world_size < 1:
+            raise ValueError("topology sizes must be >= 1")
+        if self.world_size % self.ici_size:
+            raise ValueError(
+                f"world_size {self.world_size} not divisible by slice size "
+                f"{self.ici_size}"
+            )
+
+    @property
+    def dcn_size(self) -> int:
+        return self.world_size // self.ici_size
+
+    @property
+    def is_two_level(self) -> bool:
+        return 1 < self.ici_size < self.world_size
+
+    @property
+    def kind(self) -> str:
+        """``"ici"`` when every hop is intra-slice, ``"dcn"`` otherwise."""
+        return "ici" if self.dcn_size == 1 else "dcn"
+
+
 @dataclass
 class GroupInfo:
     group_name: str

@@ -82,6 +82,7 @@ class XlaGroup:
         rank: int,
         coordinator_address: Optional[str] = None,
         local_device_count: Optional[int] = None,
+        hosts_per_slice: Optional[int] = None,
     ):
         import jax
 
@@ -98,13 +99,22 @@ class XlaGroup:
         )
         from jax.sharding import Mesh
 
+        from .types import Topology
+
         devices = jax.devices()
         self.devices_per_host = len(devices) // world_size
         self.mesh = Mesh(
             np.array(devices).reshape(world_size, self.devices_per_host),
             ("host", "device"),
         )
+        # ``hosts_per_slice``: group members per TPU slice.  Default: the
+        # whole group is one slice (every hop ICI).  Multi-slice groups
+        # (cross-slice DCN) unlock the two-level algorithms, whose DCN
+        # hop carries 1/hosts_per_slice of the payload.
+        self.topology = Topology(world_size, hosts_per_slice or world_size)
+        self._mesh3 = None  # (dcn, ici, device) view for two-level ops
         self._fn_cache: Dict[tuple, object] = {}
+        self._last_decision = None
         # Flight recorder: per-op bytes/duration/bandwidth capture.  These
         # ops materialize results to numpy (host sync), so the recorded
         # durations reflect the real collective, ICI included.
@@ -147,47 +157,164 @@ class XlaGroup:
             self._fn_cache[key] = fn
         return fn
 
+    def _build2(self, key, body):
+        """shard_map over the (dcn, ici, device) three-axis view — the
+        host axis split into inter-slice x intra-slice for the two-level
+        algorithms; the per-host device axis stays replicated exactly as
+        in the flat path."""
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from .types import compat_shard_map
+
+        fn = self._fn_cache.get(key)
+        if fn is None:
+            if self._mesh3 is None:
+                topo = self.topology
+                self._mesh3 = Mesh(
+                    np.array(jax.devices()).reshape(
+                        topo.dcn_size, topo.ici_size, self.devices_per_host
+                    ),
+                    ("dcn", "ici", "device"),
+                )
+            spec = P(("dcn", "ici"))
+            fn = jax.jit(compat_shard_map(body, self._mesh3, (spec,), spec))
+            self._fn_cache[key] = fn
+        return fn
+
+    # ----------------------------------------------------- tuner plumbing
+    def _tuner_sync(self, vec: np.ndarray) -> np.ndarray:
+        """Allreduce-MEAN of the tuner's measurement table across group
+        members, via a dedicated always-flat psum (never routed through
+        the selection layer — selection must not depend on itself).
+        Called at deterministic commit points, so every member reaches
+        this collective at the same point in its call sequence."""
+        import jax
+
+        g = self._global_from_local(np.asarray(vec, np.float64))
+
+        def body(x):
+            return jax.lax.psum(x, "host")
+
+        out = self._build(("tuner_sync", g.shape), body)(g)
+        return self._local_from_global(out)[0] / self.world_size
+
+    def _select(self, op: str, nbytes: int, quantized: bool) -> str:
+        from .tuner import select_for_group
+
+        return select_for_group(
+            self, op, nbytes, quantized,
+            sync=self._tuner_sync if self.world_size > 1 else None,
+        )
+
+    def _resolve_quantized(self, op: ReduceOp, dtype, quantized) -> bool:
+        from .algorithms import resolve_quantized
+
+        return resolve_quantized(op, dtype, quantized)
+
     # ------------------------------------------------------------------ ops
-    def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM):
+    def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM,
+                  quantized: bool = None):
         import jax
         import jax.numpy as jnp
 
+        from . import algorithms as alg
+        from ..core.config import GlobalConfig
+
         g = self._global_from_local(tensor)
+        quantized = self._resolve_quantized(op, g.dtype, quantized)
+        self._last_decision = None
 
-        def body(x):
-            red = {
-                ReduceOp.SUM: jax.lax.psum,
-                ReduceOp.MAX: jax.lax.pmax,
-                ReduceOp.MIN: jax.lax.pmin,
-                ReduceOp.MEAN: jax.lax.pmean,
-            }.get(op)
-            if red is None:  # PRODUCT
-                return jnp.prod(jax.lax.all_gather(x[0], "host"), axis=0)[None]
-            return red(x, "host")
+        if op != ReduceOp.SUM:
+            def body(x):
+                red = {
+                    ReduceOp.MAX: jax.lax.pmax,
+                    ReduceOp.MIN: jax.lax.pmin,
+                    ReduceOp.MEAN: jax.lax.pmean,
+                }.get(op)
+                if red is None:  # PRODUCT
+                    return jnp.prod(
+                        jax.lax.all_gather(x[0], "host"), axis=0
+                    )[None]
+                return red(x, "host")
 
-        out = self._build(("ar", op, g.shape, str(g.dtype)), body)(g)
+            out = self._build(("ar", op, g.shape, str(g.dtype)), body)(g)
+            return self._local_from_global(out)[0]
+
+        nbytes = g.nbytes // max(1, self.world_size)
+        algo = self._select("allreduce", nbytes, quantized)
+        n = self.world_size
+        topo = self.topology
+        block = GlobalConfig.collective_quant_block_size
+
+        if algo in (alg.TWO_LEVEL, alg.TWO_LEVEL_Q8):
+            def body(x):
+                return alg.two_level_allreduce(
+                    x[0], "ici", "dcn", topo.ici_size,
+                    quantized=(algo == alg.TWO_LEVEL_Q8), block_size=block,
+                )[None]
+
+            out = self._build2(
+                ("ar2", algo, block, g.shape, str(g.dtype)), body
+            )(g)
+        else:
+            def body(x):
+                if algo == alg.RING:
+                    return alg.ring_allreduce(x[0], "host", n)[None]
+                if algo == alg.TREE:
+                    return alg.tree_allreduce(x[0], "host", n)[None]
+                if algo == alg.FLAT_Q8:
+                    return alg.quantized_allreduce(
+                        x[0], "host", block_size=block
+                    )[None]
+                return jax.lax.psum(x, "host")
+
+            out = self._build(
+                ("ar", op, algo, block if quantized else 0, g.shape,
+                 str(g.dtype)),
+                body,
+            )(g)
         return self._local_from_global(out)[0]
 
     def allgather(self, tensor):
         import jax
 
+        from . import algorithms as alg
+
         g = self._global_from_local(tensor)
+        self._last_decision = None
+        algo = self._select(
+            "allgather", g.nbytes // max(1, self.world_size), False
+        )
+        n = self.world_size
 
         def body(x):
+            if algo == alg.RING:
+                return alg.ring_allgather(x[0], "host", n)[None]
             return jax.lax.all_gather(x[0], "host")[None]
 
-        out = self._build(("ag", g.shape, str(g.dtype)), body)(g)
+        out = self._build(("ag", algo, g.shape, str(g.dtype)), body)(g)
         return list(self._local_from_global(out)[0])
 
     def reducescatter(self, tensor, op: ReduceOp = ReduceOp.SUM):
         import jax
         import jax.numpy as jnp
 
+        from . import algorithms as alg
+
         g = self._global_from_local(tensor)
         n = self.world_size
+        self._last_decision = None
+        algo = alg.FLAT
+        if op == ReduceOp.SUM:
+            algo = self._select(
+                "reducescatter", g.nbytes // max(1, n), False
+            )
 
         def body(x):
             if op == ReduceOp.SUM:
+                if algo == alg.RING:
+                    return alg.ring_reducescatter(x[0], "host", n)[None]
                 return jax.lax.psum_scatter(
                     x[0], "host", scatter_dimension=0, tiled=True
                 )[None]
@@ -203,7 +330,7 @@ class XlaGroup:
             chunk = red.shape[0] // n
             return jax.lax.dynamic_slice_in_dim(red, rank * chunk, chunk)[None]
 
-        out = self._build(("rs", op, g.shape, str(g.dtype)), body)(g)
+        out = self._build(("rs", op, algo, g.shape, str(g.dtype)), body)(g)
         return self._local_from_global(out)[0]
 
     def broadcast(self, tensor, src_rank: int = 0):

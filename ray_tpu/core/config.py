@@ -146,6 +146,25 @@ _KNOBS: Dict[str, tuple] = {
     ),
     # -- TPU --
     "tpu_visible_chips_env": (str, "TPU_VISIBLE_CHIPS", "Env var used for chip isolation"),
+    # -- collectives --
+    "collective_autotune": (
+        bool, True,
+        "Online per-bucket collective algorithm selection (flat/ring/"
+        "tree/two-level by op, message size, world size, ICI-vs-DCN "
+        "topology), fed by the flight recorder's achieved-bandwidth "
+        "capture.  Off = the static heuristic table only",
+    ),
+    "collective_quantized_allreduce": (
+        bool, False,
+        "Process default for SUM-allreduce block quantization (int8 "
+        "blocks + per-block scales, EQuARX-style) on float payloads — "
+        "~4x fewer wire bytes on bandwidth-bound gradient exchange with "
+        "a bounded per-block error.  OFF by default; per-call "
+        "allreduce(..., quantized=True) overrides",
+    ),
+    "collective_quant_block_size": (
+        int, 256, "Elements per quantization block (one fp32 scale each)"
+    ),
     # -- data --
     "data_max_tasks_per_op": (int, 8, "Streaming executor in-flight cap per op"),
     "data_memory_budget_per_op_bytes": (

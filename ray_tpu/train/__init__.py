@@ -8,6 +8,7 @@ from .backend import (  # noqa: F401
 from .checkpoint import Checkpoint  # noqa: F401
 from .config import (  # noqa: F401
     CheckpointConfig,
+    CollectiveConfig,
     FailureConfig,
     PipelineConfig,
     Result,

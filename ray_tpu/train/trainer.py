@@ -28,7 +28,13 @@ from ray_tpu.core.serialization import dumps_function
 
 from .backend import Backend, JaxBackend
 from .checkpoint import Checkpoint, CheckpointManager
-from .config import FailureConfig, Result, RunConfig, ScalingConfig
+from .config import (
+    CollectiveConfig,
+    FailureConfig,
+    Result,
+    RunConfig,
+    ScalingConfig,
+)
 from .worker_group import WorkerGroup
 
 logger = logging.getLogger(__name__)
@@ -47,12 +53,16 @@ class DataParallelTrainer:
         backend: Optional[Backend] = None,
         resume_from_checkpoint: Optional[Checkpoint] = None,
         datasets: Optional[Dict[str, Any]] = None,
+        collective_config: Optional[CollectiveConfig] = None,
     ):
         self.train_loop = train_loop_per_worker
         self.train_loop_config = train_loop_config
         self.scaling_config = scaling_config or ScalingConfig()
         self.run_config = run_config or RunConfig()
         self.backend = backend or self.backend_cls()
+        # Collective-layer opt-ins (quantized gradient allreduce, tuner
+        # toggle) applied on every gang member before the user loop.
+        self.collective_config = collective_config
         self.resume_from_checkpoint = resume_from_checkpoint
         # Data ingest (reference: the DatasetsCallback + streaming_split):
         # each dataset splits into one lazy shard per worker, read in the
@@ -83,6 +93,16 @@ class DataParallelTrainer:
             group = self._create_group_elastic()
             try:
                 self.backend.on_start(group)
+                if self.collective_config is not None:
+                    ray_tpu.get(
+                        [
+                            w.apply_system_config.remote(
+                                self.collective_config.as_system_config()
+                            )
+                            for w in group.workers
+                        ],
+                        timeout=60,
+                    )
                 shards_per_worker = None
                 if self.datasets:
                     n = group.num_workers

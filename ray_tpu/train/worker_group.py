@@ -77,6 +77,15 @@ class TrainWorker:
         os.environ.update({k: str(v) for k, v in env.items()})
         return True
 
+    def apply_system_config(self, overrides: dict) -> bool:
+        """Apply per-gang GlobalConfig overrides (e.g. the trainer's
+        CollectiveConfig: quantized allreduce opt-in, autotune toggle)
+        before the user loop runs collectives in this process."""
+        from ray_tpu.core.config import GlobalConfig
+
+        GlobalConfig.override(**overrides)
+        return True
+
     # -------------------------------------------------------------- run/poll
     def run(self, train_fn_payload: bytes, config: Optional[dict],
             latest_checkpoint, run_dir: Optional[str] = None,

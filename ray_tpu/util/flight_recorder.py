@@ -45,10 +45,16 @@ from . import metrics as _metrics
 from .metric_registry import (  # noqa: F401 — re-exports
     BACKPRESSURE_BLOCKED_TOTAL,
     BACKPRESSURE_WAIT_HIST,
+    COLLECTIVE_ALGO_OPS_TOTAL,
     COLLECTIVE_BANDWIDTH_HIST,
     COLLECTIVE_BYTES_TOTAL,
     COLLECTIVE_DURATION_HIST,
     COLLECTIVE_OPS_TOTAL,
+    COLLECTIVE_QUANTIZED_BYTES_SAVED_TOTAL,
+    COLLECTIVE_QUANTIZED_OPS_TOTAL,
+    COLLECTIVE_TUNER_BEST_BANDWIDTH,
+    COLLECTIVE_TUNER_COMMITS_TOTAL,
+    COLLECTIVE_TUNER_EXPLORATIONS_TOTAL,
     DATA_AUTOSCALE_EVENTS_TOTAL,
     DATA_BLOCKS_COALESCED_TOTAL,
     DATA_BLOCKS_EMITTED_TOTAL,
@@ -335,26 +341,46 @@ def _payload_nbytes(tensor) -> int:
 
 
 def record_collective(op: str, backend: str, nbytes: int, world_size: int,
-                      duration_s: float, cold: bool = False) -> None:
+                      duration_s: float, cold: bool = False,
+                      algo: str = "", group: str = "",
+                      wire_bytes: Optional[int] = None) -> None:
     if not GlobalConfig.enable_flight_recorder:
         return
     if duration_s <= 0:
         duration_s = 1e-9
     op_tags = {"op": op, "backend": backend}
+    if group:
+        op_tags["group"] = group
     hist_tags = {"op": op, "world_size": str(world_size)}
+    if algo:
+        hist_tags["algo"] = algo
     if cold:
         # First call of an (op, shape, dtype): the duration carries jax
         # trace+compile, not collective transfer — tagged so scrapers (and
         # local_collective_stats) can exclude it from bandwidth math.
         hist_tags["cold"] = "1"
-    _metrics._record_batch([
+    entries = [
         (COLLECTIVE_OPS_TOTAL, "counter", op_tags, 1.0, None),
         (COLLECTIVE_BYTES_TOTAL, "counter", op_tags, float(nbytes), None),
         (COLLECTIVE_DURATION_HIST, "histogram", hist_tags, duration_s,
          DURATION_BOUNDARIES),
         (COLLECTIVE_BANDWIDTH_HIST, "histogram", hist_tags,
          nbytes / duration_s, BANDWIDTH_BOUNDARIES),
-    ])
+    ]
+    if wire_bytes is not None and wire_bytes < nbytes:
+        # Block-quantized exchange: account the wire-byte reduction.
+        entries.append((COLLECTIVE_QUANTIZED_OPS_TOTAL, "counter",
+                        {"op": op}, 1.0, None))
+        entries.append((COLLECTIVE_QUANTIZED_BYTES_SAVED_TOTAL, "counter",
+                        {"op": op}, float(nbytes - wire_bytes), None))
+    _metrics._record_batch(entries)
+
+
+def _payload_dtype(tensor):
+    """dtype of one op's input (first leaf of a per-rank list)."""
+    if isinstance(tensor, (list, tuple)):
+        return _payload_dtype(tensor[0]) if tensor else "float32"
+    return getattr(tensor, "dtype", "float32")
 
 
 def _shape_sig(tensor) -> tuple:
@@ -376,17 +402,63 @@ def _wrap_collective_op(fn, op: str, backend: str, group, seen_keys: set):
             return fn(tensor, *args, **kwargs)
         # Mirrors the groups' compiled-fn cache keying (op + shape +
         # dtype): the first call of a key pays trace+compile and is
-        # tagged cold.
+        # tagged cold.  The ALGORITHM is part of the executable too, so a
+        # tuner exploration that switches algorithms is its own cold key.
+        # Ops outside the selection layer (broadcast/alltoall/permute)
+        # never write _last_decision — clear it so they can't inherit
+        # the previous op's algorithm/bucket attribution.
+        group._last_decision = None
         key = (op, _shape_sig(tensor))
-        cold = key not in seen_keys
-        seen_keys.add(key)
         t0 = time.perf_counter()
         out = fn(tensor, *args, **kwargs)
+        if getattr(group, "_last_decision", None) is not None:
+            # The op went through algorithm selection: the autotuner's
+            # feedback must be device-complete time, not async dispatch
+            # (the LOCAL backend returns unsynced jax arrays — timing
+            # dispatch would make the commit argmax a coin flip).  The
+            # XLA backend already materializes to numpy; this is a no-op
+            # there.
+            try:
+                import jax
+
+                jax.block_until_ready(out)
+            except Exception:  # noqa: BLE001 — non-jax outputs pass through
+                count_suppressed("collective_observe_sync")
         dt = time.perf_counter() - t0
+        decision = getattr(group, "_last_decision", None)
+        if decision is not None:
+            key = key + (decision["algo"],)
+        cold = key not in seen_keys
+        seen_keys.add(key)
+        nbytes = _payload_nbytes(tensor)
+        world = getattr(group, "world_size", 0) or 1
+        wire = None
+        if decision is not None and decision["algo"].endswith("_q8"):
+            # Keyed on the EXECUTED algorithm, not the request: a
+            # quantized=True call that lowered to plain flat (e.g.
+            # world_size 1) exchanged exact bytes and saved nothing.
+            from ..collective import algorithms as _alg
+
+            wire = _alg.quantized_wire_bytes(
+                nbytes, _payload_dtype(tensor),
+                GlobalConfig.collective_quant_block_size,
+            )
         record_collective(
-            op, backend, _payload_nbytes(tensor),
-            getattr(group, "world_size", 0) or 1, dt, cold=cold,
+            op, backend, nbytes, world, dt, cold=cold,
+            algo=decision["algo"] if decision else "",
+            group=getattr(group, "group_name", ""),
+            wire_bytes=wire,
         )
+        if decision is not None:
+            # Close the loop: the achieved-bandwidth sample drives the
+            # online autotuner's next selection for this bucket.
+            from ..collective.tuner import get_tuner
+
+            get_tuner().observe(
+                op, decision["nbytes"], decision["world_size"],
+                getattr(group, "topology", None), decision["algo"],
+                nbytes / max(dt, 1e-9), cold=cold,
+            )
         return out
 
     wrapped._fr_wrapped = True
@@ -497,3 +569,55 @@ def local_collective_stats() -> Dict[str, dict]:
             row["duration_sum_s"] / row["samples"] if row["samples"] else 0.0
         )
     return out
+
+
+def cluster_collective_stats() -> Dict[str, dict]:
+    """Cluster-aggregated collective view: every worker's collective
+    counters merged through the owner-service metrics registry (workers
+    flush their local registries to the control-plane KV on the
+    heartbeat cadence; ``metrics.snapshot()`` reads them all back), so
+    the autotuner's decisions are observable from the driver.
+
+    Returns ``{"ops": {op: {...}}, "groups": {group: {op: {...}}},
+    "algorithms": {op: {algo: {bucket: ops}}}}`` — ops/bytes summed
+    across workers, per-group rows keyed by the group tag recorded with
+    each op, and the per-bucket algorithm-decision counters."""
+    from . import metrics as _m
+
+    snap = _m.snapshot()
+    ops: Dict[str, dict] = {}
+    groups: Dict[str, dict] = {}
+    algos: Dict[str, dict] = {}
+    dur: Dict[str, dict] = {}
+    for ent in snap.values():
+        name, tags = ent.get("name"), ent.get("tags") or {}
+        op = tags.get("op")
+        if op is None:
+            continue
+        if name in (COLLECTIVE_OPS_TOTAL, COLLECTIVE_BYTES_TOTAL):
+            field = "ops" if name == COLLECTIVE_OPS_TOTAL else "bytes"
+            val = int(ent["value"]) if field == "ops" else ent["value"]
+            row = ops.setdefault(op, {"ops": 0, "bytes": 0.0})
+            row[field] += val
+            g = tags.get("group")
+            if g:
+                grow = groups.setdefault(g, {}).setdefault(
+                    op, {"ops": 0, "bytes": 0.0}
+                )
+                grow[field] += val
+        elif name == COLLECTIVE_DURATION_HIST and tags.get("cold") != "1":
+            d = dur.setdefault(op, {"sum": 0.0, "count": 0})
+            d["sum"] += ent["sum"]
+            d["count"] += ent["count"]
+        elif name == COLLECTIVE_ALGO_OPS_TOTAL:
+            bucket = tags.get("bucket", "?")
+            by_algo = algos.setdefault(op, {}).setdefault(
+                tags.get("algo", "?"), {}
+            )
+            by_algo[bucket] = by_algo.get(bucket, 0) + int(ent["value"])
+    for op, row in ops.items():
+        d = dur.get(op)
+        row["mean_duration_s"] = (
+            d["sum"] / d["count"] if d and d["count"] else 0.0
+        )
+    return {"ops": ops, "groups": groups, "algorithms": algos}

@@ -27,6 +27,19 @@ COLLECTIVE_BYTES_TOTAL = "ray_tpu_collective_bytes_total"
 COLLECTIVE_DURATION_HIST = "ray_tpu_collective_duration_s"
 COLLECTIVE_BANDWIDTH_HIST = "ray_tpu_collective_bandwidth_bytes_per_s"
 ICI_SCALING_EFFICIENCY = "ray_tpu_ici_scaling_efficiency"
+# Algorithm selection / online autotuner (docs/collective.md)
+COLLECTIVE_ALGO_OPS_TOTAL = "ray_tpu_collective_algo_ops_total"
+COLLECTIVE_TUNER_EXPLORATIONS_TOTAL = (
+    "ray_tpu_collective_tuner_explorations_total"
+)
+COLLECTIVE_TUNER_COMMITS_TOTAL = "ray_tpu_collective_tuner_commits_total"
+COLLECTIVE_TUNER_BEST_BANDWIDTH = (
+    "ray_tpu_collective_tuner_best_bandwidth_bytes_per_s"
+)
+COLLECTIVE_QUANTIZED_OPS_TOTAL = "ray_tpu_collective_quantized_ops_total"
+COLLECTIVE_QUANTIZED_BYTES_SAVED_TOTAL = (
+    "ray_tpu_collective_quantized_bytes_saved_total"
+)
 
 # ----------------------------------------------------------- object store
 OBJECT_STORE_FULL_ERRORS_TOTAL = "ray_tpu_object_store_full_errors_total"
@@ -118,6 +131,21 @@ METRICS: Dict[str, str] = {
     COLLECTIVE_BANDWIDTH_HIST: "achieved collective bandwidth (histogram)",
     ICI_SCALING_EFFICIENCY: "calibrated partition-retention ratio per mesh "
                             "size",
+    COLLECTIVE_ALGO_OPS_TOTAL: "collective ops by selected algorithm, "
+                               "size bucket, and topology (tuner "
+                               "decisions)",
+    COLLECTIVE_TUNER_EXPLORATIONS_TOTAL: "tuner selections that probed a "
+                                         "non-committed algorithm",
+    COLLECTIVE_TUNER_COMMITS_TOTAL: "tuner (re)commits to a bucket's "
+                                    "measured-best algorithm",
+    COLLECTIVE_TUNER_BEST_BANDWIDTH: "mean achieved bandwidth of the "
+                                     "committed algorithm per bucket "
+                                     "(gauge)",
+    COLLECTIVE_QUANTIZED_OPS_TOTAL: "block-quantized allreduce ops "
+                                    "executed (opt-in)",
+    COLLECTIVE_QUANTIZED_BYTES_SAVED_TOTAL: "logical minus wire bytes for "
+                                            "quantized exchanges (int8 "
+                                            "payload + per-block scales)",
     OBJECT_STORE_FULL_ERRORS_TOTAL: "ObjectStoreFullError occurrences",
     OBJECT_STORE_SPILL_BYTES_TOTAL: "bytes ever written to the spill tier",
     OBJECT_STORE_SPILL_RECLAIMED_TOTAL: "spill-tier bytes reclaimed by "
