@@ -1485,16 +1485,15 @@ def run_scaling_suite():
         emit("sp_ring_ulysses_parity", 1.0 if parity_ok else 0.0, "bool")
 
 
-# -------------------------------------------------------- collective suite
+# ------------------------------------------- subprocess-stage scaffolding
 
-def run_collective_suite(quick=False):
-    """Topology-aware collective selection A/B (ray_tpu.collective.
-    bench_collective).  Runs in a subprocess so the 8-virtual-device
-    flags bind before jax imports; the mesh is treated as 2 slices of 4
-    (the inter-slice axis standing in for DCN, same methodology as the
-    scaling suite).  Emits the per-algorithm device-side A/B, the
-    tuner's committed choice with a same-window tuned-vs-flat ratio, the
-    opt-in quantized-allreduce row, and the user-facing group path."""
+def _bench_subprocess(module, record_key, quick):
+    """Run a bench stage module in a subprocess (so XLA device flags
+    bind before jax imports) and return ``(rows, proc)`` — every
+    ``{record_key: {...}}`` JSON line parsed from stdout, rows first so
+    a nonzero exit can still be raised AFTER salvaging partial metrics.
+    A hang fails loudly: these stages are acceptance surfaces and must
+    not vanish from the summary."""
     import os
     import subprocess
 
@@ -1506,7 +1505,7 @@ def run_collective_suite(quick=False):
             env.get("XLA_FLAGS", "")
             + " --xla_force_host_platform_device_count=8"
         ).strip()
-    cmd = [sys.executable, "-m", "ray_tpu.collective.bench_collective"]
+    cmd = [sys.executable, "-m", module]
     if quick:
         cmd.append("--quick")
     try:
@@ -1514,20 +1513,34 @@ def run_collective_suite(quick=False):
             cmd, capture_output=True, text=True, timeout=600, env=env,
         )
     except subprocess.TimeoutExpired as e:
-        # This suite is the PR's acceptance surface — a hung stage must
-        # fail loudly, not vanish from the summary.
         raise RuntimeError(
-            "bench_collective timed out after 600s; partial stdout: "
+            f"{module} timed out after 600s; partial stdout: "
             f"{(e.stdout or b'')[-500:]!r}"
         ) from None
+    rows = []
     for line in proc.stdout.splitlines():
         try:
             rec = json.loads(line)
         except ValueError:
             continue
-        if "collective" not in rec:
-            continue
-        row = dict(rec["collective"])
+        if record_key in rec:
+            rows.append(dict(rec[record_key]))
+    return rows, proc
+
+
+# -------------------------------------------------------- collective suite
+
+def run_collective_suite(quick=False):
+    """Topology-aware collective selection A/B (ray_tpu.collective.
+    bench_collective).  The mesh is treated as 2 slices of 4 (the
+    inter-slice axis standing in for DCN, same methodology as the
+    scaling suite).  Emits the per-algorithm device-side A/B, the
+    tuner's committed choice with a same-window tuned-vs-flat ratio, the
+    opt-in quantized-allreduce row, and the user-facing group path."""
+    rows, proc = _bench_subprocess(
+        "ray_tpu.collective.bench_collective", "collective", quick
+    )
+    for row in rows:
         metric = row.pop("metric")
         if metric == "collective_allreduce_algo_ab":
             bws = row.pop("bandwidth_bytes_per_s")
@@ -1787,6 +1800,39 @@ def run_pipeline_suite():
         )
 
 
+def run_rl_suite(quick=False):
+    """Podracer RL throughput (ray_tpu.rllib.podracer.bench_rl).  Emits
+    Anakin env-steps/s scaling across 1→8 devices, the Sebulba learner
+    rate, and the Anakin-vs-host-loop-IMPALA ratio measured in ONE
+    interleaved window (both trainers alternate inside the same window —
+    this box swings ~2x between windows, a split A/B would be noise)."""
+    rows, proc = _bench_subprocess(
+        "ray_tpu.rllib.podracer.bench_rl", "rl", quick
+    )
+    ratio = None
+    for row in rows:
+        metric = row.pop("metric")
+        value = row.pop("value")
+        baseline = row.pop("baseline", None)
+        if metric == "rl_anakin_vs_host_loop":
+            ratio = row.get("ratio")
+        unit = (
+            "fraction" if "efficiency" in metric
+            else "updates/s" if "learner" in metric
+            else "steps/s"
+        )
+        emit(metric, value, unit, baseline=baseline, **row)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"bench_rl exited {proc.returncode}: {proc.stderr[-2000:]}"
+        )
+    if ratio is not None and ratio <= 1.0:
+        print(
+            f"# rl_anakin_vs_host_loop GUARD EXCEEDED: ratio "
+            f"{ratio} <= 1.0", flush=True,
+        )
+
+
 def run_obs_overhead_suite():
     res = measure_obs_overhead()
     emit(
@@ -1843,6 +1889,8 @@ def main():
             run("pipeline", run_pipeline_suite)
         if only in ("all", "collective"):
             run("collective", lambda: run_collective_suite(quick=quick))
+        if only in ("all", "rl"):
+            run("rl", lambda: run_rl_suite(quick=quick))
         if only in ("all", "scaling"):
             run("scaling", run_scaling_suite)
         if only in ("all", "model"):

@@ -1,0 +1,54 @@
+"""Podracer RL (arxiv 2104.06272): Anakin on-chip, then Sebulba split.
+
+Run: JAX_PLATFORMS=cpu python examples/podracer_rl.py
+(On a laptop set XLA_FLAGS=--xla_force_host_platform_device_count=4 to
+see the pmap axis; on a TPU host Anakin binds the real chips.)
+"""
+
+import ray_tpu
+from ray_tpu.rllib import AnakinConfig, CartPole, SebulbaConfig
+from ray_tpu.rllib.env import CartPoleJax
+from ray_tpu.rllib.podracer import evaluate_policy_numpy
+
+
+def main():
+    # --- Anakin: envs + learner fused into one jitted TPU-resident loop.
+    cfg = AnakinConfig().environment(CartPoleJax())
+    cfg.num_envs_per_device = 64
+    cfg.unroll_length = 16
+    cfg.updates_per_step = 50
+    anakin = cfg.build()
+    print(f"anakin: baseline greedy return {anakin.evaluate():.1f}")
+    for i in range(3):
+        r = anakin.train()
+        print(
+            f"anakin iter {i}: {r['env_steps_per_s']:,.0f} env-steps/s "
+            f"on {r['num_devices']} device(s), loss {r['loss']:.2f}, "
+            f"eval {anakin.evaluate():.1f}"
+        )
+
+    # --- Sebulba: host envs, device inference, bounded-staleness v-trace.
+    ray_tpu.init()
+    scfg = SebulbaConfig()
+    scfg.num_env_runners = 2
+    scfg.envs_per_runner = 4
+    scfg.batches_per_step = 8
+    sebulba = scfg.build()
+    try:
+        for i in range(3):
+            r = sebulba.train()
+            ev = evaluate_policy_numpy(
+                sebulba._np_params(), lambda: CartPole(), episodes=4
+            )
+            print(
+                f"sebulba iter {i}: {r['learner_steps_per_s']:.1f} "
+                f"updates/s, staleness mean {r['staleness_mean']:.1f}, "
+                f"return {r['episode_return_mean']}, eval {ev:.1f}"
+            )
+    finally:
+        sebulba.stop()
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -71,6 +71,23 @@ class Mailbox:
                 self._cond.wait(timeout=min(_RECV_POLL_S, remaining))
             return self._slots.pop(key)
 
+    def try_take_latest(self, edge: str):
+        """Non-blocking: remove and return ``(seq, value)`` for the
+        HIGHEST seq parked on ``edge``, discarding older ones (parameter
+        broadcast: a runner that slept through three versions wants the
+        newest, not a replay).  Seqs on one edge must be mutually
+        comparable (the broadcast path uses ints).  None if empty."""
+        with self._cond:
+            keys = [k for k in self._slots if k[0] == edge]
+            if not keys:
+                return None
+            best = max(keys, key=lambda k: k[1])
+            value = self._slots.pop(best)
+            for k in keys:
+                if k != best:
+                    del self._slots[k]
+            return best[1], value
+
     def drop_prefix(self, prefix: str) -> int:
         """Discard every parked message whose edge name starts with
         ``prefix`` (stage restart: a new generation must not consume the
@@ -140,18 +157,22 @@ class StageChannel:
             local_mailbox().deposit(edge, seq, value)
             self._local_msgs += 1
             return
-        from ..core.core_worker import global_worker
-
         # Zero-copy capture: the payload's buffers are NOT snapshotted —
         # the caller must not mutate them until flush() (pipeline sends
         # are fresh host views of immutable jax arrays, so this holds by
         # construction and saves one full copy per activation).
         payload = serialize_payload(value, prefer_plain=True)
+        self._push_remote(edge, seq, payload, dst_address, timeout)
+
+    def _push_remote(self, edge: str, seq, payload: SerializedPayload,
+                     dst_address: str, timeout: Optional[float]) -> None:
+        import asyncio
+
+        from ..core.core_worker import global_worker
+
         nbytes = payload.nbytes
         worker = global_worker()
         client = worker.worker_clients.get(dst_address)
-        import asyncio
-
         fut = asyncio.run_coroutine_threadsafe(
             client.call(
                 "pipeline_push",
@@ -163,6 +184,29 @@ class StageChannel:
         self._pending.append((fut, nbytes, time.perf_counter()))
         self._sent_msgs += 1
         self._sent_bytes += nbytes
+
+    def broadcast(self, seq, value, destinations,
+                  timeout: Optional[float] = None) -> int:
+        """Fan ``value`` out to many endpoints, serializing ONCE.
+
+        ``destinations`` is an iterable of ``(edge, dst_address)``; the
+        same ``SerializedPayload`` (same out-of-band buffer views) backs
+        every remote push, so an N-runner parameter broadcast pays one
+        serialization however wide the fan-out.  Local endpoints get
+        the raw value deposited directly.  Returns the serialized size
+        in bytes (0 if every destination was local).  Like ``send``,
+        delivery is async — ``flush()`` collects the acks.
+        """
+        payload = None
+        for edge, addr in destinations:
+            if not addr or addr == self.self_address():
+                local_mailbox().deposit(edge, seq, value)
+                self._local_msgs += 1
+                continue
+            if payload is None:
+                payload = serialize_payload(value, prefer_plain=True)
+            self._push_remote(edge, seq, payload, addr, timeout)
+        return payload.nbytes if payload is not None else 0
 
     def flush(self, timeout: Optional[float] = None) -> None:
         """Wait for every in-flight push to be acknowledged; raises the

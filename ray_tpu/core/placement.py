@@ -136,6 +136,91 @@ def pipeline_stage_placement_group(
     )
 
 
+class PodracerPlacement:
+    """Device-role bundles for podracer RL (arxiv 2104.06272).
+
+    One placement group with two runs of bundles: ``num_actor_bundles``
+    "actor"-role bundles (Sebulba env-runner actors doing batched
+    inference on their local devices; Anakin jobs pin whole trainers
+    here to share chips with other workloads) followed by
+    ``num_learner_bundles`` "learner"-role bundles (the v-trace
+    learner).  Reserving both roles in ONE group keeps the gang atomic —
+    a half-placed Sebulba job (runners without a learner) can never hold
+    resources.  SPREAD by default; STRICT_SPREAD when chips are
+    requested, matching ``SlicePlacementGroup`` whole-slice ownership.
+    """
+
+    def __init__(
+        self,
+        num_actor_bundles: int,
+        num_learner_bundles: int = 1,
+        actor_resources: Optional[Dict[str, float]] = None,
+        learner_resources: Optional[Dict[str, float]] = None,
+        chips_per_actor: int = 0,
+        chips_per_learner: int = 0,
+        accelerator_version: str = "",
+        name: str = "",
+    ):
+        if num_actor_bundles < 1 or num_learner_bundles < 0:
+            raise ValueError(
+                "need >= 1 actor bundle and >= 0 learner bundles"
+            )
+        self.num_actor_bundles = num_actor_bundles
+        self.num_learner_bundles = num_learner_bundles
+
+        def _bundle(base, chips):
+            b = dict(base) if base else {"CPU": 1.0}
+            if chips:
+                b["TPU"] = float(chips)
+                if accelerator_version:
+                    b[f"TPU-{accelerator_version}"] = float(chips)
+            return b
+
+        actor_bundle = _bundle(actor_resources, chips_per_actor)
+        learner_bundle = _bundle(learner_resources, chips_per_learner)
+        bundles = [dict(actor_bundle) for _ in range(num_actor_bundles)]
+        bundles += [dict(learner_bundle) for _ in range(num_learner_bundles)]
+        any_tpu = "TPU" in actor_bundle or "TPU" in learner_bundle
+        if len(bundles) == 1:
+            strategy = "PACK"
+        elif any_tpu:
+            strategy = "STRICT_SPREAD"
+        else:
+            strategy = "SPREAD"
+        self.pg = placement_group(bundles, strategy=strategy, name=name)
+
+    def ready(self, timeout: Optional[float] = None) -> bool:
+        return self.pg.ready(timeout)
+
+    def actor_strategy(self, index: int) -> PlacementGroupStrategy:
+        """Scheduling strategy pinning into actor-role bundle ``index``."""
+        if not 0 <= index < self.num_actor_bundles:
+            raise IndexError(f"actor bundle {index} out of range")
+        return placement_group_strategy(self.pg, index)
+
+    def learner_strategy(self, index: int = 0) -> PlacementGroupStrategy:
+        """Scheduling strategy pinning into learner-role bundle ``index``."""
+        if not 0 <= index < self.num_learner_bundles:
+            raise IndexError(f"learner bundle {index} out of range")
+        return placement_group_strategy(
+            self.pg, self.num_actor_bundles + index
+        )
+
+    def remove(self):
+        remove_placement_group(self.pg)
+
+
+def podracer_placement_group(
+    num_actor_bundles: int,
+    num_learner_bundles: int = 1,
+    **kwargs,
+) -> PodracerPlacement:
+    """Reserve actor/learner device-role bundles for a podracer RL job."""
+    return PodracerPlacement(
+        num_actor_bundles, num_learner_bundles, **kwargs
+    )
+
+
 class SlicePlacementGroup:
     """Reserve a whole TPU slice (all hosts of a pod) as one gang unit.
 

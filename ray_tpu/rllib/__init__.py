@@ -20,7 +20,20 @@ from .algorithm import Algorithm, AlgorithmConfig  # noqa: F401
 from .bc import BC, BCConfig, MARWIL, MARWILConfig  # noqa: F401
 from .dqn import DQN, DQNConfig  # noqa: F401
 from .env import CartPole, Pendulum  # noqa: F401
-from .impala import APPO, APPOConfig, IMPALA, IMPALAConfig  # noqa: F401
+from .impala import (  # noqa: F401
+    APPO,
+    APPOConfig,
+    IMPALA,
+    IMPALAConfig,
+    make_vtrace_loss,
+    make_vtrace_update,
+)
+from .podracer import (  # noqa: F401
+    Anakin,
+    AnakinConfig,
+    Sebulba,
+    SebulbaConfig,
+)
 from .ppo import PPO, PPOConfig  # noqa: F401
 from .replay import PrioritizedReplayBuffer, ReplayBuffer  # noqa: F401
 from .rl_module import (  # noqa: F401

@@ -3,6 +3,21 @@
 The RL workload for BASELINE.md north-star config #3 is PPO; CartPole is the
 standard smoke env.  Implemented in numpy with the classic dynamics so tests
 run anywhere.
+
+Two families live here:
+
+- ``CartPole`` / ``Pendulum`` — stateful numpy envs for host-side
+  env-runner actors (PPO/IMPALA/Sebulba samplers; no jax import).
+- ``CartPoleJax`` / ``PendulumJax`` — functional pure-jax twins with
+  identical dynamics, written so ``reset``/``step`` trace cleanly under
+  ``jit``/``vmap``/``scan``.  These are what the Anakin trainer steps
+  *on the accelerator*: thousands of env instances batched over an env
+  axis inside one compiled rollout+learn loop (Podracer, arxiv
+  2104.06272).  ``step`` auto-resets: the returned state/obs belong to a
+  fresh episode whenever ``done`` is True, while ``reward``/``done``
+  always describe the transition that just happened (the standard
+  gymnax/Anakin convention — a bootstrap value of the post-reset obs is
+  harmless because the loss discounts through ``done``).
 """
 
 from __future__ import annotations
@@ -102,3 +117,161 @@ class Pendulum:
         self.steps += 1
         done = self.steps >= self.max_steps
         return self._obs(), -cost, done, {}
+
+
+# --------------------------------------------------------------- jax twins
+class CartPoleJax:
+    """Functional pure-jax CartPole with auto-reset.
+
+    State is a pytree ``{"phys": (4,) f32, "steps": () i32}``; ``reset``
+    and ``step`` are pure functions of (key, state) so they vmap over an
+    env axis and scan over time.  Dynamics are the numpy ``CartPole``'s,
+    verbatim — parity is pinned in tests/test_rllib_podracer.py.
+    """
+
+    observation_size = 4
+    num_actions = 2
+
+    def __init__(self, max_steps: int = 200):
+        self.max_steps = max_steps
+        self.gravity = 9.8
+        self.masscart = 1.0
+        self.masspole = 0.1
+        self.length = 0.5
+        self.force_mag = 10.0
+        self.tau = 0.02
+        self.theta_threshold = 12 * 2 * np.pi / 360
+        self.x_threshold = 2.4
+
+    def reset(self, key):
+        import jax
+        import jax.numpy as jnp
+
+        phys = jax.random.uniform(
+            key, (4,), jnp.float32, minval=-0.05, maxval=0.05
+        )
+        state = {"phys": phys, "steps": jnp.zeros((), jnp.int32)}
+        return state, phys
+
+    def obs(self, state):
+        return state["phys"]
+
+    def step(self, key, state, action):
+        import jax.numpy as jnp
+
+        x, x_dot, theta, theta_dot = (
+            state["phys"][0], state["phys"][1],
+            state["phys"][2], state["phys"][3],
+        )
+        force = jnp.where(action == 1, self.force_mag, -self.force_mag)
+        costheta, sintheta = jnp.cos(theta), jnp.sin(theta)
+        total_mass = self.masspole + self.masscart
+        polemass_length = self.masspole * self.length
+        temp = (force + polemass_length * theta_dot**2 * sintheta) / total_mass
+        thetaacc = (self.gravity * sintheta - costheta * temp) / (
+            self.length * (4.0 / 3.0 - self.masspole * costheta**2 / total_mass)
+        )
+        xacc = temp - polemass_length * thetaacc * costheta / total_mass
+        x = x + self.tau * x_dot
+        x_dot = x_dot + self.tau * xacc
+        theta = theta + self.tau * theta_dot
+        theta_dot = theta_dot + self.tau * thetaacc
+        phys = jnp.stack([x, x_dot, theta, theta_dot]).astype(jnp.float32)
+        steps = state["steps"] + 1
+        done = (
+            (jnp.abs(x) > self.x_threshold)
+            | (jnp.abs(theta) > self.theta_threshold)
+            | (steps >= self.max_steps)
+        )
+        reset_state, _ = self.reset(key)
+        new_state = {
+            "phys": jnp.where(done, reset_state["phys"], phys),
+            "steps": jnp.where(done, reset_state["steps"], steps),
+        }
+        reward = jnp.float32(1.0)
+        return new_state, new_state["phys"], reward, done
+
+    # Batched-over-an-env-axis views (the Anakin rollout shape).
+    def vec_reset(self, key, num_envs: int):
+        import jax
+
+        keys = jax.random.split(key, num_envs)
+        return jax.vmap(self.reset)(keys)
+
+    def vec_step(self, keys, state, actions):
+        import jax
+
+        return jax.vmap(self.step)(keys, state, actions)
+
+
+class PendulumJax:
+    """Functional pure-jax Pendulum swing-up with auto-reset.
+
+    State ``{"phys": (2,) f32 (theta, theta_dot), "steps": () i32}``;
+    continuous action clipped to [-2, 2]; episodes truncate at
+    ``max_steps`` (the only ``done`` source, matching the numpy env).
+    """
+
+    observation_size = 3
+    action_size = 1
+    action_low = -2.0
+    action_high = 2.0
+
+    def __init__(self, max_steps: int = 200):
+        self.max_steps = max_steps
+        self.g, self.m, self.l, self.dt = 10.0, 1.0, 1.0, 0.05
+
+    def reset(self, key):
+        import jax
+        import jax.numpy as jnp
+
+        k1, k2 = jax.random.split(key)
+        th = jax.random.uniform(
+            k1, (), jnp.float32, minval=-np.pi, maxval=np.pi
+        )
+        thdot = jax.random.uniform(k2, (), jnp.float32, minval=-1.0, maxval=1.0)
+        state = {
+            "phys": jnp.stack([th, thdot]),
+            "steps": jnp.zeros((), jnp.int32),
+        }
+        return state, self.obs(state)
+
+    def obs(self, state):
+        import jax.numpy as jnp
+
+        th, thdot = state["phys"][0], state["phys"][1]
+        return jnp.stack([jnp.cos(th), jnp.sin(th), thdot])
+
+    def step(self, key, state, action):
+        import jax.numpy as jnp
+
+        th, thdot = state["phys"][0], state["phys"][1]
+        u = jnp.clip(jnp.reshape(action, (-1,))[0], -2.0, 2.0)
+        norm_th = ((th + jnp.pi) % (2 * jnp.pi)) - jnp.pi
+        cost = norm_th**2 + 0.1 * thdot**2 + 0.001 * u**2
+        thdot = thdot + (
+            3 * self.g / (2 * self.l) * jnp.sin(th)
+            + 3.0 / (self.m * self.l**2) * u
+        ) * self.dt
+        thdot = jnp.clip(thdot, -8.0, 8.0)
+        th = th + thdot * self.dt
+        steps = state["steps"] + 1
+        done = steps >= self.max_steps
+        reset_state, _ = self.reset(key)
+        phys = jnp.stack([th, thdot]).astype(jnp.float32)
+        new_state = {
+            "phys": jnp.where(done, reset_state["phys"], phys),
+            "steps": jnp.where(done, reset_state["steps"], steps),
+        }
+        return new_state, self.obs(new_state), -cost, done
+
+    def vec_reset(self, key, num_envs: int):
+        import jax
+
+        keys = jax.random.split(key, num_envs)
+        return jax.vmap(self.reset)(keys)
+
+    def vec_step(self, keys, state, actions):
+        import jax
+
+        return jax.vmap(self.step)(keys, state, actions)

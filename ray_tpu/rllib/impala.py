@@ -21,10 +21,106 @@ import numpy as np
 import ray_tpu
 from ray_tpu.core.serialization import dumps_function
 
+from .actor_manager import FaultTolerantActorManager
 from .algorithm import Algorithm, AlgorithmConfig, init_mlp, mlp_forward
 from .ppo import EnvRunner  # same on-policy sampler (returns logp_old)
 
 logger = logging.getLogger(__name__)
+
+
+def make_vtrace_loss(
+    *,
+    gamma: float,
+    rho_bar: float = 1.0,
+    c_bar: float = 1.0,
+    value_coeff: float = 0.5,
+    entropy_coeff: float = 0.01,
+    use_appo_clip: bool = False,
+    appo_clip_eps: float = 0.2,
+):
+    """Single-trajectory time-major V-trace actor-critic loss.
+
+    This is THE loss for every actor-learner split in the package:
+    IMPALA/APPO use it directly, the podracer trainers reuse it —
+    Sebulba vmapped over a trajectory-batch axis (host rollouts under a
+    stale behavior policy, rho/c clipping doing the off-policy
+    correction), Anakin vmapped over the on-chip env axis (on-policy, so
+    the ratios are exactly 1 and it reduces to n-step actor-critic).
+
+    ``batch`` keys: obs (T, obs), actions (T,), rewards (T,), dones
+    (T, float), logp_old (T,), last_value () — returns
+    ``(loss, (pg_loss, value_loss, entropy))``.
+    """
+
+    def loss_fn(params, batch):
+        import jax
+        import jax.numpy as jnp
+
+        from .ppo import _policy_forward
+
+        logits, values = _policy_forward(params, batch["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][:, None], axis=1
+        )[:, 0]
+        # Importance ratios target/behavior.
+        rhos = jnp.exp(logp - batch["logp_old"])
+        clipped_rho = jnp.minimum(rho_bar, rhos)
+        clipped_c = jnp.minimum(c_bar, rhos)
+        discounts = gamma * (1.0 - batch["dones"])
+        values_next = jnp.concatenate([values[1:], batch["last_value"][None]])
+        deltas = clipped_rho * (
+            batch["rewards"] + discounts * values_next - values
+        )
+
+        def scan_fn(acc, xs):
+            delta, discount, c = xs
+            acc = delta + discount * c * acc
+            return acc, acc
+
+        _, vs_minus_v = jax.lax.scan(
+            scan_fn,
+            jnp.zeros(()),
+            (deltas, discounts, clipped_c),
+            reverse=True,
+        )
+        vs = jax.lax.stop_gradient(vs_minus_v + values)
+        vs_next = jnp.concatenate([vs[1:], batch["last_value"][None]])
+        pg_adv = jax.lax.stop_gradient(
+            clipped_rho * (batch["rewards"] + discounts * vs_next - values)
+        )
+        if use_appo_clip:  # APPO: clipped surrogate on vtrace advantages
+            surrogate = jnp.minimum(
+                rhos * pg_adv,
+                jnp.clip(rhos, 1 - appo_clip_eps, 1 + appo_clip_eps) * pg_adv,
+            )
+            pg_loss = -jnp.mean(surrogate)
+        else:
+            pg_loss = -jnp.mean(logp * pg_adv)
+        value_loss = jnp.mean((values - vs) ** 2)
+        entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+        loss = pg_loss + value_coeff * value_loss - entropy_coeff * entropy
+        return loss, (pg_loss, value_loss, entropy)
+
+    return loss_fn
+
+
+def make_vtrace_update(tx, loss_fn):
+    """value_and_grad + optimizer apply around a v-trace ``loss_fn``.
+    Caller jits (IMPALA) or vmaps-then-jits (Sebulba) the result."""
+
+    def update(params, opt_state, batch):
+        import jax
+        import optax
+
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, batch
+        )
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss, aux
+
+    return update
 
 
 class IMPALAConfig(AlgorithmConfig):
@@ -69,97 +165,44 @@ class IMPALA(Algorithm):
         self.tx = optax.adam(config.lr)
         self.opt_state = self.tx.init(self.params)
 
-        gamma = config.gamma
-        rho_bar = config.vtrace_clip_rho
-        c_bar = config.vtrace_clip_c
-        vf, ent = config.value_coeff, config.entropy_coeff
-        use_clip, clip_eps = config.use_appo_clip, config.appo_clip_eps
-        tx = self.tx
+        loss_fn = make_vtrace_loss(
+            gamma=config.gamma,
+            rho_bar=config.vtrace_clip_rho,
+            c_bar=config.vtrace_clip_c,
+            value_coeff=config.value_coeff,
+            entropy_coeff=config.entropy_coeff,
+            use_appo_clip=config.use_appo_clip,
+            appo_clip_eps=config.appo_clip_eps,
+        )
+        self._vtrace_update = jax.jit(make_vtrace_update(self.tx, loss_fn))
 
-        def vtrace_update(params, opt_state, batch):
-            """One V-trace update over a single trajectory (time-major)."""
-            import jax.numpy as jnp
-
-            from .ppo import _policy_forward
-
-            def loss_fn(p):
-                logits, values = _policy_forward(p, batch["obs"])
-                logp_all = jax.nn.log_softmax(logits)
-                logp = jnp.take_along_axis(
-                    logp_all, batch["actions"][:, None], axis=1
-                )[:, 0]
-                # Importance ratios target/behavior.
-                rhos = jnp.exp(logp - batch["logp_old"])
-                clipped_rho = jnp.minimum(rho_bar, rhos)
-                clipped_c = jnp.minimum(c_bar, rhos)
-                discounts = gamma * (1.0 - batch["dones"])
-                values_next = jnp.concatenate(
-                    [values[1:], batch["last_value"][None]]
-                )
-                deltas = clipped_rho * (
-                    batch["rewards"] + discounts * values_next - values
-                )
-
-                def scan_fn(acc, xs):
-                    delta, discount, c = xs
-                    acc = delta + discount * c * acc
-                    return acc, acc
-
-                _, vs_minus_v = jax.lax.scan(
-                    scan_fn,
-                    jnp.zeros(()),
-                    (deltas, discounts, clipped_c),
-                    reverse=True,
-                )
-                vs = jax.lax.stop_gradient(vs_minus_v + values)
-                vs_next = jnp.concatenate([vs[1:], batch["last_value"][None]])
-                pg_adv = jax.lax.stop_gradient(
-                    clipped_rho
-                    * (batch["rewards"] + discounts * vs_next - values)
-                )
-                if use_clip:  # APPO: clipped surrogate on vtrace advantages
-                    surrogate = jnp.minimum(
-                        rhos * pg_adv,
-                        jnp.clip(rhos, 1 - clip_eps, 1 + clip_eps) * pg_adv,
-                    )
-                    pg_loss = -jnp.mean(surrogate)
-                else:
-                    pg_loss = -jnp.mean(logp * pg_adv)
-                value_loss = jnp.mean((values - vs) ** 2)
-                entropy = -jnp.mean(
-                    jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1)
-                )
-                loss = pg_loss + vf * value_loss - ent * entropy
-                return loss, (pg_loss, value_loss, entropy)
-
-            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                params
-            )
-            updates, opt_state = tx.update(grads, opt_state, params)
-            import optax as _optax
-
-            params = _optax.apply_updates(params, updates)
-            return params, opt_state, loss, aux
-
-        self._vtrace_update = jax.jit(vtrace_update)
-
-        self.runners = [
-            EnvRunner.remote(self._maker_payload, config.seed + i)
-            for i in range(config.num_env_runners)
-        ]
-        # One in-flight sample per runner at all times (the async core).
-        self._inflight: Dict[int, Any] = {}
-        np_params = self._np_params()
-        for i, r in enumerate(self.runners):
-            self._inflight[i] = r.sample.remote(
-                np_params, config.rollout_steps
-            )
+        # One in-flight sample per runner at all times (the async core);
+        # the manager owns liveness: a dead/stalled runner is killed,
+        # respawned (bounded budget — a deterministic failure such as an
+        # unimportable env_maker must not respawn forever), and
+        # resubmitted with current params via on_respawn.
+        self.runner_group = FaultTolerantActorManager(
+            self._make_runner,
+            config.num_env_runners,
+            max_restarts=2 * config.num_env_runners + 4,
+            on_respawn=self._resubmit,
+            name="impala",
+        )
+        for i in range(config.num_env_runners):
+            self._resubmit(i)
 
     def _np_params(self):
         return {k: np.asarray(v) for k, v in self.params.items()}
 
     def _make_runner(self, i: int):
         return EnvRunner.remote(self._maker_payload, self.config.seed + i)
+
+    def _resubmit(self, i: int, actor=None) -> None:
+        """Issue the next sample for runner ``i`` (also the on_respawn
+        hook — a replacement runner starts sampling with fresh params)."""
+        self.runner_group.submit(
+            i, "sample", self._np_params(), self.config.rollout_steps
+        )
 
     def training_step(self) -> Dict[str, Any]:
         import jax.numpy as jnp
@@ -169,37 +212,15 @@ class IMPALA(Algorithm):
         steps = 0
         loss = None
         processed = 0
-        failures = 0
+        restarts_before = self.runner_group.num_replacements
+        # Per-step restart budget: transient deaths over a long run are
+        # absorbed; a crash-loop within one step still trips it.
+        self.runner_group.new_restart_window()
         while processed < cfg.batches_per_step:
-            # Harvest whichever runner finishes first.
-            refs = list(self._inflight.values())
-            idx_by_ref = {ref: i for i, ref in self._inflight.items()}
-            ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=300)
-            if not ready:
-                raise TimeoutError("no env runner produced a batch in 300s")
-            ref = ready[0]
-            i = idx_by_ref[ref]
-            try:
-                traj = ray_tpu.get(ref, timeout=60)
-            except Exception as e:  # noqa: BLE001 — replace dead runner
-                failures += 1
-                if failures > 2 * len(self.runners) + 4:
-                    # A deterministic failure (e.g. env_maker unimportable
-                    # in workers) would otherwise respawn runners forever.
-                    raise RuntimeError(
-                        f"env runners keep failing ({failures} in one "
-                        f"step); last error: {e}"
-                    ) from e
-                logger.warning("runner %d failed (%s); replacing", i, e)
-                try:
-                    ray_tpu.kill(self.runners[i])
-                except Exception:
-                    pass
-                self.runners[i] = self._make_runner(i)
-                self._inflight[i] = self.runners[i].sample.remote(
-                    self._np_params(), cfg.rollout_steps
-                )
-                continue
+            # Harvest whichever runner finishes first; death handling
+            # (kill + bounded respawn + resubmit) lives in the manager —
+            # the wait never stalls on a dead runner.
+            i, traj = self.runner_group.wait_any(timeout=300)
             batch = {
                 "obs": jnp.asarray(traj["obs"]),
                 "actions": jnp.asarray(traj["actions"]),
@@ -215,15 +236,16 @@ class IMPALA(Algorithm):
             steps += len(traj["obs"])
             processed += 1
             # Resubmit with fresh params — only this runner, no barrier.
-            self._inflight[i] = self.runners[i].sample.remote(
-                self._np_params(), cfg.rollout_steps
-            )
+            self._resubmit(i)
         return {
             "episode_return_mean": (
                 float(np.mean(episode_returns)) if episode_returns else None
             ),
             "num_env_steps_sampled": steps,
             "loss": float(loss) if loss is not None else None,
+            "num_runner_restarts": (
+                self.runner_group.num_replacements - restarts_before
+            ),
         }
 
     def get_state(self) -> Dict[str, Any]:
@@ -234,11 +256,7 @@ class IMPALA(Algorithm):
         self.opt_state = self.tx.init(self.params)
 
     def cleanup(self) -> None:
-        for r in self.runners:
-            try:
-                ray_tpu.kill(r)
-            except Exception:
-                pass
+        self.runner_group.kill_all()
 
 
 class APPO(IMPALA):

@@ -1,0 +1,27 @@
+"""``ray_tpu.rllib.podracer`` — Podracer architectures for scalable RL.
+
+Reference: "Podracer architectures for scalable Reinforcement Learning"
+(arxiv 2104.06272).  Two trainers on top of the task/actor/placement-
+group/collective runtime:
+
+- **Anakin** (``anakin.py``): envs AND learner fused into one jitted
+  TPU-resident loop — pure-jax vectorized envs stepped under
+  ``lax.scan``, ``pmap`` over devices, parameters never leave the chip.
+  Use when the env is (re)writable in jax: env throughput scales with
+  chips, not Python.
+- **Sebulba** (``sebulba.py``): host-side env-runner actors (arbitrary
+  Python envs) doing batched inference on their local "actor" devices,
+  trajectories queued to the "learner" devices with bounded-staleness
+  V-trace correction (IMPALA's loss) and parameter broadcast over the
+  zero-copy ``StageChannel`` path.  Use when the env cannot be jitted.
+
+``docs/rllib.md`` has the decision table, placement shapes, and knobs.
+"""
+
+from .anakin import Anakin, AnakinConfig  # noqa: F401
+from .sebulba import (  # noqa: F401
+    Sebulba,
+    SebulbaConfig,
+    SebulbaEnvRunner,
+    evaluate_policy_numpy,
+)

@@ -1,0 +1,185 @@
+"""``bench.py rl`` backend — podracer throughput stages.
+
+Run as a subprocess (``python -m ray_tpu.rllib.podracer.bench_rl
+[--quick]``) so the 8-virtual-device XLA flags bind before jax imports;
+each stage prints one ``{"rl": {...}}`` JSON line that ``bench.py``
+re-emits into the summary.
+
+Stages:
+
+- ``rl_anakin_env_steps_per_s`` across 1→2→4→8 devices (one pmap
+  compile per width, rate measured post-warmup) plus the 8-device
+  scaling efficiency vs linear;
+- ``rl_anakin_vs_host_loop`` — Anakin against the host-loop IMPALA
+  (Python envs in runner actors, learner on the driver), both measured
+  as end-to-end env-steps/s in ONE interleaved window (this box swings
+  ~2x window-to-window, so A and B alternate within the same window and
+  the ratio is trustworthy even when the absolute rates are not);
+- ``rl_sebulba_learner_steps_per_s`` — Sebulba learner updates/s with
+  env throughput and mean staleness alongside.
+
+``--quick`` shrinks everything to a smoke (1 device, tiny unrolls) —
+that's the path tier-1 pins via tests/test_rllib_podracer.py.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List
+
+
+def _emit(row: Dict[str, Any]) -> Dict[str, Any]:
+    print(json.dumps({"rl": row}), flush=True)
+    return row
+
+
+def _anakin_config(num_devices: int, quick: bool):
+    from .anakin import AnakinConfig
+
+    cfg = AnakinConfig()
+    cfg.num_devices = num_devices
+    cfg.num_envs_per_device = 16 if quick else 64
+    cfg.unroll_length = 8 if quick else 16
+    cfg.updates_per_step = 4 if quick else 20
+    cfg.seed = 0
+    return cfg
+
+
+def _anakin_rate(algo, trials: int) -> float:
+    """Mean post-warmup env-steps/s over ``trials`` training_steps."""
+    rates = []
+    for _ in range(trials):
+        rates.append(algo.train()["env_steps_per_s"])
+    return float(sum(rates) / len(rates))
+
+
+def bench_anakin_scaling(quick: bool = False) -> List[Dict[str, Any]]:
+    """Anakin env-step throughput at 1, 2, 4, 8 devices."""
+    import jax
+
+    widths = [1] if quick else [1, 2, 4, 8]
+    widths = [w for w in widths if w <= len(jax.local_devices())]
+    trials = 2 if quick else 3
+    rows = []
+    rates = {}
+    for d in widths:
+        algo = _anakin_config(d, quick).build()
+        algo.train()  # warmup: pmap compile + first chunk
+        rate = _anakin_rate(algo, trials)
+        rates[d] = rate
+        cfg = algo.config
+        # Device count in the NAME: bench.py's one-line summary keys by
+        # metric, and the scaling story needs every width to survive.
+        rows.append(_emit({
+            "metric": f"rl_anakin_env_steps_per_s_{d}dev",
+            "value": round(rate, 1),
+            "devices": d,
+            "envs_per_device": cfg.num_envs_per_device,
+            "unroll": cfg.unroll_length,
+        }))
+    if len(widths) > 1:
+        top = widths[-1]
+        rows.append(_emit({
+            "metric": "rl_anakin_scaling_efficiency",
+            "value": round(rates[top] / (top * rates[1]), 4),
+            "devices": top,
+        }))
+    return rows
+
+
+def bench_anakin_vs_host_loop(quick: bool = False) -> List[Dict[str, Any]]:
+    """Anakin vs host-loop IMPALA, end-to-end env-steps/s, interleaved.
+
+    Needs a running ray_tpu cluster (IMPALA's env runners are actors).
+    Both sides include their learner update — this is trainer
+    throughput, not bare env stepping.
+    """
+    from ray_tpu.rllib import IMPALAConfig
+
+    anakin = _anakin_config(1, quick).build()
+    anakin.train()  # warmup/compile outside the measured window
+
+    icfg = (
+        IMPALAConfig()
+        .env_runners(2, rollout_steps=32 if quick else 128)
+        .training(batches_per_step=2 if quick else 4)
+    )
+    impala = icfg.build()
+    impala.train()  # warmup: runner spin-up + jit
+
+    trials = 2 if quick else 3
+    anakin_rates, impala_rates = [], []
+    for _ in range(trials):
+        # ONE window, A/B interleaved back-to-back.
+        anakin_rates.append(anakin.train()["env_steps_per_s"])
+        t0 = time.perf_counter()
+        r = impala.train()
+        impala_rates.append(
+            r["num_env_steps_sampled"] / max(time.perf_counter() - t0, 1e-9)
+        )
+    impala.stop()
+    a = sum(anakin_rates) / len(anakin_rates)
+    b = sum(impala_rates) / len(impala_rates)
+    return [_emit({
+        "metric": "rl_anakin_vs_host_loop",
+        "value": round(a, 1),
+        "baseline": round(b, 1),
+        "ratio": round(a / b, 3),
+        "guard": ">1.0",
+        "anakin_devices": 1,
+        "impala_runners": 2,
+        "trials": trials,
+    })]
+
+
+def bench_sebulba(quick: bool = False) -> List[Dict[str, Any]]:
+    """Sebulba learner-update and env-step throughput (needs cluster)."""
+    from .sebulba import SebulbaConfig
+
+    cfg = SebulbaConfig()
+    cfg.num_env_runners = 2
+    cfg.envs_per_runner = 2 if quick else 4
+    cfg.rollout_steps = 16 if quick else 64
+    cfg.batches_per_step = 4 if quick else 8
+    cfg.seed = 0
+    algo = cfg.build()
+    algo.train()  # warmup: actor spin-up + jit compile
+    trials = 1 if quick else 3
+    lps, eps, stale = [], [], []
+    for _ in range(trials):
+        r = algo.train()
+        lps.append(r["learner_steps_per_s"])
+        eps.append(r["num_env_steps_sampled"])
+        stale.append(r["staleness_mean"])
+    algo.stop()
+    return [_emit({
+        "metric": "rl_sebulba_learner_steps_per_s",
+        "value": round(sum(lps) / len(lps), 2),
+        "runners": cfg.num_env_runners,
+        "envs_per_runner": cfg.envs_per_runner,
+        "staleness_mean": round(sum(stale) / len(stale), 2),
+    })]
+
+
+def main(argv=None) -> int:
+    import sys
+
+    quick = "--quick" in (argv if argv is not None else sys.argv[1:])
+    bench_anakin_scaling(quick)
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        bench_anakin_vs_host_loop(quick)
+        bench_sebulba(quick)
+    finally:
+        ray_tpu.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -71,6 +71,20 @@ def _policy_forward(params, obs):
     return logits, value
 
 
+def _np_policy_forward(params, obs):
+    """Numpy twin of ``_policy_forward`` for host-side samplers (no jax
+    import): EnvRunner, the Sebulba host-inference path, and
+    ``evaluate_policy_numpy`` ALL call this one function — the
+    bit-identical-parity claims between them are pinned on there being
+    exactly one copy of this math.  ``obs`` may be a single observation
+    (``(obs,)``) or a batch (``(B, obs)``); values follow the leading
+    shape."""
+    h = np.tanh(obs @ params["w1"] + params["b1"])
+    logits = h @ params["wp"] + params["bp"]
+    values = (h @ params["wv"] + params["bv"])[..., 0]
+    return logits, values
+
+
 class JaxLearner:
     """Jitted PPO update (clipped surrogate + value + entropy)."""
 
@@ -170,12 +184,11 @@ class EnvRunner:
             [], [], [], [], [], [],
         )
         for _ in range(num_steps):
-            h = np.tanh(self.obs @ params["w1"] + params["b1"])
-            logits = h @ params["wp"] + params["bp"]
+            logits, value = _np_policy_forward(params, self.obs)
             logits = logits - logits.max()
             probs = np.exp(logits) / np.exp(logits).sum()
             action = int(self.rng.choice(len(probs), p=probs))
-            value = float(h @ params["wv"] + params["bv"])
+            value = float(value)
             obs_buf.append(self.obs)
             act_buf.append(action)
             logp_buf.append(float(np.log(probs[action] + 1e-12)))
@@ -189,8 +202,8 @@ class EnvRunner:
                 self.episode_return = 0.0
                 self.obs = self.env.reset()
         # Bootstrap value for the unfinished tail.
-        h = np.tanh(self.obs @ params["w1"] + params["b1"])
-        last_value = float(h @ params["wv"] + params["bv"])
+        _, last_value = _np_policy_forward(params, self.obs)
+        last_value = float(last_value)
         returns, self.completed_returns = self.completed_returns, []
         return {
             "obs": np.asarray(obs_buf, np.float32),

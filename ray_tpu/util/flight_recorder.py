@@ -92,6 +92,15 @@ from .metric_registry import (  # noqa: F401 — re-exports
     RPC_LANE_FORWARDED_TOTAL,
     RPC_LANE_FRAMES_TOTAL,
     RPC_LANE_QUEUE_DEPTH,
+    RL_ENV_STEPS_PER_S,
+    RL_ENV_STEPS_TOTAL,
+    RL_LEARNER_STEPS_PER_S,
+    RL_LEARNER_UPDATES_TOTAL,
+    RL_PARAM_BROADCAST_BYTES_TOTAL,
+    RL_PARAM_STALENESS_HIST,
+    RL_RUNNER_RESTARTS_TOTAL,
+    RL_STALE_TRAJS_DROPPED_TOTAL,
+    RL_TRAJ_QUEUE_DEPTH,
     RPC_OOB_BYTES_TOTAL,
     RPC_OOB_FRAMES_TOTAL,
     TASK_EVENTS_DROPPED_TOTAL,
@@ -531,6 +540,63 @@ def record_pipeline_bubble(overall: float, per_stage=None) -> None:
 
 def record_pipeline_restart(stage: int) -> None:
     counter(PIPELINE_STAGE_RESTARTS_TOTAL, 1.0, {"stage": str(stage)})
+
+
+# ------------------------------------------------------- podracer RL
+# Staleness is measured in learner versions (small ints), not seconds.
+STALENESS_BOUNDARIES = [0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0]
+
+
+def record_rl_rollout(arch: str, env_steps: int, duration_s: float,
+                      devices: int = 0) -> None:
+    """One measured rollout window for an RL trainer: transitions
+    produced and the achieved env-step throughput gauge."""
+    if not GlobalConfig.enable_flight_recorder:
+        return
+    tags = {"arch": arch}
+    if devices:
+        tags["devices"] = str(devices)
+    _metrics._record_batch([
+        (RL_ENV_STEPS_TOTAL, "counter", tags, float(env_steps), None),
+        (RL_ENV_STEPS_PER_S, "gauge", tags,
+         env_steps / max(duration_s, 1e-9), None),
+    ])
+
+
+def record_rl_update(arch: str, staleness: Optional[int] = None,
+                     queue_depth: Optional[int] = None, n: int = 1) -> None:
+    """``n`` learner gradient updates (Anakin applies a whole scanned
+    chunk per call); ``staleness`` is how many learner versions behind
+    the consumed trajectory's behavior policy was."""
+    if not GlobalConfig.enable_flight_recorder:
+        return
+    tags = {"arch": arch}
+    rows = [(RL_LEARNER_UPDATES_TOTAL, "counter", tags, float(n), None)]
+    if staleness is not None:
+        rows.append((RL_PARAM_STALENESS_HIST, "histogram", tags,
+                     float(staleness), STALENESS_BOUNDARIES))
+    if queue_depth is not None:
+        rows.append((RL_TRAJ_QUEUE_DEPTH, "gauge", tags,
+                     float(queue_depth), None))
+    _metrics._record_batch(rows)
+
+
+def record_rl_learner_rate(arch: str, updates_per_s: float) -> None:
+    gauge(RL_LEARNER_STEPS_PER_S, updates_per_s, {"arch": arch})
+
+
+def record_rl_broadcast(nbytes: int, fanout: int) -> None:
+    """One parameter broadcast: payload serialized once, pushed to
+    ``fanout`` runners (wire bytes = nbytes * remote fan-out)."""
+    counter(RL_PARAM_BROADCAST_BYTES_TOTAL, float(nbytes) * max(fanout, 1))
+
+
+def record_rl_stale_dropped(arch: str, n: int = 1) -> None:
+    counter(RL_STALE_TRAJS_DROPPED_TOTAL, float(n), {"arch": arch})
+
+
+def record_rl_runner_restart(group: str) -> None:
+    counter(RL_RUNNER_RESTARTS_TOTAL, 1.0, {"group": group})
 
 
 # -------------------------------------------------------- scaling gauge

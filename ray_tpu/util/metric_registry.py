@@ -111,6 +111,17 @@ LEASE_GRANT_WAIT_HIST = "ray_tpu_lease_grant_wait_s"
 LEASE_QUEUE_DEPTH = "ray_tpu_lease_queue_depth"
 LEASES_HELD = "ray_tpu_leases_held"
 
+# ------------------------------------------------------ podracer RL (PR 9)
+RL_ENV_STEPS_TOTAL = "ray_tpu_rl_env_steps_total"
+RL_LEARNER_UPDATES_TOTAL = "ray_tpu_rl_learner_updates_total"
+RL_ENV_STEPS_PER_S = "ray_tpu_rl_env_steps_per_s"
+RL_LEARNER_STEPS_PER_S = "ray_tpu_rl_learner_steps_per_s"
+RL_PARAM_BROADCAST_BYTES_TOTAL = "ray_tpu_rl_param_broadcast_bytes_total"
+RL_PARAM_STALENESS_HIST = "ray_tpu_rl_param_staleness"
+RL_STALE_TRAJS_DROPPED_TOTAL = "ray_tpu_rl_stale_trajs_dropped_total"
+RL_TRAJ_QUEUE_DEPTH = "ray_tpu_rl_traj_queue_depth"
+RL_RUNNER_RESTARTS_TOTAL = "ray_tpu_rl_runner_restarts_total"
+
 # ------------------------------------------------- runtime self-diagnosis
 EXCEPTION_SUPPRESSED_TOTAL = "ray_tpu_exception_suppressed_total"
 DEBUG_LOCK_CYCLES_TOTAL = "ray_tpu_debug_lock_cycles_total"
@@ -233,6 +244,24 @@ METRICS: Dict[str, str] = {
                                  "(forward+backward pairs)",
     PIPELINE_STAGE_RESTARTS_TOTAL: "stage actors restarted from the last "
                                    "synchronized checkpoint",
+    RL_ENV_STEPS_TOTAL: "environment transitions generated, by arch "
+                        "(anakin/sebulba/impala)",
+    RL_LEARNER_UPDATES_TOTAL: "learner gradient updates applied, by arch",
+    RL_ENV_STEPS_PER_S: "rollout throughput of the last measured window "
+                        "(gauge, by arch/devices)",
+    RL_LEARNER_STEPS_PER_S: "learner update throughput of the last "
+                            "measured window (gauge, by arch)",
+    RL_PARAM_BROADCAST_BYTES_TOTAL: "serialized-once parameter bytes fanned "
+                                    "out to env runners (wire bytes x "
+                                    "fan-out)",
+    RL_PARAM_STALENESS_HIST: "behavior-policy staleness in learner versions "
+                             "at consume time (histogram)",
+    RL_STALE_TRAJS_DROPPED_TOTAL: "trajectories discarded for exceeding "
+                                  "the staleness bound",
+    RL_TRAJ_QUEUE_DEPTH: "trajectories parked in the learner's inbound "
+                         "queue (gauge)",
+    RL_RUNNER_RESTARTS_TOTAL: "env-runner actors killed and respawned by "
+                              "the actor manager, by group",
     LEASE_GRANT_WAIT_HIST: "lease request wait until grant/spillback/retry "
                            "(histogram)",
     LEASE_QUEUE_DEPTH: "lease requests parked on the node agent (gauge)",
