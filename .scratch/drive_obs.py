@@ -1,0 +1,81 @@
+import json, os, time
+import numpy as np
+import ray_tpu
+from ray_tpu.util import tracing, obs, flight_recorder as fr
+from ray_tpu.util import metrics as m
+
+ray_tpu.init(num_cpus=2)
+
+# 1. traced task + p2p edge
+@ray_tpu.remote
+class Peer:
+    def address(self):
+        from ray_tpu.collective.p2p import StageChannel
+        return StageChannel.self_address()
+    def pull(self):
+        from ray_tpu.collective.p2p import StageChannel
+        return float(StageChannel("d").recv("d:0->1", 1, timeout=30)["a"].sum())
+
+@ray_tpu.remote
+def work(x):
+    with tracing.start_span("inner-work"):
+        return x + 1
+
+from ray_tpu.collective.p2p import StageChannel
+p = Peer.remote()
+dst = ray_tpu.get(p.address.remote(), timeout=60)
+pull_ref = p.pull.remote()
+with tracing.start_span("drive-root") as root:
+    assert ray_tpu.get(work.remote(1), timeout=60) == 2
+    ch = StageChannel("d")
+    ch.send("d:0->1", 1, {"a": np.ones(8, np.float32)}, dst)
+    ch.flush(timeout=30)
+assert ray_tpu.get(pull_ref, timeout=60) == 8.0
+
+deadline = time.time() + 30
+while True:
+    spans = tracing.get_trace(root.trace_id)
+    names = {s["name"] for s in spans}
+    if {"drive-root", "task:work", "inner-work", "p2p.recv:d:0->1"} <= names or time.time() > deadline:
+        break
+    time.sleep(0.3)
+print("TRACE names:", sorted(names))
+assert {"drive-root", "task:work", "inner-work", "p2p.recv:d:0->1"} <= names, names
+assert not spans.truncated
+print("TRACE processes:", len(obs.trace_processes(root.trace_id)))
+
+# 2. aggregator rides heartbeat; no new loop
+from ray_tpu.core.core_worker import global_worker
+w = global_worker()
+st = w._run_sync(w.agent.call("debug_state"))
+print("OBS:", st["obs"], "LOOPS:", st["background_loops"])
+assert st["obs"]["rounds"] > 0 and not any("obs" in n.lower() for n in st["background_loops"])
+
+# 3. SLO: injected straggler
+for s in range(3):
+    for _ in range(5):
+        fr.histogram(fr.PIPELINE_STAGE_STALL_HIST, 2.0 if s == 2 else 0.01, {"stage": str(s)})
+m.flush()
+from ray_tpu.util.slo import SloEngine
+v = SloEngine().evaluate()
+print("SLO:", [(x.rule, x.subject) for x in v])
+assert any(x.rule == "pipeline_straggler" and x.subject == "stage=2" for x in v)
+
+# 4. cluster timeline + CLI dump
+tl = obs.cluster_timeline()
+flows = sum(1 for e in tl["traceEvents"] if e.get("ph") == "s")
+print("TIMELINE:", len(tl["traceEvents"]), "events,", tl["otherData"], "flows:", flows)
+assert tl["traceEvents"] and tl["otherData"]["num_spans"] > 0 and flows > 0
+from ray_tpu.scripts import cli
+assert cli.main(["timeline", "--cluster", "-o", "/tmp/drive_trace.json"]) == 0
+dumped = json.load(open("/tmp/drive_trace.json"))
+assert dumped["traceEvents"]
+
+# 5. truncation marker end-to-end
+w.task_events._count_dropped(3, spans=3)
+t2 = tracing.get_trace(root.trace_id, min_spans=1)
+assert t2.truncated and t2.dropped_spans >= 3
+print("TRUNCATION: flagged, dropped =", t2.dropped_spans)
+
+ray_tpu.shutdown()
+print("DRIVE OK")

@@ -1058,6 +1058,24 @@ def run_control_plane_suite():
                 "llm_disagg_stream_stall_speedup",
                 mono_max / max(dis_max, 1e-4), "x",
             )
+            # Per-request serving telemetry for the streamed stage: the
+            # decode replicas recorded TTFT / inter-token histograms
+            # (deployment="llm_decode") during the streams above; read
+            # them back through the cluster observability plane so the
+            # bench summary carries the SLO signals item 5 gates on.
+            from ray_tpu.util import obs as _obs
+
+            time.sleep(2.5)  # replica registries flush/pull to the KV
+            decode_stats = _obs.serving_stats().get("llm_decode") or {}
+            ttft = decode_stats.get("ttft")
+            if ttft and ttft.get("count"):
+                emit("llm_stream_ttft_mean_s", ttft["mean_s"], "s",
+                     p50=ttft["p50_s"], p99=ttft["p99_s"],
+                     n=ttft["count"])
+            itl = decode_stats.get("inter_token")
+            if itl and itl.get("count"):
+                emit("llm_stream_inter_token_mean_s", itl["mean_s"], "s",
+                     p50=itl["p50_s"], p99=itl["p99_s"], n=itl["count"])
         except Exception as e:  # noqa: BLE001 — A/B is informative, not gating
             print(f"# llm disagg A/B skipped: {e}", flush=True)
 
@@ -1572,7 +1590,8 @@ def run_collective_suite(quick=False):
 
 # --------------------------------------------------------- obs overhead
 
-def measure_obs_overhead(n_calls=300, trials=3, n_warmup=30):
+def measure_obs_overhead(n_calls=300, trials=3, n_warmup=30,
+                         traced=False):
     """Task round-trip cost with the flight recorder ON vs OFF.
 
     Two fresh clusters (same shape) so the OFF run carries zero residue of
@@ -1580,10 +1599,22 @@ def measure_obs_overhead(n_calls=300, trials=3, n_warmup=30):
     single-shot throughput on a shared 1-core box swings with scheduler
     noise.  Returns per-call seconds for each config and the overhead
     fraction.  The <5% guard is the acceptance bar for all flight-recorder
-    instrumentation on the hot path."""
-    import ray_tpu
+    instrumentation on the hot path.
 
-    def per_call_s(flight_recorder_on: bool) -> float:
+    ``traced=True`` additionally measures the FULL observability plane:
+    recorder on, a request-scoped span wrapped around every call (trace
+    injection + executor-side span recording live on each hop), and the
+    node-agent aggregator pulling on its heartbeat — all of it must stay
+    inside the same envelope (``overhead_traced_fraction``)."""
+    import ray_tpu
+    from ray_tpu.util import tracing
+
+    def per_call_s(flight_recorder_on: bool,
+                   measure_traced: bool = False):
+        """Best-of-trials per-call time.  With ``measure_traced``, plain
+        and span-wrapped blocks alternate back-to-back inside the SAME
+        cluster/window — this box swings ~2x between windows, so the
+        traced/plain comparison must never span two of them."""
         ray_tpu.init(
             num_cpus=1,
             _system_config={
@@ -1596,25 +1627,42 @@ def measure_obs_overhead(n_calls=300, trials=3, n_warmup=30):
             def f():
                 return b"ok"
 
+            def block(with_span: bool) -> float:
+                t0 = time.perf_counter()
+                for _ in range(n_calls):
+                    if with_span:
+                        with tracing.start_span("bench-call"):
+                            ray_tpu.get(f.remote(), timeout=60)
+                    else:
+                        ray_tpu.get(f.remote(), timeout=60)
+                return (time.perf_counter() - t0) / n_calls
+
             for _ in range(n_warmup):
                 ray_tpu.get(f.remote(), timeout=60)
             best = float("inf")
+            best_traced = float("inf")
             for _ in range(trials):
-                t0 = time.perf_counter()
-                for _ in range(n_calls):
-                    ray_tpu.get(f.remote(), timeout=60)
-                best = min(best, (time.perf_counter() - t0) / n_calls)
-            return best
+                best = min(best, block(False))
+                if measure_traced:
+                    best_traced = min(best_traced, block(True))
+            return (best, best_traced) if measure_traced else best
         finally:
             ray_tpu.shutdown()
 
-    t_on = per_call_s(True)
+    if traced:
+        t_on, t_traced = per_call_s(True, measure_traced=True)
+    else:
+        t_on, t_traced = per_call_s(True), None
     t_off = per_call_s(False)
-    return {
+    out = {
         "per_call_on_s": t_on,
         "per_call_off_s": t_off,
         "overhead_fraction": max(0.0, t_on / t_off - 1.0),
     }
+    if traced:
+        out["per_call_traced_s"] = t_traced
+        out["overhead_traced_fraction"] = max(0.0, t_traced / t_off - 1.0)
+    return out
 
 
 # ------------------------------------------------------ data streaming
@@ -1834,18 +1882,28 @@ def run_rl_suite(quick=False):
 
 
 def run_obs_overhead_suite():
-    res = measure_obs_overhead()
+    res = measure_obs_overhead(traced=True)
     emit(
         "obs_overhead_fraction", res["overhead_fraction"], "fraction",
         per_call_on_us=round(res["per_call_on_s"] * 1e6, 1),
         per_call_off_us=round(res["per_call_off_s"] * 1e6, 1),
         guard="<0.05",
     )
-    if res["overhead_fraction"] >= 0.05:
-        print(
-            f"# obs_overhead GUARD EXCEEDED: "
-            f"{res['overhead_fraction']:.3f} >= 0.05", flush=True,
-        )
+    # Full plane: tracing span per call + executor-side span recording +
+    # node-agent aggregator pull, same <5% gate.
+    emit(
+        "obs_overhead_traced_fraction", res["overhead_traced_fraction"],
+        "fraction",
+        per_call_traced_us=round(res["per_call_traced_s"] * 1e6, 1),
+        per_call_off_us=round(res["per_call_off_s"] * 1e6, 1),
+        guard="<0.05",
+    )
+    for key in ("overhead_fraction", "overhead_traced_fraction"):
+        if res[key] >= 0.05:
+            print(
+                f"# obs_overhead GUARD EXCEEDED: {key} "
+                f"{res[key]:.3f} >= 0.05", flush=True,
+            )
 
 
 def main():

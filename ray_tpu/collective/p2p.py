@@ -34,6 +34,45 @@ logger = logging.getLogger(__name__)
 _RECV_POLL_S = 1.0  # condition re-check cadence while waiting for a message
 
 
+class _TracedMsg:
+    """In-process envelope for a pushed message that carries the sender's
+    trace context.  Never serialized: the remote path ships the context
+    as a sibling field of the push RPC and this wrapper is rebuilt on the
+    receiving side (``deposit_push``), so the wire format of untraced
+    pushes is unchanged."""
+
+    __slots__ = ("value", "trace", "deposit_ts")
+
+    def __init__(self, value, trace, deposit_ts):
+        self.value = value
+        self.trace = trace  # sender's (trace_id, span_id)
+        self.deposit_ts = deposit_ts
+
+
+def _consume_traced(edge: str, seq, value):
+    """Unwrap a traced message at take time, stitching the cross-process
+    edge: records a ``p2p.recv`` span parented to the SENDER's span (the
+    deposit→consume interval on the receiving process)."""
+    if type(value) is not _TracedMsg:
+        return value
+    from ..util import tracing
+
+    tracing.record_span(
+        f"p2p.recv:{edge}", value.deposit_ts, time.time(),
+        {"edge": edge, "seq": str(seq)}, context=value.trace,
+    )
+    return value.value
+
+
+def deposit_push(edge: str, seq, data, trace=None) -> None:
+    """RPC-server side of ``pipeline_push``: park the (still-serialized)
+    payload, wrapping it with the sender's trace context when the push
+    carried one.  Lane-safe — one dict insert + notify."""
+    if trace is not None:
+        data = _TracedMsg(data, tuple(trace), time.time())
+    local_mailbox().deposit(edge, seq, data)
+
+
 class Mailbox:
     """Process-local buffer of pushed messages, keyed (edge, seq).
 
@@ -69,7 +108,8 @@ class Mailbox:
                         f"for edge {edge!r} seq {seq!r}"
                     )
                 self._cond.wait(timeout=min(_RECV_POLL_S, remaining))
-            return self._slots.pop(key)
+            value = self._slots.pop(key)
+        return _consume_traced(edge, seq, value)
 
     def try_take_latest(self, edge: str):
         """Non-blocking: remove and return ``(seq, value)`` for the
@@ -86,7 +126,7 @@ class Mailbox:
             for k in keys:
                 if k != best:
                     del self._slots[k]
-            return best[1], value
+        return best[1], _consume_traced(edge, best[1], value)
 
     def drop_prefix(self, prefix: str) -> int:
         """Discard every parked message whose edge name starts with
@@ -154,7 +194,7 @@ class StageChannel:
         ``dst_address``.  Empty/self address delivers locally without
         serializing."""
         if not dst_address or dst_address == self.self_address():
-            local_mailbox().deposit(edge, seq, value)
+            deposit_push(edge, seq, value, self._trace_ctx())
             self._local_msgs += 1
             return
         # Zero-copy capture: the payload's buffers are NOT snapshotted —
@@ -163,6 +203,14 @@ class StageChannel:
         # construction and saves one full copy per activation).
         payload = serialize_payload(value, prefer_plain=True)
         self._push_remote(edge, seq, payload, dst_address, timeout)
+
+    @staticmethod
+    def _trace_ctx():
+        """Sender's trace context, propagated with every push so the
+        receiving process can stitch the p2p edge into the same trace."""
+        from ..util import tracing
+
+        return tracing.current_context()
 
     def _push_remote(self, edge: str, seq, payload: SerializedPayload,
                      dst_address: str, timeout: Optional[float]) -> None:
@@ -173,10 +221,14 @@ class StageChannel:
         nbytes = payload.nbytes
         worker = global_worker()
         client = worker.worker_clients.get(dst_address)
+        msg = {"edge": edge, "seq": seq, "data": payload}
+        trace = self._trace_ctx()
+        if trace is not None:
+            msg["trace"] = trace
         fut = asyncio.run_coroutine_threadsafe(
             client.call(
                 "pipeline_push",
-                {"edge": edge, "seq": seq, "data": payload},
+                msg,
                 timeout=timeout or self.recv_timeout_s,
             ),
             worker.loop,
@@ -200,7 +252,7 @@ class StageChannel:
         payload = None
         for edge, addr in destinations:
             if not addr or addr == self.self_address():
-                local_mailbox().deposit(edge, seq, value)
+                deposit_push(edge, seq, value, self._trace_ctx())
                 self._local_msgs += 1
                 continue
             if payload is None:

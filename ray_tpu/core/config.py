@@ -216,6 +216,13 @@ _KNOBS: Dict[str, tuple] = {
         "counters (ray_tpu_* metrics + timeline phase rows).  Guarded at "
         "<5% round-trip overhead by `bench.py obs_overhead`",
     ),
+    "enable_obs_aggregator": (
+        bool, True,
+        "Node-agent pull of each local worker's span/task-event/metric "
+        "deltas, ridden on the existing heartbeat (one obs_report RPC "
+        "per beat; no new periodic loop).  Workers drop their own "
+        "task-event flush to a slow backup cadence while pulled",
+    ),
     "task_events_flush_period_s": (float, 0.5, "Worker buffer flush period"),
     "task_events_max_buffer": (int, 10000, "Per-worker unflushed event cap"),
     "task_events_max_stored": (int, 100000, "Control-plane stored task cap"),

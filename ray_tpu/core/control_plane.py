@@ -159,6 +159,7 @@ class ControlPlane:
         }
         self._bg_tasks: List[asyncio.Task] = []
         self.task_event_store = TaskEventStore()
+        self._obs_seen: Dict[str, int] = {}  # worker -> last obs batch id
         self._requested_resources: List[dict] = []
         self._recent_unplaceable: List[tuple] = []  # (monotonic ts, resources)
         self.store = make_store_client(store_path)
@@ -1222,6 +1223,38 @@ class ControlPlane:
         self.task_event_store.add_batch(
             payload.get("events", ()), payload.get("profile_events", ())
         )
+        if payload.get("worker_id"):
+            self.task_event_store.report_span_drops(
+                payload["worker_id"], payload.get("span_drops", 0)
+            )
+        return True
+
+    def handle_obs_report(self, payload, conn):
+        """Node-agent aggregated observability delivery: one RPC per
+        heartbeat carrying every pulled worker's task events, spans,
+        span-drop totals, and metrics-registry snapshot.  Metrics land
+        under the same per-worker KV key the worker's own flush uses, so
+        the two delivery paths overwrite instead of double counting.
+        Batches carry per-worker ids (the pull staging's at-least-once
+        redelivery): an id seen before is a duplicate of a batch that
+        DID land — only its idempotent span-drop total is merged."""
+        metrics_ns = self._kv.setdefault("metrics", {})
+        for batch in payload.get("batches") or ():
+            wid = batch.get("worker_id")
+            if wid and batch.get("span_drops"):
+                self.task_event_store.report_span_drops(
+                    wid, batch["span_drops"]
+                )
+            bid = batch.get("batch_id")
+            if bid is not None and wid and self._obs_seen.get(wid) == bid:
+                continue
+            self.task_event_store.add_batch(
+                batch.get("events") or (), batch.get("profile_events") or ()
+            )
+            if batch.get("metrics") and batch.get("metrics_key"):
+                metrics_ns[batch["metrics_key"]] = batch["metrics"]
+            if bid is not None and wid:
+                self._obs_seen[wid] = bid
         return True
 
     def handle_list_task_events(self, payload, conn):
@@ -1231,6 +1264,7 @@ class ControlPlane:
             ),
             "profile_events": self.task_event_store.profile_events(),
             "num_dropped": self.task_event_store.num_dropped,
+            "num_span_drops": self.task_event_store.span_drop_total(),
         }
 
     async def handle_list_objects(self, payload, conn):

@@ -1166,7 +1166,17 @@ class CoreWorker:
         self.task_events = TaskEventBuffer(
             self.cp, self.node_id.hex(), self.worker_id.hex()
         )
+        # Leased workers are drained by their node agent's heartbeat pull
+        # (obs_pull); their own flush loop drops to a backup cadence.
+        # Drivers have no agent pulling them and keep the fast loop.
+        self.task_events.pull_mode = (
+            self.mode == self.WORKER and GlobalConfig.enable_obs_aggregator
+        )
         self.task_events.start()
+        # obs_pull staging (at-least-once): the last pull reply is kept
+        # until the agent acks it on a later pull.
+        self._obs_pending = None
+        self._obs_batch_seq = 0
         if self.mode == self.DRIVER:
             await self.cp.call(
                 "register_job",
@@ -1322,6 +1332,7 @@ class CoreWorker:
                 await asyncio.wait_for(self.task_events.stop(), timeout=2)
             except Exception as e:
                 logger.debug("task-event stop flush failed: %s", e)
+        await self._flush_obs_pending()
         # Final metrics push: a short-lived worker/driver must not silently
         # lose the last _FLUSH_INTERVAL_S window of counters on exit.
         try:
@@ -1355,6 +1366,33 @@ class CoreWorker:
         if payload is not None and self.cp is not None:
             await _metrics._kv_put_async(self, payload)
 
+    async def _flush_obs_pending(self):
+        """Deliver an unacked obs_pull staging batch straight to the
+        control plane (exit path: the agent will never re-pull us).  On
+        failure the loss is counted — never silent."""
+        pending = getattr(self, "_obs_pending", None)
+        if pending is None or self.cp is None:
+            return
+        te = self.task_events
+        try:
+            await asyncio.wait_for(
+                self.cp.call("task_events", {
+                    "events": pending["events"],
+                    "profile_events": pending["profile_events"],
+                    "worker_id": self.worker_id.hex(),
+                    "span_drops": te.num_span_dropped if te else 0,
+                }),
+                timeout=2,
+            )
+            self._obs_pending = None
+        except Exception as e:  # noqa: BLE001 — exit flush is best-effort
+            if te is not None:
+                te._count_dropped(
+                    len(pending["events"]) + len(pending["profile_events"]),
+                    spans=te._count_spans(pending["profile_events"]),
+                )
+            logger.debug("obs pending flush failed on exit: %s", e)
+
     async def _flush_observability(self):
         """Flush the task-event buffer AND the metrics registry — the final
         window must survive worker disconnect/exit."""
@@ -1363,6 +1401,7 @@ class CoreWorker:
                 await asyncio.wait_for(self.task_events.flush(), timeout=2)
             except Exception as e:
                 logger.debug("task-event flush failed on disconnect: %s", e)
+        await self._flush_obs_pending()
         try:
             await asyncio.wait_for(self._flush_metrics(), timeout=2)
         except Exception as e:
@@ -3816,14 +3855,80 @@ class CoreWorker:
             ),
         }
 
+    def handle_obs_pull(self, payload, conn):
+        """Node-agent observability pull (heartbeat cadence): drain this
+        worker's task-event/span buffers and snapshot its metrics
+        registry.  The agent forwards the merged batches to the control
+        plane as ONE ``obs_report`` per beat — so per-worker telemetry
+        reaches the cluster store without each worker keeping its own
+        fast flush timer against the control plane.
+
+        At-least-once: the reply is STAGED here until the agent acks its
+        batch_id on a later pull (it acks only after a successful
+        obs_report), so a lost reply or failed report re-delivers
+        instead of silently dropping the drained events.  Sustained
+        delivery failure degrades into oldest-first shedding with the
+        normal drop accounting — loss stays explicit."""
+        from ..util import metrics as _metrics
+
+        te = self.task_events
+        pending = self._obs_pending
+        if pending is not None and payload.get("ack") == pending["batch_id"]:
+            pending = self._obs_pending = None
+        events, profiles = te.drain() if te is not None else ([], [])
+        metrics_payload = _metrics.payload_snapshot(only_dirty=True)
+        new_content = bool(events or profiles or metrics_payload is not None)
+        if pending is not None:
+            events = pending["events"] + events
+            profiles = pending["profile_events"] + profiles
+            if metrics_payload is None:
+                metrics_payload = pending["metrics"]
+        if te is not None:
+            cap = 2 * GlobalConfig.task_events_max_buffer
+            if len(events) > cap:
+                shed = len(events) - cap
+                del events[:shed]
+                te._count_dropped(shed)
+            if len(profiles) > cap:
+                shed = len(profiles) - cap
+                shed_rows = profiles[:shed]
+                del profiles[:shed]
+                te._count_dropped(shed, spans=te._count_spans(shed_rows))
+        span_drops = te.num_span_dropped if te is not None else 0
+        if not events and not profiles and metrics_payload is None:
+            return {"worker_id": self.worker_id.hex(), "batch_id": None,
+                    "span_drops": span_drops}
+        if pending is not None and not new_content:
+            # Pure re-delivery: keep the id so the control plane can
+            # drop the duplicate if the first report DID land.
+            batch_id = pending["batch_id"]
+        else:
+            self._obs_batch_seq += 1
+            batch_id = self._obs_batch_seq
+        self._obs_pending = {
+            "batch_id": batch_id,
+            "events": events,
+            "profile_events": profiles,
+            "metrics": metrics_payload,
+        }
+        return {
+            "worker_id": self.worker_id.hex(),
+            "batch_id": batch_id,
+            "events": events,
+            "profile_events": profiles,
+            "span_drops": span_drops,
+            "metrics_key": f"worker:{self.worker_id.hex()}",
+            "metrics": metrics_payload,
+        }
+
     def handle_pipeline_push(self, payload, conn):
         """Stage-boundary p2p delivery (train.pipeline activations/grads):
         park the still-serialized payload in the local mailbox for the
         consuming actor thread.  Lane-safe — one dict insert + notify."""
-        from ..collective.p2p import local_mailbox
+        from ..collective.p2p import deposit_push
 
-        local_mailbox().deposit(payload["edge"], payload["seq"],
-                                payload["data"])
+        deposit_push(payload["edge"], payload["seq"], payload["data"],
+                     payload.get("trace"))
         return True
 
     def handle_device_fetch(self, payload, conn):

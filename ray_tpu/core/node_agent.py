@@ -175,6 +175,16 @@ class NodeAgent:
         self._default_env_key = tuple(sorted(env.items()))
         self._bg: List[asyncio.Task] = []
         self._loop: Optional[asyncio.AbstractEventLoop] = None
+        # Observability aggregator counters (pull rides the heartbeat —
+        # by design there is NO separate periodic loop for it; a test
+        # pins that via the _bg task list in debug_state).
+        self._obs_rounds = 0
+        self._obs_events_forwarded = 0
+        self._obs_workers_pulled = 0
+        # batch-id acks per worker: sent with the next pull only AFTER a
+        # successful obs_report, so workers re-deliver un-forwarded
+        # batches instead of losing them (at-least-once).
+        self._obs_acks: Dict[str, int] = {}
 
     # ------------------------------------------------------------- lifecycle
     async def start(self) -> str:
@@ -338,6 +348,14 @@ class NodeAgent:
             except Exception:  # raylint: waive[RTL003] telemetry must not kill heartbeat
                 pass
             try:
+                # Observability aggregation rides the SAME cadence: pull
+                # every local worker's span/task-event/metric deltas and
+                # forward one merged obs_report — no extra periodic RPC.
+                if GlobalConfig.enable_obs_aggregator:
+                    await self._obs_pull_round()
+            except Exception:  # raylint: waive[RTL003] telemetry must not kill heartbeat
+                pass
+            try:
                 reply = await self.cp_client.call(
                     "heartbeat",
                     {"node_id": self.node_id, "snapshot": self._snapshot()},
@@ -355,6 +373,62 @@ class NodeAgent:
             except Exception as e:
                 logger.debug("heartbeat send failed: %s", e)
             await asyncio.sleep(period)
+
+    async def _obs_pull_round(self):
+        """One aggregator round: drain each ready local worker's
+        observability buffers (obs_pull) and ship the merged batches to
+        the control plane as one obs_report.  Per-worker failures are
+        isolated — a dying worker must not cost the node its telemetry."""
+        self._obs_rounds += 1
+        timeout = max(1.0, GlobalConfig.health_check_period_s)
+
+        async def pull_one(handle):
+            if handle.address is None or handle.proc.poll() is not None:
+                return None
+            wid = handle.worker_id.hex()
+            try:
+                return await self.worker_clients.get(handle.address).call(
+                    "obs_pull", {"ack": self._obs_acks.get(wid)},
+                    timeout=timeout,
+                )
+            except Exception:  # noqa: BLE001 — worker may be mid-exit
+                # Nothing is lost: the worker staged the reply and will
+                # re-deliver it on the next (un-acked) pull.
+                from ..util import flight_recorder as fr
+
+                fr.count_suppressed("obs_pull")
+                return None
+
+        handles = list(self.workers.values())
+        replies = await asyncio.gather(*(pull_one(h) for h in handles))
+        live = {h.worker_id.hex() for h in handles}
+        for wid in [w for w in self._obs_acks if w not in live]:
+            del self._obs_acks[wid]
+        batches = [
+            b for b in replies
+            if b and (b.get("events") or b.get("profile_events")
+                      or b.get("metrics") or b.get("span_drops"))
+        ]
+        self._obs_workers_pulled += sum(1 for b in replies if b)
+        if not batches:
+            return
+        n_events = sum(
+            len(b.get("events") or ()) + len(b.get("profile_events") or ())
+            for b in batches
+        )
+        try:
+            await self.cp_client.call(
+                "obs_report",
+                {"node_id": self.node_id.hex(), "batches": batches},
+                retries=1,
+            )
+        except Exception as e:  # noqa: BLE001 — workers re-deliver un-acked batches
+            logger.debug("obs_report failed (will re-pull): %s", e)
+            return
+        self._obs_events_forwarded += n_events
+        for b in batches:
+            if b.get("batch_id") is not None and b.get("worker_id"):
+                self._obs_acks[b["worker_id"]] = b["batch_id"]
 
     # --------------------------------------------------------------- workers
     def _spawn_worker(
@@ -1360,6 +1434,17 @@ class NodeAgent:
             "num_spilled_total": self.directory.num_spilled,
             "rpc_stats": dict(self.server.stats),
             "rpc_lanes": self.server.lane_stats(),
+            # Aggregator introspection: rounds counts obs pulls (ridden on
+            # the heartbeat); background_loops names every periodic task
+            # this agent runs so tests can pin "no new periodic RPC loop".
+            "obs": {
+                "rounds": self._obs_rounds,
+                "workers_pulled": self._obs_workers_pulled,
+                "events_forwarded": self._obs_events_forwarded,
+            },
+            "background_loops": sorted(
+                t.get_coro().__qualname__ for t in self._bg
+            ),
         }
 
 

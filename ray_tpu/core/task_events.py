@@ -46,19 +46,40 @@ class TaskEventBuffer:
         self._task: Optional[asyncio.Task] = None
         self._stopped = False
         self.num_dropped = 0  # events lost to shedding or failed flushes
+        # Spans ride the profile channel, so buffer shedding silently
+        # punches holes in traces — span drops are counted separately
+        # (ray_tpu_trace_spans_dropped_total) and the running total ships
+        # with every flush so get_trace()/the timeline can flag affected
+        # traces as truncated instead of returning them as complete.
+        self.num_span_dropped = 0
+        self._span_drops_reported = 0  # last total shipped to the CP
+        # When the node agent pulls this buffer on the heartbeat
+        # (obs_pull), the worker's own flush loop drops to a slow backup
+        # cadence instead of racing the agent with per-worker RPCs.
+        self.pull_mode = False
 
-    def _count_dropped(self, n: int) -> None:
+    def _count_dropped(self, n: int, spans: int = 0) -> None:
         if n <= 0:
             return
         self.num_dropped += n
+        self.num_span_dropped += spans
         try:
             from ray_tpu.util import flight_recorder
 
             flight_recorder.counter(
                 flight_recorder.TASK_EVENTS_DROPPED_TOTAL, n
             )
+            flight_recorder.counter(
+                flight_recorder.TRACE_SPANS_DROPPED_TOTAL, spans
+            )
         except Exception:  # raylint: waive[RTL003] telemetry of the telemetry
             pass
+
+    @staticmethod
+    def _count_spans(rows) -> int:
+        return sum(
+            1 for r in rows if ((r.get("extra") or {}).get("span"))
+        )
 
     # ------------------------------------------------------------- recording
     def record(
@@ -105,8 +126,9 @@ class TaskEventBuffer:
         )
         if len(self._profile_events) > GlobalConfig.task_events_max_buffer:
             shed = len(self._profile_events) // 2
+            shed_rows = self._profile_events[:shed]
             del self._profile_events[:shed]
-            self._count_dropped(shed)
+            self._count_dropped(shed, spans=self._count_spans(shed_rows))
 
     @contextlib.contextmanager
     def profile(self, event_name: str, extra: Optional[dict] = None):
@@ -131,9 +153,11 @@ class TaskEventBuffer:
             self._task = None
         await self.flush()
 
-    async def flush(self) -> None:
-        if not self._events and not self._profile_events:
-            return
+    def drain(self) -> tuple:
+        """Atomically take every buffered event, shaped for the control
+        plane's ``task_events``/``obs_report`` handlers.  Shared by the
+        worker's own flush and the node agent's heartbeat pull — each
+        event leaves through exactly one of the two paths."""
         raw, self._events = self._events, []
         profiles, self._profile_events = self._profile_events, []
         events = [
@@ -152,22 +176,46 @@ class TaskEventBuffer:
             }
             for t in raw
         ]
+        return events, profiles
+
+    async def flush(self) -> None:
+        if (
+            not self._events
+            and not self._profile_events
+            # An empty buffer still flushes when sheds happened since the
+            # last report — truncation visibility must not wait for the
+            # next event to ride along.
+            and self.num_span_dropped == self._span_drops_reported
+        ):
+            return
+        events, profiles = self.drain()
+        span_drops = self.num_span_dropped
         try:
             await self._cp.call(
                 "task_events",
-                {"events": events, "profile_events": profiles},
+                {"events": events, "profile_events": profiles,
+                 "worker_id": self._worker,
+                 "span_drops": span_drops},
                 retries=2,
             )
+            self._span_drops_reported = span_drops
         except Exception as e:  # noqa: BLE001 — observability is best-effort
             # Lossy by design — but visibly so: the counter flushes with
             # the metrics registry once the control plane is reachable
             # again, so operators can see how much history is missing.
-            self._count_dropped(len(events) + len(profiles))
+            self._count_dropped(
+                len(events) + len(profiles),
+                spans=self._count_spans(profiles),
+            )
             logger.debug("task-event flush dropped %d events: %s", len(events), e)
 
     async def _flush_loop(self) -> None:
-        period = GlobalConfig.task_events_flush_period_s
         while not self._stopped:
+            period = GlobalConfig.task_events_flush_period_s
+            if self.pull_mode:
+                # The node agent drains this buffer each heartbeat; the
+                # local loop stays only as a slow backup for agent gaps.
+                period = max(5.0, period)
             await asyncio.sleep(period)
             await self.flush()
 
@@ -187,6 +235,24 @@ class TaskEventStore:
         self._tasks: Dict[tuple, dict] = {}
         self._profile_events: List[dict] = []
         self.num_dropped = 0
+        # Cluster span-loss accounting: per-worker shed totals (reported
+        # with each flush/pull, max-merged so the two delivery paths
+        # can't double count) plus spans this store itself evicted.
+        self._worker_span_drops: Dict[str, int] = {}
+        self._own_span_drops = 0
+
+    def report_span_drops(self, worker_id: str, total) -> None:
+        """Record a worker's cumulative span-shed count (idempotent:
+        totals only ratchet up, so redelivery is harmless)."""
+        try:
+            total = int(total)
+        except (TypeError, ValueError):
+            return
+        if total > self._worker_span_drops.get(worker_id, 0):
+            self._worker_span_drops[worker_id] = total
+
+    def span_drop_total(self) -> int:
+        return self._own_span_drops + sum(self._worker_span_drops.values())
 
     def add_batch(self, events: List[dict], profile_events: List[dict]) -> None:
         for ev in events:
@@ -238,7 +304,11 @@ class TaskEventStore:
                     evicted += 1
             self.num_dropped += evicted
         if len(self._profile_events) > cap:
-            del self._profile_events[: len(self._profile_events) - cap]
+            overflow = len(self._profile_events) - cap
+            self._own_span_drops += TaskEventBuffer._count_spans(
+                self._profile_events[:overflow]
+            )
+            del self._profile_events[:overflow]
 
     def list_tasks(
         self, filters: Optional[Dict[str, Any]] = None, limit: int = 1000

@@ -65,7 +65,8 @@ def start_dashboard(
                 "endpoints": [
                     "/api/cluster", "/api/nodes", "/api/actors",
                     "/api/tasks", "/api/jobs", "/api/placement_groups",
-                    "/api/timeline", "/api/task_phases", "/metrics",
+                    "/api/timeline", "/api/timeline?cluster=1",
+                    "/api/task_phases", "/api/slo", "/metrics",
                 ]
             }
         )
@@ -182,6 +183,12 @@ def start_dashboard(
         return _json(await run_sync(state_api.list_placement_groups))
 
     async def timeline(request):
+        if request.query.get("cluster", "") not in ("", "0", "false"):
+            # Cluster-merged Chrome trace: spans from every process,
+            # cross-process flow links, explicit truncation metadata.
+            from .util import obs
+
+            return _json(await run_sync(obs.cluster_timeline))
         reply = await run_sync(client.list_task_events, None, 100000)
         return _json(chrome_trace_events(reply))
 
@@ -189,6 +196,16 @@ def start_dashboard(
         """Flight-recorder phase percentiles (queue wait, arg resolution,
         execute, return-put, backpressure wait)."""
         return _json(await run_sync(state_api.summarize_task_phases))
+
+    async def slo(request):
+        """SLO/anomaly engine findings over the aggregated stream (one
+        process-wide engine: rate/sustain rules accumulate state across
+        requests)."""
+        from .util.slo import get_slo_engine
+
+        engine = get_slo_engine()
+        await run_sync(engine.evaluate)
+        return _json(engine.report())
 
     async def metrics(request):
         from .util import metrics as metrics_mod
@@ -212,6 +229,7 @@ def start_dashboard(
     app.router.add_get("/api/placement_groups", pgs)
     app.router.add_get("/api/timeline", timeline)
     app.router.add_get("/api/task_phases", task_phases)
+    app.router.add_get("/api/slo", slo)
     app.router.add_get("/metrics", metrics)
 
     loop = asyncio.new_event_loop()

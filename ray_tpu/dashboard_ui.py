@@ -36,13 +36,15 @@ INDEX_HTML = """<!doctype html>
 <body>
 <h1>ray_tpu dashboard <span class="muted" id="ts"></span> <span id="err"></span></h1>
 <div class="cards" id="cards"></div>
+<h2>SLO violations</h2><div id="slo"></div>
 <h2>Nodes</h2><div id="nodes"></div>
 <h2>Actors</h2><div id="actors"></div>
 <h2>Placement groups</h2><div id="pgs"></div>
 <h2>Jobs</h2><div id="jobs"></div>
 <h2>Recent tasks</h2><div id="tasks"></div>
 <p class="muted">JSON API: /api/cluster /api/nodes /api/actors /api/tasks
-/api/jobs /api/placement_groups /api/timeline (chrome://tracing) /metrics
+/api/jobs /api/placement_groups /api/timeline (chrome://tracing;
+?cluster=1 for the stitched cluster trace) /api/slo /metrics
 (Prometheus)</p>
 <script>
 async function j(u) { const r = await fetch(u); return r.json(); }
@@ -69,9 +71,10 @@ function fmtRes(o) {
 }
 async function refresh() {
   try {
-    const [cluster, nodes, actors, pgs, jobs, tasks] = await Promise.all([
+    const [cluster, nodes, actors, pgs, jobs, tasks, slo] = await Promise.all([
       j('/api/cluster'), j('/api/nodes'), j('/api/actors'),
       j('/api/placement_groups'), j('/api/jobs'), j('/api/tasks?limit=60'),
+      j('/api/slo'),
     ]);
     document.getElementById('cards').innerHTML =
       card('nodes alive', `${cluster.nodes_alive}/${cluster.nodes_total}`) +
@@ -80,6 +83,11 @@ async function refresh() {
       card('total', fmtRes(cluster.resources_total) || '-') +
       Object.entries(cluster.actors_by_state || {}).map(
         ([s, n]) => card('actors ' + s, n)).join('');
+    document.getElementById('slo').innerHTML =
+      (slo.violations && slo.violations.length)
+        ? table(slo.violations,
+                ['rule', 'subject', 'value', 'threshold', 'detail'])
+        : `<p class="muted">none (${(slo.rules || []).join(', ')})</p>`;
     document.getElementById('nodes').innerHTML =
       table(nodes, ['node_id', 'alive', 'total', 'available', 'idle_s']);
     document.getElementById('actors').innerHTML =

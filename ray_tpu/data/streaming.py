@@ -861,6 +861,20 @@ class StreamingScheduler:
             # Plan with no stages (pre-materialized refs / raw blocks).
             yield from list(self.source)
             return
+        from ray_tpu.util import tracing
+
+        # Root the stream's whole task fan-out in one trace so a dataset
+        # execution exports as a single stitched cluster trace.  Detached
+        # (not installed in the current context): a start_span block
+        # entered here would leak its contextvar into the consumer between
+        # yields.  A consumer that already has an active span wins — the
+        # launches inherit it naturally and no extra root is made.
+        root = None
+        if GlobalConfig.enable_task_events and tracing.current_context() is None:
+            root = tracing.detached_span(
+                "data.stream",
+                {"ops": ",".join(n.name for n in self.nodes)},
+            )
         sink = self.nodes[-1]
         try:
             while True:
@@ -868,8 +882,11 @@ class StreamingScheduler:
                     yield sink.out.popleft()
                 if all(n.done for n in self.nodes):
                     break
-                self._step()
+                with tracing.span_context(root):
+                    self._step()
         finally:
+            if root is not None:
+                tracing.finish_span(root)
             # Normal exhaustion: everything below is a no-op.  Abandoned
             # consumer (take() satisfied, generator closed): cancel all
             # remaining upstream work and tear down pools.

@@ -168,8 +168,24 @@ class DecodeReplica:
         """Stream an adopted request's text deltas as they decode (the
         disaggregated analog of ``JaxLLMEngine.generate_stream``) — this
         replica's streams are never interrupted by prefill programs, the
-        inter-token-latency property the pattern exists for."""
-        yield from self.engine.stream_request(request_id, timeout_s)
+        inter-token-latency property the pattern exists for.
+
+        Each stream records its TTFT and inter-token-gap histograms
+        (``deployment="llm_decode"``) — the exact per-request signals
+        the continuous-batching serving gate measures against."""
+        from ray_tpu.util import flight_recorder
+
+        tele = flight_recorder.StreamTelemetry("llm_decode", "decode")
+        outcome = "ok"
+        try:
+            for delta in self.engine.stream_request(request_id, timeout_s):
+                tele.tick()
+                yield delta
+        except BaseException:
+            outcome = "error"
+            raise
+        finally:
+            tele.done(outcome)
 
 
 class PrefillReplica:
@@ -212,16 +228,54 @@ class DisaggRouter:
         timeout_s: float = 300.0,
     ) -> dict:
         import ray_tpu
+        from ray_tpu.util import flight_recorder, tracing
 
         p = self.prefill_replicas[next(self._p_rr)]
         d = self.decode_replicas[next(self._d_rr)]
-        if self._is_actor(p):
-            meta = ray_tpu.get(p.prefill.remote(prompt, params), timeout=timeout_s)
-            rid = ray_tpu.get(d.add_from_kv.remote(meta), timeout=timeout_s)
-            return ray_tpu.get(d.run.remote(rid), timeout=timeout_s)
-        meta = p.prefill(prompt, params)
-        rid = d.add_from_kv(meta)
-        return d.run(rid, timeout_s=timeout_s)
+        # One request-scoped span per generate: the prefill and decode
+        # actor calls inside inherit the trace, so the router -> prefill
+        # -> decode path exports as a single stitched cluster trace.
+        # TTFT here is prompt-in to first-token-out (the prefill hop),
+        # the disaggregation pattern's protected latency.
+        t0 = time.perf_counter()
+        ttft_s = None
+        outcome = "ok"
+        try:
+            with tracing.start_span(
+                "llm.disagg.generate", {"deployment": "llm_disagg"}
+            ) as span:
+                try:
+                    if self._is_actor(p):
+                        meta = ray_tpu.get(
+                            p.prefill.remote(prompt, params),
+                            timeout=timeout_s,
+                        )
+                        ttft_s = time.perf_counter() - t0
+                        rid = ray_tpu.get(
+                            d.add_from_kv.remote(meta), timeout=timeout_s
+                        )
+                        result = ray_tpu.get(d.run.remote(rid),
+                                             timeout=timeout_s)
+                    else:
+                        meta = p.prefill(prompt, params)
+                        ttft_s = time.perf_counter() - t0
+                        rid = d.add_from_kv(meta)
+                        result = d.run(rid, timeout_s=timeout_s)
+                    span.set_attribute("ttft_s", ttft_s)
+                except BaseException as e:
+                    span.set_attribute("error", str(e))
+                    raise
+            return result
+        except BaseException:
+            outcome = "error"
+            raise
+        finally:
+            flight_recorder.record_serve_request(
+                "llm_disagg", "router", 0.0,
+                ttft_s if ttft_s is not None
+                else time.perf_counter() - t0,
+                outcome=outcome,
+            )
 
     def generate_many(
         self,

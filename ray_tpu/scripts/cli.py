@@ -225,14 +225,59 @@ def cmd_summary(args) -> int:
 def cmd_timeline(args) -> int:
     from ..util.state.api import StateApiClient, chrome_trace_events
 
+    out = args.output or f"ray-tpu-timeline-{int(time.time())}.json"
+    if getattr(args, "cluster", False):
+        # Cluster-merged trace: spans from every process, cross-process
+        # flow links, and explicit truncation metadata.
+        from ..util import obs
+
+        trace = obs.cluster_timeline(args.address)
+        with open(out, "w") as f:
+            json.dump(trace, f)
+        meta = trace["otherData"]
+        print(f"wrote {len(trace['traceEvents'])} events "
+              f"({meta['num_spans']} spans, {meta['num_traces']} traces) "
+              f"to {out} (open in chrome://tracing or ui.perfetto.dev)")
+        if meta["truncated"]:
+            print(f"WARNING: {meta['spans_dropped']} spans were shed from "
+                  "the task-event channel — traces may be incomplete")
+        return 0
     client = StateApiClient(args.address)
     events = chrome_trace_events(client.list_task_events(limit=100000))
-    out = args.output or f"ray-tpu-timeline-{int(time.time())}.json"
     with open(out, "w") as f:
         json.dump(events, f)
     print(f"wrote {len(events)} events to {out} "
           "(open in chrome://tracing or ui.perfetto.dev)")
     return 0
+
+
+def cmd_slo(args) -> int:
+    """Evaluate the SLO/anomaly rules against the running cluster and
+    print current violations (rate rules need two samples — the command
+    evaluates, waits ``--window``, and evaluates again)."""
+    import ray_tpu
+    from ..util.slo import SloEngine
+
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(address=args.address or "auto")
+    engine = SloEngine()
+    engine.evaluate()
+    if args.window > 0:
+        time.sleep(args.window)
+    violations = engine.evaluate()
+    report = engine.report()
+    rc = 1 if violations else 0
+    if args.json:
+        print(json.dumps(report, indent=2))
+        return rc
+    if not violations:
+        print(f"no SLO violations (rules: {', '.join(report['rules'])})")
+        return rc
+    print(_fmt_table(
+        [v.to_dict() for v in violations],
+        ["rule", "subject", "value", "threshold", "detail"],
+    ))
+    return rc
 
 
 def cmd_logs(args) -> int:
@@ -377,7 +422,18 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("timeline", help="dump Chrome-trace task timeline")
     p.add_argument("--address", default=None)
     p.add_argument("-o", "--output", default=None)
+    p.add_argument("--cluster", action="store_true",
+                   help="cluster-merged trace: spans from every process, "
+                   "cross-process flow links, truncation metadata")
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("slo", help="print current SLO/anomaly violations")
+    p.add_argument("--address", default=None)
+    p.add_argument("--window", type=float, default=1.0,
+                   help="seconds between the two evaluations rate rules "
+                   "need (0 = single evaluation)")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_slo)
 
     p = sub.add_parser("logs", help="list/tail system logs of the newest session")
     p.add_argument("component", nargs="?", default=None,

@@ -248,41 +248,59 @@ def start_http_proxy(host: str = "127.0.0.1", port: int = 8000) -> str:
                 name = max(candidates, key=lambda c: len(c[0]))[1]
         return name
 
-    async def stream_sse(request: "web.Request", handle, body):
+    async def stream_sse(request: "web.Request", handle, body, name=""):
         import asyncio as _asyncio
+        import contextvars as _cv
 
-        resp = web.StreamResponse(
-            headers={
-                "Content-Type": "text/event-stream",
-                "Cache-Control": "no-cache",
-            }
-        )
-        await resp.prepare(request)
-        loop = _asyncio.get_running_loop()
-        # Routing does blocking control-plane/replica probes — keep it off
-        # the proxy loop (same as the non-stream path).
-        gen = await loop.run_in_executor(
-            None, lambda: handle.options(stream=True).remote(body)
-        )
-        sentinel = object()
-        try:
-            while True:
-                chunk = await loop.run_in_executor(
-                    None, lambda: next(gen, sentinel)
-                )
-                if chunk is sentinel:
-                    break
+        from ray_tpu.util import tracing
+
+        # One request-scoped span covering the whole stream; the trace id
+        # goes out as a response header so clients can fetch the stitched
+        # cross-process trace (driver/proxy -> replica -> downstream).
+        with tracing.start_span(
+            "serve.http.stream", {"route": request.path, "deployment": name}
+        ) as span:
+            resp = web.StreamResponse(
+                headers={
+                    "Content-Type": "text/event-stream",
+                    "Cache-Control": "no-cache",
+                    "x-ray-tpu-trace-id": span.trace_id,
+                }
+            )
+            await resp.prepare(request)
+            loop = _asyncio.get_running_loop()
+            # Routing does blocking control-plane/replica probes — keep it
+            # off the proxy loop (same as the non-stream path).  The copied
+            # context carries the span into the executor thread so the
+            # replica submission inherits the trace.
+            ctx = _cv.copy_context()
+            gen = await loop.run_in_executor(
+                None,
+                lambda: ctx.run(
+                    lambda: handle.options(stream=True).remote(body)
+                ),
+            )
+            sentinel = object()
+            try:
+                while True:
+                    chunk = await loop.run_in_executor(
+                        None, lambda: next(gen, sentinel)
+                    )
+                    if chunk is sentinel:
+                        break
+                    await resp.write(
+                        b"data: " + json.dumps(chunk, default=str).encode()
+                        + b"\n\n"
+                    )
+            except Exception as e:  # noqa: BLE001 — surface mid-stream errors
+                span.set_attribute("error", str(e))
                 await resp.write(
-                    b"data: " + json.dumps(chunk, default=str).encode()
+                    b"data: " + json.dumps({"error": str(e)}).encode()
                     + b"\n\n"
                 )
-        except Exception as e:  # noqa: BLE001 — surface mid-stream errors
-            await resp.write(
-                b"data: " + json.dumps({"error": str(e)}).encode() + b"\n\n"
-            )
-        await resp.write(b"data: [DONE]\n\n")
-        await resp.write_eof()
-        return resp
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+            return resp
 
     async def handle_request(request: "web.Request"):
         import time as _time
@@ -325,7 +343,7 @@ def start_http_proxy(host: str = "127.0.0.1", port: int = 8000) -> str:
             # generator; each chunk goes out as one `data:` frame
             # (reference: serve HTTP response streaming / OpenAI
             # `stream: true`).
-            return await stream_sse(request, handle, body)
+            return await stream_sse(request, handle, body, name)
         if isinstance(body, dict) and ("args" in body or "kwargs" in body):
             args = body.get("args", [])
             kwargs = body.get("kwargs", {})
@@ -334,17 +352,29 @@ def start_http_proxy(host: str = "127.0.0.1", port: int = 8000) -> str:
         else:
             args, kwargs = [body], {}
         loop = asyncio.get_running_loop()
-        response = handle.remote(*args, **kwargs)
+        from ray_tpu.util import tracing
+
+        # Request-scoped span: the replica submission below happens
+        # inside it, so the whole proxy -> replica -> downstream-task
+        # path stitches into one trace (returned in the trace header).
+        with tracing.start_span(
+            "serve.http", {"route": request.path, "deployment": name}
+        ) as span:
+            headers = {"x-ray-tpu-trace-id": span.trace_id}
+            response = handle.remote(*args, **kwargs)
+            try:
+                result = await loop.run_in_executor(
+                    None, lambda: response.result(timeout=60)
+                )
+            except Exception as e:  # noqa: BLE001
+                span.set_attribute("error", str(e))
+                return web.json_response(
+                    {"error": str(e)}, status=500, headers=headers
+                )
         try:
-            result = await loop.run_in_executor(
-                None, lambda: response.result(timeout=60)
-            )
-        except Exception as e:  # noqa: BLE001
-            return web.json_response({"error": str(e)}, status=500)
-        try:
-            return web.json_response({"result": result})
+            return web.json_response({"result": result}, headers=headers)
         except TypeError:
-            return web.json_response({"result": repr(result)})
+            return web.json_response({"result": repr(result)}, headers=headers)
 
     app = web.Application()
     app.router.add_route("*", "/{tail:.*}", handle_request)

@@ -39,7 +39,10 @@ class Replica:
     """Hosts one copy of the user callable."""
 
     def __init__(self, payload: bytes, init_args, init_kwargs,
-                 max_ongoing_requests: int = 16):
+                 max_ongoing_requests: int = 16,
+                 deployment_name: str = ""):
+        import os as _os
+
         obj = loads_function(payload)
         if isinstance(obj, type):
             self.callable = obj(*init_args, **init_kwargs)
@@ -50,6 +53,10 @@ class Replica:
         self._ongoing = 0
         self._lock = make_lock("serve.replica.stats")
         self._total = 0
+        # Per-request serving telemetry identity: TTFT / inter-token /
+        # queue-wait histograms are tagged per deployment+replica.
+        self._deployment = deployment_name or "anonymous"
+        self._replica_tag = _os.urandom(3).hex()
         # User-request concurrency is gated HERE, not by actor-level
         # max_concurrency: system calls (queue_len / health_check) must
         # bypass the user queue or a saturated replica looks dead and its
@@ -65,7 +72,9 @@ class Replica:
     async def handle_request(self, method: str, args, kwargs,
                              metadata: Optional[dict] = None):
         from . import multiplex
+        from ray_tpu.util import flight_recorder, tracing
 
+        t_arrive = time.perf_counter()
         with self._lock:
             # Counts queued + executing — the backlog signal autoscaling
             # and pow-2 routing want.
@@ -77,31 +86,50 @@ class Replica:
                 metadata["multiplexed_model_id"]
             )
         await self._user_sem.acquire()
+        queue_wait_s = time.perf_counter() - t_arrive
+        outcome = "ok"
         try:
-            if self._is_class:
-                target = getattr(self.callable, method or "__call__")
-            else:
-                target = self.callable
-            if asyncio.iscoroutinefunction(target):
-                result = target(*args, **kwargs)
-            else:
-                # Sync callables must NOT run on the replica's event loop: a
-                # blocking call (e.g. composing another deployment handle's
-                # .result()) would deadlock the loop and trip the worker
-                # watchdog.
-                loop = asyncio.get_running_loop()
-                ctx = __import__("contextvars").copy_context()
-                result = await loop.run_in_executor(
-                    None, lambda: ctx.run(target, *args, **kwargs)
-                )
-            if inspect.iscoroutine(result):
-                # inspect, not asyncio: asyncio.iscoroutine() also matches
-                # plain generators (legacy @coroutine support on py<=3.11),
-                # and awaiting a user generator raises TypeError.
-                result = await result
-            return result
+            with tracing.start_span(
+                "serve.request",
+                {"deployment": self._deployment,
+                 "replica": self._replica_tag,
+                 "method": method or "__call__"},
+            ):
+                if self._is_class:
+                    target = getattr(self.callable, method or "__call__")
+                else:
+                    target = self.callable
+                if asyncio.iscoroutinefunction(target):
+                    result = target(*args, **kwargs)
+                else:
+                    # Sync callables must NOT run on the replica's event
+                    # loop: a blocking call (e.g. composing another
+                    # deployment handle's .result()) would deadlock the
+                    # loop and trip the worker watchdog.
+                    loop = asyncio.get_running_loop()
+                    ctx = __import__("contextvars").copy_context()
+                    result = await loop.run_in_executor(
+                        None, lambda: ctx.run(target, *args, **kwargs)
+                    )
+                if inspect.iscoroutine(result):
+                    # inspect, not asyncio: asyncio.iscoroutine() also
+                    # matches plain generators (legacy @coroutine support
+                    # on py<=3.11), and awaiting a user generator raises
+                    # TypeError.
+                    result = await result
+                return result
+        except BaseException:
+            outcome = "error"
+            raise
         finally:
             self._user_sem.release()
+            try:
+                flight_recorder.record_serve_request(
+                    self._deployment, self._replica_tag, queue_wait_s,
+                    time.perf_counter() - t_arrive, outcome=outcome,
+                )
+            except Exception:  # raylint: waive[RTL003] telemetry must not corrupt replica accounting
+                pass
             if token is not None:
                 multiplex._model_id_var.reset(token)
             with self._lock:
@@ -113,7 +141,10 @@ class Replica:
         (sync or async); each yielded chunk streams to the caller via the
         core runtime's streaming actor-method path."""
         from . import multiplex
+        from ray_tpu.util import flight_recorder, tracing
 
+        t_arrive = time.perf_counter()
+        t_wall = time.time()
         with self._lock:
             self._ongoing += 1
             self._total += 1
@@ -123,6 +154,14 @@ class Replica:
                 metadata["multiplexed_model_id"]
             )
         await self._user_sem.acquire()
+        # Per-chunk cost stays an append; histograms land in one batch at
+        # stream end (TTFT + every inter-chunk gap — the inter-token
+        # stall distribution the serving SLOs gate on).
+        tele = flight_recorder.StreamTelemetry(
+            self._deployment, self._replica_tag,
+            time.perf_counter() - t_arrive,
+        )
+        outcome = "ok"
         try:
             if self._is_class:
                 target = getattr(self.callable, method or "__call__")
@@ -138,6 +177,7 @@ class Replica:
                 result = await result
             if hasattr(result, "__aiter__"):
                 async for item in result:
+                    tele.tick()
                     yield item
             elif hasattr(result, "__iter__"):
                 # Sync generator: pull items on a thread so a blocking body
@@ -157,14 +197,34 @@ class Replica:
                     )
                     if item is sentinel:
                         break
+                    tele.tick()
                     yield item
             else:
                 raise TypeError(
                     f"stream=True requires {method or '__call__'} to be a "
                     f"generator; got {type(result).__name__}"
                 )
+        except BaseException:
+            outcome = "error"
+            raise
         finally:
             self._user_sem.release()
+            try:
+                tele.done(outcome)
+                # A completed span per stream (recorded, not opened, so
+                # no contextvar crosses the generator's yields); parents
+                # to the task:handle_request_streaming span when the
+                # call is traced.
+                tracing.record_span(
+                    "serve.request.stream", t_wall, time.time(),
+                    {"deployment": self._deployment,
+                     "replica": self._replica_tag,
+                     "ttft_s": tele.ttft_s,
+                     "chunks": len(tele.gaps) + (1 if tele.ttft_s else 0),
+                     "outcome": outcome},
+                )
+            except Exception:  # raylint: waive[RTL003] telemetry must not corrupt replica accounting
+                pass
             if token is not None:
                 multiplex._model_id_var.reset(token)
             with self._lock:
@@ -290,6 +350,7 @@ class ServeController:
             # concurrent user work.
             opts.setdefault("max_concurrency", 1000)
             entry["spec"] = {
+                "name": name,
                 "payload": payload,
                 "init_args": init_args,
                 "init_kwargs": init_kwargs,
@@ -326,6 +387,7 @@ class ServeController:
             spec["init_args"],
             spec["init_kwargs"],
             spec.get("max_ongoing_requests", 16),
+            spec.get("name", ""),
         )
 
     def _set_replica_count(self, entry: dict, n: int) -> None:

@@ -937,15 +937,24 @@ class PipelinedTrainer:
             inputs, targets = self._microbatches(step)
             t_step = time.perf_counter()
             try:
-                refs = []
-                for i, s in enumerate(self.stages):
-                    kw = {}
-                    if i == 0:
-                        kw["inputs"] = inputs
-                    if i == cfg.num_stages - 1:
-                        kw["targets"] = targets
-                    refs.append(s.run_step.remote(step, **kw))
-                stats = ray_tpu.get(refs, timeout=step_timeout)
+                # One span per step: every stage's run_step (and, through
+                # the p2p trace propagation, every pipeline_push edge
+                # between stages) stitches into a single cluster trace.
+                from ray_tpu.util import tracing
+
+                with tracing.start_span(
+                    "pipeline.step",
+                    {"step": step, "num_stages": cfg.num_stages},
+                ):
+                    refs = []
+                    for i, s in enumerate(self.stages):
+                        kw = {}
+                        if i == 0:
+                            kw["inputs"] = inputs
+                        if i == cfg.num_stages - 1:
+                            kw["targets"] = targets
+                        refs.append(s.run_step.remote(step, **kw))
+                    stats = ray_tpu.get(refs, timeout=step_timeout)
             except Exception as e:  # noqa: BLE001 — stage death/step loss
                 attempts += 1
                 if attempts > max(0, failure_cfg.max_failures):

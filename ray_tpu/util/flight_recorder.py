@@ -103,9 +103,15 @@ from .metric_registry import (  # noqa: F401 — re-exports
     RL_TRAJ_QUEUE_DEPTH,
     RPC_OOB_BYTES_TOTAL,
     RPC_OOB_FRAMES_TOTAL,
+    SERVE_INTER_TOKEN_HIST,
+    SERVE_QUEUE_WAIT_HIST,
+    SERVE_REQUESTS_TOTAL,
+    SERVE_TTFT_HIST,
+    SLO_VIOLATIONS_TOTAL,
     TASK_EVENTS_DROPPED_TOTAL,
     TASK_PHASE_HIST,
     TASKS_CANCELLED_TOTAL,
+    TRACE_SPANS_DROPPED_TOTAL,
 )
 
 # Sub-millisecond to minutes: runtime phases span five orders of magnitude.
@@ -418,6 +424,7 @@ def _wrap_collective_op(fn, op: str, backend: str, group, seen_keys: set):
         # the previous op's algorithm/bucket attribution.
         group._last_decision = None
         key = (op, _shape_sig(tensor))
+        t_wall = time.time()
         t0 = time.perf_counter()
         out = fn(tensor, *args, **kwargs)
         if getattr(group, "_last_decision", None) is not None:
@@ -458,6 +465,21 @@ def _wrap_collective_op(fn, op: str, backend: str, group, seen_keys: set):
             group=getattr(group, "group_name", ""),
             wire_bytes=wire,
         )
+        # Stitch into an active trace: a collective inside a traced task
+        # records a span tagged with the tuner's chosen algorithm, so a
+        # cluster trace shows which algorithm each hop committed to.
+        from . import tracing as _tracing
+
+        if _tracing.current_context() is not None:
+            _tracing.record_span(
+                f"collective:{op}", t_wall, t_wall + dt,
+                {
+                    "op": op, "backend": backend, "bytes": nbytes,
+                    "world_size": world,
+                    "algo": decision["algo"] if decision else "",
+                    "cold": cold,
+                },
+            )
         if decision is not None:
             # Close the loop: the achieved-bandwidth sample drives the
             # online autotuner's next selection for this bucket.
@@ -599,6 +621,92 @@ def record_rl_runner_restart(group: str) -> None:
     counter(RL_RUNNER_RESTARTS_TOTAL, 1.0, {"group": group})
 
 
+# --------------------------------------------------- per-request serving
+def record_serve_request(deployment: str, replica: str, queue_wait_s: float,
+                         ttft_s: float, outcome: str = "ok",
+                         streaming: bool = False) -> None:
+    """One completed serving request on a replica: queue wait (arrival →
+    user-concurrency slot) and time-to-first-result (the full latency for
+    unary requests, the first chunk for streams).  These are the signals
+    the continuous-batching serving gate (ROADMAP item 5) reports on."""
+    if not GlobalConfig.enable_flight_recorder:
+        return
+    tags = {"deployment": deployment, "replica": replica}
+    _metrics._record_batch([
+        (SERVE_QUEUE_WAIT_HIST, "histogram", tags, max(0.0, queue_wait_s),
+         DURATION_BOUNDARIES),
+        (SERVE_TTFT_HIST, "histogram", tags, max(0.0, ttft_s),
+         DURATION_BOUNDARIES),
+        (SERVE_REQUESTS_TOTAL, "counter",
+         {"deployment": deployment, "outcome": outcome,
+          "streaming": "1" if streaming else "0"}, 1.0, None),
+    ])
+
+
+def record_serve_stream(deployment: str, replica: str, queue_wait_s: float,
+                        ttft_s: float, gaps, outcome: str = "ok") -> None:
+    """One completed streaming request: TTFT plus every inter-chunk gap
+    (the inter-token stall distribution), recorded in ONE registry round
+    trip at stream end so the per-token path stays an append."""
+    if not GlobalConfig.enable_flight_recorder:
+        return
+    tags = {"deployment": deployment, "replica": replica}
+    entries = [
+        (SERVE_QUEUE_WAIT_HIST, "histogram", tags, max(0.0, queue_wait_s),
+         DURATION_BOUNDARIES),
+        (SERVE_TTFT_HIST, "histogram", tags, max(0.0, ttft_s),
+         DURATION_BOUNDARIES),
+        (SERVE_REQUESTS_TOTAL, "counter",
+         {"deployment": deployment, "outcome": outcome, "streaming": "1"},
+         1.0, None),
+    ]
+    entries.extend(
+        (SERVE_INTER_TOKEN_HIST, "histogram", tags, max(0.0, g),
+         DURATION_BOUNDARIES)
+        for g in gaps
+    )
+    _metrics._record_batch(entries)
+
+
+class StreamTelemetry:
+    """Per-stream accumulator for the serving hot path: ``tick()`` per
+    chunk is two float ops + an append; everything else happens once at
+    ``done()``."""
+
+    __slots__ = ("deployment", "replica", "queue_wait_s", "_t0", "_last",
+                 "gaps", "ttft_s")
+
+    def __init__(self, deployment: str, replica: str,
+                 queue_wait_s: float = 0.0):
+        self.deployment = deployment
+        self.replica = replica
+        self.queue_wait_s = queue_wait_s
+        self._t0 = time.perf_counter()
+        self._last: Optional[float] = None
+        self.gaps: list = []
+        self.ttft_s: Optional[float] = None
+
+    def tick(self) -> None:
+        now = time.perf_counter()
+        if self._last is None:
+            self.ttft_s = now - self._t0
+        else:
+            self.gaps.append(now - self._last)
+        self._last = now
+
+    def done(self, outcome: str = "ok") -> None:
+        record_serve_stream(
+            self.deployment, self.replica, self.queue_wait_s,
+            self.ttft_s if self.ttft_s is not None else
+            time.perf_counter() - self._t0,
+            self.gaps, outcome=outcome,
+        )
+
+
+def record_slo_violation(rule: str) -> None:
+    counter(SLO_VIOLATIONS_TOTAL, 1.0, {"rule": rule})
+
+
 # -------------------------------------------------------- scaling gauge
 def record_scaling_efficiency(devices: int, retention: float) -> None:
     """ICI scaling-efficiency gauge, fed by scaling_bench's calibrated
@@ -639,51 +747,17 @@ def local_collective_stats() -> Dict[str, dict]:
 
 def cluster_collective_stats() -> Dict[str, dict]:
     """Cluster-aggregated collective view: every worker's collective
-    counters merged through the owner-service metrics registry (workers
-    flush their local registries to the control-plane KV on the
-    heartbeat cadence; ``metrics.snapshot()`` reads them all back), so
-    the autotuner's decisions are observable from the driver.
+    counters merged through the cluster observability plane
+    (``ray_tpu.util.obs`` — workers flush their local registries to the
+    control-plane KV, the node agent forwards them on its heartbeat),
+    so the autotuner's decisions are observable from the driver.
 
     Returns ``{"ops": {op: {...}}, "groups": {group: {op: {...}}},
     "algorithms": {op: {algo: {bucket: ops}}}}`` — ops/bytes summed
     across workers, per-group rows keyed by the group tag recorded with
-    each op, and the per-bucket algorithm-decision counters."""
-    from . import metrics as _m
+    each op, and the per-bucket algorithm-decision counters.  Kept as a
+    thin API-compatible wrapper; the merge itself lives once, in
+    ``obs.collective_view``."""
+    from . import obs as _obs
 
-    snap = _m.snapshot()
-    ops: Dict[str, dict] = {}
-    groups: Dict[str, dict] = {}
-    algos: Dict[str, dict] = {}
-    dur: Dict[str, dict] = {}
-    for ent in snap.values():
-        name, tags = ent.get("name"), ent.get("tags") or {}
-        op = tags.get("op")
-        if op is None:
-            continue
-        if name in (COLLECTIVE_OPS_TOTAL, COLLECTIVE_BYTES_TOTAL):
-            field = "ops" if name == COLLECTIVE_OPS_TOTAL else "bytes"
-            val = int(ent["value"]) if field == "ops" else ent["value"]
-            row = ops.setdefault(op, {"ops": 0, "bytes": 0.0})
-            row[field] += val
-            g = tags.get("group")
-            if g:
-                grow = groups.setdefault(g, {}).setdefault(
-                    op, {"ops": 0, "bytes": 0.0}
-                )
-                grow[field] += val
-        elif name == COLLECTIVE_DURATION_HIST and tags.get("cold") != "1":
-            d = dur.setdefault(op, {"sum": 0.0, "count": 0})
-            d["sum"] += ent["sum"]
-            d["count"] += ent["count"]
-        elif name == COLLECTIVE_ALGO_OPS_TOTAL:
-            bucket = tags.get("bucket", "?")
-            by_algo = algos.setdefault(op, {}).setdefault(
-                tags.get("algo", "?"), {}
-            )
-            by_algo[bucket] = by_algo.get(bucket, 0) + int(ent["value"])
-    for op, row in ops.items():
-        d = dur.get(op)
-        row["mean_duration_s"] = (
-            d["sum"] / d["count"] if d and d["count"] else 0.0
-        )
-    return {"ops": ops, "groups": groups, "algorithms": algos}
+    return _obs.collective_view()

@@ -20,6 +20,16 @@ TASK_PHASE_HIST = "ray_tpu_task_phase_s"
 BACKPRESSURE_WAIT_HIST = "ray_tpu_backpressure_wait_s"
 BACKPRESSURE_BLOCKED_TOTAL = "ray_tpu_backpressure_blocked_total"
 TASK_EVENTS_DROPPED_TOTAL = "ray_tpu_task_events_dropped_total"
+TRACE_SPANS_DROPPED_TOTAL = "ray_tpu_trace_spans_dropped_total"
+
+# --------------------------------------------- cluster observability plane
+SLO_VIOLATIONS_TOTAL = "ray_tpu_slo_violations_total"
+
+# ------------------------------------------------- per-request serving SLO
+SERVE_TTFT_HIST = "ray_tpu_serve_ttft_s"
+SERVE_INTER_TOKEN_HIST = "ray_tpu_serve_inter_token_s"
+SERVE_QUEUE_WAIT_HIST = "ray_tpu_serve_queue_wait_s"
+SERVE_REQUESTS_TOTAL = "ray_tpu_serve_requests_total"
 
 # ------------------------------------------------------------ collectives
 COLLECTIVE_OPS_TOTAL = "ray_tpu_collective_ops_total"
@@ -136,6 +146,21 @@ METRICS: Dict[str, str] = {
                                 "memory cap",
     TASK_EVENTS_DROPPED_TOTAL: "task events lost to flush failure or "
                                "buffer shedding",
+    TRACE_SPANS_DROPPED_TOTAL: "tracing spans shed from the task-event "
+                               "profile channel (traces with drops are "
+                               "flagged truncated)",
+    SLO_VIOLATIONS_TOTAL: "SLO/anomaly rule findings, by rule "
+                          "(straggler, bandwidth drift, restart storm, "
+                          "queue pressure)",
+    SERVE_TTFT_HIST: "serving time-to-first-result per deployment/"
+                     "replica (histogram; full latency for unary "
+                     "requests)",
+    SERVE_INTER_TOKEN_HIST: "gap between consecutive streamed chunks "
+                            "per deployment/replica (histogram)",
+    SERVE_QUEUE_WAIT_HIST: "request wait for a replica user-concurrency "
+                           "slot per deployment/replica (histogram)",
+    SERVE_REQUESTS_TOTAL: "serving requests completed, by deployment/"
+                          "outcome/streaming",
     COLLECTIVE_OPS_TOTAL: "collective ops executed, by op/backend",
     COLLECTIVE_BYTES_TOTAL: "collective payload bytes, by op/backend",
     COLLECTIVE_DURATION_HIST: "collective op duration (histogram)",

@@ -102,12 +102,14 @@ def clear_flush_hook(fn: Callable[[dict], None]):
         _flush_hook = None
 
 
-def payload_snapshot() -> Optional[dict]:
+def payload_snapshot(only_dirty: bool = False) -> Optional[dict]:
     """Serializable view of the local registry; marks it clean.  Returns
-    None when nothing was ever recorded."""
+    None when nothing was ever recorded — or, with ``only_dirty``, when
+    nothing changed since the last snapshot (payloads are cumulative, so
+    a reader that already has the previous one loses nothing)."""
     global _dirty, _last_flush
     with _lock:
-        if not _local:
+        if not _local or (only_dirty and not _dirty):
             return None
         payload = {
             f"{name}|{dict(tags)}": {
@@ -246,16 +248,12 @@ def flush():
     _maybe_flush(force=True)
 
 
-def snapshot() -> Dict[str, dict]:
-    """Cluster-wide merged metric view (counters summed across workers,
-    gauges last-writer-wins, histograms merged)."""
-    from ..core.core_worker import global_worker
-
-    w = global_worker()
-    flush()
+def merge_payloads(payloads) -> Dict[str, dict]:
+    """Merge per-process registry payloads into the cluster view
+    (counters summed, gauges last-writer-wins, histograms merged).
+    ``payloads``: iterable of payload dicts (one per process)."""
     merged: Dict[str, dict] = {}
-    for key in w.kv_keys(_REGISTRY_NS):
-        data = w.kv_get(_REGISTRY_NS, key)
+    for data in payloads:
         if not data:
             continue
         for mkey, ent in data.items():
@@ -275,6 +273,18 @@ def snapshot() -> Dict[str, dict]:
                         zip(cur["bucket_counts"], ent["bucket_counts"])
                     ]
     return merged
+
+
+def snapshot() -> Dict[str, dict]:
+    """Cluster-wide merged metric view (counters summed across workers,
+    gauges last-writer-wins, histograms merged)."""
+    from ..core.core_worker import global_worker
+
+    w = global_worker()
+    flush()
+    return merge_payloads(
+        w.kv_get(_REGISTRY_NS, key) for key in w.kv_keys(_REGISTRY_NS)
+    )
 
 
 def _escape_label(v) -> str:

@@ -1,0 +1,323 @@
+"""Cluster observability plane: the single merge path over the
+control-plane telemetry stores.
+
+Every per-process flight recorder ships its state to the control plane
+two ways — the metrics registry lands in the metrics KV (worker flush +
+node-agent heartbeat pull), span/task-event rows land in the task-event
+store.  This module is the ONE place those stores are read back as a
+cluster-wide picture:
+
+  - ``merged_metrics()`` / ``per_worker_metric_payloads()`` — the
+    cluster metric view and the per-process views under it (the SLO
+    engine compares members against the merged mean).
+  - ``collective_view()`` — the per-op / per-group / per-algorithm
+    collective merge (``flight_recorder.cluster_collective_stats`` and
+    ``collective_stats(cluster=True)`` are thin wrappers over it).
+  - ``cluster_timeline()`` — the cluster-merged Chrome trace: task +
+    span rows from every process, cross-process parent→child span links
+    rendered as flow events, and explicit truncation metadata when the
+    task-event channel shed spans (``/api/timeline?cluster=1`` and
+    ``cli timeline --cluster``).
+  - ``serving_stats()`` — per-deployment TTFT / inter-token-stall /
+    queue-wait summaries from the serving histograms.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from . import metrics as _metrics
+from .metric_registry import (
+    COLLECTIVE_ALGO_OPS_TOTAL,
+    COLLECTIVE_BANDWIDTH_HIST,
+    COLLECTIVE_BYTES_TOTAL,
+    COLLECTIVE_DURATION_HIST,
+    COLLECTIVE_OPS_TOTAL,
+    SERVE_INTER_TOKEN_HIST,
+    SERVE_QUEUE_WAIT_HIST,
+    SERVE_REQUESTS_TOTAL,
+    SERVE_TTFT_HIST,
+)
+
+_METRICS_NS = "metrics"
+
+
+# ------------------------------------------------------------ metric views
+def merged_metrics() -> Dict[str, dict]:
+    """Cluster-merged metric snapshot (counters summed, gauges
+    last-writer-wins, histograms merged)."""
+    return _metrics.snapshot()
+
+
+def per_worker_metric_payloads() -> Dict[str, dict]:
+    """The raw per-process registry payloads behind ``merged_metrics``,
+    keyed by their KV key (``worker:<id>`` / ``agent:<node>`` / ...).
+    This is the member-level view anomaly rules need: a collective
+    member drifting below its peers is invisible in the merged sum."""
+    from ..core.core_worker import global_worker
+
+    w = global_worker()
+    _metrics.flush()
+    out: Dict[str, dict] = {}
+    for key in w.kv_keys(_METRICS_NS):
+        data = w.kv_get(_METRICS_NS, key)
+        if data:
+            out[key] = data
+    return out
+
+
+def merged_from_payloads(payloads: Dict[str, dict]) -> Dict[str, dict]:
+    """Merge already-fetched per-process payloads into the cluster view
+    — callers that need BOTH views (the SLO engine) pay one KV scan,
+    not two."""
+    return _metrics.merge_payloads(payloads.values())
+
+
+# -------------------------------------------------------- collective merge
+def collective_view(snapshot: Optional[Dict[str, dict]] = None) -> Dict[str, dict]:
+    """Cluster-aggregated collective telemetry, merged from the metrics
+    KV: ops/bytes summed across workers, per-group rows keyed by the
+    group tag recorded with each op, per-bucket algorithm-decision
+    counters, and warm-only mean durations."""
+    snap = merged_metrics() if snapshot is None else snapshot
+    ops: Dict[str, dict] = {}
+    groups: Dict[str, dict] = {}
+    algos: Dict[str, dict] = {}
+    dur: Dict[str, dict] = {}
+    for ent in snap.values():
+        name, tags = ent.get("name"), ent.get("tags") or {}
+        op = tags.get("op")
+        if op is None:
+            continue
+        if name in (COLLECTIVE_OPS_TOTAL, COLLECTIVE_BYTES_TOTAL):
+            field = "ops" if name == COLLECTIVE_OPS_TOTAL else "bytes"
+            val = int(ent["value"]) if field == "ops" else ent["value"]
+            row = ops.setdefault(op, {"ops": 0, "bytes": 0.0})
+            row[field] += val
+            g = tags.get("group")
+            if g:
+                grow = groups.setdefault(g, {}).setdefault(
+                    op, {"ops": 0, "bytes": 0.0}
+                )
+                grow[field] += val
+        elif name == COLLECTIVE_DURATION_HIST and tags.get("cold") != "1":
+            d = dur.setdefault(op, {"sum": 0.0, "count": 0})
+            d["sum"] += ent["sum"]
+            d["count"] += ent["count"]
+        elif name == COLLECTIVE_ALGO_OPS_TOTAL:
+            bucket = tags.get("bucket", "?")
+            by_algo = algos.setdefault(op, {}).setdefault(
+                tags.get("algo", "?"), {}
+            )
+            by_algo[bucket] = by_algo.get(bucket, 0) + int(ent["value"])
+    for op, row in ops.items():
+        d = dur.get(op)
+        row["mean_duration_s"] = (
+            d["sum"] / d["count"] if d and d["count"] else 0.0
+        )
+    return {"ops": ops, "groups": groups, "algorithms": algos}
+
+
+def per_worker_collective_bandwidth(
+    payloads: Optional[Dict[str, dict]] = None,
+) -> Dict[str, Dict[str, float]]:
+    """Per-process mean achieved collective bandwidth by op (warm
+    samples only, count-weighted across tag sets):
+    ``{worker_key: {op: mean_bytes_per_s}}``.  Feeds the
+    bandwidth-drift SLO rule — a member whose mean sits far below the
+    committed algorithm's cluster mean is the slow link."""
+    if payloads is None:
+        payloads = per_worker_metric_payloads()
+    acc: Dict[str, Dict[str, list]] = {}
+    for key, payload in payloads.items():
+        for ent in payload.values():
+            tags = ent.get("tags") or {}
+            if (
+                ent.get("name") != COLLECTIVE_BANDWIDTH_HIST
+                or tags.get("cold") == "1"
+                or not ent.get("count")
+            ):
+                continue
+            cell = acc.setdefault(key, {}).setdefault(
+                tags.get("op", "?"), [0.0, 0]
+            )
+            cell[0] += ent.get("sum", 0.0)
+            cell[1] += ent["count"]
+    return {
+        key: {op: s / c for op, (s, c) in row.items() if c}
+        for key, row in acc.items()
+    }
+
+
+# ------------------------------------------------------------ serving view
+_SERVE_HISTS = {
+    SERVE_TTFT_HIST: "ttft",
+    SERVE_INTER_TOKEN_HIST: "inter_token",
+    SERVE_QUEUE_WAIT_HIST: "queue_wait",
+}
+
+
+def _hist_quantile(ent: dict, q: float) -> float:
+    """Approximate quantile from cumulative bucket counts (upper bound
+    of the bucket the quantile falls in)."""
+    buckets = ent.get("buckets") or []
+    counts = ent.get("bucket_counts") or []
+    total = ent.get("count", 0)
+    if not total or len(counts) != len(buckets) + 1:
+        return 0.0
+    target = q * total
+    cum = 0
+    for b, c in zip(buckets, counts):
+        cum += c
+        if cum >= target:
+            return float(b)
+    return float(buckets[-1]) if buckets else 0.0
+
+
+def serving_stats(snapshot: Optional[Dict[str, dict]] = None) -> Dict[str, dict]:
+    """Per-deployment serving SLO summary from the merged registry::
+
+        {deployment: {"ttft": {count, mean_s, p50_s, p99_s},
+                      "inter_token": {...}, "queue_wait": {...},
+                      "requests": {outcome: n}}}
+    """
+    snap = merged_metrics() if snapshot is None else snapshot
+    out: Dict[str, dict] = {}
+    for ent in snap.values():
+        name, tags = ent.get("name"), ent.get("tags") or {}
+        dep = tags.get("deployment")
+        if dep is None:
+            continue
+        row = out.setdefault(dep, {})
+        kind = _SERVE_HISTS.get(name)
+        if kind is not None:
+            agg = row.setdefault(
+                kind, {"count": 0, "sum": 0.0, "_ents": []}
+            )
+            agg["count"] += ent.get("count", 0)
+            agg["sum"] += ent.get("sum", 0.0)
+            agg["_ents"].append(ent)
+        elif name == SERVE_REQUESTS_TOTAL:
+            req = row.setdefault("requests", {})
+            outcome = tags.get("outcome", "?")
+            req[outcome] = req.get(outcome, 0) + int(ent["value"])
+    for row in out.values():
+        for kind in list(_SERVE_HISTS.values()):
+            agg = row.get(kind)
+            if not agg:
+                continue
+            ents = agg.pop("_ents")
+            merged = _merge_hist_ents(ents)
+            agg["mean_s"] = (
+                agg["sum"] / agg["count"] if agg["count"] else 0.0
+            )
+            agg["p50_s"] = _hist_quantile(merged, 0.50)
+            agg["p99_s"] = _hist_quantile(merged, 0.99)
+            agg.pop("sum", None)
+    return out
+
+
+def _merge_hist_ents(ents: List[dict]) -> dict:
+    """Merge same-boundary histogram entries (different replica tags)
+    into one for quantile math."""
+    if not ents:
+        return {}
+    base = dict(ents[0])
+    base["bucket_counts"] = list(base.get("bucket_counts") or [])
+    base["count"] = base.get("count", 0)
+    for ent in ents[1:]:
+        base["count"] += ent.get("count", 0)
+        bc = ent.get("bucket_counts") or []
+        if len(bc) == len(base["bucket_counts"]):
+            base["bucket_counts"] = [
+                a + b for a, b in zip(base["bucket_counts"], bc)
+            ]
+    return base
+
+
+# --------------------------------------------------------- cluster timeline
+def cluster_timeline(address: Optional[str] = None,
+                     limit: int = 100000) -> Dict[str, Any]:
+    """Cluster-merged Chrome trace with cross-process trace stitching.
+
+    Returns the ``{"traceEvents": [...], "otherData": {...}}`` Chrome
+    trace object form: every task/profile row from every process, plus
+    flow events (``ph: "s"/"f"``) linking each span to its parent when
+    the two live on different (pid, tid) rows — in Perfetto the arrows
+    ARE the cross-process request path.  ``otherData`` carries explicit
+    truncation metadata: ``spans_dropped > 0`` means the task-event
+    channel shed spans somewhere and traces may have holes."""
+    from .state.api import StateApiClient, chrome_trace_events
+
+    from ..core.core_worker import try_global_worker
+
+    w = try_global_worker()
+    if w is not None and w.task_events is not None:
+        # Push this process's unflushed rows out before asking.
+        try:
+            w._run_sync(w.task_events.flush(), timeout=5)
+        except Exception:  # raylint: waive[RTL003] export stays best-effort
+            pass
+    reply = StateApiClient(address).list_task_events(limit=limit)
+    events = chrome_trace_events(reply)
+    spans: Dict[str, dict] = {}
+    trace_ids = set()
+    for p in reply.get("profile_events", ()):
+        extra = p.get("extra") or {}
+        if extra.get("span") and extra.get("span_id"):
+            spans[extra["span_id"]] = p
+            trace_ids.add(extra.get("trace_id"))
+    flow_id = 0
+    for span_id, row in spans.items():
+        extra = row["extra"]
+        parent = spans.get(extra.get("parent_id"))
+        if parent is None:
+            continue
+        ploc = (parent["node_id"], parent["worker_id"])
+        cloc = (row["node_id"], row["worker_id"])
+        if ploc == cloc:
+            continue  # same row: nesting is already visible
+        flow_id += 1
+        common = {
+            "cat": "trace", "name": "span",
+            "id": flow_id,
+            "args": {"trace_id": extra.get("trace_id")},
+        }
+        events.append({
+            **common, "ph": "s",
+            "ts": parent["start"] * 1e6,
+            "pid": "node:" + (parent["node_id"] or "?")[:8],
+            "tid": "worker:" + (parent["worker_id"] or "?")[:8],
+        })
+        events.append({
+            **common, "ph": "f", "bp": "e",
+            "ts": row["start"] * 1e6,
+            "pid": "node:" + (row["node_id"] or "?")[:8],
+            "tid": "worker:" + (row["worker_id"] or "?")[:8],
+        })
+    spans_dropped = int(reply.get("num_span_drops", 0))
+    return {
+        "traceEvents": events,
+        "otherData": {
+            "cluster": True,
+            "num_traces": len(trace_ids),
+            "num_spans": len(spans),
+            "spans_dropped": spans_dropped,
+            "truncated": spans_dropped > 0,
+        },
+    }
+
+
+def trace_processes(trace_id: str,
+                    address: Optional[str] = None) -> List[tuple]:
+    """Distinct (node_id, worker_id) rows that contributed spans to one
+    trace — the 'spans from N processes' stitching check."""
+    from .state.api import StateApiClient
+
+    reply = StateApiClient(address).list_task_events(limit=100000)
+    procs = set()
+    for p in reply.get("profile_events", ()):
+        extra = p.get("extra") or {}
+        if extra.get("span") and extra.get("trace_id") == trace_id:
+            procs.add((p.get("node_id"), p.get("worker_id")))
+    return sorted(procs)

@@ -1,0 +1,379 @@
+"""SLO / anomaly engine over the cluster observability plane.
+
+A small rule evaluator on the aggregated telemetry stream (the merged
+metric snapshot plus the per-process payloads behind it — both from
+``ray_tpu.util.obs``).  Rules are pure-ish objects: ``evaluate(view,
+now)`` takes a ``MetricView`` built from snapshots, keeps whatever
+cross-evaluation state it needs (rate windows, sustain timers) on the
+rule instance, and returns ``SloViolation`` findings — so unit tests
+drive them with synthetic streams, no cluster required.
+
+Built-in rules:
+
+  - ``pipeline_straggler`` — a pipeline stage whose mean stall sits far
+    above its peers' median (the 1F1B schedule cannot hide a slow
+    stage; the stall histogram is where it shows).
+  - ``collective_bw_drift`` — a collective member (worker) whose
+    achieved bandwidth drifted below the committed algorithm's cluster
+    mean (the slow link a merged histogram hides).
+  - ``restart_storm`` — actor restarts (pipeline stages, RL runners)
+    arriving faster than a bound within a window.
+  - ``queue_pressure`` — a queue-depth gauge (data ops, RL trajectory
+    queue, lease queue, serve queue-wait) sustained above threshold.
+
+Findings surface three ways: the
+``ray_tpu_slo_violations_total{rule}`` counter, the dashboard's
+``/api/slo`` endpoint (+ UI panel), and ``cli slo``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from . import obs as _obs
+from .metric_registry import (
+    DATA_QUEUE_DEPTH,
+    LEASE_QUEUE_DEPTH,
+    PIPELINE_STAGE_RESTARTS_TOTAL,
+    PIPELINE_STAGE_STALL_HIST,
+    RL_RUNNER_RESTARTS_TOTAL,
+    RL_TRAJ_QUEUE_DEPTH,
+    SERVE_QUEUE_WAIT_HIST,
+)
+
+
+@dataclasses.dataclass
+class SloViolation:
+    rule: str
+    subject: str      # what violated: "stage=2", "worker:ab12", "op=map"
+    value: float      # observed
+    threshold: float  # the bound it crossed
+    detail: str
+    ts: float = 0.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+class MetricView:
+    """Read helpers over one evaluation's snapshots."""
+
+    def __init__(self, merged: Dict[str, dict],
+                 per_worker: Optional[Dict[str, dict]] = None):
+        self.merged = merged
+        self.per_worker = per_worker or {}
+
+    def hist_stats(self, name: str, by_tag: str) -> Dict[str, dict]:
+        """{tag_value: {"count": n, "mean": s}} for one histogram."""
+        out: Dict[str, dict] = {}
+        for ent in self.merged.values():
+            tags = ent.get("tags") or {}
+            if ent.get("name") != name or by_tag not in tags:
+                continue
+            row = out.setdefault(tags[by_tag], {"count": 0, "sum": 0.0})
+            row["count"] += ent.get("count", 0)
+            row["sum"] += ent.get("sum", 0.0)
+        for row in out.values():
+            row["mean"] = row["sum"] / row["count"] if row["count"] else 0.0
+        return out
+
+    def counter_total(self, name: str) -> float:
+        return sum(
+            ent.get("value", 0.0)
+            for ent in self.merged.values()
+            if ent.get("name") == name
+        )
+
+    def counters_by_tags(self, name: str) -> Dict[str, float]:
+        """{rendered-tag-string: value} per tag set of a counter."""
+        out: Dict[str, float] = {}
+        for ent in self.merged.values():
+            if ent.get("name") != name:
+                continue
+            tags = ent.get("tags") or {}
+            key = ",".join(f"{k}={v}" for k, v in sorted(tags.items()))
+            out[key] = out.get(key, 0.0) + ent.get("value", 0.0)
+        return out
+
+    def gauges(self, name: str) -> Dict[str, float]:
+        """{rendered-tag-string: value} for every tag set of a gauge."""
+        out: Dict[str, float] = {}
+        for ent in self.merged.values():
+            if ent.get("name") != name:
+                continue
+            tags = ent.get("tags") or {}
+            key = ",".join(f"{k}={v}" for k, v in sorted(tags.items()))
+            out[key] = ent.get("value", 0.0)
+        return out
+
+
+def _median(vals: List[float]) -> float:
+    vals = sorted(vals)
+    if not vals:
+        return 0.0
+    mid = len(vals) // 2
+    if len(vals) % 2:
+        return vals[mid]
+    return (vals[mid - 1] + vals[mid]) / 2.0
+
+
+class PipelineStragglerRule:
+    """A stage whose mean stall exceeds ``ratio`` × the median of its
+    peers (with enough samples to mean anything) is a straggler —
+    either its own compute is slow or its neighbor is starving it."""
+
+    name = "pipeline_straggler"
+
+    def __init__(self, ratio: float = 3.0, min_samples: int = 3,
+                 min_stall_s: float = 0.05):
+        self.ratio = ratio
+        self.min_samples = min_samples
+        self.min_stall_s = min_stall_s
+
+    def evaluate(self, view: MetricView, now: float) -> List[SloViolation]:
+        stages = {
+            k: v for k, v in
+            view.hist_stats(PIPELINE_STAGE_STALL_HIST, "stage").items()
+            if k != "all" and v["count"] >= self.min_samples
+        }
+        if len(stages) < 2:
+            return []
+        out = []
+        for stage, row in stages.items():
+            peers = [v["mean"] for k, v in stages.items() if k != stage]
+            baseline = max(_median(peers), 1e-6)
+            if (
+                row["mean"] >= self.min_stall_s
+                and row["mean"] > self.ratio * baseline
+            ):
+                out.append(SloViolation(
+                    self.name, f"stage={stage}", row["mean"],
+                    self.ratio * baseline,
+                    f"mean stall {row['mean']:.3f}s vs peer median "
+                    f"{baseline:.3f}s over {row['count']} steps", now,
+                ))
+        return out
+
+
+class CollectiveBandwidthDriftRule:
+    """A member (worker) whose warm mean achieved bandwidth for an op
+    sits below ``frac`` × the cluster mean across members: the slow
+    link the tuner's committed mean is being dragged down by."""
+
+    name = "collective_bw_drift"
+
+    def __init__(self, frac: float = 0.5, min_members: int = 2):
+        self.frac = frac
+        self.min_members = min_members
+
+    def evaluate(self, view: MetricView, now: float) -> List[SloViolation]:
+        # Per-member means come from the per-process payloads (the
+        # merged histogram can't see members); the merge itself lives in
+        # obs so drift math exists once.
+        by_member: Dict[str, Dict[str, float]] = {}
+        for member, ops in _obs.per_worker_collective_bandwidth(
+            view.per_worker
+        ).items():
+            for op, mean in ops.items():
+                by_member.setdefault(op, {})[member] = mean
+        out = []
+        for op, members in by_member.items():
+            if len(members) < self.min_members:
+                continue
+            cluster_mean = sum(members.values()) / len(members)
+            bound = self.frac * cluster_mean
+            for member, mean in members.items():
+                if mean < bound:
+                    out.append(SloViolation(
+                        self.name, f"{member} op={op}", mean, bound,
+                        f"member mean {mean:.3e} B/s vs cluster mean "
+                        f"{cluster_mean:.3e} B/s "
+                        f"({len(members)} members)", now,
+                    ))
+        return out
+
+
+class RestartStormRule:
+    """More than ``max_restarts`` restarts of ONE actor group (a stage,
+    a runner group) within ``window_s`` — a crash loop, not absorbed
+    one-off deaths.  Tracked per counter tag set: a node death that
+    restarts four DIFFERENT stages once each is four absorbed deaths,
+    not a storm."""
+
+    name = "restart_storm"
+
+    _COUNTERS = (PIPELINE_STAGE_RESTARTS_TOTAL, RL_RUNNER_RESTARTS_TOTAL)
+
+    def __init__(self, max_restarts: int = 3, window_s: float = 60.0):
+        self.max_restarts = max_restarts
+        self.window_s = window_s
+        self._history: Dict[tuple, deque] = {}
+
+    def evaluate(self, view: MetricView, now: float) -> List[SloViolation]:
+        out = []
+        for name in self._COUNTERS:
+            for tag_key, total in view.counters_by_tags(name).items():
+                hist = self._history.setdefault((name, tag_key), deque())
+                hist.append((now, total))
+                while hist and now - hist[0][0] > self.window_s:
+                    hist.popleft()
+                delta = total - hist[0][1]
+                if delta > self.max_restarts:
+                    subject = f"{name}{{{tag_key}}}" if tag_key else name
+                    out.append(SloViolation(
+                        self.name, subject, delta,
+                        float(self.max_restarts),
+                        f"{delta:.0f} restarts in the last "
+                        f"{min(self.window_s, now - hist[0][0]):.0f}s", now,
+                    ))
+        return out
+
+
+class QueuePressureRule:
+    """A queue-depth gauge sustained at/above ``depth`` for
+    ``sustain_s`` — transient bursts are normal, sustained pressure
+    means the consumer side is undersized."""
+
+    name = "queue_pressure"
+
+    _GAUGES = (DATA_QUEUE_DEPTH, RL_TRAJ_QUEUE_DEPTH, LEASE_QUEUE_DEPTH)
+
+    def __init__(self, depth: float = 8.0, sustain_s: float = 10.0,
+                 queue_wait_s: float = 1.0):
+        self.depth = depth
+        self.sustain_s = sustain_s
+        self.queue_wait_s = queue_wait_s
+        self._since: Dict[str, float] = {}
+        # Serve queue-wait is a cumulative histogram: pressure must be
+        # judged on the per-window DELTA mean (the all-time mean decays
+        # only after hundreds of fast requests) and then sustained like
+        # the gauges.
+        self._qw_prev: Dict[str, tuple] = {}  # dep -> (count, sum)
+
+    def evaluate(self, view: MetricView, now: float) -> List[SloViolation]:
+        out = []
+        seen = set()
+        for name in self._GAUGES:
+            for tag_key, value in view.gauges(name).items():
+                subject = f"{name}{{{tag_key}}}" if tag_key else name
+                seen.add(subject)
+                if value >= self.depth:
+                    since = self._since.setdefault(subject, now)
+                    if now - since >= self.sustain_s:
+                        out.append(SloViolation(
+                            self.name, subject, value, self.depth,
+                            f"depth {value:.0f} sustained "
+                            f"{now - since:.0f}s", now,
+                        ))
+                else:
+                    self._since.pop(subject, None)
+        # Serve queue-wait pressure: the window-delta mean wait for a
+        # user slot above bound means replicas are saturated (the
+        # autoscaler's signal) — sustained, like the gauges, so a
+        # cold-start burst alone never fires.
+        for dep, row in view.hist_stats(
+            SERVE_QUEUE_WAIT_HIST, "deployment"
+        ).items():
+            subject = f"serve_queue_wait{{deployment={dep}}}"
+            seen.add(subject)
+            prev = self._qw_prev.get(dep)
+            self._qw_prev[dep] = (row["count"], row["sum"])
+            if prev is None:
+                continue  # first sight: history, not current pressure
+            d_count = row["count"] - prev[0]
+            d_mean = (
+                (row["sum"] - prev[1]) / d_count if d_count > 0 else 0.0
+            )
+            if d_count > 0 and d_mean >= self.queue_wait_s:
+                since = self._since.setdefault(subject, now)
+                if now - since >= self.sustain_s:
+                    out.append(SloViolation(
+                        self.name, subject, d_mean, self.queue_wait_s,
+                        f"mean queue wait {d_mean:.2f}s over "
+                        f"{d_count} requests in the last window "
+                        f"(sustained {now - since:.0f}s)", now,
+                    ))
+            elif d_count > 0:
+                self._since.pop(subject, None)
+        for subject in [s for s in self._since if s not in seen]:
+            del self._since[subject]
+        for dep in [
+            d for d in self._qw_prev
+            if f"serve_queue_wait{{deployment={d}}}" not in seen
+        ]:
+            del self._qw_prev[dep]
+        return out
+
+
+def default_rules() -> List[Any]:
+    return [
+        PipelineStragglerRule(),
+        CollectiveBandwidthDriftRule(),
+        RestartStormRule(),
+        QueuePressureRule(),
+    ]
+
+
+class SloEngine:
+    """Evaluates the rule set against the aggregated stream; keeps the
+    last findings for the ``/api/slo`` endpoint and bumps
+    ``ray_tpu_slo_violations_total{rule}`` per finding."""
+
+    def __init__(self, rules: Optional[List[Any]] = None):
+        self.rules = default_rules() if rules is None else list(rules)
+        self.last_violations: List[SloViolation] = []
+        self.evaluations = 0
+
+    def evaluate(self, merged: Optional[Dict[str, dict]] = None,
+                 per_worker: Optional[Dict[str, dict]] = None,
+                 now: Optional[float] = None) -> List[SloViolation]:
+        if per_worker is None:
+            try:
+                per_worker = _obs.per_worker_metric_payloads()
+            except Exception:  # noqa: BLE001 — no cluster: caller-fed rules still run
+                per_worker = {}
+        if merged is None:
+            # Derive the merged view from the payloads already fetched —
+            # one KV scan per evaluation, not two (the dashboard hits
+            # this on its refresh cadence).
+            merged = _obs.merged_from_payloads(per_worker)
+        view = MetricView(merged, per_worker)
+        now = time.time() if now is None else now
+        out: List[SloViolation] = []
+        for rule in self.rules:
+            try:
+                out.extend(rule.evaluate(view, now))
+            except Exception:  # noqa: BLE001 — one bad rule must not kill the sweep
+                from . import flight_recorder
+
+                flight_recorder.count_suppressed("slo_rule")
+        from . import flight_recorder
+
+        for v in out:
+            flight_recorder.record_slo_violation(v.rule)
+        self.evaluations += 1
+        self.last_violations = out
+        return out
+
+    def report(self) -> Dict[str, Any]:
+        """JSON-ready state for ``/api/slo`` / the CLI."""
+        return {
+            "evaluations": self.evaluations,
+            "rules": [r.name for r in self.rules],
+            "violations": [v.to_dict() for v in self.last_violations],
+        }
+
+
+_engine: Optional[SloEngine] = None
+
+
+def get_slo_engine() -> SloEngine:
+    """Process-wide engine (the dashboard and CLI evaluate through one
+    instance so rate/sustain rule state accumulates across calls)."""
+    global _engine
+    if _engine is None:
+        _engine = SloEngine()
+    return _engine

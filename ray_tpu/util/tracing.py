@@ -104,6 +104,73 @@ def start_span(name: str, attributes: Optional[Dict[str, Any]] = None):
         _record(span)
 
 
+def detached_span(name: str,
+                  attributes: Optional[Dict[str, Any]] = None) -> Span:
+    """Open a span WITHOUT installing it as the current context.
+
+    For long-lived scopes that cross ``yield`` boundaries (the streaming
+    data scheduler's generator pump): a ``start_span`` block entered
+    inside a generator would leak its contextvar into the consumer's
+    context between yields.  Scope individual operations to the span
+    with ``span_context``; close it with ``finish_span``."""
+    parent = _current.get()
+    return Span(
+        trace_id=parent[0] if parent else _rand_id(16),
+        span_id=_rand_id(),
+        parent_id=parent[1] if parent else None,
+        name=name,
+        start=time.time(),
+        attributes=dict(attributes or {}),
+    )
+
+
+def finish_span(span: Span) -> None:
+    """Close and record a ``detached_span``."""
+    if not span.end:
+        span.end = time.time()
+    _record(span)
+
+
+@contextlib.contextmanager
+def span_context(span: Optional[Span]):
+    """Install ``span`` as the current context for the block (submits in
+    the block parent to it).  ``None`` is a no-op, so callers can hold an
+    optional root without branching."""
+    if span is None:
+        yield
+        return
+    token = _current.set((span.trace_id, span.span_id))
+    try:
+        yield
+    finally:
+        _current.reset(token)
+
+
+def record_span(name: str, start: float, end: float,
+                attributes: Optional[Dict[str, Any]] = None,
+                context: Optional[Tuple[str, str]] = None) -> Optional[Span]:
+    """Record an already-measured interval as a completed span.
+
+    ``context``: an explicit (trace_id, parent_span_id) — e.g. one
+    extracted from a cross-process message — defaulting to the caller's
+    current context.  Returns None (records nothing) when neither
+    exists, so instrumentation sites can call this unconditionally."""
+    ctx = context if context is not None else _current.get()
+    if ctx is None:
+        return None
+    span = Span(
+        trace_id=ctx[0],
+        span_id=_rand_id(),
+        parent_id=ctx[1],
+        name=name,
+        start=start,
+        end=end,
+        attributes=dict(attributes or {}),
+    )
+    _record(span)
+    return span
+
+
 @contextlib.contextmanager
 def task_execution_span(spec) -> Any:
     """Executor-side: extract the submitted trace context (if any) and wrap
@@ -122,13 +189,26 @@ def task_execution_span(spec) -> Any:
         _current.reset(token)
 
 
+class Trace(list):
+    """``get_trace`` result: a plain list of span rows (backwards
+    compatible) carrying truncation metadata — when the task-event
+    profile channel shed spans anywhere in the cluster, the trace may
+    have holes and must not be read as complete."""
+
+    truncated: bool = False
+    dropped_spans: int = 0
+
+
 def get_trace(trace_id: str, timeout: float = 30.0,
-              min_spans: int = 0) -> List[Dict[str, Any]]:
+              min_spans: int = 0) -> Trace:
     """Fetch all recorded spans of a trace from the control plane.
 
     Remote workers flush their span buffers on a short period; with
     ``min_spans`` the query polls until that many spans arrived (or
-    ``timeout`` elapses) instead of racing the flush."""
+    ``timeout`` elapses) instead of racing the flush.  The returned
+    ``Trace`` is marked ``truncated`` when span rows were shed from any
+    worker's task-event buffer (or the control-plane store cap) since
+    the cluster started — the trace may be missing spans."""
     from ray_tpu.core.core_worker import global_worker
 
     w = global_worker()
@@ -139,11 +219,13 @@ def get_trace(trace_id: str, timeout: float = 30.0,
         reply = w._run_sync(
             w.cp.call("list_task_events", {}, timeout=timeout)
         )
-        spans = []
+        spans = Trace()
         for ev in reply.get("profile_events", ()):
             extra = ev.get("extra") or {}
             if extra.get("span") and extra.get("trace_id") == trace_id:
                 spans.append(ev)
+        spans.dropped_spans = int(reply.get("num_span_drops", 0))
+        spans.truncated = spans.dropped_spans > 0
         if len(spans) >= min_spans or time.monotonic() > deadline:
             return spans
         time.sleep(0.2)
