@@ -853,231 +853,11 @@ def run_control_plane_suite():
             pool_depth_after=depth,
         )
 
-        # LLM serving pattern A/B: monolithic engine replica vs
-        # prefill/decode disaggregation (2 prefill + 2 decode, KV pages
-        # over the device-object plane).  Engines run CPU-jax inside
-        # worker actors (chip isolation blanks TPU_VISIBLE_CHIPS), so this
-        # measures the serving-pattern orchestration + KV-transfer cost,
-        # not chip throughput.
-        try:
-            from ray_tpu.llm.disagg import DecodeReplica, PrefillReplica
-            from ray_tpu.llm.engine import (
-                EngineConfig, JaxLLMEngine, SamplingParams,
-            )
+        # The LLM serving A/B moved to its own suite (`bench.py
+        # llm_load` -> ray_tpu.llm.bench_llm): mono vs disagg-batched
+        # is measured there interleaved in ONE window under
+        # concurrent load, next to the llm_load high-QPS stage.
 
-            eng_cfg = EngineConfig(max_batch_size=4, max_seq_len=64, seed=3)
-            sampling = SamplingParams(max_tokens=16, temperature=0.0)
-            prompts = [f"bench prompt {i}" for i in range(8)]
-
-            actors = []
-            try:
-                Mono = ray_tpu.remote(num_cpus=1)(JaxLLMEngine)
-                mono = Mono.remote(eng_cfg)
-                actors.append(mono)
-                ray_tpu.get(mono.generate.remote(prompts[:1], sampling),
-                            timeout=300)  # compile
-                t0 = time.perf_counter()
-                ray_tpu.get(mono.generate.remote(prompts, sampling),
-                            timeout=300)
-                mono_dt = time.perf_counter() - t0
-                emit("llm_mono_8prompts_s", mono_dt, "s")
-
-                from ray_tpu.llm.disagg import DisaggRouter
-
-                Pre = ray_tpu.remote(num_cpus=0.5)(PrefillReplica)
-                # max_concurrency is load-bearing: run() loops must
-                # interleave with add_from_kv admissions or decode
-                # batches never form (requests would decode solo).
-                Dec = ray_tpu.remote(num_cpus=0.5, max_concurrency=8)(
-                    DecodeReplica
-                )
-                pre = [Pre.remote(eng_cfg) for _ in range(2)]
-                dec = [Dec.remote(eng_cfg) for _ in range(2)]
-                actors.extend(pre + dec)
-                router = DisaggRouter(pre, dec)
-                for _ in range(2):  # round-robin hits every replica pair
-                    router.generate(prompts[0], sampling, timeout_s=300)
-                t0 = time.perf_counter()
-                router.generate_many(prompts, sampling, timeout_s=300)
-                disagg_dt = time.perf_counter() - t0
-                emit("llm_disagg_2p2d_8prompts_s", disagg_dt, "s")
-                # Honest loss regime: on ONE chip-less box, disagg's extra
-                # RPC hops can't be paid back by pool parallelism, so the
-                # throughput A/B stays below 1.0 by construction.
-                emit("llm_disagg_vs_mono_speedup", mono_dt / disagg_dt, "x")
-            finally:
-                for a in actors:
-                    try:
-                        ray_tpu.kill(a)
-                    except Exception:  # noqa: BLE001
-                        pass
-
-            # Interference regime — the property disaggregation exists
-            # for: a live token stream must not freeze while a burst of
-            # long prompts prefills.  Mono runs prefill programs inside
-            # its decode loop, stalling every in-flight stream for whole
-            # prefill durations; disagg's decode replica never compiles or
-            # runs prefill at all.  Metric: worst inter-token gap and
-            # total stall time (gaps > 50 ms) of a stream during a
-            # 10-long-prompt burst (reference regime:
-            # serving_patterns/prefill_decode — TTFT/ITL protection).
-            import threading
-
-            from ray_tpu.models import GPT2Config
-
-            imodel = GPT2Config(
-                n_layer=4, n_head=8, d_model=256, vocab_size=512, max_seq=256
-            )
-            icfg = EngineConfig(
-                model=imodel, max_batch_size=4, max_seq_len=256, seed=3
-            )
-            stream_s = SamplingParams(max_tokens=120, temperature=0.0)
-            burst_s = SamplingParams(max_tokens=4, temperature=0.0)
-            burst_prompts = [("load-" + "y" * 200 + f"-{i}") for i in range(10)]
-
-            def stall_stats(ts):
-                gaps = [b - a for a, b in zip(ts, ts[1:])]
-                if not gaps:
-                    return 0.0, 0.0
-                return max(gaps), sum(g for g in gaps if g > 0.05)
-
-            def interference_mono():
-                actors = []
-                try:
-                    Mono = ray_tpu.remote(
-                        num_cpus=1, max_concurrency=16
-                    )(JaxLLMEngine)
-                    mono = Mono.remote(icfg)
-                    actors.append(mono)
-                    ray_tpu.get(
-                        mono.generate.remote(["warm"], burst_s), timeout=600
-                    )
-                    ts = []
-
-                    def stream():
-                        gen = mono.generate_stream.options(
-                            num_returns="streaming"
-                        ).remote("the stream", stream_s)
-                        for _ in gen:
-                            ts.append(time.perf_counter())
-
-                    st = threading.Thread(target=stream)
-                    st.start()
-                    time.sleep(0.4)
-                    ray_tpu.get(
-                        [mono.generate.remote([p], burst_s)
-                         for p in burst_prompts],
-                        timeout=600,
-                    )
-                    st.join()
-                    return stall_stats(ts)
-                finally:
-                    for a in actors:
-                        try:
-                            ray_tpu.kill(a)
-                        except Exception:  # noqa: BLE001
-                            pass
-
-            def interference_disagg():
-                actors = []
-                try:
-                    Pre = ray_tpu.remote(num_cpus=0.5)(PrefillReplica)
-                    Dec = ray_tpu.remote(
-                        num_cpus=0.5, max_concurrency=8
-                    )(DecodeReplica)
-                    pre = [Pre.remote(icfg) for _ in range(2)]
-                    dcfg = EngineConfig(
-                        model=imodel, max_batch_size=2, max_seq_len=256,
-                        seed=3,
-                    )
-                    dec = [Dec.remote(dcfg) for _ in range(2)]
-                    actors.extend(pre + dec)
-                    m = ray_tpu.get(
-                        pre[0].prefill.remote("warm", burst_s), timeout=600
-                    )
-                    rid = ray_tpu.get(
-                        dec[0].add_from_kv.remote(m), timeout=600
-                    )
-                    ray_tpu.get(dec[0].run.remote(rid), timeout=600)
-                    ts = []
-
-                    def stream():
-                        mm = ray_tpu.get(
-                            pre[0].prefill.remote("the stream", stream_s),
-                            timeout=600,
-                        )
-                        r = ray_tpu.get(
-                            dec[0].add_from_kv.remote(mm), timeout=600
-                        )
-                        gen = dec[0].run_stream.options(
-                            num_returns="streaming"
-                        ).remote(r)
-                        for _ in gen:
-                            ts.append(time.perf_counter())
-
-                    st = threading.Thread(target=stream)
-                    st.start()
-                    time.sleep(0.4)
-
-                    def one(i):
-                        mm = ray_tpu.get(
-                            pre[i % 2].prefill.remote(
-                                burst_prompts[i], burst_s
-                            ),
-                            timeout=600,
-                        )
-                        r = ray_tpu.get(
-                            dec[1].add_from_kv.remote(mm), timeout=600
-                        )
-                        ray_tpu.get(dec[1].run.remote(r), timeout=600)
-
-                    ths = [
-                        threading.Thread(target=one, args=(i,))
-                        for i in range(len(burst_prompts))
-                    ]
-                    for t in ths:
-                        t.start()
-                    for t in ths:
-                        t.join()
-                    st.join()
-                    return stall_stats(ts)
-                finally:
-                    for a in actors:
-                        try:
-                            ray_tpu.kill(a)
-                        except Exception:  # noqa: BLE001
-                            pass
-
-            mono_max, mono_stall = interference_mono()
-            dis_max, dis_stall = interference_disagg()
-            emit("llm_mono_stream_max_stall_s", mono_max, "s")
-            emit("llm_disagg_stream_max_stall_s", dis_max, "s")
-            emit("llm_mono_stream_stall_time_s", mono_stall, "s")
-            emit("llm_disagg_stream_stall_time_s", dis_stall, "s")
-            emit(
-                "llm_disagg_stream_stall_speedup",
-                mono_max / max(dis_max, 1e-4), "x",
-            )
-            # Per-request serving telemetry for the streamed stage: the
-            # decode replicas recorded TTFT / inter-token histograms
-            # (deployment="llm_decode") during the streams above; read
-            # them back through the cluster observability plane so the
-            # bench summary carries the SLO signals item 5 gates on.
-            from ray_tpu.util import obs as _obs
-
-            time.sleep(2.5)  # replica registries flush/pull to the KV
-            decode_stats = _obs.serving_stats().get("llm_decode") or {}
-            ttft = decode_stats.get("ttft")
-            if ttft and ttft.get("count"):
-                emit("llm_stream_ttft_mean_s", ttft["mean_s"], "s",
-                     p50=ttft["p50_s"], p99=ttft["p99_s"],
-                     n=ttft["count"])
-            itl = decode_stats.get("inter_token")
-            if itl and itl.get("count"):
-                emit("llm_stream_inter_token_mean_s", itl["mean_s"], "s",
-                     p50=itl["p50_s"], p99=itl["p99_s"], n=itl["count"])
-        except Exception as e:  # noqa: BLE001 — A/B is informative, not gating
-            print(f"# llm disagg A/B skipped: {e}", flush=True)
 
         # wait over 1k in-flight task refs, popped one wait() at a time as
         # they complete — the reference's wait_multiple_refs shape
@@ -1588,6 +1368,36 @@ def run_collective_suite(quick=False):
         )
 
 
+# ------------------------------------------------- llm serving suite
+
+def run_llm_suite(quick=False):
+    """Continuous-batching LLM serving stages (ray_tpu.llm.bench_llm).
+
+    ``llm_disagg_vs_mono_speedup`` is the serving-pattern gate: mono vs
+    prefill/decode + continuous-batching decode, both arms driven by the
+    same concurrent repeat-traffic stream and ALTERNATING back-to-back
+    inside one window (best-of-N, per-arm spread recorded — this box
+    swings ~2x window-to-window).  ``llm_load_*`` rows come from the
+    high-QPS harness, whose p99 inter-token-stall bound and
+    occupancy > 1 are asserted INSIDE the stage (a violation fails the
+    subprocess and this suite)."""
+    rows, proc = _bench_subprocess("ray_tpu.llm.bench_llm", "llm", quick)
+    for row in rows:
+        metric = row.pop("metric")
+        value = row.pop("value")
+        unit = (
+            "x" if metric.endswith("_speedup")
+            else "req/s" if metric.endswith("_per_s")
+            else "s" if metric.endswith("_s")
+            else "count"
+        )
+        emit(metric, value, unit, **row)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"bench_llm exited {proc.returncode}: {proc.stderr[-2000:]}"
+        )
+
+
 # --------------------------------------------------------- obs overhead
 
 def measure_obs_overhead(n_calls=300, trials=3, n_warmup=30,
@@ -1949,6 +1759,8 @@ def main():
             run("collective", lambda: run_collective_suite(quick=quick))
         if only in ("all", "rl"):
             run("rl", lambda: run_rl_suite(quick=quick))
+        if only in ("all", "llm", "llm_load"):
+            run("llm_load", lambda: run_llm_suite(quick=quick))
         if only in ("all", "scaling"):
             run("scaling", run_scaling_suite)
         if only in ("all", "model"):

@@ -7,7 +7,7 @@ Serve app, and batch inference rides the Data layer's actor pools.
 """
 
 from .engine import EngineConfig, JaxLLMEngine, SamplingParams  # noqa: F401
-from .serve_app import build_openai_app  # noqa: F401
+from .serve_app import build_disagg_openai_app, build_openai_app  # noqa: F401
 from .batch import build_llm_processor  # noqa: F401
 from .tokenizer import ByteTokenizer  # noqa: F401
 from .disagg import (  # noqa: F401
@@ -15,4 +15,9 @@ from .disagg import (  # noqa: F401
     DisaggRouter,
     PrefillEngine,
     PrefillReplica,
+)
+from .continuous_batching import (  # noqa: F401
+    BatchedDecodeReplica,
+    ContinuousBatchingConfig,
+    ContinuousBatchingEngine,
 )

@@ -31,6 +31,15 @@ _AUTOSCALE_DEFAULTS = {
     "target_ongoing_requests": 2.0,
     "upscale_delay_s": 0.5,
     "downscale_delay_s": 5.0,
+    # Recorded-signal threshold (PR-10 per-request telemetry): sustained
+    # window-mean queue wait above this upscales even when instantaneous
+    # queue-depth probes look calm (queue wait integrates the pressure
+    # the probes sample).  None disables the recorded signal.
+    "target_queue_wait_s": 1.0,
+    # Downscale is drain-then-retire: the replica leaves the routable
+    # set immediately, keeps its in-flight work, and is killed when its
+    # queue empties — or force-killed after this timeout.
+    "drain_timeout_s": 30.0,
 }
 
 
@@ -261,6 +270,16 @@ class ServeController:
         self._lp_lock = make_lock("serve.controller.long_poll")
         self._lp_snapshots: Dict[tuple, tuple] = {}  # key -> (id, value)
         self._lp_waiters: list = []  # [(loop, asyncio.Event)]
+        # Recorded-signal state for autoscaling: a rate-limited snapshot
+        # of the merged serving histograms, the per-deployment
+        # (count, sum) watermark for window-delta queue-wait means, and
+        # the last computed window mean (held between refreshes — the
+        # snapshot TTL exceeds the reconcile period, and a None on
+        # cached cycles would reset the sustain timer every round,
+        # making the recorded signal unable to survive upscale_delay_s).
+        self._serving_cache: Dict[str, Any] = {"ts": 0.0, "stats": {}}
+        self._qw_prev: Dict[str, tuple] = {}
+        self._qw_window: Dict[str, Optional[float]] = {}
         self._reconciler = threading.Thread(
             target=self._reconcile_loop, daemon=True, name="serve-reconcile"
         )
@@ -338,6 +357,8 @@ class ServeController:
                 # Versioned update: replace replicas in place.
                 for h in entry["replicas"]:
                     self._kill(h)
+                for h, _t0 in entry.get("draining", []):
+                    self._kill(h)
                 entry = None
             if entry is None:
                 entry = {"replicas": [], "version": version}
@@ -375,6 +396,7 @@ class ServeController:
                 entry.pop("autoscaling", None)
             entry["last_scale_ts"] = time.monotonic()
             entry["scale_pressure_since"] = None
+            entry.setdefault("draining", [])  # [(handle, drain_start_ts)]
             self._set_replica_count(entry, num_replicas)
             self.deployments[name] = entry
             self._publish_state(name)
@@ -390,15 +412,26 @@ class ServeController:
             spec.get("name", ""),
         )
 
-    def _set_replica_count(self, entry: dict, n: int) -> None:
+    def _set_replica_count(self, entry: dict, n: int,
+                           drain: bool = False) -> None:
         current = len(entry["replicas"])
         if n > current:
             for _ in range(n - current):
                 entry["replicas"].append(self._spawn_replica(entry))
         elif n < current:
-            for h in entry["replicas"][n:]:
-                self._kill(h)
+            surplus = entry["replicas"][n:]
             entry["replicas"] = entry["replicas"][:n]
+            if drain:
+                # Drain-then-retire: out of the routable set now, killed
+                # by _reap_draining once the queue empties (autoscale
+                # downscales must not drop in-flight requests).
+                now = time.monotonic()
+                entry.setdefault("draining", []).extend(
+                    (h, now) for h in surplus
+                )
+            else:
+                for h in surplus:
+                    self._kill(h)
 
     @staticmethod
     def _kill(handle) -> None:
@@ -422,6 +455,86 @@ class ServeController:
             self._replace_dead_replicas(name, entry)
             if "autoscaling" in entry:
                 self._autoscale(name, entry)
+            if entry.get("draining"):
+                self._reap_draining(name, entry)
+
+    # ---------------------------------------------- recorded queue-wait
+    def _recorded_queue_wait(self, name: str) -> Optional[float]:
+        """Window-delta mean of the recorded per-request queue-wait
+        histogram for deployment ``name`` (the PR-10 serving telemetry) —
+        the autoscaler's second signal next to instantaneous queue-depth
+        probes.  Returns None when no new samples landed this window or
+        the merged registry is unreachable."""
+        now = time.monotonic()
+        if now - self._serving_cache["ts"] > 2.0:
+            try:
+                from ray_tpu.util import obs
+
+                self._serving_cache["stats"] = obs.serving_stats()
+                self._serving_cache["ts"] = now
+            except Exception as e:  # noqa: BLE001 — probes still autoscale
+                logger.debug("serving-stats pull failed: %s", e)
+                return self._qw_window.get(name)
+            # Fresh snapshot: advance the watermark and recompute the
+            # window mean for EVERY deployment in sight — only one
+            # deployment's call triggers each refresh, and recomputing
+            # just that one would leave the siblings' windows frozen
+            # (None forever, or stuck at a stale high value that blocks
+            # their downscale).  An idle window clears the value.
+            stats = self._serving_cache["stats"]
+            for dep in set(stats) | set(self._qw_prev):
+                row = (stats.get(dep) or {}).get("queue_wait")
+                if not row or not row.get("count"):
+                    self._qw_window[dep] = None
+                    continue
+                count = row["count"]
+                total = row.get("mean_s", 0.0) * count
+                prev_count, prev_total = self._qw_prev.get(dep, (0, 0.0))
+                self._qw_prev[dep] = (count, total)
+                self._qw_window[dep] = (
+                    (total - prev_total) / (count - prev_count)
+                    if count > prev_count else None
+                )
+        # Held between refreshes so sustained pressure can out-live the
+        # snapshot TTL and actually reach upscale_delay_s.
+        return self._qw_window.get(name)
+
+    def _reap_draining(self, name: str, entry: dict):
+        """Retire draining replicas whose queues emptied; force-kill past
+        the drain timeout.  Runs on the reconcile thread."""
+        from ray_tpu.util import flight_recorder
+
+        cfg = entry.get("autoscaling") or {}
+        timeout = cfg.get("drain_timeout_s",
+                          _AUTOSCALE_DEFAULTS["drain_timeout_s"])
+        now = time.monotonic()
+        keep = []
+        events = []
+        for h, t0 in list(entry.get("draining", [])):
+            try:
+                qlen = ray_tpu.get(h.queue_len.remote(), timeout=5)
+            except Exception:  # noqa: BLE001 — dead already: reap it
+                qlen = 0
+            if qlen <= 0:
+                self._kill(h)
+                events.append("drain_retired")
+            elif now - t0 > timeout:
+                logger.warning(
+                    "deployment %s: force-killing draining replica with %d "
+                    "requests still queued after %.0fs", name, qlen, timeout,
+                )
+                self._kill(h)
+                events.append("drain_forced")
+            else:
+                keep.append((h, t0))
+        with self._lock:
+            if self.deployments.get(name) is not entry:
+                return
+            entry["draining"] = keep
+        for direction in events:
+            flight_recorder.record_serve_autoscale(
+                name, direction, len(entry["replicas"]) + len(keep)
+            )
 
     def _replace_dead_replicas(self, name: str, entry: dict):
         """Health check every replica; respawn the dead (reference:
@@ -490,6 +603,13 @@ class ServeController:
             self._publish_state(name)
 
     def _autoscale(self, name: str, entry: dict):
+        """Scale replica counts from TWO signals: instantaneous queue-
+        depth probes (reference pow-2 metric) and the recorded window-mean
+        queue wait (PR-10 per-request histograms — pressure the probes
+        can sample past).  Up on sustained pressure from either; down via
+        drain-then-retire on sustained starvation."""
+        from ray_tpu.util import flight_recorder
+
         cfg = entry["autoscaling"]
         replicas = entry["replicas"]
         if not replicas:
@@ -502,9 +622,17 @@ class ServeController:
             return
         per_replica = sum(queue_lens) / len(replicas)
         target = cfg["target_ongoing_requests"]
+        qw_target = cfg.get("target_queue_wait_s")
+        qw_mean = (
+            self._recorded_queue_wait(name) if qw_target is not None else None
+        )
+        qw_pressure = qw_mean is not None and qw_mean > qw_target
         now = time.monotonic()
         desired = None
-        if per_replica > target and len(replicas) < cfg["max_replicas"]:
+        direction = None
+        if (per_replica > target or qw_pressure) and (
+            len(replicas) < cfg["max_replicas"]
+        ):
             if entry["scale_pressure_since"] is None:
                 entry["scale_pressure_since"] = now
             if now - entry["scale_pressure_since"] >= cfg["upscale_delay_s"]:
@@ -515,25 +643,36 @@ class ServeController:
                         int(len(replicas) * per_replica / target),
                     ),
                 )
-        elif per_replica < target * 0.5 and len(replicas) > cfg["min_replicas"]:
+                direction = "up"
+        elif (
+            per_replica < target * 0.5
+            and not qw_pressure
+            and len(replicas) > cfg["min_replicas"]
+        ):
             if entry["scale_pressure_since"] is None:
                 entry["scale_pressure_since"] = now
             if now - entry["scale_pressure_since"] >= cfg["downscale_delay_s"]:
                 desired = max(cfg["min_replicas"], len(replicas) - 1)
+                direction = "down"
         else:
             entry["scale_pressure_since"] = None
         if desired is not None and desired != len(replicas):
             logger.info(
-                "autoscaling %s: %d -> %d (avg ongoing %.2f, target %.2f)",
+                "autoscaling %s: %d -> %d (avg ongoing %.2f, target %.2f, "
+                "queue-wait window mean %s)",
                 name, len(replicas), desired, per_replica, target,
+                f"{qw_mean:.3f}s" if qw_mean is not None else "n/a",
             )
             with self._lock:
                 if self.deployments.get(name) is not entry:
                     return
-                self._set_replica_count(entry, desired)
+                self._set_replica_count(entry, desired,
+                                        drain=direction == "down")
                 entry["scale_pressure_since"] = None
                 entry["last_scale_ts"] = now
                 self._publish_state(name)
+                total = len(entry["replicas"]) + len(entry.get("draining", []))
+            flight_recorder.record_serve_autoscale(name, direction, total)
 
     # -------------------------------------------------------------- query API
     def get_replicas(self, name: str) -> List:
@@ -554,6 +693,8 @@ class ServeController:
                 return False
             for h in entry["replicas"]:
                 self._kill(h)
+            for h, _t0 in entry.get("draining", []):
+                self._kill(h)
             self._publish_state(name)
             return True
 
@@ -561,6 +702,7 @@ class ServeController:
         return {
             name: {
                 "num_replicas": len(e["replicas"]),
+                "num_draining": len(e.get("draining", [])),
                 "version": e["version"],
                 "route_prefix": e["route_prefix"],
                 "autoscaling": e.get("autoscaling"),

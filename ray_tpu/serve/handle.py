@@ -144,6 +144,12 @@ class DeploymentHandle:
         for attempt in (0, 1):
             self._refresh(force=attempt > 0)
             if not self._replicas:
+                # A pushed EMPTY list can be the stale delete snapshot of
+                # a just-redeployed deployment (delete publishes [], the
+                # redeploy's push may not have landed) — ask the
+                # controller directly before declaring it empty.
+                if attempt == 0:
+                    continue
                 raise RuntimeError(
                     f"deployment {self.deployment_name!r} has no replicas"
                 )

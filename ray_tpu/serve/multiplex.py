@@ -37,6 +37,8 @@ def multiplexed(_fn=None, *, max_num_models_per_replica: int = 3):
     def wrap(fn: Callable):
         @functools.wraps(fn)
         async def wrapper(self, model_id: str):
+            from ray_tpu.util import flight_recorder
+
             cache: OrderedDict = getattr(self, "_rtpu_mux_cache", None)
             if cache is None:
                 cache = OrderedDict()
@@ -45,6 +47,7 @@ def multiplexed(_fn=None, *, max_num_models_per_replica: int = 3):
             # Fast path: cache hits never wait on another model's load.
             if model_id in cache:
                 cache.move_to_end(model_id)
+                flight_recorder.record_mux_cache_event("hit")
                 return cache[model_id]
             # Per-model lock: concurrent requests for the SAME new model
             # load once; different models load in parallel.
@@ -52,13 +55,16 @@ def multiplexed(_fn=None, *, max_num_models_per_replica: int = 3):
             async with lock:
                 if model_id in cache:
                     cache.move_to_end(model_id)
+                    flight_recorder.record_mux_cache_event("hit")
                     return cache[model_id]
+                flight_recorder.record_mux_cache_event("miss")
                 model = fn(self, model_id)
                 if asyncio.iscoroutine(model):
                     model = await model
                 cache[model_id] = model
                 while len(cache) > max_num_models_per_replica:
                     evicted_id, evicted = cache.popitem(last=False)
+                    flight_recorder.record_mux_cache_event("eviction")
                     self._rtpu_mux_locks.pop(evicted_id, None)
                     unload = getattr(evicted, "unload", None)
                     if callable(unload):

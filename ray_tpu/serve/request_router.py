@@ -84,6 +84,12 @@ class PrefixAwareRouter(RequestRouter):
         self.extract = prompt_extractor
         self._affinity: Dict[str, Any] = {}  # prefix -> actor id
         self._fallback = PowerOfTwoChoicesRouter()
+        # Cache-hit accounting: a "hit" is a warm-affinity route actually
+        # taken (the request lands where its prefix KV is); re-homes and
+        # cold prefixes are misses.  Published to the
+        # ray_tpu_llm_prefix_cache_* counters under site="router".
+        self.hits = 0
+        self.misses = 0
         # Probing every replica per warm-prefix hit is O(n) RPCs on the hot
         # path; a short TTL bounds it to O(n) per interval (the reference's
         # bounded-probe design).  Queue depths staler than ~100 ms only
@@ -108,6 +114,22 @@ class PrefixAwareRouter(RequestRouter):
         self._lens_cache = (now, key, lens)
         return lens
 
+    def _account(self, hit: bool):
+        if hit:
+            self.hits += 1
+        else:
+            self.misses += 1
+        try:
+            from ray_tpu.util import flight_recorder
+
+            flight_recorder.record_llm_prefix_lookup("router", hit)
+        except Exception:  # raylint: waive[RTL003] accounting must not fail routing
+            pass
+
+    def stats(self) -> Dict[str, int]:
+        return {"hits": self.hits, "misses": self.misses,
+                "entries": len(self._affinity)}
+
     def choose(self, replicas: List, args, kwargs):
         prompt = self.extract(args, kwargs)
         if prompt is None or len(replicas) == 1:
@@ -131,12 +153,14 @@ class PrefixAwareRouter(RequestRouter):
             warm_len = lens[replicas.index(warm)]
             min_len = min(lens)
             if warm_len <= max(self.imbalance_factor * max(min_len, 1), 1):
+                self._account(True)
                 return warm
             # Overloaded warm replica: we already hold every queue length —
             # take the shortest instead of re-probing two random ones.
             chosen = replicas[lens.index(min_len)]
         if chosen is None:
             chosen = self._fallback.choose(replicas, args, kwargs)
+        self._account(False)
         if len(self._affinity) >= self.max_entries:
             self._affinity.pop(next(iter(self._affinity)))
         self._affinity[prefix] = chosen._actor_id

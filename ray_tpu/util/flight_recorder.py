@@ -103,8 +103,20 @@ from .metric_registry import (  # noqa: F401 — re-exports
     RL_TRAJ_QUEUE_DEPTH,
     RPC_OOB_BYTES_TOTAL,
     RPC_OOB_FRAMES_TOTAL,
+    LLM_ADMITTED_TOTAL,
+    LLM_BATCH_BUCKET,
+    LLM_BATCH_OCCUPANCY,
+    LLM_DECODE_STEPS_TOTAL,
+    LLM_PREEMPTIONS_TOTAL,
+    LLM_PREFIX_CACHE_HITS_TOTAL,
+    LLM_PREFIX_CACHE_MISSES_TOTAL,
+    LLM_QUEUE_DEPTH,
+    LLM_RETIRED_TOTAL,
+    SERVE_AUTOSCALE_EVENTS_TOTAL,
     SERVE_INTER_TOKEN_HIST,
+    SERVE_MUX_CACHE_EVENTS_TOTAL,
     SERVE_QUEUE_WAIT_HIST,
+    SERVE_REPLICAS,
     SERVE_REQUESTS_TOTAL,
     SERVE_TTFT_HIST,
     SLO_VIOLATIONS_TOTAL,
@@ -701,6 +713,64 @@ class StreamTelemetry:
             time.perf_counter() - self._t0,
             self.gaps, outcome=outcome,
         )
+
+
+def record_serve_autoscale(deployment: str, direction: str,
+                           replicas: int) -> None:
+    """One autoscale decision on the serve controller: ``direction`` is
+    up / down / drain_retired / drain_forced; ``replicas`` is the new
+    total (routable + draining) for the deployment gauge."""
+    if not GlobalConfig.enable_flight_recorder:
+        return
+    _metrics._record_batch([
+        (SERVE_AUTOSCALE_EVENTS_TOTAL, "counter",
+         {"deployment": deployment, "direction": direction}, 1.0, None),
+        (SERVE_REPLICAS, "gauge", {"deployment": deployment},
+         float(replicas), None),
+    ])
+
+
+def record_mux_cache_event(event: str) -> None:
+    """One multiplexed-model cache event on a replica (hit / miss /
+    eviction)."""
+    counter(SERVE_MUX_CACHE_EVENTS_TOTAL, 1.0, {"event": event})
+
+
+# ------------------------------------------ continuous-batching LLM serving
+def record_llm_step(occupancy: int, queue_depth: int, admitted: int,
+                    retired: int, bucket: int) -> None:
+    """One token boundary + decode step of the continuous-batching
+    scheduler: batch occupancy / bucket / queue-depth gauges plus the
+    per-step admission/retirement counters (docs/llm_serving.md)."""
+    if not GlobalConfig.enable_flight_recorder:
+        return
+    entries = [
+        (LLM_BATCH_OCCUPANCY, "gauge", {}, float(occupancy), None),
+        (LLM_BATCH_BUCKET, "gauge", {}, float(bucket), None),
+        (LLM_QUEUE_DEPTH, "gauge", {}, float(queue_depth), None),
+        (LLM_DECODE_STEPS_TOTAL, "counter", {}, 1.0, None),
+    ]
+    if admitted:
+        entries.append((LLM_ADMITTED_TOTAL, "counter", {}, float(admitted),
+                        None))
+    if retired:
+        entries.append((LLM_RETIRED_TOTAL, "counter", {}, float(retired),
+                        None))
+    _metrics._record_batch(entries)
+
+
+def record_llm_preemption() -> None:
+    counter(LLM_PREEMPTIONS_TOTAL, 1.0)
+
+
+def record_llm_prefix_lookup(site: str, hit: bool, n: int = 1) -> None:
+    """Prefix-KV cache accounting, by lookup site (``engine`` = full-
+    coverage admission reuse on a decode replica, ``router`` = affinity
+    decisions on the request router)."""
+    counter(
+        LLM_PREFIX_CACHE_HITS_TOTAL if hit else LLM_PREFIX_CACHE_MISSES_TOTAL,
+        float(n), {"site": site},
+    )
 
 
 def record_slo_violation(rule: str) -> None:

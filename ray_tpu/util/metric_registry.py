@@ -30,6 +30,20 @@ SERVE_TTFT_HIST = "ray_tpu_serve_ttft_s"
 SERVE_INTER_TOKEN_HIST = "ray_tpu_serve_inter_token_s"
 SERVE_QUEUE_WAIT_HIST = "ray_tpu_serve_queue_wait_s"
 SERVE_REQUESTS_TOTAL = "ray_tpu_serve_requests_total"
+SERVE_AUTOSCALE_EVENTS_TOTAL = "ray_tpu_serve_autoscale_events_total"
+SERVE_REPLICAS = "ray_tpu_serve_replicas"
+SERVE_MUX_CACHE_EVENTS_TOTAL = "ray_tpu_serve_mux_cache_events_total"
+
+# ------------------------------------------- continuous-batching LLM serving
+LLM_BATCH_OCCUPANCY = "ray_tpu_llm_batch_occupancy"
+LLM_BATCH_BUCKET = "ray_tpu_llm_batch_bucket"
+LLM_QUEUE_DEPTH = "ray_tpu_llm_queue_depth"
+LLM_DECODE_STEPS_TOTAL = "ray_tpu_llm_decode_steps_total"
+LLM_ADMITTED_TOTAL = "ray_tpu_llm_admitted_total"
+LLM_RETIRED_TOTAL = "ray_tpu_llm_retired_total"
+LLM_PREEMPTIONS_TOTAL = "ray_tpu_llm_preemptions_total"
+LLM_PREFIX_CACHE_HITS_TOTAL = "ray_tpu_llm_prefix_cache_hits_total"
+LLM_PREFIX_CACHE_MISSES_TOTAL = "ray_tpu_llm_prefix_cache_misses_total"
 
 # ------------------------------------------------------------ collectives
 COLLECTIVE_OPS_TOTAL = "ray_tpu_collective_ops_total"
@@ -161,6 +175,30 @@ METRICS: Dict[str, str] = {
                            "slot per deployment/replica (histogram)",
     SERVE_REQUESTS_TOTAL: "serving requests completed, by deployment/"
                           "outcome/streaming",
+    SERVE_AUTOSCALE_EVENTS_TOTAL: "serve replica autoscale decisions, by "
+                                  "deployment/direction (up, down, "
+                                  "drain_retired, drain_forced)",
+    SERVE_REPLICAS: "serve replicas per deployment — routable + still-"
+                    "draining (gauge)",
+    SERVE_MUX_CACHE_EVENTS_TOTAL: "multiplexed model-cache events on "
+                                  "replicas, by event (hit, miss, "
+                                  "eviction)",
+    LLM_BATCH_OCCUPANCY: "sequences decoded by the last continuous-"
+                         "batching step (gauge)",
+    LLM_BATCH_BUCKET: "current padded decode batch bucket (gauge)",
+    LLM_QUEUE_DEPTH: "requests waiting for a decode slot (gauge; "
+                     "admission + preemption-resume queues)",
+    LLM_DECODE_STEPS_TOTAL: "batched decode steps executed",
+    LLM_ADMITTED_TOTAL: "sequences admitted into the running batch at a "
+                        "token boundary",
+    LLM_RETIRED_TOTAL: "sequences retired from the running batch at a "
+                       "token boundary",
+    LLM_PREEMPTIONS_TOTAL: "sequences preempted (KV to host, requeued) by "
+                           "the starvation guard",
+    LLM_PREFIX_CACHE_HITS_TOTAL: "prompt admissions served from cached "
+                                 "prefix KV, by site (engine, router)",
+    LLM_PREFIX_CACHE_MISSES_TOTAL: "prompt lookups that found no full "
+                                   "prefix-KV coverage, by site",
     COLLECTIVE_OPS_TOTAL: "collective ops executed, by op/backend",
     COLLECTIVE_BYTES_TOTAL: "collective payload bytes, by op/backend",
     COLLECTIVE_DURATION_HIST: "collective op duration (histogram)",

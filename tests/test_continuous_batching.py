@@ -1,0 +1,483 @@
+"""Continuous-batching LLM serving: token-boundary admission parity,
+bucketed batch shapes, starvation guard, prefix-cache reuse, prefix-aware
+routing, queue-signal autoscaling with drain-then-retire, and the
+llm_load bench smoke (reference: ray ``llm/_internal/serve/
+serving_patterns/prefill_decode/`` + Orca iteration-level scheduling)."""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.llm.continuous_batching import (
+    BatchedDecodeReplica,
+    ContinuousBatchingConfig,
+    ContinuousBatchingEngine,
+    PrefixKVCache,
+    prefix_block_keys,
+)
+from ray_tpu.llm.disagg import DisaggRouter, PrefillEngine, PrefillReplica
+from ray_tpu.llm.engine import EngineConfig, JaxLLMEngine, SamplingParams
+from ray_tpu.models.gpt2 import GPT2Config
+
+
+def _tiny_cfg(**kw):
+    defaults = dict(max_batch_size=4, max_seq_len=64, seed=0)
+    defaults.update(kw)
+    return EngineConfig(
+        model=GPT2Config.tiny(vocab_size=384, max_seq=64, dtype="float32"),
+        **defaults,
+    )
+
+
+def _greedy(n=8):
+    return SamplingParams(max_tokens=n, temperature=0.0)
+
+
+def _solo(prompt, params, cfg=None):
+    """Unbatched reference: a fresh single-slot engine per prompt."""
+    cfg = cfg or _tiny_cfg()
+    solo_cfg = EngineConfig(
+        model=cfg.model, max_batch_size=1,
+        max_seq_len=cfg.max_seq_len, seed=cfg.seed,
+    )
+    [out] = JaxLLMEngine(solo_cfg).generate([prompt], params)
+    return out
+
+
+def _admit_local(engine, pre, prompt, params):
+    """Prefill locally + zero-copy handoff into the batching engine."""
+    from ray_tpu.llm.disagg import fetch_prefill_kv
+
+    meta = pre.prefill(prompt, params)
+    k, v = fetch_prefill_kv(meta)
+    return engine.submit_kv(meta, k, v)
+
+
+@pytest.fixture
+def cb_engine():
+    engines = []
+
+    def make(cfg=None, cb=None):
+        e = ContinuousBatchingEngine(cfg or _tiny_cfg(), cb)
+        e.start()
+        engines.append(e)
+        return e
+
+    yield make
+    for e in engines:
+        e.stop()
+
+
+class TestTokenBoundaryAdmission:
+    def test_staggered_admission_parity(self, cb_engine):
+        """Sequences admitted mid-flight at token boundaries produce
+        greedy outputs token-identical to unbatched decode — across
+        bucket growth 1 -> 2 -> 4 (parity is at the sampled-token level;
+        raw logits are not bitwise-stable across batch shapes)."""
+        cfg = _tiny_cfg()
+        params = _greedy(10)
+        prompts = ["hello world", "jax on tpu", "disaggregate me", "mid", "z"]
+        expected = {p: _solo(p, params, cfg)["token_ids"] for p in prompts}
+
+        engine = cb_engine(cfg)
+        pre = PrefillEngine(cfg)
+        rids = {}
+        for p in prompts:  # staggered: each joins a RUNNING batch
+            rids[p] = _admit_local(engine, pre, p, params)
+            time.sleep(0.05)
+        for p, rid in rids.items():
+            got = engine.result(rid, timeout_s=120)
+            assert got["token_ids"] == expected[p], p
+        st = engine.stats()
+        assert st["max_occupancy"] > 1  # they really shared decode steps
+        assert st["admitted"] == len(prompts)
+        assert st["retired"] == len(prompts)
+
+    def test_stream_matches_result(self, cb_engine):
+        cfg = _tiny_cfg()
+        params = _greedy(8)
+        expected = _solo("stream me", params, cfg)
+        engine = cb_engine(cfg)
+        pre = PrefillEngine(cfg)
+        rid = _admit_local(engine, pre, "stream me", params)
+        deltas = list(engine.stream(rid, timeout_s=120))
+        assert len(deltas) >= 2  # incremental, not one blob
+        assert "".join(deltas) == expected["text"]
+
+    def test_bucket_growth_and_shrink_with_compaction(self, cb_engine):
+        """The physical batch grows to demand and shrinks (with row
+        compaction) after sustained low occupancy — without perturbing a
+        still-running sequence's output."""
+        cfg = _tiny_cfg(max_batch_size=4)
+        engine = cb_engine(
+            cfg, ContinuousBatchingConfig(shrink_patience=3)
+        )
+        pre = PrefillEngine(cfg)
+        long_params = _greedy(40)
+        expected = _solo("survivor", long_params, cfg)["token_ids"]
+        short = [
+            _admit_local(engine, pre, f"s{i}", _greedy(4)) for i in range(3)
+        ]
+        rid = _admit_local(engine, pre, "survivor", long_params)
+        assert engine.result(short[0], timeout_s=120) is not None
+        for r in short[1:]:
+            engine.result(r, timeout_s=120)
+        got = engine.result(rid, timeout_s=120)
+        assert got["token_ids"] == expected
+        st = engine.stats()
+        assert st["bucket"] < cfg.max_batch_size  # shrank after the burst
+
+    def test_cancel_frees_slot(self, cb_engine):
+        cfg = _tiny_cfg(max_batch_size=2)
+        engine = cb_engine(cfg)
+        pre = PrefillEngine(cfg)
+        rid = _admit_local(engine, pre, "cancel me", _greedy(60))
+        deadline = time.monotonic() + 30
+        while engine.stats()["occupancy"] == 0:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        engine.cancel(rid)
+        deadline = time.monotonic() + 30
+        while engine.stats()["occupancy"]:
+            assert time.monotonic() < deadline, "cancelled slot not freed"
+            time.sleep(0.02)
+
+    def test_starvation_guard_preempts_and_preserves_outputs(self, cb_engine):
+        """A long-running batch cannot starve the queue head: past the
+        timeout the longest-running sequence is preempted (KV to host),
+        the waiter admits, and the preempted sequence resumes to a
+        token-exact result."""
+        # A 128-seq model gives the long sequences a ~100-step (>0.3 s)
+        # runway; with the guard at 0.05 s they cannot finish before it
+        # fires even when the box hiccups (the 64-seq variant flaked:
+        # ~50 steps of runway raced the timer).  stop_token=-1 disables
+        # EOS so the runway length is exact.
+        cfg = EngineConfig(
+            model=GPT2Config.tiny(vocab_size=384, max_seq=128,
+                                  dtype="float32"),
+            max_batch_size=2, max_seq_len=128, seed=0,
+        )
+        cb = ContinuousBatchingConfig(
+            starvation_timeout_s=0.05, preempt_min_tokens=2,
+        )
+        long_params = SamplingParams(max_tokens=100, temperature=0.0,
+                                     stop_token=-1)
+        short_params = _greedy(4)
+        expected = {
+            "long a": _solo("long a", long_params, cfg)["token_ids"],
+            "long b": _solo("long b", long_params, cfg)["token_ids"],
+            "starved": _solo("starved", short_params, cfg)["token_ids"],
+        }
+        engine = cb_engine(cfg, cb)
+        pre = PrefillEngine(cfg)
+        la = _admit_local(engine, pre, "long a", long_params)
+        lb = _admit_local(engine, pre, "long b", long_params)
+        deadline = time.monotonic() + 60
+        while engine.stats()["occupancy"] < 2:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        sv = _admit_local(engine, pre, "starved", short_params)
+        got_short = engine.result(sv, timeout_s=120)
+        stats = engine.stats()
+        assert stats["preempted"] >= 1  # guard actually fired
+        assert got_short["token_ids"] == expected["starved"]
+        assert engine.result(la, timeout_s=180)["token_ids"] == \
+            expected["long a"]
+        assert engine.result(lb, timeout_s=180)["token_ids"] == \
+            expected["long b"]
+
+
+class TestPrefixKVCache:
+    def test_block_chain_keys(self):
+        a = prefix_block_keys(list(range(40)), 16)
+        b = prefix_block_keys(list(range(32)) + [99, 98], 16)
+        assert len(a) == 2 and len(b) == 2
+        assert a[:2] == b[:2]  # same first two full blocks
+        c = prefix_block_keys([7] + list(range(1, 40)), 16)
+        assert c[0] != a[0]  # first-token divergence changes every key
+
+    def test_lru_eviction_by_token_budget(self):
+        cache = PrefixKVCache(max_tokens=8, block_tokens=4)
+        import numpy as np
+
+        def entry(ids):
+            z = np.zeros((1, 1, 1, len(ids), 1), np.float32)
+            return PrefixKVCache.build_entry(ids, z, z, np.zeros(4), 4)
+
+        cache.insert(entry([1, 2, 3, 4]))
+        cache.insert(entry([5, 6, 7, 8]))
+        assert cache.lookup([1, 2, 3, 4]) is not None  # refresh LRU
+        cache.insert(entry([9, 10, 11, 12]))  # evicts [5,6,7,8]
+        assert cache.lookup([5, 6, 7, 8]) is None
+        assert cache.lookup([1, 2, 3, 4]) is not None
+
+    def test_full_coverage_reuse_is_exact_and_accounted(self, cb_engine):
+        """submit_cached admits a repeated prompt straight from cached
+        prefix KV (no prefill anywhere) with token-exact output."""
+        cfg = _tiny_cfg()
+        params = _greedy(8)
+        expected = _solo("hot prompt", params, cfg)
+        engine = cb_engine(cfg)
+        pre = PrefillEngine(cfg)
+        assert engine.submit_cached("hot prompt", params) is None  # cold
+        rid = _admit_local(engine, pre, "hot prompt", params)
+        engine.result(rid, timeout_s=120)
+        rid2 = engine.submit_cached("hot prompt", params)
+        assert rid2 is not None  # full-coverage hit
+        got = engine.result(rid2, timeout_s=120)
+        assert got["token_ids"] == expected["token_ids"]
+        pc = engine.stats()["prefix_cache"]
+        assert pc["hits"] == 1 and pc["misses"] == 1
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=8)
+    yield
+    import ray_tpu.serve as serve
+
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _wait_for(pred, timeout=60, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.25)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class TestBatchedDecodeActors:
+    def test_disagg_batched_matches_monolithic(self, cluster):
+        cfg = _tiny_cfg(seed=3)
+        params = _greedy(10)
+        prompts = ["hello world", "jax on tpu", "disagg me", "one more"]
+        mono = JaxLLMEngine(cfg).generate(prompts, params)
+
+        Pre = ray_tpu.remote(num_cpus=0)(PrefillReplica)
+        Dec = ray_tpu.remote(num_cpus=0, max_concurrency=16)(
+            BatchedDecodeReplica
+        )
+        pre = [Pre.remote(cfg) for _ in range(2)]
+        dec = [Dec.remote(cfg) for _ in range(2)]
+        try:
+            router = DisaggRouter(pre, dec)
+            outs = router.generate_many(prompts, params, timeout_s=240)
+            assert [o["token_ids"] for o in outs] == [
+                m["token_ids"] for m in mono
+            ]
+        finally:
+            for a in pre + dec:
+                ray_tpu.kill(a)
+
+    def test_prefix_router_cache_hit_vs_cold(self, cluster):
+        """Repeat traffic routes back to the warm decode replica and
+        admits from its prefix cache (no prefill hop); cold prompts pay
+        the full path.  Accounting is split router vs engine."""
+        cfg = _tiny_cfg(seed=3)
+        params = _greedy(6)
+        mono = JaxLLMEngine(cfg).generate(["hot hot hot"], params)
+
+        Pre = ray_tpu.remote(num_cpus=0)(PrefillReplica)
+        Dec = ray_tpu.remote(num_cpus=0, max_concurrency=16)(
+            BatchedDecodeReplica
+        )
+        pre = [Pre.remote(cfg)]
+        dec = [Dec.remote(cfg) for _ in range(2)]
+        try:
+            router = DisaggRouter(pre, dec)
+            first = router.generate("hot hot hot", params, timeout_s=240)
+            assert router.router_hits == 0  # cold: nobody held the prefix
+            for _ in range(3):
+                got = router.generate("hot hot hot", params, timeout_s=240)
+                assert got["token_ids"] == mono[0]["token_ids"]
+            assert got["token_ids"] == first["token_ids"]
+            assert router.router_hits >= 3  # affinity held
+            stats = [
+                ray_tpu.get(d.stats.remote(), timeout=60) for d in dec
+            ]
+            hits = [s["prefix_cache"]["hits"] for s in stats]
+            # Every repeat hit ONE warm replica's engine cache; the other
+            # replica stayed cold.
+            assert sorted(hits)[-1] >= 3 and sorted(hits)[0] == 0, hits
+        finally:
+            for a in pre + dec:
+                ray_tpu.kill(a)
+
+
+class TestAutoscaleDrainRetire:
+    def test_up_then_drain_then_down(self, cluster):
+        """Queue pressure scales replicas up; idling scales down via
+        drain-then-retire — the retiring replica leaves the routable set
+        but finishes its queue, so no request is dropped."""
+        import ray_tpu.serve as serve
+
+        @serve.deployment(
+            name="SlowEcho",
+            ray_actor_options={"num_cpus": 0},
+            max_ongoing_requests=2,
+            autoscaling_config={
+                "min_replicas": 1,
+                "max_replicas": 3,
+                "target_ongoing_requests": 1.0,
+                "upscale_delay_s": 0.2,
+                "downscale_delay_s": 0.8,
+                "drain_timeout_s": 30.0,
+            },
+        )
+        class SlowEcho:
+            def __call__(self, x):
+                time.sleep(0.3)
+                return x
+
+        handle = serve.run(SlowEcho.bind())
+        results = []
+        errors = []
+        stop = threading.Event()
+
+        def client(i):
+            j = 0
+            while not stop.is_set():
+                try:
+                    results.append(
+                        handle.remote((i, j)).result(timeout=120)
+                    )
+                except Exception as e:  # noqa: BLE001 — assert below
+                    errors.append(e)
+                j += 1
+
+        threads = [
+            threading.Thread(target=client, args=(i,), daemon=True,
+                             name=f"load-{i}")
+            for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        try:
+            _wait_for(
+                lambda: serve.status()["SlowEcho"]["num_replicas"] >= 2,
+                timeout=90, msg="scale-up under queue pressure",
+            )
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=120)
+        assert not errors, errors[:3]
+        assert results  # load actually flowed
+        n_before = len(results)
+        _wait_for(
+            lambda: serve.status()["SlowEcho"]["num_replicas"] == 1
+            and serve.status()["SlowEcho"]["num_draining"] == 0,
+            timeout=120, msg="drain-then-retire back to min",
+        )
+        assert len(results) == n_before  # nothing trickled in as errors
+        assert not errors
+        serve.delete("SlowEcho")
+
+    def test_autoscale_events_recorded(self, cluster):
+        """The scale decisions above landed on the flight recorder."""
+        from ray_tpu.util import metrics
+        from ray_tpu.util.metric_registry import (
+            SERVE_AUTOSCALE_EVENTS_TOTAL,
+        )
+
+        def directions():
+            return {
+                (ent.get("tags") or {}).get("direction")
+                for ent in metrics.snapshot().values()
+                if ent.get("name") == SERVE_AUTOSCALE_EVENTS_TOTAL
+            }
+
+        _wait_for(
+            lambda: {"up", "down", "drain_retired"} <= directions(),
+            timeout=60, msg="autoscale events in the metrics registry",
+        )
+
+
+class TestDisaggServeApp:
+    def test_sse_stream_stitched_trace(self, cluster):
+        """One batched streaming request exports ONE stitched trace:
+        proxy span -> replica serve.request.stream -> prefill task ->
+        decode stream, with the trace id in x-ray-tpu-trace-id."""
+        import json
+        import urllib.error
+        import urllib.request
+
+        import ray_tpu.serve as serve
+        from ray_tpu.llm import build_disagg_openai_app
+        from ray_tpu.util import obs, tracing
+
+        serve.run(build_disagg_openai_app(_tiny_cfg(seed=3)))
+        url = serve.start_http_proxy(port=8179)
+        req = urllib.request.Request(
+            f"{url}/v1/completions",
+            data=json.dumps(
+                {"prompt": "trace me", "max_tokens": 4, "stream": True}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        deadline = time.monotonic() + 90.0
+        while True:
+            try:
+                resp = urllib.request.urlopen(req, timeout=240)
+                break
+            except urllib.error.HTTPError:
+                raise
+            except (urllib.error.URLError, ConnectionError):
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.2)
+        trace_id = resp.headers["x-ray-tpu-trace-id"]
+        raw = resp.read().decode()
+        frames = [
+            line[len("data: "):]
+            for line in raw.splitlines() if line.startswith("data: ")
+        ]
+        assert frames[-1] == "[DONE]"
+        chunks = [json.loads(f) for f in frames[:-1]]
+        assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+        assert trace_id
+        # serve.request.stream is recorded at stream END and flushes
+        # asynchronously — poll the trace until every required hop
+        # appears instead of trusting the first >=3 spans.
+        required = {"serve.http.stream", "serve.request.stream"}
+        deadline = time.monotonic() + 120
+        while True:
+            spans = tracing.get_trace(trace_id, min_spans=3, timeout=30)
+            names = {s["name"] for s in spans}
+            if required <= names and len(obs.trace_processes(trace_id)) >= 3:
+                break
+            assert time.monotonic() < deadline, sorted(names)
+            time.sleep(0.5)
+        serve.stop_http_proxy()
+        serve.delete("LLMDisaggServer")
+
+    def test_unary_completions_via_router(self, cluster):
+        import ray_tpu.serve as serve
+        from ray_tpu.llm import build_disagg_openai_app
+
+        handle = serve.run(build_disagg_openai_app(_tiny_cfg(seed=3)))
+        out = handle.remote(
+            {"prompt": "hi", "max_tokens": 4}
+        ).result(timeout=240)
+        assert out["object"] == "text_completion"
+        assert out["usage"]["completion_tokens"] >= 1
+        serve.delete("LLMDisaggServer")
+
+
+class TestBenchSmoke:
+    def test_bench_llm_load_quick(self):
+        """The tier-1 pin for ``bench.py llm_load --quick``: the load
+        stage runs end-to-end with its in-bench asserts (occupancy > 1,
+        stall bound) active."""
+        from ray_tpu.llm import bench_llm
+
+        rows = bench_llm.bench_load(quick=True)
+        by_metric = {r["metric"]: r for r in rows}
+        assert by_metric["llm_load_batch_occupancy_max"]["value"] > 1
+        assert "llm_load_p99_inter_token_s" in by_metric
+        assert by_metric["llm_load_requests_per_s"]["value"] > 0
