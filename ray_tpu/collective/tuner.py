@@ -285,6 +285,31 @@ class CollectiveTuner:
             st.samples += 1
             st.bw_sum += bandwidth
 
+    def force_reprobe(self, op: Optional[str] = None) -> int:
+        """Arm an immediate re-probe on every committed multi-candidate
+        bucket (optionally restricted to one ``op``): the next call in
+        each bucket explores an alternative and the call after re-commits
+        to the measured argmax — the SLO remediation path for bandwidth
+        drift, skipping the geometric wait.
+
+        SPMD caveat: arming ONE member of a multi-member group makes its
+        call sequence diverge from its peers until the next synced
+        commit.  The remediation broadcast therefore fans the directive
+        to EVERY worker process (node-agent ``remediate`` fan-out), so
+        members re-probe in lockstep and the synced re-commit realigns
+        any residue.  Returns the number of buckets armed."""
+        armed = 0
+        with self._lock:
+            for b in self._buckets.values():
+                if op is not None and b.op != op:
+                    continue
+                if b.committed is None or len(b.candidates) <= 1:
+                    continue
+                b.next_probe = b.calls + 1
+                b.pending_recommit = False
+                armed += 1
+        return armed
+
     # -------------------------------------------------------------- export
     def stats(self) -> Dict[str, dict]:
         """Per-bucket decision table keyed ``op|bucket|w<world>|<topo>``:

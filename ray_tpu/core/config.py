@@ -223,6 +223,20 @@ _KNOBS: Dict[str, tuple] = {
         "per beat; no new periodic loop).  Workers drop their own "
         "task-event flush to a slow backup cadence while pulled",
     ),
+    "enable_remediation": (
+        bool, False,
+        "Auto-attach the SLO remediation controller (util/remediation.py) "
+        "when the dashboard starts: findings are mapped to bounded "
+        "actuator actions (serve scale-up, pipeline-stage respawn, "
+        "tuner re-probe) each aggregation beat.  Off by default — "
+        "explicit remediation.start() always works",
+    ),
+    "remediation_beat_s": (
+        float, 0.0,
+        "Remediation controller beat period; 0 follows the node-agent "
+        "heartbeat (health_check_period_s), the cadence aggregated "
+        "telemetry actually arrives on",
+    ),
     "task_events_flush_period_s": (float, 0.5, "Worker buffer flush period"),
     "task_events_max_buffer": (int, 10000, "Per-worker unflushed event cap"),
     "task_events_max_stored": (int, 100000, "Control-plane stored task cap"),

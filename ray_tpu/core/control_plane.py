@@ -160,6 +160,10 @@ class ControlPlane:
         self._bg_tasks: List[asyncio.Task] = []
         self.task_event_store = TaskEventStore()
         self._obs_seen: Dict[str, int] = {}  # worker -> last obs batch id
+        # Aggregation beats: obs_report arrivals.  The remediation
+        # controller's beat thread reads this (debug_control_plane) to
+        # evaluate once per beat instead of polling blind.
+        self.obs_beats = 0
         self._requested_resources: List[dict] = []
         self._recent_unplaceable: List[tuple] = []  # (monotonic ts, resources)
         self.store = make_store_client(store_path)
@@ -1238,6 +1242,7 @@ class ControlPlane:
         Batches carry per-worker ids (the pull staging's at-least-once
         redelivery): an id seen before is a duplicate of a batch that
         DID land — only its idempotent span-drop total is merged."""
+        self.obs_beats += 1
         metrics_ns = self._kv.setdefault("metrics", {})
         for batch in payload.get("batches") or ():
             wid = batch.get("worker_id")
@@ -1307,6 +1312,7 @@ class ControlPlane:
             "rpc_lanes": self.server.lane_stats(),
             "nodes": len(self.nodes),
             "placement_groups": len(self.placement_groups),
+            "obs_beats": self.obs_beats,
         }
 
     def handle_get_state(self, payload, conn):

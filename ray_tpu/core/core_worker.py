@@ -3921,6 +3921,21 @@ class CoreWorker:
             "metrics": metrics_payload,
         }
 
+    def handle_remediate(self, payload, conn):
+        """Remediation directive fan-in (node-agent broadcast): apply
+        each directive against THIS process's local actuators — e.g. a
+        ``collective_reprobe`` arms the process-wide tuner so every
+        group member re-probes in lockstep (util/remediation.py)."""
+        from ..util import remediation
+
+        return {
+            "worker_id": self.worker_id.hex(),
+            "results": [
+                remediation.apply_local_directive(d)
+                for d in payload.get("directives", ())
+            ],
+        }
+
     def handle_pipeline_push(self, payload, conn):
         """Stage-boundary p2p delivery (train.pipeline activations/grads):
         park the still-serialized payload in the local mailbox for the

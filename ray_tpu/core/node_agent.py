@@ -1391,6 +1391,35 @@ class NodeAgent:
         else:
             self.directory.seal(oid, size)
 
+    async def handle_remediate(self, payload, conn):
+        """Remediation directive fan-out: forward the directives to every
+        live local worker's ``remediate`` handler.  The remediation
+        controller broadcasts through agents (one RPC per node) so
+        per-process actuators — the collective tuner, registered
+        in-process hooks — are reachable without per-worker addressing.
+        Per-worker failures are isolated, mirroring the obs pull."""
+        from ..util import flight_recorder as fr
+
+        directives = payload.get("directives", ())
+        timeout = max(1.0, GlobalConfig.health_check_period_s)
+
+        async def one(handle):
+            if handle.address is None or handle.proc.poll() is not None:
+                return None
+            try:
+                return await self.worker_clients.get(handle.address).call(
+                    "remediate", {"directives": directives}, timeout=timeout,
+                )
+            except Exception:  # noqa: BLE001 — worker may be mid-exit
+                fr.count_suppressed("remediate_fanout")
+                return None
+
+        replies = await asyncio.gather(
+            *(one(h) for h in list(self.workers.values()))
+        )
+        done = [r for r in replies if r]
+        return {"workers": len(done), "results": done}
+
     def handle_ping(self, payload, conn):
         return "pong"
 

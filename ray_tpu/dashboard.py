@@ -40,6 +40,16 @@ def start_dashboard(
     if not ray_tpu.is_initialized():
         ray_tpu.init(address=address or "auto")
 
+    from .core.config import GlobalConfig
+
+    if GlobalConfig.enable_remediation:
+        # Self-healing opt-in: attach the process-wide remediation
+        # controller to the aggregation beat (util/remediation.py).
+        from .util import remediation as remediation_mod
+
+        if remediation_mod.get_remediation_controller() is None:
+            remediation_mod.start()
+
     from .util.state import api as state_api
     from .util.state.api import StateApiClient, chrome_trace_events
 
@@ -200,12 +210,18 @@ def start_dashboard(
     async def slo(request):
         """SLO/anomaly engine findings over the aggregated stream (one
         process-wide engine: rate/sustain rules accumulate state across
-        requests)."""
+        requests), plus the remediation controller's actions/quarantine
+        state when one is running (here or elsewhere in the cluster)."""
+        from .util import remediation as remediation_mod
         from .util.slo import get_slo_engine
 
         engine = get_slo_engine()
         await run_sync(engine.evaluate)
-        return _json(engine.report())
+        report = engine.report()
+        rem = await run_sync(remediation_mod.report_snapshot)
+        if rem is not None:
+            report["remediation"] = rem
+        return _json(report)
 
     async def metrics(request):
         from .util import metrics as metrics_mod

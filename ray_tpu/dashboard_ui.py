@@ -37,6 +37,7 @@ INDEX_HTML = """<!doctype html>
 <h1>ray_tpu dashboard <span class="muted" id="ts"></span> <span id="err"></span></h1>
 <div class="cards" id="cards"></div>
 <h2>SLO violations</h2><div id="slo"></div>
+<h2>Remediation</h2><div id="remediation"></div>
 <h2>Nodes</h2><div id="nodes"></div>
 <h2>Actors</h2><div id="actors"></div>
 <h2>Placement groups</h2><div id="pgs"></div>
@@ -86,8 +87,24 @@ async function refresh() {
     document.getElementById('slo').innerHTML =
       (slo.violations && slo.violations.length)
         ? table(slo.violations,
-                ['rule', 'subject', 'value', 'threshold', 'detail'])
+                ['rule', 'subject', 'value', 'threshold', 'ongoing',
+                 'detail'])
         : `<p class="muted">none (${(slo.rules || []).join(', ')})</p>`;
+    const rem = slo.remediation;
+    const quarantined = rem && rem.quarantined
+      ? Object.entries(rem.quarantined) : [];
+    document.getElementById('remediation').innerHTML = !rem
+      ? '<p class="muted">no remediation controller attached</p>'
+      : (quarantined.length
+          ? '<p><b>QUARANTINED</b> (self-healing stopped; human needed): '
+            + quarantined.map(([t, e]) =>
+                `${esc(t)} — ${esc(e.reason || '')}`).join('; ') + '</p>'
+          : '') +
+        ((rem.actions && rem.actions.length)
+          ? table(rem.actions.slice(-20),
+                  ['rule', 'action', 'target', 'outcome', 'detail'])
+          : '<p class="muted">no actions taken'
+            + ` (beats: ${rem.beats || 0})</p>`);
     document.getElementById('nodes').innerHTML =
       table(nodes, ['node_id', 'alive', 'total', 'available', 'idle_s']);
     document.getElementById('actors').innerHTML =

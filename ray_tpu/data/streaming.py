@@ -401,12 +401,32 @@ class _ActorPoolNode(_OpNode):
         self._emit_seq = 0
         self._last_autoscale = 0.0
         self._pressure_streak = 0
+        self._force_scale_up = False
         self._input_bound = max(
             self._input_bound, self.max_size * self.max_in_flight * 2
         )
         for _ in range(self.min_size):
             self._spawn_actor()
         self._record_pool_size()
+        # SLO remediation hook: while this pool runs, a sustained
+        # queue_pressure finding on its op can force one scale-up
+        # (outside the two-streak hysteresis; still bounded by max_size).
+        from ray_tpu.util import remediation as _remediation
+
+        self._remediation_handle = _remediation.register_actuator(
+            "data_pool_scale_up", self._remediation_scale_up,
+            target=self.op_label,
+        )
+
+    def _remediation_scale_up(self, target: str, violation, **_kw) -> str:
+        from ray_tpu.util.remediation import RemediationSkipped
+
+        if self.finished:
+            raise RemediationSkipped("pool already finished")
+        if len(self._actors) >= self.max_size:
+            raise RemediationSkipped(f"at max_size={self.max_size}")
+        self._force_scale_up = True  # applied by the scheduler thread
+        return f"pool {self.op_label}: scale-up forced"
 
     # -- pool management ---------------------------------------------------
     def _spawn_actor(self) -> None:
@@ -430,6 +450,16 @@ class _ActorPoolNode(_OpNode):
         fr.gauge(fr.DATA_POOL_SIZE, float(n), {"op": self.op_label})
 
     def _autoscale(self, now: float) -> None:
+        if self._force_scale_up:
+            # Remediation override: skip the streak hysteresis (the SLO
+            # rule already judged the pressure sustained), keep the cap.
+            self._force_scale_up = False
+            if len(self._actors) < self.max_size:
+                self._spawn_actor()
+                self.stats.autoscale_up_events += 1
+                fr.counter(fr.DATA_AUTOSCALE_EVENTS_TOTAL, 1.0,
+                           {"op": self.op_label, "direction": "up"})
+                self._record_pool_size()
         if now - self._last_autoscale < GlobalConfig.data_autoscale_interval_s:
             return
         self._last_autoscale = now
@@ -523,6 +553,9 @@ class _ActorPoolNode(_OpNode):
         return False
 
     def _teardown_pool(self) -> None:
+        from ray_tpu.util import remediation as _remediation
+
+        _remediation.unregister_actuator(self._remediation_handle)
         for entry in list(self._actors):
             self._kill_actor(entry)
         self._record_pool_size()

@@ -1,0 +1,315 @@
+"""Fault-injection harness: composable, scoped, revertible injectors.
+
+Chaos tests for the self-healing loop (``util/remediation.py``) need
+faults that are *real enough* to drive the actual detect → act →
+recover arc, yet hermetic: every injector is scoped (it targets one
+component instance), revertible (``revert()`` restores the world, and
+the injectors are context managers so test teardown cannot leak chaos),
+and composable (``scoped(...)`` stacks several).
+
+Injectors:
+
+  - ``SlowPipelineStage`` — a slow host under one pipeline stage actor:
+    ``compute_delay_s`` slows its forward ops (peers accumulate stall —
+    the signature the straggler rule flags), ``recv_delay_s`` slows its
+    tensor delivery.  The chaos state lives ON the actor, so the
+    remediation respawn-and-replace clears it the way replacing a sick
+    process clears its sickness.
+  - ``KilledStageActor`` — kills a stage actor outright (one-shot);
+    repeated kills drive the restart-storm → quarantine path.
+  - ``OverloadedServeReplica`` — a closed-loop client fleet hammering a
+    deployment until reverted; the fault is offered load exceeding one
+    replica's capacity, and recovery is the remediation scale-up
+    absorbing it (no revert needed for the SLO to clean up).
+  - ``ThrottledCollectiveLink`` — degrades one fabric member's
+    bandwidth for one algorithm (the slow-link model), driving the
+    bandwidth-drift rule; the remediation re-probe lets the tuner
+    re-commit around the throttled path.
+
+``CollectiveFabricMember`` is the workload half of the collective
+scenario: a simulated fabric (timed memcpy at per-algorithm bandwidths)
+driven through the REAL tuner / flight-recorder / SLO pipeline — the
+chaos boundary is the fabric model, everything above it is production
+code.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class ChaosInjector:
+    """Base: ``apply()`` injects, ``revert()`` restores; context-manager
+    use makes tests hermetic by construction."""
+
+    def apply(self) -> "ChaosInjector":
+        raise NotImplementedError
+
+    def revert(self) -> None:
+        raise NotImplementedError
+
+    def __enter__(self) -> "ChaosInjector":
+        return self.apply()
+
+    def __exit__(self, *exc) -> None:
+        self.revert()
+
+
+class scoped:
+    """Compose several injectors into one scope: applied in order,
+    reverted in reverse, every revert attempted even if one fails."""
+
+    def __init__(self, *injectors: ChaosInjector):
+        self.injectors = injectors
+
+    def __enter__(self) -> tuple:
+        applied = []
+        try:
+            for inj in self.injectors:
+                inj.apply()
+                applied.append(inj)
+        except BaseException:
+            for inj in reversed(applied):
+                try:
+                    inj.revert()
+                except Exception as e:  # noqa: BLE001 — best-effort unwind
+                    logger.warning("chaos unwind failed: %s", e)
+            raise
+        return self.injectors
+
+    def __exit__(self, *exc) -> None:
+        for inj in reversed(self.injectors):
+            try:
+                inj.revert()
+            except Exception as e:  # noqa: BLE001 — keep reverting the rest
+                logger.warning("chaos revert failed: %s", e)
+
+
+# ------------------------------------------------------------ pipeline chaos
+class SlowPipelineStage(ChaosInjector):
+    """Slow one stage of a running ``PipelinedTrainer``.
+
+    ``revert()`` clears the injection on whatever actor currently holds
+    the stage slot — after a remediation respawn that is a fresh actor
+    which never saw the chaos, so revert degrades to a no-op."""
+
+    def __init__(self, trainer, stage: int,
+                 compute_delay_s: Optional[float] = None,
+                 recv_delay_s: Optional[float] = None,
+                 timeout: float = 30.0):
+        self.trainer = trainer
+        self.stage = stage
+        self.spec: Dict[str, float] = {}
+        if compute_delay_s:
+            self.spec["compute_delay_s"] = compute_delay_s
+        if recv_delay_s:
+            self.spec["recv_delay_s"] = recv_delay_s
+        self.timeout = timeout
+
+    def _push(self, spec: Optional[Dict[str, float]]) -> None:
+        import ray_tpu
+
+        ray_tpu.get(
+            self.trainer.stages[self.stage].inject_chaos.remote(spec),
+            timeout=self.timeout,
+        )
+
+    def apply(self) -> "SlowPipelineStage":
+        self._push(self.spec)
+        return self
+
+    def revert(self) -> None:
+        try:
+            self._push(None)
+        except Exception as e:  # noqa: BLE001 — slot may hold a fresh (clean) actor
+            logger.debug("SlowPipelineStage revert skipped: %s", e)
+
+
+class KilledStageActor(ChaosInjector):
+    """Kill a pipeline stage actor outright (one-shot; recovery is the
+    system's job, so ``revert`` is a no-op).  Repeated kills inside one
+    window are the restart-storm scenario."""
+
+    def __init__(self, trainer, stage: int):
+        self.trainer = trainer
+        self.stage = stage
+
+    def apply(self) -> "KilledStageActor":
+        import ray_tpu
+
+        ray_tpu.kill(self.trainer.stages[self.stage])
+        return self
+
+    def revert(self) -> None:
+        return None
+
+
+# --------------------------------------------------------------- serve chaos
+class OverloadedServeReplica(ChaosInjector):
+    """Closed-loop load: ``concurrency`` client threads each looping
+    ``request_fn()`` until reverted.  Request failures are counted, not
+    raised — overload chaos is allowed to shed."""
+
+    def __init__(self, request_fn: Callable[[], Any], concurrency: int = 4,
+                 name: str = "chaos-load"):
+        self.request_fn = request_fn
+        self.concurrency = concurrency
+        self.name = name
+        self.requests = 0
+        self.errors = 0
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._count_lock = threading.Lock()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.request_fn()
+                with self._count_lock:
+                    self.requests += 1
+            except Exception:  # noqa: BLE001 — shed under overload is expected
+                with self._count_lock:
+                    self.errors += 1
+                # Back off a beat so a hard-down target doesn't spin.
+                self._stop.wait(0.1)
+
+    def apply(self) -> "OverloadedServeReplica":
+        self._stop.clear()
+        self._threads = [
+            threading.Thread(target=self._loop, name=f"{self.name}-{i}",
+                             daemon=True)
+            for i in range(self.concurrency)
+        ]
+        for t in self._threads:
+            t.start()
+        return self
+
+    def revert(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=30)
+        self._threads = []
+
+
+# ---------------------------------------------------------- collective chaos
+class CollectiveFabricMember:
+    """One member of a simulated collective fabric, driven through the
+    REAL tuner → flight-recorder → SLO pipeline.
+
+    Each ``run_ops`` call asks the process-wide ``CollectiveTuner`` for
+    an algorithm (real selection: heuristic seed → exploration → commit
+    → decaying/forced re-probes), then models the transfer: a timed
+    memcpy plus a duration computed from the fabric's per-algorithm
+    bandwidth table, recorded via ``flight_recorder.record_collective``
+    and fed back with ``tuner.observe`` — exactly the feedback loop the
+    jax groups use.  A ``ThrottledCollectiveLink`` divides ONE
+    algorithm's bandwidth on ONE member, which is what a degraded link
+    looks like from that member's accounting.
+
+    Deploy as an actor (``ray_tpu.remote(CollectiveFabricMember)``) so
+    each member is its own process with its own tuner and metrics
+    payload — the member-granular view the drift rule compares."""
+
+    #: per-rank bandwidths (bytes/s) of the healthy fabric, per algorithm
+    DEFAULT_BANDWIDTH = {"flat": 2e8, "ring": 8e8, "tree": 6e8,
+                         "two_level": 5e8}
+
+    def __init__(self, op: str = "allreduce", world_size: int = 4,
+                 nbytes: int = 1 << 20,
+                 algo_bandwidth: Optional[Dict[str, float]] = None):
+        self.op = op
+        self.world_size = world_size
+        self.nbytes = nbytes
+        self.algo_bandwidth = dict(
+            self.DEFAULT_BANDWIDTH, **(algo_bandwidth or {})
+        )
+        self.throttle: Dict[str, float] = {}
+        self._buf = bytearray(min(nbytes, 1 << 16))
+
+    def set_throttle(self, algo: str, factor: Optional[float]) -> bool:
+        if factor is None:
+            self.throttle.pop(algo, None)
+        else:
+            self.throttle[algo] = float(factor)
+        return True
+
+    def run_ops(self, n: int = 1) -> str:
+        from ray_tpu.collective import algorithms as alg
+        from ray_tpu.collective.tuner import get_tuner
+        from ray_tpu.util import flight_recorder
+
+        tuner = get_tuner()
+        candidates = alg.candidates_for(self.op, self.world_size, None)
+        algo = ""
+        for _ in range(n):
+            decision = tuner.select(
+                self.op, self.nbytes, self.world_size, None, candidates
+            )
+            algo = decision["algo"]
+            bandwidth = self.algo_bandwidth.get(algo, 1e8)
+            bandwidth /= self.throttle.get(algo, 1.0)
+            # The modeled transfer: a real (small) memcpy so the op does
+            # work, with the fabric model supplying the duration.
+            bytes(self._buf)
+            duration = self.nbytes / bandwidth
+            flight_recorder.record_collective(
+                self.op, "chaos", self.nbytes, self.world_size, duration,
+                algo=algo, group="chaos_fabric",
+            )
+            tuner.observe(self.op, self.nbytes, self.world_size, None,
+                          algo, bandwidth=self.nbytes / duration)
+        return algo
+
+    def committed(self) -> Optional[str]:
+        """The tuner's committed algorithm for this member's bucket."""
+        from ray_tpu.collective.tuner import get_tuner
+
+        for row in get_tuner().stats().values():
+            if row["op"] == self.op and row["world_size"] == self.world_size:
+                return row["chosen"]
+        return None
+
+    def flush_metrics(self) -> bool:
+        """Push this member's registry to the cluster KV now (tests can
+        tighten the beat instead of waiting for the agent pull)."""
+        from ray_tpu.util import metrics as _metrics
+
+        _metrics.flush()
+        return True
+
+
+class ThrottledCollectiveLink(ChaosInjector):
+    """Degrade one fabric member's bandwidth for one algorithm by
+    ``factor`` (an actor handle to a ``CollectiveFabricMember``)."""
+
+    def __init__(self, member, algo: str, factor: float = 50.0,
+                 timeout: float = 30.0):
+        self.member = member
+        self.algo = algo
+        self.factor = factor
+        self.timeout = timeout
+
+    def apply(self) -> "ThrottledCollectiveLink":
+        import ray_tpu
+
+        ray_tpu.get(
+            self.member.set_throttle.remote(self.algo, self.factor),
+            timeout=self.timeout,
+        )
+        return self
+
+    def revert(self) -> None:
+        import ray_tpu
+
+        try:
+            ray_tpu.get(
+                self.member.set_throttle.remote(self.algo, None),
+                timeout=self.timeout,
+            )
+        except Exception as e:  # noqa: BLE001 — member may already be gone
+            logger.debug("ThrottledCollectiveLink revert skipped: %s", e)

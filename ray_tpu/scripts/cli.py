@@ -254,8 +254,13 @@ def cmd_timeline(args) -> int:
 def cmd_slo(args) -> int:
     """Evaluate the SLO/anomaly rules against the running cluster and
     print current violations (rate rules need two samples — the command
-    evaluates, waits ``--window``, and evaluates again)."""
+    evaluates, waits ``--window``, and evaluates again) plus the
+    remediation controller's state: actions taken, rate-limit and
+    quarantine status.  Exit codes: 0 clean, 1 violations found, 2 a
+    remediation target is QUARANTINED (the self-healing loop stopped
+    itself — a human is needed)."""
     import ray_tpu
+    from ..util import remediation as remediation_mod
     from ..util.slo import SloEngine
 
     if not ray_tpu.is_initialized():
@@ -266,17 +271,35 @@ def cmd_slo(args) -> int:
         time.sleep(args.window)
     violations = engine.evaluate()
     report = engine.report()
-    rc = 1 if violations else 0
+    remediation = remediation_mod.report_snapshot()
+    if remediation is not None:
+        report["remediation"] = remediation
+    quarantined = bool(remediation and remediation.get("quarantined"))
+    rc = 2 if quarantined else (1 if violations else 0)
     if args.json:
         print(json.dumps(report, indent=2))
         return rc
     if not violations:
         print(f"no SLO violations (rules: {', '.join(report['rules'])})")
-        return rc
-    print(_fmt_table(
-        [v.to_dict() for v in violations],
-        ["rule", "subject", "value", "threshold", "detail"],
-    ))
+    else:
+        print(_fmt_table(
+            [v.to_dict() for v in violations],
+            ["rule", "subject", "value", "threshold", "ongoing", "detail"],
+        ))
+    if remediation:
+        actions = remediation.get("actions") or []
+        if actions:
+            print("\nremediation actions (most recent last):")
+            print(_fmt_table(
+                actions[-20:],
+                ["rule", "action", "target", "outcome", "detail"],
+            ))
+        if quarantined:
+            print("\nQUARANTINED (remediation stopped itself; "
+                  "human attention needed):")
+            for target, entry in remediation["quarantined"].items():
+                print(f"  {target}: {entry.get('reason', '')} "
+                      f"[rule={entry.get('rule', '?')}]")
     return rc
 
 

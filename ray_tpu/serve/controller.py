@@ -674,6 +674,37 @@ class ServeController:
                 total = len(entry["replicas"]) + len(entry.get("draining", []))
             flight_recorder.record_serve_autoscale(name, direction, total)
 
+    def remediation_scale_up(self, name: str) -> Dict[str, Any]:
+        """SLO-remediation nudge: one replica up through the same
+        bookkeeping the reconcile-loop autoscaler uses (max_replicas
+        clamp, pressure-timer reset, state publish, autoscale-event
+        recording) — the remediation controller's queue-pressure
+        actuator.  Idempotent at the max: declines instead of
+        overshooting, so a finding re-delivered every beat cannot grow
+        the fleet past the deployment's own bound."""
+        from ray_tpu.util import flight_recorder
+
+        with self._lock:
+            entry = self.deployments.get(name)
+            if entry is None:
+                return {"scaled": False, "reason": f"unknown deployment {name!r}"}
+            cfg = entry.get("autoscaling") or _AUTOSCALE_DEFAULTS
+            current = len(entry["replicas"])
+            if current >= cfg["max_replicas"]:
+                return {"scaled": False, "replicas": current,
+                        "reason": f"at max_replicas={cfg['max_replicas']}"}
+            self._set_replica_count(entry, current + 1)
+            entry["scale_pressure_since"] = None
+            entry["last_scale_ts"] = time.monotonic()
+            self._publish_state(name)
+            total = len(entry["replicas"]) + len(entry.get("draining", []))
+        flight_recorder.record_serve_autoscale(name, "up", total)
+        logger.info(
+            "remediation scale-up: deployment %s %d -> %d replicas",
+            name, current, current + 1,
+        )
+        return {"scaled": True, "replicas": current + 1}
+
     # -------------------------------------------------------------- query API
     def get_replicas(self, name: str) -> List:
         entry = self.deployments.get(name)

@@ -477,6 +477,11 @@ class PipelineStage:
         self._inflight = 0
         self._abort = False
         self._inflight_lock = make_lock("pipeline-stage-inflight")
+        # Fault-injection state (devtools.chaos): lives on the ACTOR, so
+        # a remediation respawn-and-replace — a fresh actor in the
+        # bundle — clears it, the way replacing a sick process clears
+        # its sickness.  reset() deliberately does NOT clear it.
+        self._chaos: Dict[str, Any] = {}
 
     # ------------------------------------------------------------- wiring
     def rpc_address(self) -> str:
@@ -578,6 +583,20 @@ class PipelineStage:
     def ping(self) -> bool:
         return True
 
+    def inject_chaos(self, spec: Optional[Dict[str, Any]]) -> bool:
+        """``devtools.chaos`` hook; ``None`` (or ``{}``) reverts.
+
+        - ``{"compute_delay_s": s}`` — slow host: every forward op takes
+          ``s`` longer, landing in this stage's fwd histogram while its
+          PEERS accumulate the stall (the real slow-host signature: the
+          straggler rule flags a waiting victim, and the trainer's
+          actuator localizes the culprit by compute share — see
+          ``PipelinedTrainer._remediation_actuator``).
+        - ``{"recv_delay_s": s}`` — slow delivery: every neighbor-tensor
+          receive stalls ``s`` extra on this stage."""
+        self._chaos = dict(spec or {})
+        return True
+
     # ---------------------------------------------------------- execution
     @staticmethod
     def _edge_fwd(channel, v: int) -> str:
@@ -593,6 +612,11 @@ class PipelineStage:
     def _recv(self, channel, edge: str, seq):
         """Blocking recv in ~1s slices so a superseded step (reset() in
         progress) bails out promptly instead of holding the quiesce."""
+        delay = self._chaos.get("recv_delay_s")
+        if delay:
+            # Injected straggle (devtools.chaos): counted inside the
+            # caller's stall window, exactly like a real slow neighbor.
+            time.sleep(float(delay))
         deadline = time.monotonic() + self.cfg.recv_timeout_s
         while True:
             self._check_abort()
@@ -663,6 +687,10 @@ class PipelineStage:
                     mb, x, targets[mb] if chunk.is_last else None
                 )
                 self._block_until_ready(y)
+                if self._chaos.get("compute_delay_s"):
+                    # Injected slow host (devtools.chaos): lands in the
+                    # forward histogram like real slow compute.
+                    time.sleep(float(self._chaos["compute_delay_s"]))
                 dt = time.perf_counter() - t0
                 fwd_s += dt
                 flight_recorder.record_pipeline_op("F", self.stage, dt)
@@ -800,6 +828,11 @@ class PipelinedTrainer:
         self._restarts = 0
         # Last synchronized checkpoint: (step_to_resume_from, [blob/stage]).
         self._ckpt: Optional[tuple] = None
+        # SLO-remediation hook: a stage index flagged (from any thread)
+        # for respawn-and-replace; fit() honors it between steps via the
+        # same generation-fenced recovery path stage DEATH takes.
+        self._respawn_request: Optional[int] = None
+        self._last_step_stats: List[Dict[str, Any]] = []
 
     # ------------------------------------------------------------ topology
     def _create_stages(self):
@@ -920,9 +953,80 @@ class PipelinedTrainer:
         self._build_and_wire(dead)  # build() on replacements; wire() on all
         return self._restore_checkpoint()
 
+    # ------------------------------------------------------- remediation
+    def request_stage_respawn(self, stage_idx: int,
+                              reason: str = "") -> bool:
+        """Flag ``stage_idx`` for respawn-and-replace (a fresh actor in
+        its bundle, every stage rolled back to the last synchronized
+        checkpoint, generation fence bumped).  Callable from any thread
+        — the remediation controller's straggler actuator; fit() applies
+        it between steps."""
+        if not 0 <= stage_idx < self.cfg.num_stages:
+            return False
+        logger.warning(
+            "stage %d flagged for remediation respawn%s", stage_idx,
+            f" ({reason})" if reason else "",
+        )
+        self._respawn_request = stage_idx
+        return True
+
+    def _remediation_actuator(self, target: str, violation, **_kw) -> str:
+        """``pipeline_stage_respawn`` actuator (registered while fit()
+        runs): target is the SLO subject's ``stage=N``.
+
+        The straggler rule flags the stage with the high STALL — in a
+        barrier-synced pipeline that is the victim waiting on a slow
+        peer, not necessarily the culprit.  Before acting, localize the
+        culprit from the last step's per-stage compute times (fwd+bwd,
+        the signal the stall correlates against): respawn the stage
+        doing outsized compute if one stands out, else the flagged
+        stage itself."""
+        from ray_tpu.util.remediation import RemediationSkipped, subject_tags
+
+        stage = subject_tags(target).get("stage")
+        if stage is None or not stage.isdigit():
+            raise RemediationSkipped(f"unparseable stage target {target!r}")
+        victim = int(stage)
+        culprit, note = victim, ""
+        stats = self._last_step_stats
+        if stats and len(stats) == self.cfg.num_stages:
+            compute = [s.get("fwd_s", 0.0) + s.get("bwd_s", 0.0)
+                       for s in stats]
+            peak = max(range(len(compute)), key=compute.__getitem__)
+            peers = [c for i, c in enumerate(compute) if i != peak]
+            if peers and compute[peak] > 2.0 * max(
+                sum(peers) / len(peers), 1e-6
+            ):
+                culprit = peak
+                if culprit != victim:
+                    note = (f" (victim stage {victim}; culprit by compute "
+                            f"share: {compute[peak]:.3f}s vs peer mean "
+                            f"{sum(peers) / len(peers):.3f}s)")
+        if not self.request_stage_respawn(
+            culprit, reason=getattr(violation, "detail", "") or "slo"
+        ):
+            raise RemediationSkipped(f"no such stage {culprit}")
+        return (f"stage {culprit} respawn requested (applied between "
+                f"steps){note}")
+
+    def _apply_pending_respawn(self) -> Optional[int]:
+        """Honor a flagged respawn: kill the target stage, then run the
+        normal generation-fenced recovery.  Returns the resume step, or
+        None when nothing was pending."""
+        pending, self._respawn_request = self._respawn_request, None
+        if pending is None or not 0 <= pending < len(self.stages):
+            return None
+        logger.warning("remediation respawn: replacing stage %d", pending)
+        try:
+            ray_tpu.kill(self.stages[pending])
+        except Exception:  # raylint: waive[RTL003] already-dead target kill is best-effort
+            pass
+        return self._recover()
+
     # ----------------------------------------------------------------- fit
     def fit(self) -> Result:
         from ray_tpu.core.usage import record_library_usage
+        from ray_tpu.util import remediation
 
         record_library_usage("train.pipeline")
         cfg = self.cfg
@@ -933,7 +1037,85 @@ class PipelinedTrainer:
         metrics_history: List[Dict[str, Any]] = []
         attempts = 0
         step = 0
-        while step < self.num_steps:
+        actuator = remediation.register_actuator(
+            "pipeline_stage_respawn", self._remediation_actuator
+        )
+        try:
+            return self._fit_loop(
+                cfg, failure_cfg, step_timeout, metrics_history,
+                attempts, step,
+            )
+        finally:
+            remediation.unregister_actuator(actuator)
+
+    def _fit_loop(self, cfg, failure_cfg, step_timeout, metrics_history,
+                  attempts, step) -> Result:
+        def failed(e) -> Result:
+            return Result(
+                metrics=metrics_history[-1] if metrics_history else {},
+                checkpoint=None,
+                path=self._ckpt_dir(),
+                error=e,
+                metrics_history=metrics_history,
+            )
+
+        err = [None]
+
+        def recover_bounded():
+            """Bounded recovery: each attempt — including recoveries
+            interrupted by ANOTHER death (chaos soak: kills landing
+            mid-rebuild) — spends a failure attempt, so a kill loop
+            exhausts the budget instead of escaping the fence.  Returns
+            the resume step, or None when the budget is spent (the
+            caller returns the failed Result)."""
+            nonlocal attempts
+            while True:
+                attempts += 1
+                if attempts > max(0, failure_cfg.max_failures):
+                    return None
+                try:
+                    return self._recover()
+                except Exception as e2:  # noqa: BLE001 — death mid-recovery
+                    err[0] = e2
+
+        def rolled_back(new_step: int) -> int:
+            # The rolled-back steps will be re-run: drop their history
+            # entries so consumers never see duplicate step numbers.
+            metrics_history[:] = [
+                m for m in metrics_history if m["step"] < new_step
+            ]
+            return new_step
+
+        final_ckpt_done = False
+        while step < self.num_steps or not final_ckpt_done:
+            if step >= self.num_steps:
+                # Training done: the FINAL synchronized checkpoint is
+                # inside the fence too — a stage dying under it rolls
+                # back and re-runs the tail instead of escaping fit()
+                # as a raw exception.
+                try:
+                    self._save_checkpoint(self.num_steps)
+                    final_ckpt_done = True
+                    continue
+                except Exception as e:  # noqa: BLE001 — death racing the final checkpoint
+                    err[0] = e
+                    new_step = recover_bounded()
+                    if new_step is None:
+                        return failed(err[0])
+                    step = rolled_back(new_step)
+                    if step >= self.num_steps:
+                        continue  # checkpoint was current: retry it
+            try:
+                respawn_step = self._apply_pending_respawn()
+            except Exception as e:  # noqa: BLE001 — death racing the respawn
+                err[0] = e
+                respawn_step = recover_bounded()
+                if respawn_step is None:
+                    return failed(err[0])
+            if respawn_step is not None:
+                step = rolled_back(respawn_step)
+            # Outside the failure fence: a bad batch shape is a config
+            # error and must RAISE, not be "recovered".
             inputs, targets = self._microbatches(step)
             t_step = time.perf_counter()
             try:
@@ -956,24 +1138,15 @@ class PipelinedTrainer:
                         refs.append(s.run_step.remote(step, **kw))
                     stats = ray_tpu.get(refs, timeout=step_timeout)
             except Exception as e:  # noqa: BLE001 — stage death/step loss
-                attempts += 1
-                if attempts > max(0, failure_cfg.max_failures):
-                    return Result(
-                        metrics=metrics_history[-1] if metrics_history else {},
-                        checkpoint=None,
-                        path=self._ckpt_dir(),
-                        error=e,
-                        metrics_history=metrics_history,
-                    )
-                step = self._recover()
-                # The rolled-back steps will be re-run: drop their history
-                # entries so consumers never see duplicate step numbers.
-                metrics_history[:] = [
-                    m for m in metrics_history if m["step"] < step
-                ]
+                err[0] = e
+                new_step = recover_bounded()
+                if new_step is None:
+                    return failed(err[0])
+                step = rolled_back(new_step)
                 continue
             losses = stats[-1].get("losses") or []
             loss = sum(losses) / len(losses) if losses else float("nan")
+            self._last_step_stats = stats
             bubble = self._record_step_metrics(stats)
             metrics_history.append({
                 "step": step,
@@ -987,8 +1160,14 @@ class PipelinedTrainer:
                 cfg.checkpoint_every_n_steps
                 and step % cfg.checkpoint_every_n_steps == 0
             ):
-                self._save_checkpoint(step)
-        self._save_checkpoint(self.num_steps)
+                try:
+                    self._save_checkpoint(step)
+                except Exception as e:  # noqa: BLE001 — death racing the checkpoint
+                    err[0] = e
+                    new_step = recover_bounded()
+                    if new_step is None:
+                        return failed(err[0])
+                    step = rolled_back(new_step)
         return Result(
             metrics=metrics_history[-1] if metrics_history else {},
             checkpoint=None,

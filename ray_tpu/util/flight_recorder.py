@@ -85,6 +85,8 @@ from .metric_registry import (  # noqa: F401 — re-exports
     PG_COMMIT_BATCHES_TOTAL,
     PG_COMMIT_FUSED_TOTAL,
     PG_COMMIT_ROLLBACKS_TOTAL,
+    REMEDIATION_ACTIONS_TOTAL,
+    REMEDIATION_QUARANTINED,
     RPC_BATCH_FRAMES_TOTAL,
     RPC_BATCHED_CALLS_TOTAL,
     RPC_LANE_CONNECTIONS,
@@ -775,6 +777,20 @@ def record_llm_prefix_lookup(site: str, hit: bool, n: int = 1) -> None:
 
 def record_slo_violation(rule: str) -> None:
     counter(SLO_VIOLATIONS_TOTAL, 1.0, {"rule": rule})
+
+
+def record_remediation_action(rule: str, action: str, outcome: str) -> None:
+    """One remediation-controller decision: what rule fired, which
+    actuator was chosen, and what actually happened to it."""
+    counter(REMEDIATION_ACTIONS_TOTAL, 1.0,
+            {"rule": rule, "action": action, "outcome": outcome})
+
+
+def record_remediation_quarantine(count: int) -> None:
+    """Gauge of currently-quarantined remediation targets (updated on
+    every controller beat; nonzero means the reflex arc stopped itself
+    and a human should look)."""
+    gauge(REMEDIATION_QUARANTINED, float(count))
 
 
 # -------------------------------------------------------- scaling gauge

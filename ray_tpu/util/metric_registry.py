@@ -25,6 +25,10 @@ TRACE_SPANS_DROPPED_TOTAL = "ray_tpu_trace_spans_dropped_total"
 # --------------------------------------------- cluster observability plane
 SLO_VIOLATIONS_TOTAL = "ray_tpu_slo_violations_total"
 
+# -------------------------------------------------- self-healing remediation
+REMEDIATION_ACTIONS_TOTAL = "ray_tpu_remediation_actions_total"
+REMEDIATION_QUARANTINED = "ray_tpu_remediation_quarantined"
+
 # ------------------------------------------------- per-request serving SLO
 SERVE_TTFT_HIST = "ray_tpu_serve_ttft_s"
 SERVE_INTER_TOKEN_HIST = "ray_tpu_serve_inter_token_s"
@@ -166,6 +170,13 @@ METRICS: Dict[str, str] = {
     SLO_VIOLATIONS_TOTAL: "SLO/anomaly rule findings, by rule "
                           "(straggler, bandwidth drift, restart storm, "
                           "queue pressure)",
+    REMEDIATION_ACTIONS_TOTAL: "remediation-controller decisions, by "
+                               "rule/action/outcome (applied, skipped, "
+                               "failed, rate_limited, quarantined, "
+                               "no_actuator)",
+    REMEDIATION_QUARANTINED: "targets currently quarantined by the "
+                             "remediation controller (gauge; nonzero "
+                             "means a human is needed)",
     SERVE_TTFT_HIST: "serving time-to-first-result per deployment/"
                      "replica (histogram; full latency for unary "
                      "requests)",

@@ -118,14 +118,13 @@ def collective_view(snapshot: Optional[Dict[str, dict]] = None) -> Dict[str, dic
     return {"ops": ops, "groups": groups, "algorithms": algos}
 
 
-def per_worker_collective_bandwidth(
+def per_worker_collective_totals(
     payloads: Optional[Dict[str, dict]] = None,
-) -> Dict[str, Dict[str, float]]:
-    """Per-process mean achieved collective bandwidth by op (warm
-    samples only, count-weighted across tag sets):
-    ``{worker_key: {op: mean_bytes_per_s}}``.  Feeds the
-    bandwidth-drift SLO rule — a member whose mean sits far below the
-    committed algorithm's cluster mean is the slow link."""
+) -> Dict[str, Dict[str, tuple]]:
+    """Per-process cumulative achieved-bandwidth totals by op (warm
+    samples only, summed across tag sets):
+    ``{worker_key: {op: (bandwidth_sum, sample_count)}}``.  The
+    bandwidth-drift SLO rule windows these cumulative series itself."""
     if payloads is None:
         payloads = per_worker_metric_payloads()
     acc: Dict[str, Dict[str, list]] = {}
@@ -144,7 +143,7 @@ def per_worker_collective_bandwidth(
             cell[0] += ent.get("sum", 0.0)
             cell[1] += ent["count"]
     return {
-        key: {op: s / c for op, (s, c) in row.items() if c}
+        key: {op: (s, c) for op, (s, c) in row.items() if c}
         for key, row in acc.items()
     }
 

@@ -53,6 +53,16 @@ class SloViolation:
     threshold: float  # the bound it crossed
     detail: str
     ts: float = 0.0
+    # Incident identity (filled by the engine's dedupe pass): the same
+    # sustained condition re-found on a later beat is ``ongoing``, not a
+    # new incident — counters and remediation key off this.
+    first_seen: float = 0.0
+    ongoing: bool = False
+    severity: str = "warning"
+
+    @property
+    def fingerprint(self) -> tuple:
+        return (self.rule, self.subject)
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -120,25 +130,69 @@ def _median(vals: List[float]) -> float:
     return (vals[mid - 1] + vals[mid]) / 2.0
 
 
+class _DeltaWindow:
+    """Sliding-window deltas over cumulative (count, sum) series.
+
+    Each key's history is seeded with a zero baseline, so the FIRST
+    judgement covers all history (one-shot ``cli slo`` evaluations keep
+    working); once real snapshots age past ``window_s`` the delta
+    becomes a true recent window — which is what lets a condition that
+    has been REMEDIATED read as recovered instead of being dragged down
+    forever by its cumulative past."""
+
+    def __init__(self, window_s: float):
+        self.window_s = window_s
+        self._history: Dict[Any, deque] = {}
+
+    def update(self, key, now: float, count: float, total: float) -> tuple:
+        """Append one cumulative snapshot; return (d_count, d_sum) vs the
+        newest baseline at least ``window_s`` old (or the zero seed)."""
+        hist = self._history.setdefault(key, deque([(now, 0, 0.0)]))
+        hist.append((now, count, total))
+        while len(hist) >= 2 and now - hist[1][0] >= self.window_s:
+            hist.popleft()
+        _ts, base_count, base_sum = hist[0]
+        return count - base_count, total - base_sum
+
+    def prune(self, live_keys) -> None:
+        for key in [k for k in self._history if k not in live_keys]:
+            del self._history[key]
+
+
 class PipelineStragglerRule:
     """A stage whose mean stall exceeds ``ratio`` × the median of its
     peers (with enough samples to mean anything) is a straggler —
-    either its own compute is slow or its neighbor is starving it."""
+    either its own compute is slow or its neighbor is starving it.
+
+    Judged over a sliding ``window_s`` of NEW samples (first sight
+    judges all history): stall histograms are cumulative, and without
+    the window a stage that was remediated would wear its bad past
+    forever."""
 
     name = "pipeline_straggler"
 
     def __init__(self, ratio: float = 3.0, min_samples: int = 3,
-                 min_stall_s: float = 0.05):
+                 min_stall_s: float = 0.05, window_s: float = 60.0):
         self.ratio = ratio
         self.min_samples = min_samples
         self.min_stall_s = min_stall_s
+        self._window = _DeltaWindow(window_s)
 
     def evaluate(self, view: MetricView, now: float) -> List[SloViolation]:
-        stages = {
+        cum = {
             k: v for k, v in
             view.hist_stats(PIPELINE_STAGE_STALL_HIST, "stage").items()
-            if k != "all" and v["count"] >= self.min_samples
+            if k != "all"
         }
+        self._window.prune(cum)
+        stages = {}
+        for stage, row in cum.items():
+            d_count, d_sum = self._window.update(
+                stage, now, row["count"], row["sum"]
+            )
+            if d_count >= self.min_samples:
+                stages[stage] = {"count": d_count,
+                                 "mean": d_sum / d_count}
         if len(stages) < 2:
             return []
         out = []
@@ -153,7 +207,8 @@ class PipelineStragglerRule:
                     self.name, f"stage={stage}", row["mean"],
                     self.ratio * baseline,
                     f"mean stall {row['mean']:.3f}s vs peer median "
-                    f"{baseline:.3f}s over {row['count']} steps", now,
+                    f"{baseline:.3f}s over {row['count']} recent steps",
+                    now,
                 ))
         return out
 
@@ -165,20 +220,33 @@ class CollectiveBandwidthDriftRule:
 
     name = "collective_bw_drift"
 
-    def __init__(self, frac: float = 0.5, min_members: int = 2):
+    def __init__(self, frac: float = 0.5, min_members: int = 2,
+                 window_s: float = 60.0, min_samples: int = 1):
         self.frac = frac
         self.min_members = min_members
+        self.min_samples = min_samples
+        self._window = _DeltaWindow(window_s)
 
     def evaluate(self, view: MetricView, now: float) -> List[SloViolation]:
-        # Per-member means come from the per-process payloads (the
+        # Per-member totals come from the per-process payloads (the
         # merged histogram can't see members); the merge itself lives in
-        # obs so drift math exists once.
+        # obs so drift math exists once.  Judged over a sliding window
+        # of NEW samples (first sight judges history) so a re-tuned
+        # member's recovered bandwidth actually clears the finding.
+        totals = _obs.per_worker_collective_totals(view.per_worker)
+        live = {
+            (member, op)
+            for member, ops in totals.items() for op in ops
+        }
+        self._window.prune(live)
         by_member: Dict[str, Dict[str, float]] = {}
-        for member, ops in _obs.per_worker_collective_bandwidth(
-            view.per_worker
-        ).items():
-            for op, mean in ops.items():
-                by_member.setdefault(op, {})[member] = mean
+        for member, ops in totals.items():
+            for op, (bw_sum, count) in ops.items():
+                d_count, d_sum = self._window.update(
+                    (member, op), now, count, bw_sum
+                )
+                if d_count >= self.min_samples:
+                    by_member.setdefault(op, {})[member] = d_sum / d_count
         out = []
         for op, members in by_member.items():
             if len(members) < self.min_members:
@@ -320,12 +388,31 @@ def default_rules() -> List[Any]:
 class SloEngine:
     """Evaluates the rule set against the aggregated stream; keeps the
     last findings for the ``/api/slo`` endpoint and bumps
-    ``ray_tpu_slo_violations_total{rule}`` per finding."""
+    ``ray_tpu_slo_violations_total{rule}`` once per INCIDENT.
+
+    Incident dedupe: findings are fingerprinted by (rule, subject); the
+    same sustained condition re-found on later beats is marked
+    ``ongoing`` (with its original ``first_seen``) instead of counting
+    as a fresh violation every evaluation — so the counter measures
+    incidents, not beats, and consumers (``/api/slo``, the remediation
+    controller) can tell a new fire from a burning one.  An incident
+    clears as soon as an evaluation no longer finds it."""
 
     def __init__(self, rules: Optional[List[Any]] = None):
         self.rules = default_rules() if rules is None else list(rules)
         self.last_violations: List[SloViolation] = []
         self.evaluations = 0
+        # fingerprint -> {rule, subject, first_seen, last_seen, beats}
+        self.incidents: Dict[tuple, Dict[str, Any]] = {}
+        # Evaluations are serialized: the process-wide engine is hit
+        # from the dashboard's request executor AND the remediation beat
+        # thread, and rule window/sustain state plus the incident table
+        # are not safe under interleaved sweeps (double-counted
+        # incidents would also reset first_seen and defeat the
+        # remediation sustain gate).
+        from .debug_locks import make_lock
+
+        self._eval_lock = make_lock("slo.engine.evaluate")
 
     def evaluate(self, merged: Optional[Dict[str, dict]] = None,
                  per_worker: Optional[Dict[str, dict]] = None,
@@ -342,20 +429,40 @@ class SloEngine:
             merged = _obs.merged_from_payloads(per_worker)
         view = MetricView(merged, per_worker)
         now = time.time() if now is None else now
-        out: List[SloViolation] = []
-        for rule in self.rules:
-            try:
-                out.extend(rule.evaluate(view, now))
-            except Exception:  # noqa: BLE001 — one bad rule must not kill the sweep
-                from . import flight_recorder
-
-                flight_recorder.count_suppressed("slo_rule")
         from . import flight_recorder
 
-        for v in out:
-            flight_recorder.record_slo_violation(v.rule)
-        self.evaluations += 1
-        self.last_violations = out
+        # The KV fetch above stays outside the lock; the sweep and the
+        # incident table mutate shared state and are serialized.
+        with self._eval_lock:
+            out: List[SloViolation] = []
+            for rule in self.rules:
+                try:
+                    out.extend(rule.evaluate(view, now))
+                except Exception:  # noqa: BLE001 — one bad rule must not kill the sweep
+                    flight_recorder.count_suppressed("slo_rule")
+            seen = set()
+            for v in out:
+                fp = v.fingerprint
+                seen.add(fp)
+                inc = self.incidents.get(fp)
+                if inc is None:
+                    inc = self.incidents[fp] = {
+                        "rule": v.rule, "subject": v.subject,
+                        "first_seen": now, "beats": 0,
+                    }
+                    # One count per incident, not per beat.
+                    flight_recorder.record_slo_violation(v.rule)
+                inc["beats"] += 1
+                inc["last_seen"] = now
+                inc["value"] = v.value
+                v.first_seen = inc["first_seen"]
+                v.ongoing = inc["beats"] > 1
+                if v.rule == RestartStormRule.name:
+                    v.severity = "critical"  # a crash loop is never routine
+            for fp in [f for f in self.incidents if f not in seen]:
+                del self.incidents[fp]
+            self.evaluations += 1
+            self.last_violations = out
         return out
 
     def report(self) -> Dict[str, Any]:
@@ -364,6 +471,7 @@ class SloEngine:
             "evaluations": self.evaluations,
             "rules": [r.name for r in self.rules],
             "violations": [v.to_dict() for v in self.last_violations],
+            "incidents": [dict(i) for i in self.incidents.values()],
         }
 
 
