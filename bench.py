@@ -84,6 +84,17 @@ def _load1():
         return -1.0
 
 
+def _rss_mb():
+    try:
+        import resource
+
+        return round(
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0, 1
+        )
+    except Exception:  # noqa: BLE001 — non-Linux fallback
+        return -1.0
+
+
 def quiesce(settle_s=0.25, timeout=60.0):
     """Pre-stage drain, pinned in the harness (not in hand-run
     validation): block until the cluster is quiet — no queued lease
@@ -114,12 +125,24 @@ def quiesce(settle_s=0.25, timeout=60.0):
 def best_of(trials, fn):
     """Best-of-N timed windows with a pinned pre-stage quiesce; the trial
     spread rides the record so a contended window is visible in the
-    artifact instead of masquerading as a slow runtime."""
+    artifact instead of masquerading as a slow runtime.  A spread above
+    15% means the window itself was contended — rerun the whole stage
+    ONCE (tagged ``reran`` so the artifact shows it) rather than
+    shipping a number the spread already impeaches."""
     quiesce()
     vals = [fn() for _ in range(trials)]
     best = max(vals)
+    spread = (best - min(vals)) / best if best else 0.0
+    if best and spread > 0.15:
+        quiesce()
+        vals = [fn() for _ in range(trials)]
+        rerun_best = max(vals)
+        if rerun_best:
+            best = rerun_best
+            spread = (best - min(vals)) / best
+        _STAGE_EXTRA["reran"] = True
     if best:
-        _STAGE_EXTRA["spread"] = round((best - min(vals)) / best, 3)
+        _STAGE_EXTRA["spread"] = round(spread, 3)
     return best
 
 
@@ -134,6 +157,11 @@ def emit(metric, value, unit, baseline=None, **extra):
         "vs_baseline": (
             round(float(value) / baseline, 3) if baseline else None
         ),
+        # Every record defends itself: the host-contention snapshot at
+        # emit time rides along, so a slow number on a loaded box reads
+        # as "loaded box", not "slow runtime".
+        "load1": _load1(),
+        "rss_mb": _rss_mb(),
         **extra,
     }
     if metric in FLEET_BASELINE_METRICS:
@@ -158,13 +186,19 @@ def emit_summary():
         return
     summary = {}
     vs = {}
+    spread = {}
     for rec in _ALL_RECORDS:
         v = rec["value"]
         summary[rec["metric"]] = round(v, 1) if abs(v) >= 100 else round(v, 4)
         if rec.get("vs_baseline") is not None:
             vs[rec["metric"]] = rec["vs_baseline"]
+        if rec.get("spread") is not None:
+            spread[rec["metric"]] = rec["spread"]
     print(
-        json.dumps({"summary": summary, "vs": vs}, separators=(",", ":")),
+        json.dumps(
+            {"summary": summary, "vs": vs, "spread": spread},
+            separators=(",", ":"),
+        ),
         flush=True,
     )
 
@@ -1658,6 +1692,223 @@ def run_pipeline_suite():
         )
 
 
+def run_fairness_suite():
+    """Multi-tenant arbitration end-to-end (docs/scheduling.md): a
+    low-priority trainer and a serve replica share one box under a job
+    quota; mid-window a high-priority burst group that cannot otherwise
+    place preempts the trainer through the REAL scheduler path
+    (checkpoint-then-evict via the node agent), serves the burst, and
+    once the burst is removed the trainer's group auto-resumes and the
+    driver restores it from the checkpoint the eviction parked in the
+    cluster KV.  Train and serve throughput are measured in ONE
+    interleaved window (the PR-8/9 pattern — this box swings ~2x
+    between windows): per-phase, per-job rates are the fairness
+    artifact, and ``fairness_params_bit_identical`` pins loss parity
+    (the same invariant tests/test_sched_preemption_chaos.py asserts)."""
+    import pickle
+    import threading
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import (
+        placement_group,
+        placement_group_strategy,
+        remove_placement_group,
+    )
+    from ray_tpu.core.core_worker import global_worker
+
+    DIM, LR = 64, 0.05
+
+    def reference_params(n_steps):
+        params = np.zeros(DIM, dtype=np.float64)
+        for s in range(n_steps):
+            params = params + LR * np.random.RandomState(s).standard_normal(DIM)
+        return params
+
+    @ray_tpu.remote
+    class Trainer:
+        # Params are a pure function of the step counter, so a
+        # checkpoint-restored run is bit-identical to an uninterrupted
+        # one — any divergence is a real arbitration bug, not noise.
+        def __init__(self):
+            self.step_n = 0
+            self.params = np.zeros(DIM, dtype=np.float64)
+
+        def step(self):
+            rng = np.random.RandomState(self.step_n)
+            self.params = self.params + LR * rng.standard_normal(DIM)
+            self.step_n += 1
+            return self.step_n
+
+        def state(self):
+            return pickle.dumps((self.step_n, self.params))
+
+        def load_state(self, blob):
+            self.step_n, self.params = pickle.loads(blob)
+            return self.step_n
+
+        def prepare_evict(self):
+            return self.state()
+
+    @ray_tpu.remote
+    class Replica:
+        def handle(self, x):
+            return x + 1
+
+    # 5 CPUs total: train group holds 2, the serve replica 1, leaving 2
+    # free — the priority-1000 burst group below needs 3, so the ONLY
+    # way it places is by preempting the priority-10 training group.
+    # Prestarted workers keep the measured resume latency about the
+    # scheduler (heartbeat + re-place + restore), not process spawn.
+    ray_tpu.init(
+        num_cpus=5,
+        job_quota={"CPU": 16},
+        _system_config={"prestart_workers": 4},
+    )
+    burst_pg = None
+    try:
+        train_pg = placement_group(
+            [{"CPU": 2}], name="bench-train", priority=10
+        )
+        assert train_pg.ready(timeout=30)
+        trainer = Trainer.options(
+            scheduling_strategy=placement_group_strategy(train_pg, 0),
+            max_restarts=4,
+        ).remote()
+        replica = Replica.remote()
+        ray_tpu.get(replica.handle.remote(0))
+
+        w = global_worker()
+        trainer_hex = trainer._actor_id.hex()
+        stop = threading.Event()
+        train_log = []  # (wall_t, step_n) per successful step
+        serve_log = []  # wall_t per successful request
+        marks = {}
+
+        def train_loop():
+            last = 0
+            while not stop.is_set():
+                try:
+                    # Short timeout: a ref submitted to the dying
+                    # incarnation may never resolve — re-probe quickly so
+                    # the measured resume latency is the scheduler's, not
+                    # this loop's.
+                    n = ray_tpu.get(trainer.step.remote(), timeout=2)
+                except Exception:  # noqa: BLE001 — evicted / restarting
+                    time.sleep(0.1)
+                    continue
+                if n < last:
+                    # Fresh incarnation: restore the checkpoint the
+                    # eviction parked in the cluster KV, then continue.
+                    try:
+                        blob = w._run_sync(w.cp.call(
+                            "kv_get",
+                            {"namespace": "eviction", "key": trainer_hex},
+                        ))
+                        if blob:
+                            n = ray_tpu.get(
+                                trainer.load_state.remote(blob), timeout=10
+                            )
+                            marks.setdefault("restored_t", time.time())
+                    except Exception:  # noqa: BLE001 — retry next step
+                        time.sleep(0.1)
+                        continue
+                last = n
+                train_log.append((time.time(), n))
+
+        def serve_loop():
+            while not stop.is_set():
+                handles = [replica] + (
+                    [marks["burst_replica"]] if "burst_replica" in marks
+                    else []
+                )
+                try:
+                    refs = [h.handle.remote(1) for h in handles]
+                    ray_tpu.get(refs, timeout=10)
+                    serve_log.extend([time.time()] * len(refs))
+                except Exception:  # noqa: BLE001 — burst replica racing
+                    time.sleep(0.1)
+
+        quiesce()
+        threads = [
+            threading.Thread(target=train_loop, daemon=True),
+            threading.Thread(target=serve_loop, daemon=True),
+        ]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        time.sleep(3.0)  # phase 1: train + serve coexist under quota
+
+        marks["burst_start"] = time.time()
+        burst_pg = placement_group(
+            [{"CPU": 3}], name="bench-burst", priority=1000
+        )
+        assert burst_pg.ready(timeout=30), "burst group failed to preempt"
+        marks["burst_placed"] = time.time()
+        marks["burst_replica"] = Replica.options(
+            scheduling_strategy=placement_group_strategy(burst_pg, 0),
+        ).remote()
+        time.sleep(3.0)  # phase 2: burst serves, training is evicted
+
+        marks.pop("burst_replica")
+        remove_placement_group(burst_pg)
+        burst_pg = None
+        marks["burst_removed"] = time.time()
+        time.sleep(6.0)  # phase 3: training auto-resumes from checkpoint
+        stop.set()
+        for t in threads:
+            t.join(timeout=15)
+        t_end = time.time()
+
+        def rate(log, lo, hi, stamp=lambda e: e):
+            n = sum(1 for e in log if lo <= stamp(e) < hi)
+            return n / max(hi - lo, 1e-9)
+
+        b0, b1 = marks["burst_start"], marks["burst_removed"]
+        emit("fairness_serve_rps_solo", rate(serve_log, t0, b0), "req/s")
+        emit(
+            "fairness_serve_rps_burst", rate(serve_log, b0, b1), "req/s",
+            burst_place_s=round(marks["burst_placed"] - b0, 3),
+        )
+        emit(
+            "fairness_train_steps_per_s_pre",
+            rate(train_log, t0, b0, stamp=lambda e: e[0]), "steps/s",
+        )
+        emit(
+            "fairness_train_steps_per_s_post",
+            rate(train_log, b1, t_end, stamp=lambda e: e[0]), "steps/s",
+        )
+        resumed = marks.get("restored_t")
+        emit(
+            "fairness_preempt_resume_s",
+            (resumed - b1) if resumed else -1.0, "s",
+        )
+        final_step, final_params = pickle.loads(
+            ray_tpu.get(trainer.state.remote(), timeout=30)
+        )
+        identical = (
+            final_params.tobytes() == reference_params(final_step).tobytes()
+        )
+        emit(
+            "fairness_params_bit_identical", 1.0 if identical else 0.0,
+            "bool", guard="==1", steps=final_step,
+        )
+        if not identical:
+            print(
+                "# fairness_params_bit_identical GUARD MISSED: resumed "
+                "params diverge from the uninterrupted reference",
+                flush=True,
+            )
+    finally:
+        if burst_pg is not None:
+            try:
+                remove_placement_group(burst_pg)
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        ray_tpu.shutdown()
+
+
 def run_rl_suite(quick=False):
     """Podracer RL throughput (ray_tpu.rllib.podracer.bench_rl).  Emits
     Anakin env-steps/s scaling across 1→8 devices, the Sebulba learner
@@ -1755,6 +2006,8 @@ def main():
             run("data", run_data_suite)
         if only in ("all", "pipeline"):
             run("pipeline", run_pipeline_suite)
+        if only in ("all", "fairness"):
+            run("fairness", run_fairness_suite)
         if only in ("all", "collective"):
             run("collective", lambda: run_collective_suite(quick=quick))
         if only in ("all", "rl"):

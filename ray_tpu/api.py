@@ -45,6 +45,8 @@ def init(
     num_cpus: Optional[float] = None,
     resources: Optional[Dict[str, float]] = None,
     labels: Optional[Dict[str, str]] = None,
+    job_priority: Optional[int] = None,
+    job_quota: Optional[Dict[str, float]] = None,
     _system_config: Optional[Dict[str, Any]] = None,
 ) -> "ClientContext":
     """Start a local cluster (head) or connect to an existing one.
@@ -52,6 +54,12 @@ def init(
     ``address``: None → start head locally; "auto" → discover local head;
     "host:port" → connect to that control plane (starts a local node agent
     for this machine if none is known).
+
+    ``job_priority``/``job_quota``: multi-tenant arbitration inputs for
+    this driver's job — higher priority may checkpoint-then-evict
+    lower-priority placement groups when chips are contended; quota caps
+    the job's durable reservations per resource (over-quota requests
+    queue instead of failing).  See ``docs/scheduling.md``.
 
     .. note:: ``init()`` calls ``gc.collect()`` + ``gc.freeze()`` (a ~3x
        win on sequential call throughput — see the comment at the call
@@ -116,6 +124,8 @@ def init(
         session_id,
         NodeID.from_random(),
         job_id=JobID.from_random(),
+        job_priority=job_priority,
+        job_quota=job_quota,
     )
     worker.start_threaded()
     set_global_worker(worker)

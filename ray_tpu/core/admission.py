@@ -1,0 +1,226 @@
+"""Multi-tenant job arbitration: priority, quota admission, preemption budget.
+
+The control plane owns one :class:`JobArbiter`.  It tracks, per job:
+
+  - **priority** (int, higher = more important; default
+    ``sched_default_priority``) — carried on job registration, resolved
+    per actor/placement-group request (a request-level ``priority``
+    overrides the job's), and consulted by the preemption path: a bundle
+    may only evict strictly-lower-priority victims.
+  - **quota** (resource → quantity; empty = unlimited) — enforced at
+    admission time against the job's *durable* reservations (live actors
+    and CREATED placement-group bundles).  Over-quota requests queue
+    (stay PENDING) instead of failing, and are retried by the regular
+    scheduling sweeps as usage drains.
+  - **preemption budget** — a token bucket (capacity
+    ``sched_preemption_burst``, one refill per
+    ``sched_preemption_cooldown_s``) spent one token per evicted victim,
+    with a quarantine (``sched_preemption_quarantine_s``) once drained:
+    a crash-looping high-priority job can evict at most a burst's worth
+    of victims, then loses the *privilege to preempt* (never the right
+    to run) until the quarantine lapses.
+
+Charges are **keyed and idempotent** (``("actor", id)`` / ``("pg", id)``)
+so control-plane recovery can blindly re-charge everything it recovers
+from sqlite — a charge replayed for a key already held is a no-op, which
+is what makes quota accounting immune to double-counting across restart.
+
+Pure bookkeeping, no IO — the control plane calls in under its own loop.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Set, Tuple
+
+from .config import GlobalConfig
+from .resources import ResourceSet
+from ..util.remediation import _TokenBucket
+
+
+class JobArbiter:
+    def __init__(self):
+        # job_id hex -> {"priority": int, "quota": {resource: float}}
+        self._jobs: Dict[str, Dict[str, Any]] = {}
+        # charge key -> (job hex, ResourceSet)
+        self._charges: Dict[Tuple[str, str], Tuple[str, ResourceSet]] = {}
+        # job hex -> aggregate charged usage
+        self._usage: Dict[str, ResourceSet] = {}
+        # admission queueing visibility: live set + cumulative counter
+        self._queued_keys: Dict[Tuple[str, str], str] = {}
+        self._queued_total: Dict[str, int] = {}
+        # preemption budget
+        self._buckets: Dict[str, _TokenBucket] = {}
+        self._quarantined_until: Dict[str, float] = {}
+        self.preemptions_total = 0
+        self.victims_total = 0
+        self.denied_total = 0
+
+    # ------------------------------------------------------------------ jobs
+    def register_job(self, job_hex: str, priority: Optional[int] = None,
+                     quota: Optional[Dict[str, float]] = None) -> int:
+        """Idempotent: re-registration (driver heartbeat re-register, CP
+        recovery replay) updates priority/quota in place, keeps charges."""
+        entry = self._jobs.setdefault(job_hex, {})
+        if priority is not None or "priority" not in entry:
+            entry["priority"] = (
+                int(priority) if priority is not None
+                else GlobalConfig.sched_default_priority
+            )
+        if quota is not None or "quota" not in entry:
+            entry["quota"] = {
+                k: float(v) for k, v in (quota or {}).items()
+            }
+        return entry["priority"]
+
+    def forget_job(self, job_hex: str) -> None:
+        self._jobs.pop(job_hex, None)
+        self._buckets.pop(job_hex, None)
+        self._quarantined_until.pop(job_hex, None)
+        self._queued_total.pop(job_hex, None)
+        for key in [k for k, j in self._queued_keys.items() if j == job_hex]:
+            del self._queued_keys[key]
+        for key in [
+            k for k, (j, _r) in self._charges.items() if j == job_hex
+        ]:
+            self.release(key)
+
+    def priority_of(self, job_hex: Optional[str],
+                    override: Optional[int] = None) -> int:
+        if override is not None:
+            return int(override)
+        if job_hex and job_hex in self._jobs:
+            return self._jobs[job_hex]["priority"]
+        return GlobalConfig.sched_default_priority
+
+    def quota_of(self, job_hex: str) -> Dict[str, float]:
+        entry = self._jobs.get(job_hex)
+        return dict(entry["quota"]) if entry else {}
+
+    # ------------------------------------------------------------- admission
+    def admit(self, job_hex: Optional[str], request: ResourceSet) -> bool:
+        """True when charging ``request`` would keep the job within quota.
+        Only resources *named in the quota* are bounded; everything else
+        is unlimited (quota is an allow-list of caps, not a full spec)."""
+        if not job_hex:
+            return True
+        entry = self._jobs.get(job_hex)
+        if not entry or not entry["quota"]:
+            return True
+        usage = self._usage.get(job_hex)
+        used = usage.to_dict() if usage else {}
+        want = request.to_dict()
+        for resource, cap in entry["quota"].items():
+            if used.get(resource, 0.0) + want.get(resource, 0.0) > cap + 1e-9:
+                return False
+        return True
+
+    def charge(self, key: Tuple[str, str], job_hex: Optional[str],
+               request: ResourceSet) -> None:
+        """Idempotent by key: recovery replay cannot double-count."""
+        if not job_hex or key in self._charges:
+            return
+        self._charges[key] = (job_hex, request)
+        held = self._usage.get(job_hex)
+        self._usage[job_hex] = request if held is None else held + request
+        self.unmark_queued(key)
+
+    def release(self, key: Tuple[str, str]) -> None:
+        held = self._charges.pop(key, None)
+        if held is None:
+            return
+        job_hex, request = held
+        usage = self._usage.get(job_hex)
+        if usage is not None:
+            self._usage[job_hex] = usage - request
+
+    def is_charged(self, key: Tuple[str, str]) -> bool:
+        return key in self._charges
+
+    def usage_of(self, job_hex: str) -> Dict[str, float]:
+        usage = self._usage.get(job_hex)
+        return usage.to_dict() if usage else {}
+
+    def mark_queued(self, key: Tuple[str, str], job_hex: str) -> None:
+        if key not in self._queued_keys:
+            self._queued_keys[key] = job_hex
+            self._queued_total[job_hex] = self._queued_total.get(job_hex, 0) + 1
+
+    def unmark_queued(self, key: Tuple[str, str]) -> None:
+        self._queued_keys.pop(key, None)
+
+    def note_queued_event(self, job_hex: str) -> None:
+        """Count a transient (keyless) admission queueing — task leases
+        have no durable identity to mark/unmark."""
+        self._queued_total[job_hex] = self._queued_total.get(job_hex, 0) + 1
+
+    # ------------------------------------------------- preemption budget
+    def can_preempt(self, job_hex: str, now: float) -> Tuple[bool, str]:
+        """Non-spending probe: quarantine check only."""
+        until = self._quarantined_until.get(job_hex, 0.0)
+        if now < until:
+            return False, f"quarantined for {until - now:.1f}s"
+        return True, ""
+
+    def spend_preemption(self, job_hex: str, victims: int,
+                         now: float) -> Tuple[bool, str]:
+        """Spend one token per victim, all-or-nothing.  A denial for an
+        empty bucket starts the quarantine — the crash-loop signature is
+        exactly 'drained the burst, immediately asking for more'."""
+        ok, reason = self.can_preempt(job_hex, now)
+        if not ok:
+            self.denied_total += 1
+            return False, reason
+        bucket = self._buckets.get(job_hex)
+        if bucket is None:
+            cooldown = max(GlobalConfig.sched_preemption_cooldown_s, 1e-3)
+            bucket = _TokenBucket(
+                GlobalConfig.sched_preemption_burst, 1.0 / cooldown
+            )
+            self._buckets[job_hex] = bucket
+        taken = 0
+        for _ in range(max(1, victims)):
+            if not bucket.take(now):
+                # Refund the partial spend and quarantine.
+                bucket.tokens = min(
+                    float(bucket.capacity), bucket.tokens + taken
+                )
+                self._quarantined_until[job_hex] = (
+                    now + GlobalConfig.sched_preemption_quarantine_s
+                )
+                self.denied_total += 1
+                return False, "preemption budget exhausted (quarantined)"
+            taken += 1
+        self.preemptions_total += 1
+        self.victims_total += victims
+        return True, ""
+
+    # ------------------------------------------------------------- surfacing
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Per-job arbitration state for cli status / /api/cluster."""
+        jobs: Set[str] = set(self._jobs) | set(self._usage)
+        jobs |= set(self._queued_keys.values())
+        # Jobs known only through their preemption budget (e.g. the
+        # remediation pseudo-job) must surface too — a quarantine nobody
+        # can see cannot be diagnosed.
+        jobs |= set(self._buckets) | set(self._quarantined_until)
+        out: Dict[str, Dict[str, Any]] = {}
+        for job_hex in sorted(jobs):
+            entry = self._jobs.get(job_hex, {})
+            bucket = self._buckets.get(job_hex)
+            out[job_hex] = {
+                "priority": entry.get(
+                    "priority", GlobalConfig.sched_default_priority
+                ),
+                "quota": dict(entry.get("quota", {})),
+                "usage": self.usage_of(job_hex),
+                "queued_now": sum(
+                    1 for j in self._queued_keys.values() if j == job_hex
+                ),
+                "queued_total": self._queued_total.get(job_hex, 0),
+                "preempt_tokens": (
+                    bucket.tokens if bucket is not None
+                    else float(GlobalConfig.sched_preemption_burst)
+                ),
+                "quarantined_until": self._quarantined_until.get(job_hex, 0.0),
+            }
+        return out

@@ -257,6 +257,7 @@ class ActorClass:
             detached=opts.get("lifetime") == "detached",
             get_if_exists=opts.get("get_if_exists", False),
             tensor_transport=opts.get("tensor_transport", ""),
+            priority=opts.get("priority"),
         )
         return ActorHandle(actor_id)
 

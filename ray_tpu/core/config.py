@@ -32,6 +32,13 @@ _KNOBS: Dict[str, tuple] = {
     "rpc_retry_base_delay_s": (float, 0.05, "Exponential backoff base"),
     "rpc_retry_max_delay_s": (float, 2.0, "Backoff cap"),
     "rpc_max_retries": (int, 8, "Retryable RPC attempts"),
+    "rpc_retry_jitter": (
+        bool, True,
+        "Decorrelated-jitter backoff (AWS-style: sleep = uniform(base, "
+        "prev*3) capped) instead of the deterministic doubling schedule.  "
+        "Deterministic backoff synchronizes every client's reconnect "
+        "attempt after a control-plane restart — a thundering herd",
+    ),
     "rpc_service_lanes": (
         int, 0,
         "Event-loop lanes per RPC service (0 = auto: min(4, cpus) for the "
@@ -65,6 +72,41 @@ _KNOBS: Dict[str, tuple] = {
     "resource_sync_period_s": (float, 0.2, "Resource view gossip period"),
     # -- scheduling --
     "scheduler_spread_threshold": (float, 0.5, "Pack until this utilization, then spread"),
+    # -- multi-tenant arbitration --
+    "sched_default_priority": (
+        int, 100,
+        "Priority assigned to jobs that register without one (higher = "
+        "more important).  Serve deployments and other latency-critical "
+        "work should register above it, batch/training below",
+    ),
+    "sched_preemption_enabled": (
+        bool, True,
+        "Checkpoint-then-evict preemption: a higher-priority bundle that "
+        "cannot place may evict lower-priority placement groups (victims "
+        "checkpoint via prepare_evict, are re-queued PENDING, and resume "
+        "automatically when capacity frees)",
+    ),
+    "sched_preemption_burst": (
+        int, 3,
+        "Token-bucket capacity of each job's preemption budget: at most "
+        "this many victim evictions in a burst, refilling one per "
+        "sched_preemption_cooldown_s.  Bounds the damage a crash-looping "
+        "high-priority job can do",
+    ),
+    "sched_preemption_cooldown_s": (
+        float, 30.0, "Seconds to refill one preemption token"
+    ),
+    "sched_preemption_quarantine_s": (
+        float, 600.0,
+        "A job that drains its preemption budget is quarantined from "
+        "preempting (not from running) for this long",
+    ),
+    "sched_evict_checkpoint_timeout_s": (
+        float, 10.0,
+        "Deadline for a victim's prepare_evict checkpoint fan-out; on "
+        "expiry the eviction proceeds anyway (the restart path falls "
+        "back to the last driver-side checkpoint)",
+    ),
     "scheduler_top_k_fraction": (float, 0.2, "Top-k random choice fraction"),
     "lease_idle_timeout_s": (float, 0.3, "Return idle leased worker after"),
     "task_push_keepalive_s": (

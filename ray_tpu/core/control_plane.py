@@ -33,6 +33,7 @@ import time
 from collections import deque
 from typing import Dict, List, Optional, Set
 
+from .admission import JobArbiter
 from .config import GlobalConfig
 from .ids import ActorID, JobID, NodeID, PlacementGroupID
 from .resources import ResourceSet
@@ -91,13 +92,26 @@ class ActorEntry:
 
 
 class PlacementGroupEntry:
-    def __init__(self, pg_id, bundles: List[dict], strategy: str, name: str):
+    def __init__(self, pg_id, bundles: List[dict], strategy: str, name: str,
+                 job_id: Optional[JobID] = None,
+                 priority: Optional[int] = None, created_seq: int = 0):
         self.pg_id = pg_id
         self.bundles = bundles
         self.strategy = strategy
         self.name = name
         self.state = "PENDING"  # PENDING | CREATED | REMOVED
         self.bundle_nodes: Optional[List[NodeID]] = None
+        # Arbitration: owning job, effective priority (resolved once at
+        # creation), and a monotonic creation sequence — victim selection
+        # is (priority asc, created_seq desc): lowest priority, newest
+        # first, so the cheapest work (least sunk progress) dies first.
+        self.job_id = job_id
+        self.priority = (
+            priority if priority is not None
+            else GlobalConfig.sched_default_priority
+        )
+        self.created_seq = created_seq
+        self.preemptions = 0
 
     def public_info(self) -> dict:
         return {
@@ -106,6 +120,9 @@ class PlacementGroupEntry:
             "bundles": self.bundles,
             "strategy": self.strategy,
             "bundle_nodes": [n.hex() if n else None for n in (self.bundle_nodes or [])],
+            "job_id": self.job_id.hex() if self.job_id else None,
+            "priority": self.priority,
+            "preemptions": self.preemptions,
         }
 
 
@@ -134,6 +151,12 @@ class ControlPlane:
         self.session_id = session_id
         self.server = RpcServer(self, host, port, lanes=resolve_service_lanes())
         self.scheduler = ClusterScheduler()
+        self.arbiter = JobArbiter()
+        self._pg_seq = 0
+        # Actors being checkpoint-then-evicted: their worker-death reports
+        # must not consume max_restarts (eviction is scheduler policy, not
+        # a failure of the actor).
+        self._evicting_actors: Set[ActorID] = set()
         self.nodes: Dict[NodeID, NodeEntry] = {}
         self.agent_clients = ClientPool()
         self._kv: Dict[str, Dict[str, bytes]] = {}  # namespace -> key -> value
@@ -231,6 +254,10 @@ class ControlPlane:
                     "name": entry.name,
                     "state": entry.state,
                     "bundle_nodes": entry.bundle_nodes,
+                    "job_id": entry.job_id,
+                    "priority": entry.priority,
+                    "created_seq": entry.created_seq,
+                    "preemptions": entry.preemptions,
                 }
             ),
         )
@@ -274,11 +301,17 @@ class ControlPlane:
             loaded = True
         for _key, blob in self.store.scan("pgs"):
             d = pickle.loads(blob)
+            # .get() defaults: blobs persisted before the arbitration
+            # fields existed must still load.
             entry = PlacementGroupEntry(
-                d["pg_id"], d["bundles"], d["strategy"], d["name"]
+                d["pg_id"], d["bundles"], d["strategy"], d["name"],
+                job_id=d.get("job_id"), priority=d.get("priority"),
+                created_seq=d.get("created_seq", 0),
             )
             entry.state = d["state"]
             entry.bundle_nodes = d["bundle_nodes"]
+            entry.preemptions = d.get("preemptions", 0)
+            self._pg_seq = max(self._pg_seq, entry.created_seq + 1)
             self.placement_groups[entry.pg_id] = entry
             if entry.state == "PENDING":
                 self._pending_pgs.append(entry.pg_id)
@@ -289,6 +322,7 @@ class ControlPlane:
             job["last_heartbeat"] = now  # grace: drivers re-heartbeat soon
             self.jobs[JobID.from_hex(key)] = job
             loaded = True
+        self._recharge_arbiter()
         if loaded:
             logger.info(
                 "recovered state: %d actors, %d pgs, %d jobs, %d kv ns",
@@ -296,6 +330,41 @@ class ControlPlane:
                 len(self._kv),
             )
         return loaded
+
+    def _recharge_arbiter(self) -> None:
+        """Rebuild quota accounting from recovered state.  Charges are
+        keyed and idempotent, so replaying them over whatever the arbiter
+        already holds can never double-count — the invariant the
+        CP-restart × preemption tests pin."""
+        for job_id, job in self.jobs.items():
+            self.arbiter.register_job(
+                job_id.hex(), job.get("priority"), job.get("quota")
+            )
+        for actor_id, entry in self.actors.items():
+            # PG-bound actors draw from their bundle (charged under the
+            # PG key); charging them too would double-count.
+            if entry.spec.placement_group_id is not None:
+                continue
+            if entry.state in (ALIVE, RESTARTING):
+                job = entry.spec.job_id
+                self.arbiter.charge(
+                    ("actor", actor_id.hex()),
+                    job.hex() if job else None,
+                    ResourceSet(entry.spec.resources),
+                )
+        for pg_id, entry in self.placement_groups.items():
+            # A victim checkpointed-and-evicted before the crash is
+            # PENDING here: it recovers un-charged and re-admits on the
+            # next sweep, exactly like any queued group.
+            if entry.state == "CREATED":
+                total = ResourceSet(entry.bundles[0])
+                for b in entry.bundles[1:]:
+                    total = total + ResourceSet(b)
+                self.arbiter.charge(
+                    ("pg", pg_id.hex()),
+                    entry.job_id.hex() if entry.job_id else None,
+                    total,
+                )
 
     # ------------------------------------------------------------- lifecycle
     async def start(self) -> str:
@@ -368,7 +437,17 @@ class ControlPlane:
             resources=payload["snapshot"].get("total", {}),
         )
         self._kick_pending()
-        return {"ok": True, "session_id": self.session_id}
+        # Reconcile the agent's held bundles against the PG table: a
+        # group removed or evicted while this node (or this control
+        # plane) was away must release its reservation — otherwise a
+        # remove that raced the re-registration window leaks the
+        # agent-side resources forever.
+        stale = []
+        for pg_id in payload.get("held_pgs", ()):
+            pg = self.placement_groups.get(pg_id)
+            if pg is None or pg.state != "CREATED":
+                stale.append(pg_id)
+        return {"ok": True, "session_id": self.session_id, "drop_pgs": stale}
 
     def handle_heartbeat(self, payload, conn):
         node_id = payload["node_id"]
@@ -499,11 +578,16 @@ class ControlPlane:
     # ------------------------------------------------------------------ jobs
     def handle_register_job(self, payload, conn):
         job_id = payload["job_id"]
+        priority = self.arbiter.register_job(
+            job_id.hex(), payload.get("priority"), payload.get("quota")
+        )
         self.jobs[job_id] = {
             "state": "RUNNING",
             "driver_address": payload.get("driver_address"),
             "start_time": time.time(),
             "last_heartbeat": time.monotonic(),
+            "priority": priority,
+            "quota": self.arbiter.quota_of(job_id.hex()),
         }
         conn.metadata["job_id"] = job_id
         self.events.record(
@@ -511,7 +595,7 @@ class ControlPlane:
             driver_address=payload.get("driver_address"),
         )
         self._persist_job(job_id)
-        return {"ok": True, "session_id": self.session_id}
+        return {"ok": True, "session_id": self.session_id, "priority": priority}
 
     def handle_job_heartbeat(self, payload, conn):
         job = self.jobs.get(payload["job_id"])
@@ -612,6 +696,18 @@ class ControlPlane:
                 return
             await self._create_actor_on_node(entry, pg.bundle_nodes[idx])
             return
+        request = ResourceSet(spec.resources)
+        job_hex = spec.job_id.hex() if spec.job_id else None
+        charge_key = ("actor", spec.actor_id.hex())
+        if job_hex and not self.arbiter.is_charged(charge_key):
+            if not self.arbiter.admit(job_hex, request):
+                # Over quota: queue (stay pending), never fail — the
+                # next drain re-admits once usage drains below the cap.
+                self.arbiter.mark_queued(charge_key, job_hex)
+                self._record_sched_event("admission_queued", job=job_hex)
+                if spec.actor_id not in self._pending_actors:
+                    self._pending_actors.append(spec.actor_id)
+                return
         try:
             node_id = self.scheduler.pick_node(
                 ResourceSet(spec.resources), spec.strategy
@@ -628,6 +724,9 @@ class ControlPlane:
             if spec.actor_id not in self._pending_actors:
                 self._pending_actors.append(spec.actor_id)
             return
+        # Charge before dispatch (idempotent by key): a RESTARTING actor
+        # keeps its charge across the respawn instead of re-admitting.
+        self.arbiter.charge(charge_key, job_hex, request)
         await self._create_actor_on_node(entry, node_id)
 
     async def _create_actor_on_node(self, entry: ActorEntry, node_id: NodeID):
@@ -674,6 +773,9 @@ class ControlPlane:
         self._publish_actor(entry)
 
     def _publish_actor(self, entry: ActorEntry):
+        if entry.state == DEAD:
+            self.arbiter.release(("actor", entry.spec.actor_id.hex()))
+            self.arbiter.unmark_queued(("actor", entry.spec.actor_id.hex()))
         # Every actor state transition publishes — persist + export events
         # at the same spot.
         self.events.record(
@@ -712,6 +814,13 @@ class ControlPlane:
     async def _on_actor_worker_died(self, actor_id: ActorID, cause: str):
         entry = self.actors.get(actor_id)
         if entry is None or entry.state == DEAD:
+            return
+        if actor_id in self._evicting_actors:
+            # Checkpoint-then-evict already moved this actor to
+            # RESTARTING; the agent's death report for the eviction kill
+            # must not burn a num_restarts credit (eviction is scheduler
+            # policy, not an actor failure).
+            self._evicting_actors.discard(actor_id)
             return
         restarts_allowed = (
             entry.spec.max_restarts == -1
@@ -782,9 +891,17 @@ class ControlPlane:
 
     async def handle_create_placement_group(self, payload, conn):
         pg_id = payload["pg_id"]
+        job_id = payload.get("job_id")
         entry = PlacementGroupEntry(
-            pg_id, payload["bundles"], payload["strategy"], payload.get("name", "")
+            pg_id, payload["bundles"], payload["strategy"],
+            payload.get("name", ""),
+            job_id=job_id,
+            priority=self.arbiter.priority_of(
+                job_id.hex() if job_id else None, payload.get("priority")
+            ),
+            created_seq=self._pg_seq,
         )
+        self._pg_seq += 1
         self.placement_groups[pg_id] = entry
         self.events.record(PG_LIFECYCLE, pg_id.hex(), "PENDING")
         self._persist_pg(entry)
@@ -851,16 +968,40 @@ class ControlPlane:
         an over-packed pick simply fails its reservation and re-queues —
         the same convergence the serial path had."""
         placeable: List[tuple] = []  # (entry, assignment)
+        # Highest priority first (oldest first within a band): when the
+        # sweep covers more demand than fits — e.g. right after a
+        # preemption freed capacity — the most important group places
+        # first instead of whichever happened to enqueue first.
+        entries = sorted(entries, key=lambda e: (-e.priority, e.created_seq))
         for entry in entries:
             if entry.state != "PENDING":
                 continue
             bundles = [ResourceSet(b) for b in entry.bundles]
+            total = bundles[0]
+            for b in bundles[1:]:
+                total = total + b
+            job_hex = entry.job_id.hex() if entry.job_id else None
+            charge_key = ("pg", entry.pg_id.hex())
+            if job_hex and not self.arbiter.is_charged(charge_key):
+                if not self.arbiter.admit(job_hex, total):
+                    # Over quota: stay PENDING and retry on later sweeps
+                    # (admission queues, never fails).
+                    self.arbiter.mark_queued(charge_key, job_hex)
+                    self._record_sched_event("admission_queued", job=job_hex)
+                    self._pg_requeue(entry)
+                    continue
             assignment = self.scheduler.pick_nodes_for_bundles(
                 bundles, entry.strategy
             )
             if assignment is None:
+                assignment = await self._try_preempt_for(entry, bundles)
+            if assignment is None:
                 self._pg_requeue(entry)
                 continue
+            # Charge before the reservation RPCs: co-admitted groups of
+            # one job in the same sweep see each other's usage.  A failed
+            # reservation re-queues through _pg_requeue, which releases.
+            self.arbiter.charge(charge_key, job_hex, total)
             placeable.append((entry, assignment))
         if not placeable:
             return
@@ -1039,8 +1180,15 @@ class ControlPlane:
         self.events.record(PG_LIFECYCLE, entry.pg_id.hex(), "CREATED")
         self._persist_pg(entry)
         self._publish("pg:" + entry.pg_id.hex(), entry.public_info())
+        # Actors parked on this group while it was PENDING (an evicted
+        # group's survivors waiting to resume) must not wait out a full
+        # heartbeat interval before re-placing.
+        self._kick_pending()
 
     def _pg_requeue(self, entry: PlacementGroupEntry):
+        # A re-queued group holds no quota: it re-admits on its next sweep
+        # (release is idempotent — a never-charged group is a no-op).
+        self.arbiter.release(("pg", entry.pg_id.hex()))
         if entry.state == "PENDING" and entry.pg_id not in self._pending_pgs:
             self._pending_pgs.append(entry.pg_id)
 
@@ -1069,11 +1217,226 @@ class ControlPlane:
             if entry.state == "REMOVED":
                 continue
             entry.state = "REMOVED"
+            self.arbiter.release(("pg", entry.pg_id.hex()))
+            self.arbiter.unmark_queued(("pg", entry.pg_id.hex()))
             self.events.record(PG_LIFECYCLE, entry.pg_id.hex(), "REMOVED")
             self._persist_pg(entry)
             if entry.pg_id in self._pending_pgs:
                 self._pending_pgs.remove(entry.pg_id)
             self._publish("pg:" + entry.pg_id.hex(), entry.public_info())
+        # Freed bundles may unblock evicted (PENDING) groups and their
+        # parked actors; don't make them wait out a heartbeat.  The
+        # retry sweep may still see a stale (heartbeat-synced) view and
+        # re-queue — the next heartbeat's kick then lands it.
+        self._kick_pending()
+
+    # ------------------------------------------------------------- preemption
+    #
+    # Checkpoint-then-evict: when a higher-priority group cannot place,
+    # pick victim groups (lowest priority first, newest first within a
+    # priority — least sunk progress dies first), simulate feasibility
+    # with the victims' resources added back to the scheduler view, and
+    # only if the demand would then fit: fan out ``prepare_evict``
+    # through the node agents (workloads checkpoint via their existing
+    # restart machinery), kill the victim's actors WITHOUT consuming
+    # max_restarts, reclaim the bundles, and re-queue the victim as
+    # PENDING — it resumes automatically when capacity frees.  Every
+    # eviction spends the demanding job's token-bucket preemption budget,
+    # so a crash-looping high-priority job drains its burst, quarantines,
+    # and provably cannot evict the world.
+
+    def _record_sched_event(self, kind: str, **tags) -> None:
+        try:
+            from ray_tpu.util import flight_recorder
+
+            flight_recorder.record_sched_event(kind, **tags)
+        except Exception as e:  # noqa: BLE001 — telemetry is best-effort
+            logger.debug("sched event record failed: %s", e)
+
+    def _select_victims(
+        self,
+        priority: int,
+        bundles: List[ResourceSet],
+        strategy: str,
+    ) -> Optional[tuple]:
+        """Pure simulation, no side effects: the smallest prefix of the
+        victim ordering whose eviction would make ``bundles`` placeable.
+        Returns (victims, assignment) or None when no set suffices.
+        Victims must be STRICTLY lower priority — same-job victims are
+        allowed (priority is per-group: a driver's latency burst evicting
+        its own batch-training group is the single-driver sharing story),
+        and the strict inequality is what prevents eviction cycles."""
+        cands = [
+            e
+            for e in self.placement_groups.values()
+            if e.state == "CREATED"
+            and e.bundle_nodes
+            and e.priority < priority
+        ]
+        cands.sort(key=lambda e: (e.priority, -e.created_seq))
+        extra: Dict[NodeID, ResourceSet] = {}
+        chosen: List[PlacementGroupEntry] = []
+        for victim in cands:
+            for idx, nid in enumerate(victim.bundle_nodes):
+                r = ResourceSet(victim.bundles[idx])
+                extra[nid] = extra[nid] + r if nid in extra else r
+            chosen.append(victim)
+            assignment = self.scheduler.pick_nodes_for_bundles(
+                bundles, strategy, extra_available=extra
+            )
+            if assignment is not None:
+                return chosen, assignment
+        return None
+
+    async def _try_preempt_for(
+        self, entry: PlacementGroupEntry, bundles: List[ResourceSet]
+    ) -> Optional[List[NodeID]]:
+        """Preemption attempt on behalf of a PENDING group that cannot
+        place.  Returns the post-eviction assignment, or None."""
+        if not GlobalConfig.sched_preemption_enabled:
+            return None
+        sel = self._select_victims(entry.priority, bundles, entry.strategy)
+        if sel is None:
+            return None
+        victims, assignment = sel
+        job_hex = entry.job_id.hex() if entry.job_id else ""
+        ok, reason = self.arbiter.spend_preemption(
+            job_hex, len(victims), time.monotonic()
+        )
+        if not ok:
+            self._record_sched_event("preemption_denied", job=job_hex)
+            logger.warning(
+                "preemption for pg %s denied: %s",
+                entry.pg_id.hex()[:8], reason,
+            )
+            return None
+        self._record_sched_event("preemption", job=job_hex,
+                                 victims=len(victims))
+        cause = (
+            f"preempted by pg {entry.pg_id.hex()[:8]} "
+            f"(priority {entry.priority} > {victims[0].priority})"
+        )
+        for victim in victims:
+            await self._preempt_pg(victim, cause)
+        return assignment
+
+    async def _preempt_pg(self, victim: PlacementGroupEntry,
+                          cause: str) -> int:
+        """Checkpoint-then-evict one CREATED group.  Returns the number
+        of workers that acked the checkpoint fan-out."""
+        victim.preemptions += 1
+        timeout = GlobalConfig.sched_evict_checkpoint_timeout_s
+        nodes = set(victim.bundle_nodes or ())
+
+        async def prep(nid):
+            node = self.nodes.get(nid)
+            if node is None or not node.alive:
+                return 0
+            client = self.agent_clients.get(node.agent_address)
+            try:
+                reply = await client.call(
+                    "prepare_evict",
+                    {"pg_id": victim.pg_id, "timeout": timeout,
+                     "cause": cause},
+                    timeout=timeout + 5, retries=1,
+                )
+                return int(reply.get("acks", 0))
+            except Exception as e:  # noqa: BLE001 — evict proceeds anyway
+                logger.warning("prepare_evict to agent failed: %s", e)
+                return 0
+
+        acks = sum(await asyncio.gather(*(prep(nid) for nid in nodes)))
+        # Kill the victim's actors through the eviction guard: they go
+        # RESTARTING (incarnation bumped, num_restarts untouched) and
+        # re-park as pending until their group re-creates.
+        for actor_id, a in list(self.actors.items()):
+            if a.spec.placement_group_id == victim.pg_id and a.state == ALIVE:
+                self._evicting_actors.add(actor_id)
+                a.incarnation += 1
+                a.state = RESTARTING
+                await self._kill_actor_worker(a)
+                a.address = None
+                self._publish_actor(a)
+                if actor_id not in self._pending_actors:
+                    self._pending_actors.append(actor_id)
+
+        async def ret(nid):
+            node = self.nodes.get(nid)
+            if node is None or not node.alive:
+                return
+            client = self.agent_clients.get(node.agent_address)
+            try:
+                await client.call(
+                    "return_bundles_batch", {"pg_ids": [victim.pg_id]}
+                )
+            except Exception as e:  # noqa: BLE001 — node racing death
+                logger.warning("preemption bundle return failed: %s", e)
+
+        await asyncio.gather(*(ret(nid) for nid in nodes))
+        victim.state = "PENDING"
+        victim.bundle_nodes = None
+        self.events.record(
+            PG_LIFECYCLE, victim.pg_id.hex(), "PREEMPTED", cause=cause
+        )
+        self._record_sched_event(
+            "preemption_victim",
+            pg=victim.pg_id.hex(), priority=victim.priority, acks=acks,
+        )
+        self._persist_pg(victim)
+        self._pg_requeue(victim)  # releases the victim's quota charge
+        self._publish("pg:" + victim.pg_id.hex(), victim.public_info())
+        logger.info(
+            "preempted pg %s (priority %d, %d checkpoint acks): %s",
+            victim.pg_id.hex()[:8], victim.priority, acks, cause,
+        )
+        return acks
+
+    async def handle_request_preemption(self, payload, conn):
+        """Explicit preemption on behalf of a high-priority demand that
+        is not itself a pending placement group — the remediation
+        controller's fair-share actuator (queue pressure on a
+        high-priority serve deployment frees training capacity here
+        instead of declining at max_replicas)."""
+        if not GlobalConfig.sched_preemption_enabled:
+            return {"preempted": [], "reason": "preemption disabled"}
+        bundles = [ResourceSet(b) for b in payload["bundles"]]
+        priority = int(
+            payload.get("priority") or GlobalConfig.sched_default_priority
+        )
+        job_id = payload.get("job_id")
+        sel = self._select_victims(
+            priority, bundles, payload.get("strategy", "PACK")
+        )
+        if sel is None:
+            return {
+                "preempted": [],
+                "reason": "no lower-priority victim set frees enough capacity",
+            }
+        victims, _assignment = sel
+        max_victims = payload.get("max_victims")
+        if max_victims is not None and len(victims) > int(max_victims):
+            return {
+                "preempted": [],
+                "reason": (
+                    f"needs {len(victims)} victims > max_victims {max_victims}"
+                ),
+            }
+        job_hex = job_id.hex() if job_id else "__remediation__"
+        ok, reason = self.arbiter.spend_preemption(
+            job_hex, len(victims), time.monotonic()
+        )
+        if not ok:
+            self._record_sched_event("preemption_denied", job=job_hex)
+            return {"preempted": [], "reason": reason}
+        self._record_sched_event("preemption", job=job_hex,
+                                 victims=len(victims))
+        cause = payload.get("cause") or "remediation request_preemption"
+        out = []
+        for victim in victims:
+            await self._preempt_pg(victim, cause)
+            out.append(victim.pg_id.hex())
+        self._kick_pending()
+        return {"preempted": out, "reason": ""}
 
     def handle_get_placement_group(self, payload, conn):
         entry = self.placement_groups.get(payload["pg_id"])
@@ -1083,12 +1446,28 @@ class ControlPlane:
         return [e.public_info() for e in self.placement_groups.values()]
 
     # ------------------------------------------------------- pending retries
+    def _actor_priority(self, actor_id) -> int:
+        """Effective drain priority of a pending actor: its spec override
+        if set, else the owning job's registered priority."""
+        entry = self.actors.get(actor_id)
+        if entry is None:
+            return GlobalConfig.sched_default_priority
+        spec = entry.spec
+        job_hex = spec.job_id.hex() if spec.job_id else None
+        return self.arbiter.priority_of(
+            job_hex, getattr(spec, "priority", None)
+        )
+
     def _kick_pending(self):
         if self._pending_actors or self._pending_pgs:
             asyncio.get_running_loop().create_task(self._drain_pending())
 
     async def _drain_pending(self):
         pending_actors, self._pending_actors = self._pending_actors, []
+        # Highest effective priority first (stable, so FIFO within a
+        # priority band): freed capacity after an eviction or node join
+        # goes to the most important waiter, not the oldest one.
+        pending_actors.sort(key=self._actor_priority, reverse=True)
         for actor_id in pending_actors:
             entry = self.actors.get(actor_id)
             if entry is not None and entry.state in (PENDING_CREATION, RESTARTING):
@@ -1144,6 +1523,15 @@ class ControlPlane:
             if node is None or not node.alive:
                 return {"node_id": None}
             return {"node_id": node_id, "agent_address": node.agent_address}
+        job_hex = payload.get("job_id")
+        if job_hex and not self.arbiter.admit(
+            job_hex, ResourceSet(payload["resources"])
+        ):
+            # Over-quota task lease: queue (submitter backs off and
+            # retries), surfaced as a queued-by-admission count.
+            self.arbiter.note_queued_event(job_hex)
+            self._record_sched_event("admission_queued", job=job_hex)
+            return {"node_id": None}
         try:
             node_id = self.scheduler.pick_node(
                 ResourceSet(payload["resources"]),
@@ -1313,6 +1701,11 @@ class ControlPlane:
             "nodes": len(self.nodes),
             "placement_groups": len(self.placement_groups),
             "obs_beats": self.obs_beats,
+            "sched": {
+                "preemptions_total": self.arbiter.preemptions_total,
+                "victims_total": self.arbiter.victims_total,
+                "denied_total": self.arbiter.denied_total,
+            },
         }
 
     def handle_get_state(self, payload, conn):
@@ -1327,6 +1720,7 @@ class ControlPlane:
                 e.public_info() for e in self.placement_groups.values()
             ],
             "jobs": {jid.hex(): dict(j) for jid, j in self.jobs.items()},
+            "scheduling": self.arbiter.snapshot(),
         }
 
 

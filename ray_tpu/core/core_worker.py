@@ -820,6 +820,11 @@ class _LeasePool:
                 # Stable owner identity: leases survive transport
                 # reconnects (grace + owner_ping re-association).
                 "owner_id": self.worker.address,
+                # Quota admission input for control-plane spillback.
+                "job_id": (
+                    self.template.job_id.hex()
+                    if self.template.job_id else None
+                ),
             }
             while True:
                 try:
@@ -877,6 +882,7 @@ class _LeasePool:
             # (task_id, attempt) (_InflightReplies), so a resend either
             # joins the still-running execution or returns the finished
             # reply instantly — exactly-once execution either way.
+            delivered = False
             while True:
                 try:
                     reply = await lease["client"].call(
@@ -888,6 +894,10 @@ class _LeasePool:
                     )
                     break
                 except RpcTimeoutError:
+                    # The request went out and the worker is (still)
+                    # executing — a later connection failure is a
+                    # mid-execution death, not a failed hand-off.
+                    delivered = True
                     continue
             self.worker._handle_task_reply(spec, reply)
         except RpcRemoteError as e:
@@ -899,7 +909,29 @@ class _LeasePool:
             # agent's worker monitor) and retry if allowed.
             lease["dead"] = True
             self._drop_lease(lease, returned=False)
-            if attempt < spec.max_retries:
+            never_started = (
+                not delivered and not getattr(e, "maybe_delivered", True)
+            )
+            if never_started and getattr(spec, "_handoff_retries", 0) < 20:
+                # Every connect attempt was refused before the push frame
+                # was ever written: the task never started anywhere, so
+                # re-leasing it is exactly-once safe whatever its
+                # max_retries (that budget is for mid-execution deaths).
+                # Typical cause: a lease granted on a node that died in
+                # the grant→push window, before the control plane's
+                # health check noticed.  Bounded separately so a
+                # persistently unreachable grant target cannot spin the
+                # submit loop forever.
+                spec._handoff_retries = getattr(spec, "_handoff_retries", 0) + 1
+                logger.warning(
+                    "task %s never reached its leased worker (%s); "
+                    "re-leasing (handoff retry %d)",
+                    spec.name, e, spec._handoff_retries,
+                )
+                await asyncio.sleep(0.2)  # let the health check catch up
+                spec._pushed_addr = None  # re-queued: cancellable again
+                self.submit(spec, attempt)
+            elif attempt < spec.max_retries:
                 logger.warning(
                     "task %s attempt %d failed (%s); retrying", spec.name, attempt, e
                 )
@@ -1030,6 +1062,8 @@ class CoreWorker:
         node_id: NodeID,
         job_id: Optional[JobID] = None,
         worker_id: Optional[WorkerID] = None,
+        job_priority: Optional[int] = None,
+        job_quota: Optional[Dict[str, float]] = None,
     ):
         self.mode = mode
         self.cp_address = cp_address
@@ -1038,6 +1072,10 @@ class CoreWorker:
         self.node_id = node_id
         self.job_id = job_id or JobID.from_random()
         self.worker_id = worker_id or WorkerID.from_random()
+        # Multi-tenant arbitration inputs, shipped with register_job (and
+        # every re-register, so they survive a control-plane restart).
+        self.job_priority = job_priority
+        self.job_quota = dict(job_quota) if job_quota else None
 
         self.server = RpcServer(
             self, "127.0.0.1", 0,
@@ -1180,7 +1218,8 @@ class CoreWorker:
         if self.mode == self.DRIVER:
             await self.cp.call(
                 "register_job",
-                {"job_id": self.job_id, "driver_address": self.address},
+                {"job_id": self.job_id, "driver_address": self.address,
+                 "priority": self.job_priority, "quota": self.job_quota},
             )
             self._heartbeat_task = self.loop.create_task(
                 self._job_heartbeat_loop()
@@ -1200,7 +1239,10 @@ class CoreWorker:
                 if reply.get("reregister"):
                     await self.cp.call(
                         "register_job",
-                        {"job_id": self.job_id, "driver_address": self.address},
+                        {"job_id": self.job_id,
+                         "driver_address": self.address,
+                         "priority": self.job_priority,
+                         "quota": self.job_quota},
                         retries=1,
                     )
             except Exception as e:
@@ -2956,6 +2998,7 @@ class CoreWorker:
         detached=False,
         get_if_exists=False,
         tensor_transport="",
+        priority=None,
     ) -> Tuple[ActorID, ActorSpec]:
         class_id = self._export_function(cls, prefix="cls")
         payload, held = self._prepare_args(args, kwargs)
@@ -2978,6 +3021,7 @@ class CoreWorker:
             detached=detached,
             owner_address=self.address,
             tensor_transport=tensor_transport,
+            priority=priority,
         )
 
         async def register():
@@ -3935,6 +3979,40 @@ class CoreWorker:
                 for d in payload.get("directives", ())
             ],
         }
+
+    async def handle_prepare_evict(self, payload, conn):
+        """Checkpoint-then-evict fan-in: the node agent warns this worker
+        that its placement-group bundle is about to be reclaimed.  Two
+        checkpoint channels, both best-effort: process-local eviction
+        hooks (``core.eviction``, for non-actor workloads), and the
+        hosted actor's ``prepare_evict()`` method — if it returns bytes
+        they are parked in the cluster KV under the actor's id, where the
+        next incarnation (or the driver's restart machinery) can pick
+        them up.  Failures never block the eviction; the workload then
+        falls back to its last driver-side checkpoint."""
+        from . import eviction
+
+        cause = payload.get("cause", "")
+        hooks = eviction.run_eviction_hooks(cause)
+        checkpointed = hooks > 0
+        inst = getattr(self, "actor_instance", None)
+        prepare = getattr(inst, "prepare_evict", None) if inst else None
+        if callable(prepare):
+            try:
+                blob = prepare()
+                if isinstance(blob, (bytes, bytearray)):
+                    await self.cp.call(
+                        "kv_put",
+                        {
+                            "namespace": "eviction",
+                            "key": self.actor_spec.actor_id.hex(),
+                            "value": bytes(blob),
+                        },
+                    )
+                checkpointed = True
+            except Exception as e:  # noqa: BLE001 — evict proceeds anyway
+                logger.warning("prepare_evict checkpoint failed: %s", e)
+        return {"checkpointed": checkpointed, "hooks": hooks}
 
     def handle_pipeline_push(self, payload, conn):
         """Stage-boundary p2p delivery (train.pipeline activations/grads):

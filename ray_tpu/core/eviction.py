@@ -1,0 +1,56 @@
+"""Process-local eviction hooks for checkpoint-then-evict preemption.
+
+When the control plane preempts a placement group, the victim's workers
+receive a ``prepare_evict`` RPC (node agent fan-out).  Actors expose a
+``prepare_evict()`` method for this; everything else in the process —
+data actor-pool state, buffered writers, anything that wants a final
+flush before the bundle is reclaimed — registers a hook here.
+
+Hooks are a stack per process (newest first), each registered under a
+token so two components never clobber each other's registration —
+the same discipline as ``util.remediation``'s actuator registry.
+Hook signature: ``fn(cause: str) -> None``; a hook that raises is
+skipped (eviction is never blocked on a checkpoint).
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+from typing import Callable, Dict
+
+logger = logging.getLogger(__name__)
+
+_lock = threading.Lock()
+_hooks: Dict[int, Callable[[str], None]] = {}
+_tokens = itertools.count(1)
+
+
+def register_eviction_hook(fn: Callable[[str], None]) -> int:
+    """Register a pre-eviction checkpoint hook; returns an unregister
+    token.  Live for the component's lifetime, not the process's."""
+    with _lock:
+        token = next(_tokens)
+        _hooks[token] = fn
+        return token
+
+
+def unregister_eviction_hook(token: int) -> None:
+    with _lock:
+        _hooks.pop(token, None)
+
+
+def run_eviction_hooks(cause: str) -> int:
+    """Run every registered hook (newest first); returns how many
+    completed without raising."""
+    with _lock:
+        items = sorted(_hooks.items(), reverse=True)
+    done = 0
+    for _token, fn in items:
+        try:
+            fn(cause)
+            done += 1
+        except Exception as e:  # noqa: BLE001 — evict proceeds regardless
+            logger.warning("eviction hook failed: %s", e)
+    return done

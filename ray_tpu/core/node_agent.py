@@ -195,9 +195,11 @@ class NodeAgent:
                 "node_id": self.node_id,
                 "agent_address": addr,
                 "snapshot": self._snapshot(),
+                "held_pgs": self._held_pg_ids(),
             },
         )
         assert reply["ok"]
+        self._drop_stale_pgs(reply.get("drop_pgs"))
         loop = asyncio.get_running_loop()
         # The agent has no CoreWorker, so its flight-recorder metrics
         # (object directory, lease waits) reach the cluster registry via a
@@ -362,14 +364,16 @@ class NodeAgent:
                     retries=1,
                 )
                 if reply.get("reregister"):
-                    await self.cp_client.call(
+                    rereg = await self.cp_client.call(
                         "register_node",
                         {
                             "node_id": self.node_id,
                             "agent_address": self.server.address,
                             "snapshot": self._snapshot(),
+                            "held_pgs": self._held_pg_ids(),
                         },
                     )
+                    self._drop_stale_pgs(rereg.get("drop_pgs"))
             except Exception as e:
                 logger.debug("heartbeat send failed: %s", e)
             await asyncio.sleep(period)
@@ -925,6 +929,7 @@ class NodeAgent:
                     "preferred": None,
                     "placement_group_id": payload.get("placement_group_id"),
                     "bundle_index": payload.get("bundle_index", -1),
+                    "job_id": payload.get("job_id"),
                 },
             )
         except Exception as e:  # noqa: BLE001
@@ -1242,6 +1247,24 @@ class NodeAgent:
         self._drain_lease_queue()
         return True
 
+    def _held_pg_ids(self):
+        """Distinct placement groups with live reservations on this node —
+        shipped with (re-)registration so the control plane can reconcile:
+        a group removed (or evicted) while this node was unreachable, or
+        while the CP itself was restarting, must not pin resources here
+        forever."""
+        return list({key[0] for key in self.bundles})
+
+    def _drop_stale_pgs(self, pg_ids) -> None:
+        for pg_id in pg_ids or ():
+            logger.info(
+                "dropping stale bundle reservation for pg %s "
+                "(control-plane reconciliation)", pg_id.hex()[:12],
+            )
+            self._drop_bundles(pg_id, drain=False)
+        if pg_ids:
+            self._drain_lease_queue()
+
     def _drop_bundles(self, pg_id, drain: bool = True):
         for key in [k for k in self.bundles if k[0] == pg_id]:
             pool = self.bundles.pop(key)
@@ -1419,6 +1442,48 @@ class NodeAgent:
         )
         done = [r for r in replies if r]
         return {"workers": len(done), "results": done}
+
+    async def handle_prepare_evict(self, payload, conn):
+        """Checkpoint fan-out ahead of a preemption: every local worker
+        holding a lease of the victim placement group gets a
+        ``prepare_evict`` call so its workload can checkpoint through its
+        existing restart machinery before the bundle is reclaimed.
+        Best-effort with per-worker isolation (like ``remediate``): a
+        wedged worker forfeits its checkpoint, never the eviction."""
+        from ..util import flight_recorder as fr
+
+        pg_id = payload["pg_id"]
+        timeout = max(1.0, float(
+            payload.get("timeout")
+            or GlobalConfig.sched_evict_checkpoint_timeout_s
+        ))
+        cause = payload.get("cause", "")
+        targets = []
+        seen = set()
+        for lease in list(self.leases.values()):
+            if lease.pg_id != pg_id:
+                continue
+            handle = lease.worker
+            if handle.address is None or handle.address in seen:
+                continue
+            if handle.proc.poll() is not None:
+                continue
+            seen.add(handle.address)
+            targets.append(handle)
+
+        async def one(handle):
+            try:
+                reply = await self.worker_clients.get(handle.address).call(
+                    "prepare_evict", {"cause": cause}, timeout=timeout,
+                    retries=1,
+                )
+                return bool(reply and reply.get("checkpointed"))
+            except Exception:  # noqa: BLE001 — evict proceeds regardless
+                fr.count_suppressed("prepare_evict_fanout")
+                return False
+
+        results = await asyncio.gather(*(one(h) for h in targets))
+        return {"acks": sum(1 for r in results if r), "workers": len(targets)}
 
     def handle_ping(self, payload, conn):
         return "pong"

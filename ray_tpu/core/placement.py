@@ -68,7 +68,13 @@ def placement_group(
     bundles: List[Dict[str, float]],
     strategy: str = "PACK",
     name: str = "",
+    priority: Optional[int] = None,
 ) -> PlacementGroup:
+    """``priority`` overrides the owning job's priority for this group
+    only (higher = more important; the default comes from the job's
+    registration, falling back to ``sched_default_priority``).  The
+    control plane may checkpoint-then-evict lower-priority groups to
+    place this one — see ``docs/scheduling.md``."""
     if strategy not in VALID_STRATEGIES:
         raise ValueError(f"strategy must be one of {VALID_STRATEGIES}")
     if not bundles or any(not b for b in bundles):
@@ -78,7 +84,8 @@ def placement_group(
     info = worker._run_sync(
         worker.cp.call(
             "create_placement_group",
-            {"pg_id": pg_id, "bundles": bundles, "strategy": strategy, "name": name},
+            {"pg_id": pg_id, "bundles": bundles, "strategy": strategy,
+             "name": name, "job_id": worker.job_id, "priority": priority},
         )
     )
     created = bool(info) and info.get("state") == "CREATED"
@@ -103,6 +110,7 @@ def pipeline_stage_placement_group(
     chips_per_stage: int = 0,
     accelerator_version: str = "",
     name: str = "",
+    priority: Optional[int] = None,
 ) -> PlacementGroup:
     """One bundle per pipeline stage — the MPMD trainer's placement shape.
 
@@ -133,6 +141,7 @@ def pipeline_stage_placement_group(
         [dict(bundle) for _ in range(num_stages)],
         strategy=strategy,
         name=name,
+        priority=priority,
     )
 
 
@@ -160,6 +169,7 @@ class PodracerPlacement:
         chips_per_learner: int = 0,
         accelerator_version: str = "",
         name: str = "",
+        priority: Optional[int] = None,
     ):
         if num_actor_bundles < 1 or num_learner_bundles < 0:
             raise ValueError(
@@ -187,7 +197,9 @@ class PodracerPlacement:
             strategy = "STRICT_SPREAD"
         else:
             strategy = "SPREAD"
-        self.pg = placement_group(bundles, strategy=strategy, name=name)
+        self.pg = placement_group(
+            bundles, strategy=strategy, name=name, priority=priority
+        )
 
     def ready(self, timeout: Optional[float] = None) -> bool:
         return self.pg.ready(timeout)
@@ -236,6 +248,7 @@ class SlicePlacementGroup:
         chips_per_host: int = 4,
         accelerator_version: str = "",
         name: str = "",
+        priority: Optional[int] = None,
     ):
         self.num_hosts = num_hosts
         self.chips_per_host = chips_per_host
@@ -247,7 +260,9 @@ class SlicePlacementGroup:
             for b in bundles:
                 b[resource] = float(chips_per_host)
         strategy = "STRICT_SPREAD" if num_hosts > 1 else "PACK"
-        self.pg = placement_group(bundles, strategy=strategy, name=name)
+        self.pg = placement_group(
+            bundles, strategy=strategy, name=name, priority=priority
+        )
 
     def ready(self, timeout: Optional[float] = None) -> bool:
         return self.pg.ready(timeout)

@@ -1282,6 +1282,21 @@ class RpcClient:
                 logger.debug("client writer close failed: %s", e)
 
 
+def next_backoff_delay(prev: float) -> float:
+    """Next retry sleep after a failed attempt that waited ``prev``.
+
+    With ``rpc_retry_jitter`` (default): decorrelated jitter —
+    ``min(cap, uniform(base, prev * 3))`` — so two clients that failed at
+    the same instant (every client in the cluster, after a control-plane
+    restart) diverge instead of reconnecting in lockstep.  Without it:
+    the classic deterministic doubling, ``min(cap, prev * 2)``."""
+    cap = GlobalConfig.rpc_retry_max_delay_s
+    if not GlobalConfig.rpc_retry_jitter:
+        return min(prev * 2, cap)
+    base = GlobalConfig.rpc_retry_base_delay_s
+    return min(cap, random.uniform(base, max(base, prev * 3)))
+
+
 class RetryableRpcClient:
     """Reconnecting client with exponential backoff — the analog of
     ``RetryableGrpcClient``.  Only retries on transport failures, never on
@@ -1321,9 +1336,16 @@ class RetryableRpcClient:
         retries = retries if retries is not None else GlobalConfig.rpc_max_retries
         delay = GlobalConfig.rpc_retry_base_delay_s
         last_exc = None
+        # False only when EVERY attempt died inside connect(): the request
+        # frame was never written to any socket, so the peer provably never
+        # saw it.  Callers use this to tell "request may have executed"
+        # from "request never left this process" (e.g. a task push is
+        # exactly-once safe to re-lease in the latter case).
+        maybe_delivered = False
         for _attempt in range(max(1, retries)):
             try:
                 client = await self._ensure()
+                maybe_delivered = True
                 return await client.call(method, payload, timeout, batch=batch)
             except (
                 RpcConnectionError, ConnectionError, OSError,
@@ -1346,10 +1368,12 @@ class RetryableRpcClient:
                     except Exception:  # raylint: waive[RTL003] half-dead socket; reconnect follows
                         pass
                 await asyncio.sleep(delay)
-                delay = min(delay * 2, GlobalConfig.rpc_retry_max_delay_s)
-        raise RpcConnectionError(
+                delay = next_backoff_delay(delay)
+        exc = RpcConnectionError(
             f"rpc {method} to {self.address} failed after {retries} attempts: {last_exc}"
         )
+        exc.maybe_delivered = maybe_delivered
+        raise exc
 
     async def notify(self, method: str, payload=None):
         client = await self._ensure()

@@ -189,15 +189,23 @@ class ClusterScheduler:
         self,
         bundles: List[ResourceSet],
         strategy: str,
+        extra_available: Optional[Dict[NodeID, ResourceSet]] = None,
     ) -> Optional[List[NodeID]]:
         """Two-phase-commit phase 0: choose a node per bundle (same node may
         appear multiple times for PACK).  Returns None if currently
         infeasible.  Simulates acquisition against a scratch copy of the view
-        so co-scheduled bundles don't double-book."""
+        so co-scheduled bundles don't double-book.
+
+        ``extra_available`` is the preemption what-if: per-node resources
+        that *would* free if candidate victims were evicted, added to the
+        scratch view so the control plane can test 'would this gang place
+        after evicting these victims?' before committing to any eviction."""
         scratch: Dict[NodeID, NodeResources] = {}
         for nid, nr in self.nodes.items():
             copy = NodeResources(nr.total.to_dict(), dict(nr.labels))
             copy.available = ResourceSet(nr.available.to_dict())
+            if extra_available and nid in extra_available:
+                copy.available = copy.available + extra_available[nid]
             scratch[nid] = copy
 
         assignment: List[Optional[NodeID]] = [None] * len(bundles)

@@ -132,6 +132,10 @@ class ActorSpec:
     # HBM and hands out DeviceRefs (the reference's tensor_transport="nccl"
     # RDT analog; ray ``experimental/gpu_object_manager``).
     tensor_transport: str = ""
+    # Per-actor override of the owning job's priority (None = inherit);
+    # orders the control plane's pending-actor drain when freed capacity
+    # is contended (docs/scheduling.md).
+    priority: Optional[int] = None
 
 
 class ObjectRef:

@@ -105,6 +105,9 @@ def start_dashboard(
                     1 for j in state["jobs"].values()
                     if j["state"] == "RUNNING"
                 ),
+                # Per-job arbitration state (priority, quota, charged
+                # usage, admission-queued counts) — who is starving whom.
+                "scheduling": state.get("scheduling", {}),
             }
         )
 

@@ -156,6 +156,17 @@ class _MapWorker:
     def apply(self, item) -> Block:
         return apply_chain(item, self._transforms)
 
+    def prepare_evict(self) -> None:
+        """Checkpoint-then-evict hook (docs/scheduling.md): a map chain
+        holds no durable pool state — in-flight blocks are simply
+        re-dispatched by the streaming scheduler after the kill — but a
+        stateful user transform (loaded model, buffered writer) gets its
+        flush if it exposes ``prepare_evict`` itself."""
+        for t in self._transforms:
+            fn = getattr(t, "prepare_evict", None)
+            if callable(fn):
+                fn()
+
 
 class ActorPoolStrategy:
     """``map_batches(..., compute=ActorPoolStrategy(size=4))`` (reference

@@ -313,3 +313,109 @@ class ThrottledCollectiveLink(ChaosInjector):
             )
         except Exception as e:  # noqa: BLE001 — member may already be gone
             logger.debug("ThrottledCollectiveLink revert skipped: %s", e)
+
+
+# ------------------------------------------------------- arbitration chaos
+class PriorityBurst(ChaosInjector):
+    """A high-priority placement-group burst landing on a busy cluster.
+
+    ``apply()`` requests ``bundles`` at ``priority`` through the REAL
+    create path — on a full cluster the control plane must
+    checkpoint-then-evict lower-priority groups to place it (the
+    latency-critical-serve-arrives scenario).  ``revert()`` removes the
+    group, freeing the capacity so evicted victims auto-resume via the
+    pending-PG drain.  ``placed`` records whether the burst actually
+    landed within ``ready_timeout``."""
+
+    def __init__(self, bundles: List[Dict[str, float]], priority: int = 1000,
+                 strategy: str = "PACK", name: str = "chaos-burst",
+                 ready_timeout: float = 30.0):
+        self.bundles = [dict(b) for b in bundles]
+        self.priority = priority
+        self.strategy = strategy
+        self.name = name
+        self.ready_timeout = ready_timeout
+        self.pg = None
+        self.placed = False
+
+    def apply(self) -> "PriorityBurst":
+        from ray_tpu.core.placement import placement_group
+
+        self.pg = placement_group(
+            self.bundles, strategy=self.strategy, name=self.name,
+            priority=self.priority,
+        )
+        self.placed = self.pg.ready(timeout=self.ready_timeout)
+        return self
+
+    def revert(self) -> None:
+        if self.pg is None:
+            return
+        from ray_tpu.core.placement import remove_placement_group
+
+        try:
+            remove_placement_group(self.pg)
+        except Exception as e:  # noqa: BLE001 — cluster may be tearing down
+            logger.debug("PriorityBurst revert skipped: %s", e)
+        self.pg = None
+
+
+class QuotaHog(ChaosInjector):
+    """A greedy tenant: floods the scheduler with ``count`` identical
+    single-bundle placement groups from the calling job.
+
+    With a job quota configured (``ray_tpu.init(job_quota=...)``) the
+    over-quota tail queues at admission — never fails, never reserves —
+    so the hog is contained to its cap while other tenants keep their
+    capacity.  ``states()`` classifies the flood (CREATED vs PENDING);
+    ``revert()`` removes every group, draining usage so any queued tail
+    admits (and then gets removed too)."""
+
+    def __init__(self, bundle: Dict[str, float], count: int,
+                 strategy: str = "PACK", name: str = "chaos-hog",
+                 settle_s: float = 1.0):
+        self.bundle = dict(bundle)
+        self.count = count
+        self.strategy = strategy
+        self.name = name
+        self.settle_s = settle_s
+        self.pgs: List[Any] = []
+
+    def apply(self) -> "QuotaHog":
+        from ray_tpu.core.placement import placement_group
+
+        self.pgs = [
+            placement_group(
+                [dict(self.bundle)], strategy=self.strategy,
+                name=f"{self.name}-{i}",
+            )
+            for i in range(self.count)
+        ]
+        # Let the group-commit sweep classify the flood before the test
+        # reads states() — admission decisions are asynchronous.
+        time.sleep(self.settle_s)
+        return self
+
+    def states(self) -> Dict[str, int]:
+        """Current state histogram of the hog's groups."""
+        from ray_tpu.core.core_worker import global_worker
+
+        worker = global_worker()
+        out: Dict[str, int] = {}
+        for pg in self.pgs:
+            info = worker._run_sync(
+                worker.cp.call("get_placement_group", {"pg_id": pg.id})
+            )
+            state = info["state"] if info else "UNKNOWN"
+            out[state] = out.get(state, 0) + 1
+        return out
+
+    def revert(self) -> None:
+        from ray_tpu.core.placement import remove_placement_group
+
+        for pg in self.pgs:
+            try:
+                remove_placement_group(pg)
+            except Exception as e:  # noqa: BLE001 — keep removing the rest
+                logger.debug("QuotaHog revert skipped: %s", e)
+        self.pgs = []

@@ -315,6 +315,18 @@ class AnakinWorker:
     def get_state(self) -> Dict[str, Any]:
         return self.algo.get_state()
 
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.algo.set_state(state)
+
+    def prepare_evict(self) -> bytes:
+        """Checkpoint-then-evict hook: pickle the learner state so the
+        runtime parks it in the cluster KV (namespace ``eviction``)
+        before this trainer's bundle is reclaimed — a preempted Anakin
+        job resumes from here bit-identical (docs/scheduling.md)."""
+        import pickle
+
+        return pickle.dumps(self.get_state())
+
 
 def anakin_actor(config: AnakinConfig, scheduling_strategy=None,
                  **actor_options):

@@ -174,6 +174,26 @@ def cmd_status(args) -> int:
         print(f"actors: " + ", ".join(f"{v} {k}" for k, v in sorted(by_state.items())))
     jobs = [j for j in state["jobs"].values() if j["state"] == "RUNNING"]
     print(f"jobs running: {len(jobs)}")
+    sched = state.get("scheduling") or {}
+    if sched:
+        # Who is starving whom: per-job priority, quota caps, charged
+        # usage, and how much demand admission is currently holding back.
+        print("scheduling (per job):")
+        for job_hex, row in sched.items():
+            quota = ",".join(
+                f"{k}={v:g}" for k, v in sorted(row["quota"].items())
+            ) or "unlimited"
+            usage = ",".join(
+                f"{k}={v:g}" for k, v in sorted(row["usage"].items())
+                if v > 1e-9
+            ) or "-"
+            line = (f"  {job_hex[:12]} priority={row['priority']} "
+                    f"quota={quota} in-use={usage} "
+                    f"queued={row['queued_now']} "
+                    f"(ever {row['queued_total']})")
+            if row.get("quarantined_until", 0.0) > 0.0:
+                line += " [preemption-quarantined]"
+            print(line)
     return 0
 
 

@@ -691,8 +691,16 @@ class ServeController:
             cfg = entry.get("autoscaling") or _AUTOSCALE_DEFAULTS
             current = len(entry["replicas"])
             if current >= cfg["max_replicas"]:
+                # The decline carries the replica resource shape so the
+                # remediation controller's fair-share fallback knows what
+                # bundle to free (preempt low-priority training) instead
+                # of just giving up — see util/remediation.py.
+                opts = (entry.get("spec") or {}).get("opts") or {}
                 return {"scaled": False, "replicas": current,
-                        "reason": f"at max_replicas={cfg['max_replicas']}"}
+                        "reason": f"at max_replicas={cfg['max_replicas']}",
+                        "replica_resources": dict(
+                            opts.get("resources") or {"CPU": 1.0}
+                        )}
             self._set_replica_count(entry, current + 1)
             entry["scale_pressure_since"] = None
             entry["last_scale_ts"] = time.monotonic()

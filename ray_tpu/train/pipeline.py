@@ -580,6 +580,13 @@ class PipelineStage:
             self.chunks[c].load_state(state)
         return True
 
+    def prepare_evict(self) -> bytes:
+        """Checkpoint-then-evict hook: the returned blob is parked in the
+        cluster KV (namespace ``eviction``) by the worker runtime before
+        this stage's bundle is reclaimed, so the preempted trainer's next
+        incarnation resumes bit-identical (docs/scheduling.md)."""
+        return self.get_state()
+
     def ping(self) -> bool:
         return True
 

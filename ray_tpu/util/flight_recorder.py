@@ -105,6 +105,10 @@ from .metric_registry import (  # noqa: F401 — re-exports
     RL_TRAJ_QUEUE_DEPTH,
     RPC_OOB_BYTES_TOTAL,
     RPC_OOB_FRAMES_TOTAL,
+    SCHED_ADMISSION_QUEUED_TOTAL,
+    SCHED_PREEMPTION_VICTIMS_TOTAL,
+    SCHED_PREEMPTIONS_DENIED_TOTAL,
+    SCHED_PREEMPTIONS_TOTAL,
     LLM_ADMITTED_TOTAL,
     LLM_BATCH_BUCKET,
     LLM_BATCH_OCCUPANCY,
@@ -736,6 +740,30 @@ def record_mux_cache_event(event: str) -> None:
     """One multiplexed-model cache event on a replica (hit / miss /
     eviction)."""
     counter(SERVE_MUX_CACHE_EVENTS_TOTAL, 1.0, {"event": event})
+
+
+# ------------------------------------------------ multi-tenant arbitration
+def record_sched_event(kind: str, **tags) -> None:
+    """One arbitration decision on the control plane.  ``kind``:
+    ``preemption`` (budget spent, victims selected — tag ``victims``),
+    ``preemption_victim`` (one group checkpoint-then-evicted — tags
+    ``pg``/``priority``/``acks``), ``preemption_denied`` (token bucket
+    empty or quarantined), ``admission_queued`` (over-quota request
+    parked, not failed)."""
+    if not GlobalConfig.enable_flight_recorder:
+        return
+    if kind == "preemption":
+        counter(SCHED_PREEMPTIONS_TOTAL, 1.0,
+                {"job": str(tags.get("job", ""))})
+    elif kind == "preemption_victim":
+        counter(SCHED_PREEMPTION_VICTIMS_TOTAL, 1.0,
+                {"priority": str(tags.get("priority", ""))})
+    elif kind == "preemption_denied":
+        counter(SCHED_PREEMPTIONS_DENIED_TOTAL, 1.0,
+                {"job": str(tags.get("job", ""))})
+    elif kind == "admission_queued":
+        counter(SCHED_ADMISSION_QUEUED_TOTAL, 1.0,
+                {"job": str(tags.get("job", ""))})
 
 
 # ------------------------------------------ continuous-batching LLM serving

@@ -139,6 +139,12 @@ LEASE_GRANT_WAIT_HIST = "ray_tpu_lease_grant_wait_s"
 LEASE_QUEUE_DEPTH = "ray_tpu_lease_queue_depth"
 LEASES_HELD = "ray_tpu_leases_held"
 
+# ------------------------------------------- multi-tenant arbitration (PR 15)
+SCHED_PREEMPTIONS_TOTAL = "ray_tpu_sched_preemptions_total"
+SCHED_PREEMPTION_VICTIMS_TOTAL = "ray_tpu_sched_preemption_victims_total"
+SCHED_PREEMPTIONS_DENIED_TOTAL = "ray_tpu_sched_preemptions_denied_total"
+SCHED_ADMISSION_QUEUED_TOTAL = "ray_tpu_sched_admission_queued_total"
+
 # ------------------------------------------------------ podracer RL (PR 9)
 RL_ENV_STEPS_TOTAL = "ray_tpu_rl_env_steps_total"
 RL_LEARNER_UPDATES_TOTAL = "ray_tpu_rl_learner_updates_total"
@@ -340,6 +346,16 @@ METRICS: Dict[str, str] = {
                            "(histogram)",
     LEASE_QUEUE_DEPTH: "lease requests parked on the node agent (gauge)",
     LEASES_HELD: "leases currently held by the node agent (gauge)",
+    SCHED_PREEMPTIONS_TOTAL: "checkpoint-then-evict preemption events "
+                             "(one per victim placement group)",
+    SCHED_PREEMPTION_VICTIMS_TOTAL: "placement groups evicted as "
+                                    "preemption victims, by victim "
+                                    "priority",
+    SCHED_PREEMPTIONS_DENIED_TOTAL: "preemption attempts denied by the "
+                                    "per-job token-bucket budget or "
+                                    "quarantine",
+    SCHED_ADMISSION_QUEUED_TOTAL: "requests queued (not failed) by "
+                                  "per-job quota admission, by job",
     EXCEPTION_SUPPRESSED_TOTAL: "intentionally suppressed exceptions, by "
                                 "site (RTL003 accounting)",
     DEBUG_LOCK_CYCLES_TOTAL: "lock-order cycles detected by DebugLock "

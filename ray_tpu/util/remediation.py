@@ -52,6 +52,7 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import logging
 import threading
 import time
 from collections import deque
@@ -59,6 +60,8 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..core.config import GlobalConfig
 from .debug_locks import make_lock
+
+logger = logging.getLogger(__name__)
 
 # Action outcomes (the {outcome} tag of the remediation counter).
 OUTCOME_APPLIED = "applied"          # actuator ran and accepted the action
@@ -74,6 +77,7 @@ ACTION_PIPELINE_RESPAWN = "pipeline_stage_respawn"
 ACTION_COLLECTIVE_REPROBE = "collective_reprobe"
 ACTION_DATA_POOL_SCALE_UP = "data_pool_scale_up"
 ACTION_QUARANTINE = "quarantine"
+ACTION_PREEMPT_LOW_PRIORITY = "preempt_low_priority"
 
 
 class RemediationSkipped(Exception):
@@ -196,8 +200,71 @@ def _builtin_serve_scale_up(target: str, violation, **_kw) -> str:
         controller.remediation_scale_up.remote(target), timeout=30
     )
     if not reply.get("scaled"):
+        # Fair-share fallback (multi-tenant arbitration): a deployment
+        # pinned at max_replicas under sustained queue pressure is a
+        # capacity fight, not a config ceiling — free the chips by
+        # checkpoint-then-evicting lower-priority training instead of
+        # declining outright.  The preemption spends the control plane's
+        # token-bucket budget, so a flapping finding cannot evict the
+        # world (see docs/scheduling.md).
+        resources = reply.get("replica_resources")
+        if resources:
+            detail = _builtin_preempt_low_priority(
+                target, violation, resources=resources,
+                cause=f"serve queue pressure on {target!r} "
+                      f"({reply.get('reason', 'declined')})",
+            )
+            if detail is not None:
+                return detail
         raise RemediationSkipped(reply.get("reason", "declined"))
     return f"deployment {target}: replicas -> {reply['replicas']}"
+
+
+def _builtin_preempt_low_priority(
+    target: str,
+    violation,
+    resources: Optional[Dict[str, float]] = None,
+    priority: Optional[int] = None,
+    max_victims: Optional[int] = None,
+    cause: str = "",
+    **_kw,
+) -> Optional[str]:
+    """Ask the control plane to checkpoint-then-evict lower-priority
+    placement groups so ``resources`` worth of capacity frees up for
+    ``target``.  Returns a detail string, or None when the control plane
+    declines (no victims / budget exhausted) — callers treat None as
+    "fall through to skipped"."""
+    from ..core.core_worker import try_global_worker
+
+    w = try_global_worker()
+    if w is None:
+        return None
+    reply = w._run_sync(
+        w.cp.call(
+            "request_preemption",
+            {
+                "bundles": [dict(resources or {"CPU": 1.0})],
+                "priority": priority,
+                "max_victims": max_victims
+                if max_victims is not None
+                else GlobalConfig.sched_preemption_burst,
+                "cause": cause or f"remediation for {target!r}",
+            },
+            timeout=30,
+        )
+    )
+    preempted = reply.get("preempted") or []
+    if not preempted:
+        logger.debug(
+            "preempt_low_priority for %s declined: %s",
+            target, reply.get("reason"),
+        )
+        return None
+    short = ", ".join(p[:8] for p in preempted)
+    return (
+        f"{target}: preempted {len(preempted)} lower-priority "
+        f"placement group(s) [{short}]"
+    )
 
 
 def _builtin_collective_reprobe(target: str, violation,
@@ -217,6 +284,18 @@ def _builtin_collective_reprobe(target: str, violation,
 
 _BUILTIN_ACTUATORS[ACTION_SERVE_SCALE_UP] = _builtin_serve_scale_up
 _BUILTIN_ACTUATORS[ACTION_COLLECTIVE_REPROBE] = _builtin_collective_reprobe
+
+
+def _preempt_actuator(target: str, violation, **kw) -> str:
+    """Registry wrapper for ``ACTION_PREEMPT_LOW_PRIORITY``: unlike the
+    serve fallback it treats a control-plane decline as ``skipped``."""
+    detail = _builtin_preempt_low_priority(target, violation, **kw)
+    if detail is None:
+        raise RemediationSkipped("control plane declined preemption")
+    return detail
+
+
+_BUILTIN_ACTUATORS[ACTION_PREEMPT_LOW_PRIORITY] = _preempt_actuator
 
 
 def broadcast_directive(directive: Dict[str, Any],

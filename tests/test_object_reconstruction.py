@@ -53,12 +53,20 @@ def _executions(counter_path) -> int:
 @pytest.fixture
 def cluster():
     import ray_tpu
+    from ray_tpu.core.config import GlobalConfig
     from ray_tpu.core.node import Cluster
 
+    # These tests kill nodes on purpose: what they measure is recovery,
+    # not death DETECTION — the default 10s mark-dead timeout would put
+    # ~20s of pure detection wait into the two-kill test alone.  Set as
+    # an override so Cluster() ships it to the spawned control plane.
+    GlobalConfig.override(health_check_timeout_s=4.0)
     c = Cluster()
     yield c
     ray_tpu.shutdown()
     c.shutdown()
+    GlobalConfig._overrides.pop("health_check_timeout_s", None)
+    GlobalConfig.__dict__.pop("health_check_timeout_s", None)
 
 
 class TestObjectReconstruction:

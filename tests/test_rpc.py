@@ -310,3 +310,42 @@ def test_v2_batch_container_exact_bytes_and_roundtrip():
     assert encoded[1] == sum(
         s.nbytes if isinstance(s, memoryview) else len(s) for s in encoded[0]
     )
+
+
+def test_retry_backoff_decorrelated_jitter_diverges():
+    """Two clients that fail at the same instant (every client in the
+    cluster, after a control-plane restart) must NOT reconnect in
+    lockstep: with ``rpc_retry_jitter`` their backoff schedules diverge,
+    while staying within [base, cap].  With the knob off, the schedule
+    is the classic deterministic doubling."""
+    from ray_tpu.core import rpc as rpc_mod
+    from ray_tpu.core.config import GlobalConfig
+
+    saved = GlobalConfig.rpc_retry_jitter
+    base = GlobalConfig.rpc_retry_base_delay_s
+    cap = GlobalConfig.rpc_retry_max_delay_s
+
+    def schedule(steps=10):
+        prev, out = base, []
+        for _ in range(steps):
+            prev = rpc_mod.next_backoff_delay(prev)
+            out.append(prev)
+        return out
+
+    try:
+        GlobalConfig.rpc_retry_jitter = False
+        assert schedule() == schedule()  # deterministic doubling
+        expect = base
+        for delay in schedule():
+            expect = min(expect * 2, cap)
+            assert delay == expect
+
+        GlobalConfig.rpc_retry_jitter = True
+        a, b = schedule(), schedule()
+        # 10 independent uniform draws each: identical schedules would
+        # mean the jitter is not jittering.
+        assert a != b
+        for delay in a + b:
+            assert base <= delay <= cap
+    finally:
+        GlobalConfig.rpc_retry_jitter = saved
