@@ -975,6 +975,10 @@ REFERENCE_LIMITS = {
     # count; the reference's multi-client tests run 1 driver per core on a
     # fleet box, so 32 concurrent clients is the single-node analog.
     "limits_many_clients_s": 32,
+    # Failover envelope: node agents carried through a control-plane
+    # leader kill -9 (scale = simulated agent fleet size; the reference's
+    # GCS-FT HA tests run 64-node clusters through a GCS restart).
+    "limits_failover_envelope_s": 64,
 }
 
 
@@ -1257,6 +1261,152 @@ def run_limits_suite():
             time.perf_counter() - t0, "s",
         )
     finally:
+        ray_tpu.shutdown()
+
+    # ---- stage 6: control-plane HA failover envelope ---------------------
+    # A >=64-agent fleet (simulated node agents speaking the full wire
+    # protocol, fake execution — ray_tpu/devtools/sim_agent.py) plus
+    # thousands of placement groups and actors live in the journal; then
+    # the leader is SIGKILLed under that load.  The number is the wall
+    # time from kill to full re-convergence THROUGH THE NEW LEADER:
+    # standby promoted (epoch bumped), every agent re-registered with its
+    # held_pgs, and the CREATED-PG / ALIVE-actor counts restored.  The
+    # driver's own control-plane client re-anchors transparently — the
+    # polling below never rebuilds it.
+    import json as _json
+    import subprocess
+
+    n_sim = int(os.environ.get("RAY_TPU_LIMITS_SIM_AGENTS", 64))
+    n_pgs = int(os.environ.get("RAY_TPU_LIMITS_SIM_PGS", 2_000))
+    n_actors = int(os.environ.get("RAY_TPU_LIMITS_SIM_ACTORS", 1_000))
+    ray_tpu.init(
+        num_cpus=2,
+        _system_config={
+            "cp_ha": 1,
+            "cp_lease_ttl_s": 1.0,
+            "cp_lease_poll_s": 0.1,
+            "prestart_workers": 0,
+        },
+    )
+    sim_procs = []
+    try:
+        node = ray_tpu.api._local_node
+        w = try_global_worker()
+        sim_env = dict(os.environ)
+        sim_env["PALLAS_AXON_POOL_IPS"] = ""
+        if "axon" in sim_env.get("JAX_PLATFORMS", ""):
+            sim_env["JAX_PLATFORMS"] = "cpu"
+        sim_procs = [
+            subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu.devtools.sim_agent",
+                 "--cp-address", node.cp_address,
+                 "--session-id", node.session_id,
+                 "--cp-ha-dir", node.ha_dir,
+                 "--resources", _json.dumps({"SIM": 64.0})],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                env=sim_env,
+            )
+            for _ in range(n_sim)
+        ]
+
+        def cp_state():
+            return w._run_sync(w.cp.call("get_state"), timeout=60)
+
+        def alive_nodes(st):
+            return sum(1 for n in st["nodes"].values() if n["alive"])
+
+        def created_pgs(st):
+            return sum(
+                1 for p in st["placement_groups"] if p["state"] == "CREATED"
+            )
+
+        def alive_actors(st):
+            return sum(1 for a in st["actors"] if a["state"] == "ALIVE")
+
+        deadline = time.time() + 120
+        while time.time() < deadline and alive_nodes(cp_state()) < n_sim + 1:
+            time.sleep(0.25)
+        assert alive_nodes(cp_state()) >= n_sim + 1, "sim fleet not registered"
+
+        @ray_tpu.remote(num_cpus=0, resources={"SIM": 1})
+        class SimOccupant:
+            pass
+
+        pgs = [  # noqa: F841 — handles pin the groups for the stage
+            ray_tpu.placement_group([{"SIM": 1.0}]) for _ in range(n_pgs)
+        ]
+        actors = [  # noqa: F841
+            SimOccupant.remote() for _ in range(n_actors)
+        ]
+        deadline = time.time() + 600
+        while time.time() < deadline:
+            st = cp_state()
+            if created_pgs(st) >= n_pgs and alive_actors(st) >= n_actors:
+                break
+            time.sleep(0.5)
+        st = cp_state()
+        want_pgs = created_pgs(st)
+        want_actors = alive_actors(st)
+        assert want_pgs >= n_pgs, f"only {want_pgs}/{n_pgs} groups placed"
+        assert want_actors >= n_actors, (
+            f"only {want_actors}/{n_actors} actors alive"
+        )
+
+        from ray_tpu.core.cp_ha import read_standby_statuses
+
+        def wait_for_standby(timeout=60):
+            # A trial must start with a WARM standby or the measured
+            # window includes candidate process startup, not failover.
+            end = time.time() + timeout
+            while time.time() < end:
+                if read_standby_statuses(node.ha_dir):
+                    return
+                time.sleep(0.2)
+            raise AssertionError("no warm standby before failover trial")
+
+        detect_windows = []
+
+        def one_failover():
+            wait_for_standby()
+            t0 = time.perf_counter()
+            old_epoch = node.kill_leader()
+            node.wait_for_failover(old_epoch, timeout=60)
+            detect_windows.append(time.perf_counter() - t0)
+            end = time.time() + 120
+            while time.time() < end:
+                try:
+                    st = cp_state()
+                except Exception:  # noqa: BLE001 — re-anchor in flight
+                    time.sleep(0.25)
+                    continue
+                if (alive_nodes(st) >= n_sim + 1
+                        and created_pgs(st) >= want_pgs
+                        and alive_actors(st) >= want_actors):
+                    break
+                time.sleep(0.25)
+            else:
+                raise AssertionError(
+                    "cluster state did not re-converge after failover"
+                )
+            dt = time.perf_counter() - t0
+            node.ensure_standby()
+            return dt
+
+        dt = best_of(2, one_failover)
+        st = cp_state()
+        _limits_emit(
+            "limits_failover_envelope_s", dt, n_sim,
+            placement_groups=want_pgs,
+            actors=want_actors,
+            lease_epoch=st["cp"]["epoch"],
+            promote_detect_s=round(max(detect_windows), 3),
+            journal_records=st["cp"].get("journal", {}).get(
+                "records_written", 0
+            ),
+        )
+    finally:
+        for p in sim_procs:
+            p.kill()
         ray_tpu.shutdown()
 
 

@@ -101,6 +101,7 @@ def init(
             cp_address = address
             info = node_mod.read_head_info()
             session_id = info["session_id"] if info else "remote"
+        ha_dir = info.get("ha_dir") if info else None
         node = node_mod.Node(
             head=False,
             cp_address=cp_address,
@@ -108,6 +109,7 @@ def init(
             labels=labels,
             session_id=session_id,
             num_cpus=num_cpus,
+            ha_dir=ha_dir,
             # A connecting driver's local agent must die with the driver:
             # client processes exiting uncleanly were orphaning 0-CPU
             # agents on shared clusters.

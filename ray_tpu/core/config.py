@@ -67,6 +67,34 @@ _KNOBS: Dict[str, tuple] = {
     ),
     # -- control plane --
     "cp_persistence": (int, 1, "Durable sqlite control-plane tables (restart FT)"),
+    "cp_ha": (
+        int, 0,
+        "Control-plane high availability: the head spawns two CP "
+        "candidates contending for a leader lease over a shared journal "
+        "(core/cp_ha.py); the warm standby takes over within the lease "
+        "TTL when the leader dies",
+    ),
+    "cp_lease_ttl_s": (
+        float, 2.0,
+        "Leader lease validity window: a standby may take over this long "
+        "after the leader's last renewal.  The detect half of the "
+        "failover window — keep well above cp_lease_poll_s",
+    ),
+    "cp_lease_poll_s": (
+        float, 0.25,
+        "Standby lease-acquisition poll (and journal tail) period",
+    ),
+    "cp_journal_fsync_interval_s": (
+        float, 0.05,
+        "Journal fsync batching: appends flush to the OS immediately "
+        "(process kill -9 loses nothing) and fsync at most this often "
+        "(whole-host crash window, the synchronous=NORMAL trade)",
+    ),
+    "cp_journal_compact_bytes": (
+        int, 8 << 20,
+        "Journal bytes past the last snapshot before the leader compacts "
+        "into a fresh snapshot",
+    ),
     "health_check_period_s": (float, 1.0, "Agent heartbeat period"),
     "health_check_timeout_s": (float, 10.0, "Mark node dead after this long"),
     "resource_sync_period_s": (float, 0.2, "Resource view gossip period"),

@@ -37,7 +37,13 @@ from .admission import JobArbiter
 from .config import GlobalConfig
 from .ids import ActorID, JobID, NodeID, PlacementGroupID
 from .resources import ResourceSet
-from .rpc import ClientPool, RpcServer, ServerConnection, resolve_service_lanes
+from .rpc import (
+    ClientPool,
+    NotLeaderError,
+    RpcServer,
+    ServerConnection,
+    resolve_service_lanes,
+)
 from .scheduler import ClusterScheduler, InfeasibleError
 from .event_export import (
     ACTOR_DEFINITION,
@@ -47,7 +53,7 @@ from .event_export import (
     PG_LIFECYCLE,
     EventRecorder,
 )
-from .store_client import make_store_client
+from .store_client import FencedWriteError, make_store_client
 from .task_events import TaskEventStore
 from .task_spec import ActorSpec
 
@@ -147,8 +153,15 @@ class ControlPlane:
     })
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 session_id: str = "", store_path: Optional[str] = None):
+                 session_id: str = "", store_path: Optional[str] = None,
+                 store=None, ha_dir: Optional[str] = None, lease=None):
         self.session_id = session_id
+        # HA mode (core/cp_ha.py): a pre-warmed journaled store and the
+        # leader lease we serve under arrive from run_ha_candidate();
+        # store_path keeps the plain single-CP sqlite path working.
+        self.ha_dir = ha_dir
+        self.lease = lease
+        self._fenced = False
         self.server = RpcServer(self, host, port, lanes=resolve_service_lanes())
         self.scheduler = ClusterScheduler()
         self.arbiter = JobArbiter()
@@ -189,12 +202,14 @@ class ControlPlane:
         self.obs_beats = 0
         self._requested_resources: List[dict] = []
         self._recent_unplaceable: List[tuple] = []  # (monotonic ts, resources)
-        self.store = make_store_client(store_path)
+        self.store = store if store is not None else make_store_client(store_path)
         export_path = None
         if store_path:
             export_path = os.path.join(
                 os.path.dirname(store_path), "events.jsonl"
             )
+        elif ha_dir:
+            export_path = os.path.join(ha_dir, "events.jsonl")
         self.events = EventRecorder(export_path)
         self._recovered = self._recover()
         # Grace window after a recovery: ALIVE actors whose node never
@@ -209,6 +224,41 @@ class ControlPlane:
     # ----------------------------------------------------------- persistence
     _KV_SEP = "\x00"
 
+    def _store_put(self, table: str, key: str, value: bytes) -> None:
+        try:
+            self.store.put(table, key, value)
+        except FencedWriteError as e:
+            self._on_fenced(e)
+
+    def _store_delete(self, table: str, key: str) -> None:
+        try:
+            self.store.delete(table, key)
+        except FencedWriteError as e:
+            self._on_fenced(e)
+
+    def _on_fenced(self, exc: FencedWriteError) -> None:
+        """A newer leader exists: stop mutating, redirect the in-flight
+        caller (NotLeaderError is retried by every client against the
+        published endpoint), and exit shortly — after the error reply
+        has had a beat to flush."""
+        from .cp_ha import read_endpoint
+
+        hint = None
+        if self.ha_dir:
+            info = read_endpoint(self.ha_dir)
+            hint = info.get("address") if info else None
+        if not self._fenced:
+            self._fenced = True
+            logger.error("fenced by a newer leader (%s); exiting: %s",
+                         hint, exc)
+            try:
+                asyncio.get_running_loop().call_later(
+                    0.2, os._exit, 3
+                )
+            except RuntimeError:
+                os._exit(3)
+        raise NotLeaderError(hint) from exc
+
     def _persist_kv(self, namespace: str, key: str, value,
                     delete: bool = False) -> None:
         if not self.store.durable:
@@ -217,14 +267,14 @@ class ControlPlane:
         # dicts), not only bytes — pickle for the blob store.
         skey = namespace + self._KV_SEP + key
         if delete:
-            self.store.delete("kv", skey)
+            self._store_delete("kv", skey)
         else:
-            self.store.put("kv", skey, pickle.dumps(value))
+            self._store_put("kv", skey, pickle.dumps(value))
 
     def _persist_actor(self, entry: ActorEntry) -> None:
         if not self.store.durable:
             return
-        self.store.put(
+        self._store_put(
             "actors",
             entry.spec.actor_id.hex(),
             pickle.dumps(
@@ -243,7 +293,7 @@ class ControlPlane:
     def _persist_pg(self, entry: PlacementGroupEntry) -> None:
         if not self.store.durable:
             return
-        self.store.put(
+        self._store_put(
             "pgs",
             entry.pg_id.hex(),
             pickle.dumps(
@@ -266,13 +316,22 @@ class ControlPlane:
         if not self.store.durable:
             return
         job = self.jobs[job_id]
-        self.store.put(
+        self._store_put(
             "jobs",
             job_id.hex(),
             pickle.dumps(
                 {k: v for k, v in job.items() if k != "last_heartbeat"}
             ),
         )
+
+    def _persist_obs_seen(self, wid: str, bid: int) -> None:
+        # The obs-report dedupe watermark must survive failover: the
+        # agents' pull staging redelivers at-least-once, and a standby
+        # that forgot the acked ids would double-count the redelivered
+        # batches' task events (the PR-16 regression test).
+        if not self.store.durable:
+            return
+        self._store_put("obs_seen", wid, pickle.dumps(bid))
 
     def _recover(self) -> bool:
         """Rebuild in-memory state from the durable store (no-op for the
@@ -322,6 +381,8 @@ class ControlPlane:
             job["last_heartbeat"] = now  # grace: drivers re-heartbeat soon
             self.jobs[JobID.from_hex(key)] = job
             loaded = True
+        for wid, blob in self.store.scan("obs_seen"):
+            self._obs_seen[wid] = pickle.loads(blob)
         self._recharge_arbiter()
         if loaded:
             logger.info(
@@ -484,6 +545,7 @@ class ControlPlane:
 
             flight_recorder.record_rpc_lanes(self.server, role="control_plane")
             flight_recorder.record_pg_batches(self.pg_batch_stats)
+            flight_recorder.record_cp_ha(self._cp_ha_info())
             payload = _m.payload_snapshot()
             if payload is not None:
                 self._kv.setdefault(_m._REGISTRY_NS, {})["controlplane"] = (
@@ -1382,7 +1444,15 @@ class ControlPlane:
             "preemption_victim",
             pg=victim.pg_id.hex(), priority=victim.priority, acks=acks,
         )
-        self._persist_pg(victim)
+        # Crash consistency across tables: the group's PENDING flip and
+        # its evicted actors' RESTARTING records land as ONE commit — a
+        # crash here can never recover a CREATED group whose actors were
+        # already evicted (which would leak phantom bundle charges).
+        with self.store.transaction():
+            self._persist_pg(victim)
+            for _aid, a in list(self.actors.items()):
+                if a.spec.placement_group_id == victim.pg_id:
+                    self._persist_actor(a)
         self._pg_requeue(victim)  # releases the victim's quota charge
         self._publish("pg:" + victim.pg_id.hex(), victim.public_info())
         logger.info(
@@ -1648,6 +1718,7 @@ class ControlPlane:
                 metrics_ns[batch["metrics_key"]] = batch["metrics"]
             if bid is not None and wid:
                 self._obs_seen[wid] = bid
+                self._persist_obs_seen(wid, bid)
         return True
 
     def handle_list_task_events(self, payload, conn):
@@ -1692,6 +1763,39 @@ class ControlPlane:
     def handle_ping(self, payload, conn):
         return "pong"
 
+    # ------------------------------------------------------------------ HA
+    def _cp_ha_info(self) -> dict:
+        """Role/lease/journal summary for cli status, /api/cluster, and
+        the ``ray_tpu_cp_*`` metrics."""
+        info = {
+            "role": "leader",
+            "ha": bool(self.ha_dir),
+            "epoch": self.lease.epoch if self.lease is not None else 0,
+        }
+        stats_fn = getattr(self.store, "journal_stats", None)
+        if stats_fn is not None:
+            info["journal"] = stats_fn()
+        if self.ha_dir:
+            from .cp_ha import read_standby_statuses
+
+            leader_seq = getattr(self.store, "applied_seq", 0)
+            standbys = []
+            for s in read_standby_statuses(self.ha_dir):
+                standbys.append({
+                    "holder": s.get("holder"),
+                    "address": s.get("address"),
+                    "applied_seq": s.get("applied_seq", 0),
+                    "lag_records": max(
+                        0, leader_seq - s.get("applied_seq", 0)
+                    ),
+                    "updated_at": s.get("updated_at"),
+                })
+            info["standbys"] = standbys
+        return info
+
+    def handle_cp_role(self, payload, conn):
+        return self._cp_ha_info()
+
     def handle_debug_control_plane(self, payload, conn):
         """Control-plane self-diagnosis: group-commit accounting + per-lane
         RPC dispatch stats (tests and the many-client limits stage)."""
@@ -1706,6 +1810,7 @@ class ControlPlane:
                 "victims_total": self.arbiter.victims_total,
                 "denied_total": self.arbiter.denied_total,
             },
+            "cp": self._cp_ha_info(),
         }
 
     def handle_get_state(self, payload, conn):
@@ -1721,8 +1826,70 @@ class ControlPlane:
             ],
             "jobs": {jid.hex(): dict(j) for jid, j in self.jobs.items()},
             "scheduling": self.arbiter.snapshot(),
+            "cp": self._cp_ha_info(),
         }
 
+
+
+async def run_ha_candidate(host: str, port: int, session_id: str,
+                           ha_dir: str) -> None:
+    """One control-plane CANDIDATE: tail the journal as a warm standby
+    while contending for the leader lease; on winning, replay the tail,
+    bump the fencing epoch (promote), and serve as the leader on the SAME
+    port — renewing the lease on the heartbeat cadence and exiting hard
+    the moment renewal fails (a standby is about to take over)."""
+    from .cp_ha import (
+        LeaderLease,
+        StandbyControlPlane,
+        publish_endpoint,
+        read_endpoint,
+        clear_standby_status,
+        write_standby_status,
+    )
+    from .store_client import JournaledStoreClient
+
+    holder = f"cp-{os.getpid()}-{port}"
+    journal_dir = os.path.join(ha_dir, "journal")
+    store = JournaledStoreClient(journal_dir)
+    lease = LeaderLease(ha_dir, holder)
+    standby = StandbyControlPlane(
+        lambda: (read_endpoint(ha_dir) or {}).get("address")
+    )
+    standby_server = RpcServer(standby, host, port, lanes=1)
+    address = await standby_server.start()
+    logger.info("cp candidate %s standing by on %s", holder, address)
+    poll = max(0.02, GlobalConfig.cp_lease_poll_s)
+    while True:
+        store.tail()
+        write_standby_status(ha_dir, holder, address, store.applied_seq)
+        if lease.try_acquire(address):
+            break
+        await asyncio.sleep(poll)
+    # Leader: free the port the standby rejector held, promote the
+    # journal under the new epoch, and serve the real control plane.
+    await standby_server.stop()
+    clear_standby_status(ha_dir, holder)
+    store.promote(lease)
+    cp = ControlPlane(
+        host, port, session_id=session_id,
+        store=store, ha_dir=ha_dir, lease=lease,
+    )
+    await cp.start()
+    publish_endpoint(ha_dir, cp.server.address, lease.epoch)
+    logger.info(
+        "cp candidate %s is LEADER (epoch %d) on %s",
+        holder, lease.epoch, cp.server.address,
+    )
+    renew_period = min(
+        GlobalConfig.health_check_period_s, max(0.05, lease.ttl / 3.0)
+    )
+    while True:
+        await asyncio.sleep(renew_period)
+        if not lease.renew():
+            logger.error(
+                "cp %s lost the leader lease; exiting for failover", holder
+            )
+            os._exit(3)
 
 
 def main():
@@ -1731,6 +1898,7 @@ def main():
     parser.add_argument("--port", type=int, required=True)
     parser.add_argument("--session-id", required=True)
     parser.add_argument("--store-path", default=None)
+    parser.add_argument("--ha-dir", default=None)
     args = parser.parse_args()
     from .reaper import watch_parent_process
 
@@ -1744,6 +1912,11 @@ def main():
         from .stack_dump import install_signal_dumpers
 
         install_signal_dumpers(asyncio.get_running_loop())
+        if args.ha_dir:
+            await run_ha_candidate(
+                args.host, args.port, args.session_id, args.ha_dir
+            )
+            return
         cp = ControlPlane(
             args.host, args.port, args.session_id, store_path=args.store_path
         )

@@ -1197,7 +1197,17 @@ class CoreWorker:
         self._lane_pool = None  # created at actor init for max_concurrency>1
         self._inflight_replies = _InflightReplies()
         self.address = await self.server.start()
-        self.cp = RetryableRpcClient(self.cp_address, push_handler=self._on_push)
+        cp_ha_dir = os.environ.get("RAY_TPU_CP_HA_DIR")
+        cp_resolver = None
+        if cp_ha_dir:
+            from .cp_ha import make_cp_resolver
+
+            cp_resolver = make_cp_resolver(cp_ha_dir, self.cp_address)
+        self.cp = RetryableRpcClient(
+            self.cp_address,
+            push_handler=self._on_push,
+            address_resolver=cp_resolver,
+        )
         self.agent = RetryableRpcClient(self.agent_address)
         from .task_events import TaskEventBuffer
 

@@ -1,0 +1,300 @@
+"""Control-plane high availability: leader lease, endpoint discovery,
+and the warm-standby request rejector.
+
+The reference gets GCS fault tolerance from an external replicated Redis
+(``src/ray/gcs/store_client/redis_store_client.h:126``) plus a single
+restartable GCS process.  TPU-native redesign: the cluster runs TWO
+control-plane candidate processes over one shared journal directory
+(``store_client.JournaledStoreClient``), coordinated by three small files
+under the HA directory — no external store to operate:
+
+  - ``lease.json``     — the leader lease: holder, fencing epoch, and a
+    CLOCK_MONOTONIC deadline (system-wide on Linux, so comparable across
+    processes on the host).  Read-modify-write is serialized by a flock
+    on ``lease.lock`` held ONLY for the compare-and-swap — never during
+    leadership — so a SIGSTOPped leader is dethroned by TTL expiry, not
+    protected by a kernel lock it still holds.
+  - ``endpoint.json``  — the published leader endpoint (address + epoch,
+    adopted monotonically by epoch).  Clients re-anchor by re-resolving
+    this inside their existing decorrelated-jitter reconnect loop.
+  - ``standby-*.json`` — each follower's applied journal sequence, so
+    the leader can report replication lag.
+
+Fencing: every journal append calls ``LeaderLease.verify()`` which
+raises ``FencedWriteError`` once the lease file names a different holder
+or epoch — a paused-then-resumed old leader gets its first write
+rejected and exits, so split-brain writes are structurally impossible
+even though the old process may briefly keep its socket open.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import json
+import logging
+import os
+import time
+from typing import Callable, List, Optional
+
+from .config import GlobalConfig
+from .store_client import FencedWriteError
+
+logger = logging.getLogger(__name__)
+
+LEASE_FILE = "lease.json"
+LEASE_LOCK = "lease.lock"
+ENDPOINT_FILE = "endpoint.json"
+
+
+def _write_json_atomic(path: str, obj: dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _read_json(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+class LeaderLease:
+    """TTL lease with a monotonically increasing fencing epoch.
+
+    ``try_acquire`` succeeds when the recorded lease is absent, expired,
+    or already ours; every fresh acquisition bumps the epoch, so a write
+    fenced on (holder, epoch) from before the takeover can never be
+    mistaken for a current one.  ``clock`` is injectable for tests."""
+
+    def __init__(self, ha_dir: str, holder: str,
+                 ttl_s: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        os.makedirs(ha_dir, exist_ok=True)
+        self.ha_dir = ha_dir
+        self.holder = holder
+        self.ttl = ttl_s if ttl_s is not None else GlobalConfig.cp_lease_ttl_s
+        self.epoch = 0
+        self.address = ""
+        self._clock = clock
+        self._lease_path = os.path.join(ha_dir, LEASE_FILE)
+        self._lock_path = os.path.join(ha_dir, LEASE_LOCK)
+        self._verify_sig = None  # (mtime_ns, size) at the last full check
+
+    def _cas(self):
+        """flock guarding the lease read-modify-write.  Kernel-released
+        on process death, held microseconds — leadership itself is
+        guarded by the TTL, never by this lock."""
+        f = open(self._lock_path, "a+")
+        fcntl.flock(f.fileno(), fcntl.LOCK_EX)
+        return f
+
+    def try_acquire(self, address: str) -> bool:
+        f = self._cas()
+        try:
+            cur = _read_json(self._lease_path)
+            now = self._clock()
+            if cur:
+                ours = (
+                    cur.get("holder") == self.holder
+                    and cur.get("epoch") == self.epoch
+                    and self.epoch > 0
+                )
+                if not ours and cur.get("deadline", 0) > now:
+                    return False  # a live foreign lease
+                epoch = cur.get("epoch", 0) if ours else cur.get("epoch", 0) + 1
+            else:
+                epoch = 1
+            _write_json_atomic(self._lease_path, {
+                "holder": self.holder,
+                "epoch": epoch,
+                "deadline": now + self.ttl,
+                "address": address,
+            })
+            self.epoch = epoch
+            self.address = address
+            self._verify_sig = None
+            return True
+        finally:
+            f.close()
+
+    def renew(self) -> bool:
+        """Extend our own, still-valid lease.  Refuses — returning False
+        and zeroing the epoch — when the lease changed hands OR already
+        expired: an expired lease may be acquired by a standby the very
+        next instant, so re-extending it would race the takeover.  The
+        caller must treat False as loss of leadership."""
+        f = self._cas()
+        try:
+            cur = _read_json(self._lease_path)
+            now = self._clock()
+            if (
+                not cur
+                or cur.get("holder") != self.holder
+                or cur.get("epoch") != self.epoch
+                or cur.get("deadline", 0) <= now
+            ):
+                self.epoch = 0
+                return False
+            _write_json_atomic(self._lease_path, {
+                "holder": self.holder,
+                "epoch": self.epoch,
+                "deadline": now + self.ttl,
+                "address": self.address,
+            })
+            return True
+        finally:
+            f.close()
+
+    def release(self) -> None:
+        """Graceful abdication: expire our lease in place (keeping the
+        epoch, so the next acquirer still bumps past it)."""
+        f = self._cas()
+        try:
+            cur = _read_json(self._lease_path)
+            if cur and cur.get("holder") == self.holder and cur.get("epoch") == self.epoch:
+                cur["deadline"] = 0.0
+                _write_json_atomic(self._lease_path, cur)
+        finally:
+            f.close()
+        self.epoch = 0
+
+    def verify(self) -> None:
+        """Fencing check on the journal's write path: cheap (one stat)
+        when the lease file is unchanged since the last full check;
+        re-reads it whenever the mtime/size moved (every renewal rewrites
+        the file, so at most one re-read per renewal — and the FIRST
+        write of a paused-then-resumed stale leader always re-reads,
+        because the new leader's acquisition rewrote the file)."""
+        if self.epoch <= 0:
+            raise FencedWriteError(f"{self.holder}: no leader lease held")
+        try:
+            st = os.stat(self._lease_path)
+        except OSError:
+            raise FencedWriteError(f"{self.holder}: lease file missing")
+        sig = (st.st_mtime_ns, st.st_size)
+        if sig == self._verify_sig:
+            return
+        cur = _read_json(self._lease_path)
+        if (
+            not cur
+            or cur.get("holder") != self.holder
+            or cur.get("epoch") != self.epoch
+        ):
+            raise FencedWriteError(
+                f"{self.holder}: lease epoch {self.epoch} superseded by "
+                f"{cur.get('holder') if cur else '?'} "
+                f"epoch {cur.get('epoch') if cur else '?'}"
+            )
+        self._verify_sig = sig
+
+
+def read_lease(ha_dir: str) -> Optional[dict]:
+    return _read_json(os.path.join(ha_dir, LEASE_FILE))
+
+
+# ------------------------------------------------------------- discovery
+def publish_endpoint(ha_dir: str, address: str, epoch: int) -> None:
+    """Record the serving leader; adopted monotonically by epoch so a
+    slow stale leader can never roll the pointer backwards."""
+    path = os.path.join(ha_dir, ENDPOINT_FILE)
+    cur = _read_json(path)
+    if cur and cur.get("epoch", 0) > epoch:
+        return
+    _write_json_atomic(path, {"address": address, "epoch": epoch})
+
+
+def read_endpoint(ha_dir: str) -> Optional[dict]:
+    return _read_json(os.path.join(ha_dir, ENDPOINT_FILE))
+
+
+def make_cp_resolver(ha_dir: Optional[str], fallback: str) -> Callable[[], str]:
+    """Address resolver for ``RetryableRpcClient``: each (re)connect
+    re-reads the published endpoint, so clients follow the leader without
+    any new discovery protocol — the reconnect loop they already run for
+    plain CP restarts does the re-anchor."""
+
+    def resolve() -> str:
+        if ha_dir:
+            info = read_endpoint(ha_dir)
+            if info and info.get("address"):
+                return info["address"]
+        return fallback
+
+    return resolve
+
+
+# --------------------------------------------------------------- standby
+def write_standby_status(ha_dir: str, holder: str, address: str,
+                         applied_seq: int) -> None:
+    _write_json_atomic(
+        os.path.join(ha_dir, f"standby-{holder}.json"),
+        {
+            "holder": holder,
+            "address": address,
+            "applied_seq": applied_seq,
+            "updated_at": time.time(),
+        },
+    )
+
+
+def clear_standby_status(ha_dir: str, holder: str) -> None:
+    try:
+        os.unlink(os.path.join(ha_dir, f"standby-{holder}.json"))
+    except OSError as e:
+        logger.debug("standby status unlink failed: %s", e)
+
+
+def read_standby_statuses(ha_dir: str) -> List[dict]:
+    out = []
+    try:
+        names = os.listdir(ha_dir)
+    except OSError:
+        return out
+    for name in names:
+        if name.startswith("standby-") and name.endswith(".json"):
+            info = _read_json(os.path.join(ha_dir, name))
+            if info:
+                out.append(info)
+    return out
+
+
+class StandbyControlPlane:
+    """RPC handler a candidate serves while NOT leader: every control
+    RPC is rejected with ``NotLeaderError`` carrying the published
+    leader's address, so a client that raced the failover (connected to
+    the standby's port directly) is redirected instead of hanging."""
+
+    LANE_SAFE_METHODS: frozenset = frozenset()
+
+    def __init__(self, leader_hint: Callable[[], Optional[str]]):
+        self._leader_hint = leader_hint
+
+    async def handle_ping(self, payload, conn):
+        return {"ok": True, "role": "standby"}
+
+    async def handle_cp_role(self, payload, conn):
+        return {
+            "role": "standby",
+            "epoch": 0,
+            "leader": self._leader_hint(),
+        }
+
+    def on_connection_closed(self, conn):
+        pass
+
+    def __getattr__(self, name):
+        if name.startswith("handle_"):
+            from .rpc import NotLeaderError
+
+            hint = self._leader_hint
+
+            async def _reject(payload, conn):
+                raise NotLeaderError(hint())
+
+            return _reject
+        raise AttributeError(name)

@@ -121,6 +121,7 @@ class Node:
         num_cpus: Optional[float] = None,
         port: Optional[int] = None,
         die_with_parent: bool = False,
+        ha_dir: Optional[str] = None,
     ):
         self.head = head
         self.port = port
@@ -136,6 +137,11 @@ class Node:
         self._cp_argv: Optional[List[str]] = None
         self._cp_log: Optional[str] = None
         self._cp_env: Optional[dict] = None
+        # HA (GlobalConfig.cp_ha): the shared lease/journal directory and
+        # the CP candidate processes contending over it (head only; a
+        # joining node receives ha_dir so its agent can follow failovers).
+        self.ha_dir = ha_dir
+        self._cp_candidates: List[dict] = []
 
         # Detection runs through the accelerator plugin registry (TPU is
         # built in; other vendors contribute by registering a manager).
@@ -166,38 +172,49 @@ class Node:
     def start(self):
         env = {"RAY_TPU_LOG_DIR": self.log_dir}
         if self.head:
-            cp_port = self.port or find_free_port()
-            self.cp_address = f"127.0.0.1:{cp_port}"
-            self._cp_argv = [
-                sys.executable, "-m", "ray_tpu.core.control_plane",
-                "--port", str(cp_port),
-                "--session-id", self.session_id,
-            ]
-            if GlobalConfig.cp_persistence:
-                self._cp_argv += [
-                    "--store-path",
-                    os.path.join(self.log_dir, "control_plane.sqlite"),
+            if GlobalConfig.cp_ha:
+                self._start_cp_candidates(env)
+            else:
+                cp_port = self.port or find_free_port()
+                self.cp_address = f"127.0.0.1:{cp_port}"
+                self._cp_argv = [
+                    sys.executable, "-m", "ray_tpu.core.control_plane",
+                    "--port", str(cp_port),
+                    "--session-id", self.session_id,
                 ]
-            self._cp_log = os.path.join(self.log_dir, "control_plane.log")
-            self._cp_env = dict(env)
-            self.pg.spawn(self._cp_argv, self._cp_log, env)
-            _wait_for_server(self.cp_address)
+                if GlobalConfig.cp_persistence:
+                    self._cp_argv += [
+                        "--store-path",
+                        os.path.join(self.log_dir, "control_plane.sqlite"),
+                    ]
+                self._cp_log = os.path.join(self.log_dir, "control_plane.log")
+                self._cp_env = dict(env)
+                self.pg.spawn(self._cp_argv, self._cp_log, env)
+                _wait_for_server(self.cp_address)
         assert self.cp_address
+        if self.ha_dir:
+            # Inherited by every child this node spawns (ProcessGroup
+            # copies os.environ), so workers and the driver build their
+            # CP clients with the leader-endpoint resolver.
+            os.environ["RAY_TPU_CP_HA_DIR"] = self.ha_dir
         agent_port = find_free_port()
         self.agent_address = f"127.0.0.1:{agent_port}"
+        agent_argv = [
+            sys.executable, "-m", "ray_tpu.core.node_agent",
+            "--port", str(agent_port),
+            "--cp-address", self.cp_address,
+            "--session-id", self.session_id,
+            # The head's agent owns session-wide shm cleanup on
+            # parent-death; worker/client agents must never delete the
+            # shared arena (same ownership rule as Node.stop()).
+            "--owns-session-shm", "1" if self.head else "0",
+            "--resources", json.dumps(self.resources),
+            "--labels", json.dumps(self.labels),
+        ]
+        if self.ha_dir:
+            agent_argv += ["--cp-ha-dir", self.ha_dir]
         self.pg.spawn(
-            [
-                sys.executable, "-m", "ray_tpu.core.node_agent",
-                "--port", str(agent_port),
-                "--cp-address", self.cp_address,
-                "--session-id", self.session_id,
-                # The head's agent owns session-wide shm cleanup on
-                # parent-death; worker/client agents must never delete the
-                # shared arena (same ownership rule as Node.stop()).
-                "--owns-session-shm", "1" if self.head else "0",
-                "--resources", json.dumps(self.resources),
-                "--labels", json.dumps(self.labels),
-            ],
+            agent_argv,
             os.path.join(self.log_dir, "node_agent.log"),
             env,
         )
@@ -206,14 +223,127 @@ class Node:
             os.makedirs(os.path.dirname(_HEAD_INFO_FILE), exist_ok=True)
             with open(_HEAD_INFO_FILE, "w") as f:
                 json.dump(
-                    {"cp_address": self.cp_address, "session_id": self.session_id}, f
+                    {
+                        "cp_address": self.cp_address,
+                        "session_id": self.session_id,
+                        "ha_dir": self.ha_dir,
+                    },
+                    f,
                 )
         return self
+
+    # ------------------------------------------------------------ HA head
+    def _start_cp_candidates(self, env: dict, count: int = 2):
+        """Spawn ``count`` control-plane candidates over one shared HA
+        directory; whichever wins the leader lease serves, the rest tail
+        the journal as warm standbys."""
+        self.ha_dir = os.path.join(self.log_dir, "cp_ha")
+        os.makedirs(self.ha_dir, exist_ok=True)
+        for i in range(count):
+            self._spawn_cp_candidate(i, env)
+        self.cp_address = self._wait_for_leader()
+
+    def _spawn_cp_candidate(self, index: int, env: dict):
+        port = find_free_port()
+        argv = [
+            sys.executable, "-m", "ray_tpu.core.control_plane",
+            "--port", str(port),
+            "--session-id", self.session_id,
+            "--ha-dir", self.ha_dir,
+        ]
+        log = os.path.join(self.log_dir, f"control_plane_{index}.log")
+        proc = self.pg.spawn(argv, log, env)
+        cand = {
+            "proc": proc,
+            "address": f"127.0.0.1:{port}",
+            "argv": argv,
+            "log": log,
+            "env": dict(env),
+            "index": index,
+        }
+        if index < len(self._cp_candidates):
+            self._cp_candidates[index] = cand
+        else:
+            self._cp_candidates.append(cand)
+        return cand
+
+    def _wait_for_leader(self, timeout: float = 30.0) -> str:
+        """Block until a candidate published the leader endpoint AND
+        answers ping there."""
+        from .cp_ha import read_endpoint
+
+        deadline = time.monotonic() + timeout
+        last = None
+        while time.monotonic() < deadline:
+            info = read_endpoint(self.ha_dir)
+            if info and info.get("address"):
+                try:
+                    _wait_for_server(info["address"], timeout=2.0)
+                    return info["address"]
+                except TimeoutError as e:
+                    last = e  # leader died between publish and now
+            time.sleep(0.05)
+        raise TimeoutError(f"no control-plane leader elected: {last}")
+
+    def leader_epoch(self) -> int:
+        from .cp_ha import read_endpoint
+
+        info = read_endpoint(self.ha_dir) if self.ha_dir else None
+        return info.get("epoch", 0) if info else 0
+
+    def kill_leader(self) -> int:
+        """``kill -9`` the current leader candidate; returns the epoch it
+        served under (pass to ``wait_for_failover``)."""
+        assert self.head and self._cp_candidates, "HA head required"
+        from .cp_ha import read_endpoint
+
+        info = read_endpoint(self.ha_dir) or {}
+        leader_address = info.get("address")
+        epoch = info.get("epoch", 0)
+        for cand in self._cp_candidates:
+            if cand["address"] == leader_address and cand["proc"].poll() is None:
+                cand["proc"].kill()
+                cand["proc"].wait(timeout=10)
+                return epoch
+        raise RuntimeError(f"no live candidate serves {leader_address}")
+
+    def wait_for_failover(self, old_epoch: int, timeout: float = 30.0) -> str:
+        """Block until a NEWER leader (epoch > old_epoch) serves; updates
+        and returns ``cp_address``."""
+        from .cp_ha import read_endpoint
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            info = read_endpoint(self.ha_dir)
+            if info and info.get("epoch", 0) > old_epoch and info.get("address"):
+                try:
+                    _wait_for_server(info["address"], timeout=2.0)
+                    self.cp_address = info["address"]
+                    return info["address"]
+                except TimeoutError:
+                    pass
+            time.sleep(0.05)
+        raise TimeoutError(
+            f"no failover past epoch {old_epoch} within {timeout}s"
+        )
+
+    def ensure_standby(self):
+        """Respawn any dead candidate so the cluster regains a warm
+        standby after a failover (the chaos injector's revert)."""
+        assert self.head and self.ha_dir
+        for cand in list(self._cp_candidates):
+            if cand["proc"].poll() is not None:
+                try:
+                    self.pg.procs.remove(cand["proc"])
+                except ValueError:
+                    pass
+                self._spawn_cp_candidate(cand["index"], cand["env"])
 
     def kill_control_plane(self):
         """Hard-kill the control-plane process (head nodes only) — the
         GCS-crash half of the restart-FT test story."""
         assert self.head, "control plane runs on the head node"
+        assert not self._cp_candidates, "HA mode: use kill_leader()"
         proc = self.pg.procs[0]
         proc.kill()
         proc.wait(timeout=10)
@@ -233,6 +363,11 @@ class Node:
         _wait_for_server(self.cp_address)
 
     def stop(self):
+        # The HA discovery env var must die with the node that exported
+        # it: a later non-HA init in this process would otherwise build
+        # resolvers on this (now dead) session's endpoint record.
+        if self.ha_dir and os.environ.get("RAY_TPU_CP_HA_DIR") == self.ha_dir:
+            del os.environ["RAY_TPU_CP_HA_DIR"]
         self.pg.kill_all()
         from .object_store import drop_arena
 

@@ -117,12 +117,24 @@ class NodeAgent:
         resources: Dict[str, float],
         labels: Dict[str, str],
         node_id: Optional[NodeID] = None,
+        cp_ha_dir: Optional[str] = None,
     ):
         self.node_id = node_id or NodeID.from_random()
         self.session_id = session_id
         self.cp_address = cp_address
+        self.cp_ha_dir = cp_ha_dir
         self.server = RpcServer(self, host, port, lanes=resolve_service_lanes())
-        self.cp_client = RetryableRpcClient(cp_address)
+        # With HA, every reconnect re-resolves the published leader
+        # endpoint — failover re-anchoring IS the plain reconnect path
+        # (heartbeat's "reregister" reply then replays node state).
+        resolver = None
+        if cp_ha_dir:
+            from .cp_ha import make_cp_resolver
+
+            resolver = make_cp_resolver(cp_ha_dir, cp_address)
+        self.cp_client = RetryableRpcClient(
+            cp_address, address_resolver=resolver
+        )
         self.agent_clients = ClientPool()  # peers, for remote pulls
         self.worker_clients = ClientPool()  # local workers (actor_init etc.)
         self.resources = NodeResources(resources, labels)
@@ -444,7 +456,9 @@ class NodeAgent:
         env.update(
             RAY_TPU_WORKER_ID=worker_id.hex(),
             RAY_TPU_AGENT_ADDRESS=self.server.address,
-            RAY_TPU_CP_ADDRESS=self.cp_address,
+            # The leader may have moved since this agent started: point
+            # new workers at the client's CURRENT resolved address.
+            RAY_TPU_CP_ADDRESS=self.cp_client.address,
             RAY_TPU_SESSION_ID=self.session_id,
             RAY_TPU_NODE_ID=self.node_id.hex(),
             # Log lines (and crash dumps) must reach the file when they
@@ -452,6 +466,8 @@ class NodeAgent:
             # worker would otherwise leave an empty log.
             PYTHONUNBUFFERED="1",
         )
+        if self.cp_ha_dir:
+            env["RAY_TPU_CP_HA_DIR"] = self.cp_ha_dir
         log_dir = os.environ.get("RAY_TPU_LOG_DIR", "/tmp/ray_tpu")
         os.makedirs(log_dir, exist_ok=True)
         out = open(os.path.join(log_dir, f"worker-{worker_id.hex()[:12]}.log"), "ab")
@@ -1556,6 +1572,11 @@ def main():
     )
     parser.add_argument("--resources", required=True, help="JSON dict")
     parser.add_argument("--labels", default="{}", help="JSON dict")
+    parser.add_argument(
+        "--cp-ha-dir", default=None,
+        help="control-plane HA directory; the CP client follows the "
+        "published leader endpoint across failovers",
+    )
     args = parser.parse_args()
 
     def _unlink_session_arena(session_id=args.session_id):
@@ -1639,6 +1660,7 @@ def main():
             args.session_id,
             json.loads(args.resources),
             json.loads(args.labels),
+            cp_ha_dir=args.cp_ha_dir,
         )
         await agent.start()
         await asyncio.Event().wait()

@@ -107,6 +107,24 @@ class RpcVersionError(RpcError):
     """Peer's protocol version is outside our compatibility window."""
 
 
+class NotLeaderError(RpcError):
+    """The peer is a control-plane STANDBY (or a freshly fenced stale
+    leader): the request must be retried against the current leader.
+    ``RetryableRpcClient`` treats this like a transport failure — drop
+    the connection, re-resolve the leader endpoint, back off, retry —
+    so callers never see it for idempotent calls."""
+
+    def __init__(self, leader_hint=None):
+        super().__init__(f"peer is not the control-plane leader "
+                         f"(current: {leader_hint or 'unknown'})")
+        self.leader_hint = leader_hint
+
+    def __reduce__(self):
+        # Crosses the wire inside an error reply; replay __init__ with
+        # the hint, not the joined message (same trap as RpcRemoteError).
+        return (NotLeaderError, (self.leader_hint,))
+
+
 class RpcRemoteError(RpcError):
     """The remote handler raised; carries the remote traceback string."""
 
@@ -1302,10 +1320,16 @@ class RetryableRpcClient:
     ``RetryableGrpcClient``.  Only retries on transport failures, never on
     remote exceptions; callers must ensure retried methods are idempotent."""
 
-    def __init__(self, address: Address, push_handler=None, on_disconnect=None):
+    def __init__(self, address: Address, push_handler=None, on_disconnect=None,
+                 address_resolver=None):
         self.address = address
         self._push_handler = push_handler
         self._on_disconnect = on_disconnect
+        # Optional leader discovery (cp_ha.make_cp_resolver): re-invoked
+        # before every (re)connect, so after a control-plane failover the
+        # normal reconnect loop transparently re-anchors the client to
+        # the new leader's published endpoint.
+        self._address_resolver = address_resolver
         self._client: Optional[RpcClient] = None
         self._connect_lock = asyncio.Lock()
 
@@ -1317,6 +1341,13 @@ class RetryableRpcClient:
             client = self._client
             if client and client.connected:
                 return client
+            if self._address_resolver is not None:
+                try:
+                    resolved = self._address_resolver()
+                    if resolved:
+                        self.address = resolved
+                except Exception as e:  # noqa: BLE001 — discovery is advisory
+                    logger.debug("address resolver failed: %s", e)
             # Work on a LOCAL and publish only after connect succeeds: a
             # concurrent call's failure path nulls self._client, and
             # returning the attribute (not the local) could hand back
@@ -1336,17 +1367,55 @@ class RetryableRpcClient:
         retries = retries if retries is not None else GlobalConfig.rpc_max_retries
         delay = GlobalConfig.rpc_retry_base_delay_s
         last_exc = None
+        # With leader discovery attached (HA mode), the attempt budget
+        # alone can drain INSIDE a leaderless failover window (old leader
+        # dead, standby still replaying the journal tail) — so retrying
+        # also continues until a grace window sized from the election
+        # parameters has elapsed.  Plain clients keep pure attempt counts.
+        ha_grace = 0.0
+        if self._address_resolver is not None:
+            ha_grace = max(
+                5.0,
+                3.0 * (GlobalConfig.cp_lease_ttl_s
+                       + GlobalConfig.cp_lease_poll_s),
+            )
+        started = time.monotonic()
+        attempts = 0
         # False only when EVERY attempt died inside connect(): the request
         # frame was never written to any socket, so the peer provably never
         # saw it.  Callers use this to tell "request may have executed"
         # from "request never left this process" (e.g. a task push is
         # exactly-once safe to re-lease in the latter case).
         maybe_delivered = False
-        for _attempt in range(max(1, retries)):
+
+        def exhausted() -> bool:
+            if attempts < max(1, retries):
+                return False
+            return time.monotonic() - started >= ha_grace
+
+        while True:
+            attempts += 1
             try:
                 client = await self._ensure()
                 maybe_delivered = True
                 return await client.call(method, payload, timeout, batch=batch)
+            except RpcRemoteError as e:
+                # A standby (or freshly fenced stale leader) answered:
+                # the request did NOT execute — drop the connection and
+                # retry, letting _ensure()'s resolver find the leader.
+                if not isinstance(e.cause, NotLeaderError):
+                    raise
+                last_exc = e
+                dropped, self._client = self._client, None
+                if dropped is not None:
+                    try:
+                        await dropped.close()
+                    except Exception:  # raylint: waive[RTL003] stale-leader socket; reconnect follows
+                        pass
+                if exhausted():
+                    break
+                await asyncio.sleep(delay)
+                delay = next_backoff_delay(delay)
             except (
                 RpcConnectionError, ConnectionError, OSError,
                 asyncio.TimeoutError,
@@ -1367,10 +1436,12 @@ class RetryableRpcClient:
                         await dropped.close()
                     except Exception:  # raylint: waive[RTL003] half-dead socket; reconnect follows
                         pass
+                if exhausted():
+                    break
                 await asyncio.sleep(delay)
                 delay = next_backoff_delay(delay)
         exc = RpcConnectionError(
-            f"rpc {method} to {self.address} failed after {retries} attempts: {last_exc}"
+            f"rpc {method} to {self.address} failed after {attempts} attempts: {last_exc}"
         )
         exc.maybe_delivered = maybe_delivered
         raise exc

@@ -4,28 +4,47 @@ Equivalent of the reference's GCS ``StoreClient`` hierarchy
 (ray ``src/ray/gcs/store_client/store_client.h``: in-memory default,
 ``redis_store_client.h:126`` for HA) behind the same two-method surface the
 GCS table storage uses (``gcs/gcs_table_storage.h:200``).  TPU-native
-redesign: instead of an external Redis, the durable backend is an embedded
-sqlite journal under the session directory — one file, crash-atomic
-(WAL), zero extra processes to operate — which is the right trade for a
-single-control-plane cluster on a TPU pod (the reference needs Redis
-because its HA story is multi-GCS; ours is restart-with-reload, covered by
-every client's retrying reconnect + re-register protocol).
+redesign: instead of an external Redis, the durable backends are embedded
+under the session directory — zero extra processes to operate — which is
+the right trade for a control plane on a TPU pod:
 
-Tables are string-named ("kv", "actors", "pgs", "jobs"); values are opaque
-bytes (callers pickle).  All methods are synchronous and fast (sqlite WAL
-commit ~100 µs) — they are called from the control plane's event loop on
-mutation paths only, never on reads (reads hit the in-memory state that
-``load_all`` rebuilt at startup).
+  - ``SqliteStoreClient``: one crash-atomic (WAL) file, for the
+    single-control-plane restart-with-reload story, covered by every
+    client's retrying reconnect + re-register protocol.
+  - ``JournaledStoreClient``: a segmented write-ahead journal plus
+    periodic snapshots, for the HA story (``core/cp_ha.py``) — a warm
+    standby TAILS the journal to hold the full table set hot, and on
+    lease takeover ``promote()``s into the writer role under a new
+    fencing epoch, so the reference's replicated-Redis role is played by
+    a shared filesystem journal instead of an external store.
+
+Tables are string-named ("kv", "actors", "pgs", "jobs", "obs_seen");
+values are opaque bytes (callers pickle).  All methods are synchronous and
+fast — they are called from the control plane's event loop on mutation
+paths only, never on reads (reads hit the in-memory state that recovery
+rebuilt at startup).
 """
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
+import pickle
+import re
 import sqlite3
-from typing import Dict, Iterator, Optional, Tuple
+import struct
+import time
+import zlib
+from typing import Dict, Iterator, List, Optional, Tuple
 
 logger = logging.getLogger(__name__)
+
+
+class FencedWriteError(Exception):
+    """A journal append was rejected because this writer's leader lease
+    epoch is no longer current — a newer leader exists.  The only safe
+    reaction is to stop writing and exit; retrying cannot succeed."""
 
 
 class StoreClient:
@@ -41,6 +60,12 @@ class StoreClient:
 
     def scan(self, table: str) -> Iterator[Tuple[str, bytes]]:
         raise NotImplementedError
+
+    @contextlib.contextmanager
+    def transaction(self):
+        """Group several puts/deletes into one atomic unit where the
+        backend supports it (sqlite); elsewhere a no-op grouping."""
+        yield
 
     def close(self) -> None:
         pass
@@ -76,6 +101,7 @@ class SqliteStoreClient(StoreClient):
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self.path = path
         self._db = sqlite3.connect(path)
+        self._in_txn = False
         self._db.execute("PRAGMA journal_mode=WAL")
         self._db.execute("PRAGMA synchronous=NORMAL")
         self._db.execute(
@@ -90,13 +116,34 @@ class SqliteStoreClient(StoreClient):
             "INSERT OR REPLACE INTO store (tbl, key, value) VALUES (?, ?, ?)",
             (table, key, sqlite3.Binary(value)),
         )
-        self._db.commit()
+        if not self._in_txn:
+            self._db.commit()
 
     def delete(self, table: str, key: str) -> None:
         self._db.execute(
             "DELETE FROM store WHERE tbl = ? AND key = ?", (table, key)
         )
-        self._db.commit()
+        if not self._in_txn:
+            self._db.commit()
+
+    @contextlib.contextmanager
+    def transaction(self):
+        """Multi-table mutations (e.g. a preemption persisting the PG and
+        its evicted actors) commit atomically: a crash mid-group leaves
+        the store at the previous commit point, never half-applied."""
+        if self._in_txn:  # reentrant: inner group joins the outer one
+            yield
+            return
+        self._in_txn = True
+        try:
+            yield
+        except BaseException:
+            self._db.rollback()
+            raise
+        else:
+            self._db.commit()
+        finally:
+            self._in_txn = False
 
     def scan(self, table: str):
         cur = self._db.execute(
@@ -110,6 +157,440 @@ class SqliteStoreClient(StoreClient):
             self._db.close()
         except Exception as e:
             logger.debug("store db close failed: %s", e)
+
+
+# --------------------------------------------------------------- journal
+#
+# Record wire format (one file per leader epoch, ``journal-<epoch>.wal``):
+#
+#     [4B LE payload length][4B LE crc32(payload)][payload]
+#     payload = pickle((seq, op, table, key, value))
+#
+# ``seq`` is a journal-wide monotonic sequence; ``op`` is "put" / "del" /
+# "seal".  A seal is the FIRST record of every segment: its value maps
+# prior segment filenames to their valid byte lengths, so records a fenced
+# stale leader appended after the takeover point are never replayed (and
+# crash-torn tails — short reads or crc mismatches — stop replay of a
+# segment early by construction).  Snapshots (``snapshot-<seq>.pkl``) are
+# whole-table pickles written tmp+rename; replay starts from the newest
+# loadable snapshot and skips records at or below its sequence.
+
+_REC_HDR = struct.Struct("<II")
+_REC_MAX = 1 << 28  # corruption guard: no record is anywhere near 256 MiB
+_SEG_RE = re.compile(r"^journal-(\d{8})\.wal$")
+_SNAP_RE = re.compile(r"^snapshot-(\d{16})\.pkl$")
+
+
+def _encode_record(rec) -> bytes:
+    payload = pickle.dumps(rec, protocol=pickle.HIGHEST_PROTOCOL)
+    return _REC_HDR.pack(len(payload), zlib.crc32(payload) & 0xFFFFFFFF) + payload
+
+
+def _read_records(f, limit: Optional[int] = None):
+    """Yield ``(record, end_offset)`` for each COMPLETE record from the
+    file's current position, stopping cleanly at a torn tail (short read
+    or crc mismatch) or at ``limit`` bytes."""
+    off = f.tell()
+    while True:
+        if limit is not None and off >= limit:
+            return
+        hdr = f.read(_REC_HDR.size)
+        if len(hdr) < _REC_HDR.size:
+            f.seek(off)
+            return
+        length, crc = _REC_HDR.unpack(hdr)
+        if length > _REC_MAX:
+            f.seek(off)
+            return
+        payload = f.read(length)
+        if len(payload) < length or (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+            f.seek(off)
+            return
+        off += _REC_HDR.size + length
+        try:
+            rec = pickle.loads(payload)
+        except Exception:  # raylint: waive[RTL003] torn/corrupt tail ends replay
+            f.seek(off - _REC_HDR.size - length)
+            return
+        yield rec, off
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError as e:
+        logger.debug("dir fsync failed for %s: %s", path, e)
+
+
+class JournaledStoreClient(StoreClient):
+    """Write-ahead journal + snapshots under a shared directory.
+
+    Two roles over one class:
+      - FOLLOWER (constructed without a lease): loads the newest snapshot
+        plus journal, then ``tail()`` applies new records incrementally,
+        keeping the in-memory table mirror hot for an instant takeover.
+      - LEADER (after ``promote(lease)``): opens a fresh epoch segment,
+        seals every prior segment at the replayed length, and appends
+        mutations — each append first checks the lease (``verify()``
+        raises ``FencedWriteError`` once a newer epoch exists), then
+        writes + flushes the record to the OS (surviving ``kill -9`` of
+        the process) with fsyncs batched on a time interval.
+
+    Compaction: once ``compact_bytes`` of journal accumulate past the
+    last snapshot, the leader writes a new snapshot and deletes sealed
+    (non-active) segments and older snapshots; the active segment is
+    reclaimed at the next promote.
+    """
+
+    durable = True
+
+    def __init__(self, dir_path: str, fsync_interval_s: Optional[float] = None,
+                 compact_bytes: Optional[int] = None):
+        from .config import GlobalConfig
+
+        os.makedirs(dir_path, exist_ok=True)
+        self.dir = dir_path
+        self._fsync_interval = (
+            fsync_interval_s if fsync_interval_s is not None
+            else GlobalConfig.cp_journal_fsync_interval_s
+        )
+        self._compact_bytes = (
+            compact_bytes if compact_bytes is not None
+            else GlobalConfig.cp_journal_compact_bytes
+        )
+        self._tables: Dict[str, Dict[str, bytes]] = {}
+        self.applied_seq = 0
+        self.epoch = 0               # epoch of the segment being read/written
+        self.snapshot_seq = 0
+        self.records_written = 0
+        self._lease = None
+        self._write_f = None
+        self._read_f = None
+        self._read_name: Optional[str] = None
+        self._consumed: Dict[str, int] = {}  # segment -> bytes replayed
+        self._bytes_since_snap = 0
+        self._last_fsync = time.monotonic()
+        self._load()
+
+    # ------------------------------------------------------------- loading
+    def _segments(self) -> List[Tuple[int, str]]:
+        out = []
+        try:
+            names = os.listdir(self.dir)
+        except FileNotFoundError:
+            return []
+        for name in names:
+            m = _SEG_RE.match(name)
+            if m:
+                out.append((int(m.group(1)), name))
+        out.sort()
+        return out
+
+    def _snapshots(self) -> List[Tuple[int, str]]:
+        out = []
+        for name in os.listdir(self.dir):
+            m = _SNAP_RE.match(name)
+            if m:
+                out.append((int(m.group(1)), name))
+        out.sort()
+        return out
+
+    def _load(self) -> None:
+        """Full (re)build: newest loadable snapshot, then a two-pass
+        journal replay — pass 1 scans every segment for seal caps (seals
+        in LATER segments cap EARLIER ones), pass 2 applies put/del
+        records inside the capped regions in epoch order."""
+        if self._read_f is not None:
+            try:
+                self._read_f.close()
+            except OSError:
+                pass
+            self._read_f = None
+            self._read_name = None
+        self._tables = {}
+        self.applied_seq = 0
+        self.snapshot_seq = 0
+        self._consumed = {}
+        for snap_seq, name in reversed(self._snapshots()):
+            try:
+                with open(os.path.join(self.dir, name), "rb") as f:
+                    snap = pickle.load(f)
+                self._tables = {
+                    t: dict(kv) for t, kv in snap["tables"].items()
+                }
+                self.applied_seq = self.snapshot_seq = snap["seq"]
+                break
+            except Exception as e:  # raylint: waive[RTL003] torn snapshot: fall back to the previous one
+                logger.warning("journal snapshot %s unreadable: %s", name, e)
+        segs = self._segments()
+        caps: Dict[str, int] = {}
+        lengths: Dict[str, int] = {}
+        for _epoch, name in segs:
+            valid = 0
+            try:
+                with open(os.path.join(self.dir, name), "rb") as f:
+                    for rec, end in _read_records(f):
+                        if rec[1] == "seal" and isinstance(rec[4], dict):
+                            for capped, length in rec[4].items():
+                                caps[capped] = min(
+                                    caps.get(capped, length), length
+                                )
+                        valid = end
+            except OSError as e:
+                logger.warning("journal segment %s unreadable: %s", name, e)
+            lengths[name] = valid
+        for epoch, name in segs:
+            limit = min(lengths[name], caps.get(name, lengths[name]))
+            try:
+                with open(os.path.join(self.dir, name), "rb") as f:
+                    for rec, end in _read_records(f, limit=limit):
+                        self._apply(rec)
+                        self._consumed[name] = end
+            except OSError:
+                continue
+            self._consumed.setdefault(name, 0)
+            self.epoch = epoch
+        if segs:
+            # Keep tailing the newest segment from where replay stopped.
+            _epoch, name = segs[-1]
+            try:
+                self._read_f = open(os.path.join(self.dir, name), "rb")
+                self._read_f.seek(self._consumed.get(name, 0))
+                self._read_name = name
+            except OSError:
+                self._read_f = None
+
+    def _apply(self, rec) -> None:
+        seq, op, table, key, value = rec
+        if seq <= self.applied_seq:
+            return
+        self.applied_seq = seq
+        if op == "put":
+            self._tables.setdefault(table, {})[key] = value
+        elif op == "del":
+            self._tables.get(table, {}).pop(key, None)
+        # "seal" records only advance the sequence.
+
+    # ------------------------------------------------------------- follower
+    def tail(self) -> int:
+        """Apply any newly appended complete records; returns the number
+        applied.  Crossing into a newer-epoch segment validates the seal
+        caps — if this follower somehow replayed PAST a cap (stale-leader
+        records), it rebuilds from scratch instead of serving them."""
+        applied = 0
+        while True:
+            applied += self._drain_current()
+            nxt = None
+            for epoch, name in self._segments():
+                if epoch > self.epoch:
+                    nxt = (epoch, name)
+                    break
+            if nxt is None:
+                return applied
+            epoch, name = nxt
+            try:
+                f = open(os.path.join(self.dir, name), "rb")
+            except OSError:
+                # Compacted away mid-switch: rebuild from snapshot.
+                self._load()
+                return applied
+            it = _read_records(f)
+            try:
+                rec, end = next(it)
+            except StopIteration:
+                # Seal not flushed yet; retry on the next tail().
+                f.close()
+                return applied
+            if rec[1] == "seal" and isinstance(rec[4], dict):
+                for capped, length in rec[4].items():
+                    if self._consumed.get(capped, 0) > length:
+                        f.close()
+                        self._load()
+                        return applied
+                    if (
+                        capped == self._read_name
+                        and self._read_f is not None
+                        and self._consumed.get(capped, 0) < length
+                    ):
+                        # Records we haven't replayed yet live below the
+                        # cap; drain them before switching (the fd stays
+                        # valid even if the file was unlinked).
+                        for old_rec, old_end in _read_records(
+                            self._read_f, limit=length
+                        ):
+                            self._apply(old_rec)
+                            self._consumed[capped] = old_end
+                            applied += 1
+            self._apply(rec)
+            if self._read_f is not None:
+                try:
+                    self._read_f.close()
+                except OSError:
+                    pass
+            self._read_f = f
+            self._read_name = name
+            self._consumed[name] = end
+            self.epoch = epoch
+            applied += 1
+
+    def _drain_current(self) -> int:
+        if self._read_f is None:
+            return 0
+        n = 0
+        for rec, end in _read_records(self._read_f):
+            self._apply(rec)
+            self._consumed[self._read_name] = end
+            n += 1
+        return n
+
+    # --------------------------------------------------------------- leader
+    def promote(self, lease) -> None:
+        """Become the writer for ``lease.epoch``: replay everything still
+        in the journal, open the new epoch's segment, seal all prior
+        segments at exactly the replayed lengths (excluding torn tails and
+        anything a fenced stale leader appends later), snapshot, and
+        reclaim the old files."""
+        self.tail()
+        caps = dict(self._consumed)
+        if self._read_f is not None:
+            try:
+                self._read_f.close()
+            except OSError:
+                pass
+            self._read_f = None
+            self._read_name = None
+        self._lease = lease
+        self.epoch = lease.epoch
+        name = f"journal-{lease.epoch:08d}.wal"
+        self._write_f = open(os.path.join(self.dir, name), "ab")
+        self.applied_seq += 1
+        seal = _encode_record((self.applied_seq, "seal", "", "", caps))
+        self._write_f.write(seal)
+        self._write_f.flush()
+        os.fsync(self._write_f.fileno())
+        _fsync_dir(self.dir)
+        self._last_fsync = time.monotonic()
+        self._bytes_since_snap = 0
+        self._write_snapshot()
+        for _epoch, old in self._segments():
+            if old != name:
+                try:
+                    os.unlink(os.path.join(self.dir, old))
+                except OSError as e:
+                    logger.debug("stale segment unlink failed: %s", e)
+
+    def put(self, table: str, key: str, value: bytes) -> None:
+        self._append("put", table, key, value)
+        self._tables.setdefault(table, {})[key] = value
+
+    def delete(self, table: str, key: str) -> None:
+        self._append("del", table, key, None)
+        self._tables.get(table, {}).pop(key, None)
+
+    def _append(self, op: str, table: str, key: str, value) -> None:
+        if self._write_f is None:
+            raise FencedWriteError("journal not promoted to writer")
+        if self._lease is not None:
+            self._lease.verify()  # raises FencedWriteError when superseded
+        rec = _encode_record((self.applied_seq + 1, op, table, key, value))
+        self._write_f.write(rec)
+        # flush() pushes to the OS page cache: a kill -9 of THIS process
+        # loses nothing (the standby on the same host reads it back);
+        # fsync (whole-host crash safety) is batched on a time interval,
+        # the same bounded window as sqlite synchronous=NORMAL.
+        self._write_f.flush()
+        self.applied_seq += 1
+        self.records_written += 1
+        self._bytes_since_snap += len(rec)
+        now = time.monotonic()
+        if now - self._last_fsync >= self._fsync_interval:
+            os.fsync(self._write_f.fileno())
+            self._last_fsync = now
+        if self._bytes_since_snap >= self._compact_bytes:
+            self._compact()
+
+    def _write_snapshot(self) -> None:
+        name = f"snapshot-{self.applied_seq:016d}.pkl"
+        tmp = os.path.join(self.dir, name + ".tmp")
+        with open(tmp, "wb") as f:
+            pickle.dump(
+                {"seq": self.applied_seq, "epoch": self.epoch,
+                 "tables": self._tables},
+                f, protocol=pickle.HIGHEST_PROTOCOL,
+            )
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self.dir, name))
+        _fsync_dir(self.dir)
+        for snap_seq, old in self._snapshots():
+            if snap_seq < self.applied_seq:
+                try:
+                    os.unlink(os.path.join(self.dir, old))
+                except OSError as e:
+                    logger.debug("old snapshot unlink failed: %s", e)
+        self.snapshot_seq = self.applied_seq
+
+    def _compact(self) -> None:
+        os.fsync(self._write_f.fileno())
+        self._last_fsync = time.monotonic()
+        self._write_snapshot()
+        for _epoch, old in self._segments():
+            if _epoch < self.epoch:
+                try:
+                    os.unlink(os.path.join(self.dir, old))
+                except OSError as e:
+                    logger.debug("sealed segment unlink failed: %s", e)
+        self._bytes_since_snap = 0
+
+    # ---------------------------------------------------------------- reads
+    def scan(self, table: str):
+        return iter(list(self._tables.get(table, {}).items()))
+
+    def journal_stats(self) -> dict:
+        return {
+            "epoch": self.epoch,
+            "applied_seq": self.applied_seq,
+            "snapshot_seq": self.snapshot_seq,
+            "records_written": self.records_written,
+            "lag_bytes": self.lag_bytes(),
+            "role": "leader" if self._write_f is not None else "follower",
+        }
+
+    def lag_bytes(self) -> int:
+        """Follower: bytes appended to the journal but not yet replayed
+        here.  Leader: always 0 (it applies as it writes)."""
+        if self._write_f is not None:
+            return 0
+        lag = 0
+        for epoch, name in self._segments():
+            try:
+                size = os.path.getsize(os.path.join(self.dir, name))
+            except OSError:
+                continue
+            if epoch < self.epoch:
+                continue
+            lag += max(0, size - self._consumed.get(name, 0))
+        return lag
+
+    def close(self) -> None:
+        if self._write_f is not None:
+            try:
+                self._write_f.flush()
+                os.fsync(self._write_f.fileno())
+                self._write_f.close()
+            except OSError as e:
+                logger.debug("journal close failed: %s", e)
+            self._write_f = None
+        if self._read_f is not None:
+            try:
+                self._read_f.close()
+            except OSError:
+                pass
+            self._read_f = None
 
 
 def make_store_client(path: Optional[str]) -> StoreClient:

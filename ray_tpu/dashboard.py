@@ -108,6 +108,9 @@ def start_dashboard(
                 # Per-job arbitration state (priority, quota, charged
                 # usage, admission-queued counts) — who is starving whom.
                 "scheduling": state.get("scheduling", {}),
+                # Control-plane HA: role, lease epoch, journal stats and
+                # per-standby replication lag (see docs/ha.md).
+                "cp": state.get("cp", {}),
             }
         )
 

@@ -25,6 +25,9 @@ Injectors:
     bandwidth for one algorithm (the slow-link model), driving the
     bandwidth-drift rule; the remediation re-probe lets the tuner
     re-commit around the throttled path.
+  - ``KilledLeader`` — SIGKILLs the control-plane leader of an HA head;
+    recovery is the warm standby taking the lease and clients
+    re-anchoring (docs/ha.md), ``revert()`` respawns the standby.
 
 ``CollectiveFabricMember`` is the workload half of the collective
 scenario: a simulated fabric (timed memcpy at per-algorithm bandwidths)
@@ -313,6 +316,34 @@ class ThrottledCollectiveLink(ChaosInjector):
             )
         except Exception as e:  # noqa: BLE001 — member may already be gone
             logger.debug("ThrottledCollectiveLink revert skipped: %s", e)
+
+
+# ----------------------------------------------------- control-plane chaos
+class KilledLeader(ChaosInjector):
+    """``kill -9`` the control-plane leader of an HA head node.
+
+    The fault is the kill itself; recovery is the warm standby winning
+    the lease, replaying the journal tail, and publishing the new
+    endpoint — clients re-anchor through their resolver-backed retry
+    clients without surfacing errors.  ``apply()`` records the epoch
+    it deposed (``old_epoch``); tests assert the failover completed via
+    ``node.wait_for_failover(old_epoch)``.  ``revert()`` respawns the
+    dead candidate so the cluster leaves the scope with a warm standby
+    again (repeated apply/revert cycles are the failover soak)."""
+
+    def __init__(self, node):
+        self.node = node
+        self.old_epoch: int = 0
+
+    def apply(self) -> "KilledLeader":
+        self.old_epoch = self.node.kill_leader()
+        return self
+
+    def revert(self) -> None:
+        try:
+            self.node.ensure_standby()
+        except Exception as e:  # noqa: BLE001 — node may be tearing down
+            logger.debug("KilledLeader revert skipped: %s", e)
 
 
 # ------------------------------------------------------- arbitration chaos

@@ -156,6 +156,18 @@ def cmd_status(args) -> int:
     state = client.get_state()
     nodes = state["nodes"]
     alive = [n for n in nodes.values() if n["alive"]]
+    cp = state.get("cp") or {}
+    if cp.get("ha"):
+        journal = cp.get("journal") or {}
+        line = (f"control plane: role={cp.get('role', '?')} "
+                f"epoch={cp.get('epoch', 0)}")
+        if journal:
+            line += (f" journal-seq={journal.get('applied_seq', 0)}"
+                     f" records={journal.get('records_written', 0)}")
+        print(line)
+        for sb in cp.get("standbys") or []:
+            print(f"  standby {sb.get('holder', '?')} "
+                  f"lag={sb.get('lag_records', '?')} records")
     print(f"nodes: {len(alive)} alive / {len(nodes)} total")
     total, avail = {}, {}
     for info in alive:

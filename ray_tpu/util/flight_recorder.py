@@ -55,6 +55,11 @@ from .metric_registry import (  # noqa: F401 — re-exports
     COLLECTIVE_TUNER_BEST_BANDWIDTH,
     COLLECTIVE_TUNER_COMMITS_TOTAL,
     COLLECTIVE_TUNER_EXPLORATIONS_TOTAL,
+    CP_FAILOVERS_TOTAL,
+    CP_JOURNAL_LAG_RECORDS,
+    CP_JOURNAL_RECORDS_TOTAL,
+    CP_LEASE_EPOCH,
+    CP_ROLE,
     DATA_AUTOSCALE_EVENTS_TOTAL,
     DATA_BLOCKS_COALESCED_TOTAL,
     DATA_BLOCKS_EMITTED_TOTAL,
@@ -275,6 +280,41 @@ def record_rpc_lanes(server, role: str = "") -> None:
         prev["forwarded"] = forwarded
         prev["wait_sum"] = snap["dispatch_wait_sum_s"]
         prev["wait_count"] = snap["dispatch_wait_count"]
+
+
+_cp_ha_published: Dict[str, float] = {}
+
+
+def record_cp_ha(info: Dict) -> None:
+    """Publish control-plane HA telemetry from a ``_cp_ha_info()``
+    summary: role/epoch gauges, journal-append and failover counter
+    deltas, and the worst standby replication lag."""
+    if not GlobalConfig.enable_flight_recorder or not info:
+        return
+    epoch = info.get("epoch", 0)
+    gauge(CP_ROLE, 1.0 if info.get("role") == "leader" else 0.0)
+    gauge(CP_LEASE_EPOCH, float(epoch))
+    prev_epoch = _cp_ha_published.get("epoch")
+    if prev_epoch is not None and epoch > prev_epoch and prev_epoch >= 1:
+        # Every epoch bump past the first election is one failover.
+        counter(CP_FAILOVERS_TOTAL, float(epoch - prev_epoch))
+    if epoch:
+        _cp_ha_published["epoch"] = epoch
+    journal = info.get("journal") or {}
+    written = journal.get("records_written", 0)
+    prev_written = _cp_ha_published.get("records", 0)
+    if written < prev_written:
+        prev_written = 0  # a fresh leader's counter restarted at zero
+    if written > prev_written:
+        counter(CP_JOURNAL_RECORDS_TOTAL, float(written - prev_written))
+    _cp_ha_published["records"] = written
+    standbys = info.get("standbys")
+    if standbys is not None:
+        gauge(
+            CP_JOURNAL_LAG_RECORDS,
+            float(max((s.get("lag_records", 0) for s in standbys),
+                      default=0)),
+        )
 
 
 _pg_published: Dict[str, float] = {}

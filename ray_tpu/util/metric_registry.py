@@ -156,6 +156,13 @@ RL_STALE_TRAJS_DROPPED_TOTAL = "ray_tpu_rl_stale_trajs_dropped_total"
 RL_TRAJ_QUEUE_DEPTH = "ray_tpu_rl_traj_queue_depth"
 RL_RUNNER_RESTARTS_TOTAL = "ray_tpu_rl_runner_restarts_total"
 
+# -------------------------------------------- control-plane HA (PR 16)
+CP_ROLE = "ray_tpu_cp_role"
+CP_LEASE_EPOCH = "ray_tpu_cp_lease_epoch"
+CP_FAILOVERS_TOTAL = "ray_tpu_cp_failovers_total"
+CP_JOURNAL_RECORDS_TOTAL = "ray_tpu_cp_journal_records_total"
+CP_JOURNAL_LAG_RECORDS = "ray_tpu_cp_journal_lag_records"
+
 # ------------------------------------------------- runtime self-diagnosis
 EXCEPTION_SUPPRESSED_TOTAL = "ray_tpu_exception_suppressed_total"
 DEBUG_LOCK_CYCLES_TOTAL = "ray_tpu_debug_lock_cycles_total"
@@ -362,6 +369,15 @@ METRICS: Dict[str, str] = {
                              "(potential deadlocks)",
     DEBUG_LOCK_HELD_WAIT_HIST: "time blocked acquiring a lock while already "
                                "holding another (histogram)",
+    CP_ROLE: "control-plane role of this process (gauge: 1 = leader, "
+             "0 = standby)",
+    CP_LEASE_EPOCH: "current leader-lease fencing epoch (gauge)",
+    CP_FAILOVERS_TOTAL: "leader-lease epoch bumps observed beyond the "
+                        "first election (each is one failover)",
+    CP_JOURNAL_RECORDS_TOTAL: "control-plane journal records appended by "
+                              "this leader",
+    CP_JOURNAL_LAG_RECORDS: "worst standby replication lag in journal "
+                            "records (gauge; leader-side view)",
 }
 
 

@@ -486,6 +486,9 @@ class TestObsAggregator:
         cp = types.SimpleNamespace(
             _kv={}, task_event_store=TaskEventStore(), _obs_seen={},
             obs_beats=0,
+            # HA journaling of acked ids is a durability side effect the
+            # dedupe logic under test doesn't depend on.
+            _persist_obs_seen=lambda wid, bid: None,
         )
         row = {"name": "s", "start": 0.0, "end": 1.0, "worker_id": "wid",
                "node_id": "n", "extra": {"span": True, "span_id": "1"}}
