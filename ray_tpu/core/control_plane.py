@@ -29,6 +29,7 @@ import asyncio
 import os
 import logging
 import pickle
+import threading
 import time
 from collections import deque
 from typing import Dict, List, Optional, Set
@@ -177,6 +178,10 @@ class ControlPlane:
         self.named_actors: Dict[tuple, ActorID] = {}  # (namespace, name) -> id
         self.placement_groups: Dict[PlacementGroupID, PlacementGroupEntry] = {}
         self.jobs: Dict[JobID, dict] = {}
+        # job_heartbeat is lane-safe (runs on lane threads, PR 6); this
+        # lock covers its liveness-stamp write against primary-loop
+        # readers/expirers of the same job dict.
+        self._heartbeat_lock = threading.Lock()
         # pubsub: channel -> set of subscriber connections
         self._subs: Dict[str, Set[ServerConnection]] = {}
         self._pending_actors: List[ActorID] = []
@@ -663,7 +668,8 @@ class ControlPlane:
         job = self.jobs.get(payload["job_id"])
         if job is None:
             return {"ok": False, "reregister": True}
-        job["last_heartbeat"] = time.monotonic()
+        with self._heartbeat_lock:
+            job["last_heartbeat"] = time.monotonic()
         return {"ok": True}
 
     def handle_list_jobs(self, payload, conn):

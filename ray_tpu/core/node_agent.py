@@ -1086,7 +1086,9 @@ class NodeAgent:
         after client reconnects)."""
         owner_id = payload.get("owner_id")
         if not owner_id:
-            return True
+            # oneway handler (clients only .notify): no reply frame ever
+            # goes out, so returning a value would just be dead code.
+            return
         prev = self._owner_conns.get(owner_id)
         self._owner_conns[owner_id] = conn
         timer = self._owner_reap_timers.pop(owner_id, None)
@@ -1096,7 +1098,7 @@ class NodeAgent:
             for lease in self.leases.values():
                 if getattr(lease, "owner_id", None) == owner_id:
                     lease.owner_conn = conn
-        return True
+        return
 
     def _reap_lease(self, lease_id: int):
         """Release a dead owner's lease: free resources, KILL the worker
@@ -1305,7 +1307,9 @@ class NodeAgent:
                 self.directory.register_spilled(oid, payload["size"])
         elif self.shm_store.contains(oid):
             self.directory.seal(oid, payload["size"])
-        return True
+        # oneway handler (clients only .notify): the return value of a
+        # msg_id-0 frame is silently dropped, so don't fake an ack.
+        return
 
     def handle_free_objects(self, payload, conn):
         for oid in payload["object_ids"]:

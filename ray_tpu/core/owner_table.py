@@ -24,13 +24,15 @@ from __future__ import annotations
 from typing import Dict, Iterator, List, Tuple
 
 from ..util.debug_locks import make_lock
+from ..util import debug_lanes
 
 
 class OwnerTable:
     """Dict-compatible sharded map (the subset of the dict API the
     core worker uses), plus per-shard accessors and counters."""
 
-    __slots__ = ("_shards", "_locks", "_mask", "num_shards", "lookups")
+    __slots__ = ("_shards", "_locks", "_mask", "num_shards", "lookups",
+                 "_lane_tags")
 
     def __init__(self, num_shards: int = 16):
         # Power-of-two shard count so routing is a mask, not a modulo.
@@ -44,6 +46,15 @@ class OwnerTable:
             make_lock(f"core_worker.owner_table.shard{i}") for i in range(n)
         ]
         self.lookups = [0] * n  # per-shard get() count (hot-path telemetry)
+        # RAY_TPU_DEBUG_LANES=1: per-shard lane tags.  Mutations from
+        # registered lane threads must hold the shard lock; the user
+        # thread and primary loop stay lock-free per the GIL-atomic
+        # thread model above.  None when off — mutators pay one is-None
+        # check, reads pay nothing.
+        self._lane_tags = (
+            [debug_lanes.LaneTag(f"owner_table.shard{i}") for i in range(n)]
+            if debug_lanes.debug_lanes_enabled() else None
+        )
 
     def shard_index(self, oid) -> int:
         # IDs precompute their hash at construction (ids.py __slots__
@@ -55,8 +66,15 @@ class OwnerTable:
 
     def shard_lock(self, oid):
         """Lock guarding compound mutations of ``oid``'s shard from off
-        the primary loop (lane-safe accessor contract)."""
-        return self._locks[oid._hash & self._mask]
+        the primary loop (lane-safe accessor contract).  Under
+        ``RAY_TPU_DEBUG_LANES=1`` the lock comes back wrapped so holding
+        it *registers* with the lane checker — mutations under it are
+        sanctioned, mutations without it from a foreign thread trip the
+        checker."""
+        i = oid._hash & self._mask
+        if self._lane_tags is not None:
+            return debug_lanes.guarded(self._locks[i], self._lane_tags[i])
+        return self._locks[i]
 
     # ----------------------------------------------------- dict-compatible
     # Bodies inline the shard routing (no self.shard_index call): get()
@@ -73,13 +91,22 @@ class OwnerTable:
         return self._shards[i][oid]
 
     def __setitem__(self, oid, obj):
-        self._shards[oid._hash & self._mask][oid] = obj
+        i = oid._hash & self._mask
+        if self._lane_tags is not None:
+            debug_lanes.check_lane_mutation(self._lane_tags[i], "__setitem__")
+        self._shards[i][oid] = obj
 
     def __delitem__(self, oid):
-        del self._shards[oid._hash & self._mask][oid]
+        i = oid._hash & self._mask
+        if self._lane_tags is not None:
+            debug_lanes.check_lane_mutation(self._lane_tags[i], "__delitem__")
+        del self._shards[i][oid]
 
     def pop(self, oid, default=None):
-        return self._shards[oid._hash & self._mask].pop(oid, default)
+        i = oid._hash & self._mask
+        if self._lane_tags is not None:
+            debug_lanes.check_lane_mutation(self._lane_tags[i], "pop")
+        return self._shards[i].pop(oid, default)
 
     def __contains__(self, oid) -> bool:
         return oid in self._shards[oid._hash & self._mask]

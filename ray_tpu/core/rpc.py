@@ -51,6 +51,7 @@ import traceback
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .config import GlobalConfig
+from ..util import debug_lanes
 
 logger = logging.getLogger(__name__)
 
@@ -395,9 +396,16 @@ class _RpcLane:
 
     def _run(self):
         asyncio.set_event_loop(self.loop)
+        lanes_on = debug_lanes.debug_lanes_enabled()
+        if lanes_on:
+            # Lane-affinity checker scope: only registered lane threads
+            # are held to the shard-lock contract (RTL007's dynamic twin).
+            debug_lanes.register_lane_thread()
         try:
             self.loop.run_forever()
         finally:
+            if lanes_on:
+                debug_lanes.deregister_lane_thread()
             try:
                 self.loop.close()
             except Exception as e:
@@ -884,6 +892,16 @@ class ServerConnection:
         # sends route through call_soon_threadsafe under a small lock.
         self._loop = asyncio.get_running_loop()
         self._xlock = threading.Lock() if cross_thread else None
+        # RAY_TPU_DEBUG_LANES=1: the connection adopts its owning lane
+        # thread at construction (we're on its loop right here); _flush
+        # asserts it only ever runs there — cross-thread senders must
+        # route through call_soon_threadsafe, never call it directly.
+        if debug_lanes.debug_lanes_enabled():
+            self._lane_tag = debug_lanes.LaneTag(
+                "rpc.server_conn", adopt=True
+            )
+        else:
+            self._lane_tag = None
         # Write queue is a SEGMENT LIST (bytes/memoryviews), not a flat
         # bytearray: out-of-band payload buffers ride to writelines
         # untouched instead of being copied into a coalescing buffer.
@@ -935,6 +953,8 @@ class ServerConnection:
                     pass  # owning lane already stopped at teardown
 
     def _flush(self):
+        if self._lane_tag is not None:
+            debug_lanes.check_mutation(self._lane_tag, "_flush")
         if self._xlock is None:
             self._flush_scheduled = False
             if not self._wsegs:

@@ -1,0 +1,235 @@
+"""Opt-in lane-affinity instrumentation: cross-lane mutation detection.
+
+raylint's RTL007 proves *statically* that lane-safe RPC handlers only
+mutate state through the shard-lock / ``ForwardToPrimary`` contract; this
+module is the rule's dynamic twin for everything the AST cannot see —
+mutations reached through dynamic dispatch, callbacks, or code paths the
+call-graph resolution gave up on.  With ``RAY_TPU_DEBUG_LANES=1``:
+
+  - every RPC lane thread registers itself with the checker at startup
+    (:func:`register_lane_thread`, called by ``_RpcLane._run`` under the
+    knob), mirroring RTL007's scope: the lane contract binds *lane*
+    threads, nothing else;
+  - each ``OwnerTable`` shard carries a :class:`LaneTag`; a mutation of
+    the shard from a registered lane thread must hold that tag's shard
+    lock through the :func:`guarded` wrapper (what
+    ``OwnerTable.shard_lock`` hands out under the knob) — the runtime
+    shape of RTL007's "hold a shard lock or forward to the primary".
+    Non-lane threads are deliberately NOT checked: single dict ops are
+    GIL-atomic, and the table's documented thread model sanctions the
+    user thread (submit-time registration for the sync-get fast path)
+    and the primary loop (completion/free) mutating lock-free;
+  - a ``ServerConnection`` write path carries an **adopted** tag instead
+    (:func:`check_mutation`): the connection is built on its lane's loop
+    and is loop-affine, so *any* foreign thread calling ``_flush`` is a
+    violation regardless of locks;
+  - a violation is counted (``ray_tpu_debug_lane_violations_total``
+    through the PR-2 flight recorder), logged with both thread names,
+    and raised as ``AssertionError`` under pytest so tests fail loudly
+    instead of racing silently.
+
+Off by default: the hooks cost one ``is None`` check when the knob is
+unset, and nothing at all on paths that never check (reads).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+_ENV_KNOB = "RAY_TPU_DEBUG_LANES"
+
+
+def debug_lanes_enabled() -> bool:
+    """Read the env knob (checked at structure-construction time, so set
+    it before ``ray_tpu.init()``)."""
+    return os.environ.get(_ENV_KNOB, "").strip() in ("1", "true", "TRUE")
+
+
+# Process-wide violation log.  Raw lock — instrumentation must never
+# recurse into instrumented primitives.
+_registry_lock = threading.Lock()
+_violations: List[dict] = []
+_held = threading.local()  # .tags: set of id(LaneTag) guarded-held
+_lane_idents: set = set()  # thread idents registered as RPC lanes
+
+
+def register_lane_thread() -> None:
+    """Mark the current thread as an RPC lane: :func:`check_lane_mutation`
+    only polices registered threads.  Called by each lane's loop thread at
+    startup when the knob is on."""
+    ident = threading.get_ident()
+    with _registry_lock:
+        _lane_idents.add(ident)
+
+
+def deregister_lane_thread() -> None:
+    """Remove the current thread from the lane set (lane shutdown —
+    thread idents are reused by the OS, so a dead lane must not taint a
+    future worker thread)."""
+    ident = threading.get_ident()
+    with _registry_lock:
+        _lane_idents.discard(ident)
+
+
+def _fr():
+    from . import flight_recorder
+
+    return flight_recorder
+
+
+def _held_tags() -> set:
+    tags = getattr(_held, "tags", None)
+    if tags is None:
+        tags = _held.tags = set()
+    return tags
+
+
+class LaneTag:
+    """Ownership record for one lane-affine structure.
+
+    ``adopt=True`` binds to the constructing thread immediately (use when
+    construction already happens on the owner, e.g. a connection built on
+    its lane's loop).  Otherwise the first :func:`check_mutation` adopts.
+    Tags checked only through :func:`check_lane_mutation` (owner-table
+    shards) never adopt — that flavor polices lane membership, not a
+    single owner.
+    """
+
+    __slots__ = ("name", "owner_ident", "owner_name")
+
+    def __init__(self, name: str, adopt: bool = False):
+        self.name = name
+        self.owner_ident: Optional[int] = None
+        self.owner_name: Optional[str] = None
+        if adopt:
+            self.adopt()
+
+    def adopt(self) -> None:
+        t = threading.current_thread()
+        self.owner_ident = t.ident
+        self.owner_name = t.name
+
+    def __repr__(self) -> str:
+        return f"<LaneTag {self.name} owner={self.owner_name!r}>"
+
+
+class guarded:
+    """Context-manager lock wrapper that registers the hold with the lane
+    checker: mutations under ``with guarded(lock, tag):`` are sanctioned
+    even from a non-owner thread — the dynamic image of the static
+    shard-lock contract.  Also usable bare (``guarded(lock, tag)`` passed
+    to ``with``) as a drop-in for the raw lock."""
+
+    __slots__ = ("_lock", "_tag")
+
+    def __init__(self, lock, tag: LaneTag):
+        self._lock = lock
+        self._tag = tag
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            _held_tags().add(id(self._tag))
+        return got
+
+    def release(self) -> None:
+        _held_tags().discard(id(self._tag))
+        self._lock.release()
+
+    def __enter__(self) -> "guarded":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<guarded {self._tag.name}>"
+
+
+def check_mutation(tag: LaneTag, op: str) -> bool:
+    """Loop-affinity flavor (``ServerConnection``): the structure has ONE
+    owning thread; any mutation from a different thread — shard lock or
+    not aside, holding the tag via :func:`guarded` still sanctions — is a
+    violation.  Returns False (after counting, logging and — under pytest
+    — raising) on a cross-lane violation."""
+    t = threading.current_thread()
+    if tag.owner_ident is None:
+        tag.owner_ident = t.ident
+        tag.owner_name = t.name
+        return True
+    if t.ident == tag.owner_ident:
+        return True
+    if id(tag) in _held_tags():
+        return True  # sanctioned: shard lock held via guarded()
+    _report_violation(tag, op, t)
+    return False
+
+
+def check_lane_mutation(tag: LaneTag, op: str) -> bool:
+    """Lane-contract flavor (``OwnerTable`` shards): only *registered
+    lane threads* are policed — they must hold the shard lock (via
+    :func:`guarded`) to mutate.  Non-lane threads pass: single dict ops
+    are GIL-atomic and the table's thread model sanctions the user thread
+    and the primary loop mutating lock-free (``owner_table.py``)."""
+    ident = threading.get_ident()
+    if ident not in _lane_idents:
+        return True
+    if id(tag) in _held_tags():
+        return True  # sanctioned: shard lock held via guarded()
+    _report_violation(tag, op, threading.current_thread())
+    return False
+
+
+def _report_violation(tag: LaneTag, op: str, thread) -> None:
+    owner = tag.owner_name or "<non-lane threads>"
+    entry = {
+        "tag": tag.name,
+        "op": op,
+        "owner_thread": owner,
+        "mutating_thread": thread.name,
+    }
+    with _registry_lock:
+        _violations.append(entry)
+    logger.warning(
+        "cross-lane mutation: %s on %r from thread %r (owner %r) without "
+        "the shard lock — the race raylint RTL007 guards against",
+        op, tag.name, thread.name, owner,
+    )
+    try:
+        from .metric_registry import DEBUG_LANE_VIOLATIONS_TOTAL
+
+        _fr().counter(DEBUG_LANE_VIOLATIONS_TOTAL, 1.0,
+                      {"tag": tag.name, "op": op})
+    except Exception:  # noqa: BLE001 — diagnosis must not take down
+        logger.debug("flight-recorder push of lane violation failed",
+                     exc_info=True)
+    if "PYTEST_CURRENT_TEST" in os.environ:
+        raise AssertionError(
+            f"cross-lane mutation: {op} on {tag.name!r} from thread "
+            f"{thread.name!r} (owner {owner!r}) without the shard lock"
+        )
+
+
+# -------------------------------------------------------------- reporting
+def violations_total() -> int:
+    with _registry_lock:
+        return len(_violations)
+
+
+def report() -> Dict[str, object]:
+    """Snapshot of recorded violations (dumps/tests)."""
+    with _registry_lock:
+        return {"total": len(_violations), "violations": list(_violations)}
+
+
+def reset() -> None:
+    """Clear recorded violations and the lane-thread set (tests)."""
+    with _registry_lock:
+        _violations.clear()
+        _lane_idents.clear()

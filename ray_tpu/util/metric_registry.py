@@ -167,6 +167,7 @@ CP_JOURNAL_LAG_RECORDS = "ray_tpu_cp_journal_lag_records"
 EXCEPTION_SUPPRESSED_TOTAL = "ray_tpu_exception_suppressed_total"
 DEBUG_LOCK_CYCLES_TOTAL = "ray_tpu_debug_lock_cycles_total"
 DEBUG_LOCK_HELD_WAIT_HIST = "ray_tpu_debug_lock_held_blocked_wait_s"
+DEBUG_LANE_VIOLATIONS_TOTAL = "ray_tpu_debug_lane_violations_total"
 
 # Name -> one-line description.  ``raylint`` checks each key appears in
 # docs/observability.md; ``registered_names()`` is the enumeration API.
@@ -369,6 +370,9 @@ METRICS: Dict[str, str] = {
                              "(potential deadlocks)",
     DEBUG_LOCK_HELD_WAIT_HIST: "time blocked acquiring a lock while already "
                                "holding another (histogram)",
+    DEBUG_LANE_VIOLATIONS_TOTAL: "cross-lane mutations caught by the "
+                                 "RAY_TPU_DEBUG_LANES checker (RTL007's "
+                                 "dynamic twin)",
     CP_ROLE: "control-plane role of this process (gauge: 1 = leader, "
              "0 = standby)",
     CP_LEASE_EPOCH: "current leader-lease fencing epoch (gauge)",

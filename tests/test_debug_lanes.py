@@ -1,0 +1,197 @@
+"""RAY_TPU_DEBUG_LANES lane-affinity checker tests: cross-lane mutation
+detection on OwnerTable shards (raylint RTL007's dynamic twin)."""
+
+import threading
+
+import pytest
+
+from ray_tpu.core.owner_table import OwnerTable
+from ray_tpu.util import debug_lanes
+
+
+class FakeOid:
+    """ObjectID stand-in: the table only needs ``_hash``."""
+
+    __slots__ = ("_hash",)
+
+    def __init__(self, h):
+        self._hash = h
+
+    def __eq__(self, other):
+        return isinstance(other, FakeOid) and other._hash == self._hash
+
+    def __hash__(self):
+        return self._hash
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    debug_lanes.reset()
+    yield
+    debug_lanes.reset()
+
+
+@pytest.fixture
+def lanes_on(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_DEBUG_LANES", "1")
+
+
+def run_in_thread(fn, lane=True, name="fake-lane-0"):
+    """Run ``fn`` on a fresh thread; re-raise anything it raised.
+    ``lane=True`` registers the thread with the lane checker first,
+    simulating an rpc-lane dispatch thread (the only kind the
+    owner-table flavor polices)."""
+    box = {}
+
+    def target():
+        if lane:
+            debug_lanes.register_lane_thread()
+        try:
+            box["result"] = fn()
+        except BaseException as e:  # noqa: BLE001 — relayed to the test
+            box["error"] = e
+        finally:
+            if lane:
+                debug_lanes.deregister_lane_thread()
+
+    t = threading.Thread(target=target, daemon=True, name=name)
+    t.start()
+    t.join(10)
+    assert not t.is_alive()
+    if "error" in box:
+        raise box["error"]
+    return box.get("result")
+
+
+class TestKnob:
+    def test_off_by_default(self, monkeypatch):
+        monkeypatch.delenv("RAY_TPU_DEBUG_LANES", raising=False)
+        table = OwnerTable(4)
+        assert table._lane_tags is None
+        oid = FakeOid(7)
+        table[oid] = "entry"  # no checks, no tags, plain lock accessor
+        assert not isinstance(table.shard_lock(oid), debug_lanes.guarded)
+        # Cross-thread mutation goes UNCHECKED when off (that's the
+        # zero-overhead contract; the checker is opt-in).
+        run_in_thread(lambda: table.__setitem__(FakeOid(8), "x"))
+        assert debug_lanes.violations_total() == 0
+
+    def test_enabled_builds_tags(self, lanes_on):
+        table = OwnerTable(4)
+        assert table._lane_tags is not None
+        assert len(table._lane_tags) == table.num_shards
+
+
+class TestCrossLaneMutation:
+    def test_non_lane_threads_mutate_freely(self, lanes_on):
+        # The table's documented thread model: single dict ops are
+        # GIL-atomic, so the user thread (submit-time registration) and
+        # the primary loop (completion/free) mutate lock-free.  Only
+        # lane threads are held to the shard-lock contract.
+        table = OwnerTable(4)
+        oid = FakeOid(5)
+        table[oid] = "entry"   # user thread (this one)
+        table[oid] = "entry2"
+        run_in_thread(lambda: table.pop(oid), lane=False,
+                      name="core-worker")  # primary-loop stand-in
+        assert debug_lanes.violations_total() == 0
+
+    def test_cross_lane_unlocked_mutation_raises(self, lanes_on):
+        table = OwnerTable(4)
+        oid = FakeOid(5)
+        table[oid] = "entry"
+        with pytest.raises(AssertionError, match="cross-lane"):
+            run_in_thread(lambda: table.__setitem__(oid, "race"))
+        assert debug_lanes.violations_total() == 1
+        rep = debug_lanes.report()
+        assert rep["violations"][0]["mutating_thread"] == "fake-lane-0"
+        assert rep["violations"][0]["op"] == "__setitem__"
+
+    def test_cross_lane_pop_and_del_checked(self, lanes_on):
+        table = OwnerTable(4)
+        oid = FakeOid(5)
+        table[oid] = "entry"
+        with pytest.raises(AssertionError):
+            run_in_thread(lambda: table.pop(oid))
+        with pytest.raises(AssertionError):
+            run_in_thread(lambda: table.__delitem__(oid))
+
+    def test_shard_lock_sanctions_cross_lane_mutation(self, lanes_on):
+        # The contract RTL007 checks statically: a foreign thread may
+        # mutate iff it holds the shard lock (via the guarded wrapper).
+        table = OwnerTable(4)
+        oid = FakeOid(5)
+        table[oid] = "entry"
+
+        def locked_mutation():
+            with table.shard_lock(oid):
+                table[oid] = "lane-write"
+
+        run_in_thread(locked_mutation)
+        assert debug_lanes.violations_total() == 0
+        assert table[oid] == "lane-write"
+
+    def test_lock_release_ends_sanction(self, lanes_on):
+        table = OwnerTable(4)
+        oid = FakeOid(5)
+        table[oid] = "entry"
+
+        def lock_then_unlocked_write():
+            with table.shard_lock(oid):
+                pass
+            table[oid] = "after-release"
+
+        with pytest.raises(AssertionError):
+            run_in_thread(lock_then_unlocked_write)
+
+    def test_other_shards_unaffected(self, lanes_on):
+        # Holding shard A's lock does not sanction writes to shard B.
+        table = OwnerTable(4)
+        a, b = FakeOid(0), FakeOid(1)
+        assert table.shard_index(a) != table.shard_index(b)
+        table[a] = "ea"
+        table[b] = "eb"
+
+        def wrong_lock():
+            with table.shard_lock(a):
+                table[b] = "race"
+
+        with pytest.raises(AssertionError):
+            run_in_thread(wrong_lock)
+
+    def test_reads_never_checked(self, lanes_on):
+        # get() is the ns-critical fast path: no instrumentation, any
+        # thread may read lock-free (GIL-atomic dict get).
+        table = OwnerTable(4)
+        oid = FakeOid(5)
+        table[oid] = "entry"
+        assert run_in_thread(lambda: table.get(oid)) == "entry"
+        assert debug_lanes.violations_total() == 0
+
+
+class TestLaneTag:
+    def test_eager_adopt_binds_constructor_thread(self):
+        tag = debug_lanes.LaneTag("conn", adopt=True)
+        assert tag.owner_ident == threading.get_ident()
+        assert debug_lanes.check_mutation(tag, "op")
+        with pytest.raises(AssertionError):
+            run_in_thread(lambda: debug_lanes.check_mutation(tag, "op"))
+
+    def test_lazy_adopt_binds_first_mutator(self):
+        tag = debug_lanes.LaneTag("shard")
+        assert tag.owner_ident is None
+        run_in_thread(lambda: debug_lanes.check_mutation(tag, "op"))
+        assert tag.owner_name == "fake-lane-0"
+        with pytest.raises(AssertionError):
+            debug_lanes.check_mutation(tag, "op")  # now WE are foreign
+
+    def test_reset_clears_report(self):
+        tag = debug_lanes.LaneTag("x", adopt=True)
+        try:
+            run_in_thread(lambda: debug_lanes.check_mutation(tag, "op"))
+        except AssertionError:
+            pass
+        assert debug_lanes.violations_total() == 1
+        debug_lanes.reset()
+        assert debug_lanes.violations_total() == 0
+        assert debug_lanes.report() == {"total": 0, "violations": []}
