@@ -378,6 +378,194 @@ def run_model_suite():
 
 # ------------------------------------------------------- control plane suite
 
+def run_rpc_suite():
+    """Native call-plane micro-stages.
+
+    Frame codec ops are measured native-vs-Python INTERLEAVED inside one
+    timed window (alternating slices), so host drift taxes both sides
+    equally and the ``vs_python`` ratio defends itself; the sync submit
+    stage measures user-thread direct-lane RTT against the loop-path RTT
+    on the same live connection, interleaved the same way."""
+    import asyncio
+    import threading
+
+    from ray_tpu.core import native as native_mod
+    from ray_tpu.core import rpc as rpc_mod
+
+    codec = native_mod.frame_codec()
+    have_native = codec is not None and rpc_mod._resolve_codec() is not None
+
+    # Representative actor-push request frame (~1 KB pickled header).
+    import pickle as _pickle
+
+    payload = {
+        "spec": {
+            "task_id": b"t" * 16, "name": "ping", "args": b"a" * 400,
+            "owner": "127.0.0.1:23456", "num_returns": 1,
+        },
+        "caller": "127.0.0.1:23456", "seq": 7, "incarnation": 0,
+        "attempt": 0,
+    }
+    # Two shapes bracketing the adaptive _C_MIN_BUFS dispatch: a small
+    # header-only call frame (default dispatch: Python — FFI loses) and a
+    # buffer-heavy frame at 8 oob buffers (default dispatch: C — the
+    # Python codec loops in the interpreter there).
+    shapes = {
+        "small": (41, "actor_push_task", payload),
+        "oob8": (41, "put",
+                 [_pickle.PickleBuffer(bytearray(32 * 1024))
+                  for _ in range(8)]),
+    }
+    bodies = {
+        k: bytes(b"".join(bytes(s)
+                          for s in rpc_mod._encode_frame_py(f)[0])[8:])
+        for k, f in shapes.items()
+    }
+
+    def ab_window(a, b, slices=8, per_slice=400):
+        """One window of alternating A/B slices; per-side ops/s.  Each
+        side is (setup, op): setup runs untimed before its slice."""
+        (setup_a, fn_a), (setup_b, fn_b) = a, b
+        t_a = t_b = 0.0
+        for _ in range(slices):
+            setup_a()
+            t0 = time.perf_counter()
+            for _ in range(per_slice):
+                fn_a()
+            t_a += time.perf_counter() - t0
+            setup_b()
+            t0 = time.perf_counter()
+            for _ in range(per_slice):
+                fn_b()
+            t_b += time.perf_counter() - t0
+        n = slices * per_slice
+        return n / t_a, n / t_b
+
+    def ab_best(fn_a, fn_b, trials=3, **kw):
+        quiesce()
+        pairs = [ab_window(fn_a, fn_b, **kw) for _ in range(trials)]
+        best_a = max(p[0] for p in pairs)
+        best_b = max(p[1] for p in pairs)
+        spread = max(
+            (best_a - min(p[0] for p in pairs)) / best_a,
+            (best_b - min(p[1] for p in pairs)) / best_b,
+        )
+        _STAGE_EXTRA["spread"] = round(spread, 3)
+        return best_a, best_b
+
+    saved = (rpc_mod.GlobalConfig.rpc_native_codec, rpc_mod._C_MIN_BUFS)
+
+    def pin_codec(on):
+        """Untimed slice setup: pin _encode_frame/_decode_body onto the
+        chosen codec (flip + resolve once per slice, not per op).  The
+        native side zeroes _C_MIN_BUFS so the metric measures the C
+        codec itself, not the adaptive dispatcher's bypass."""
+        def setup():
+            rpc_mod.GlobalConfig.rpc_native_codec = on and have_native
+            rpc_mod._C_MIN_BUFS = 0 if on else saved[1]
+            rpc_mod._reset_codec_for_tests()
+            rpc_mod._resolve_codec()
+        return setup
+
+    try:
+        for shape, frame in shapes.items():
+            body = bodies[shape]
+            nbufs = 0 if shape == "small" else 8
+            default = "c" if nbufs >= saved[1] else "python"
+            # ---- encode: one window, native/Python slices interleaved
+            enc_nat, enc_py = ab_best(
+                (pin_codec(True), lambda: rpc_mod._encode_frame(frame)),
+                (pin_codec(False), lambda: rpc_mod._encode_frame(frame)),
+            )
+            ratio = round(enc_nat / enc_py, 3) if enc_py else None
+            emit(f"rpc_frame_encode_{shape}_native_ops_s", enc_nat, "ops/s",
+                 vs_python=ratio, native_codec=have_native,
+                 dispatch_default=default)
+            emit(f"rpc_frame_encode_{shape}_python_ops_s", enc_py, "ops/s")
+
+            # ---- decode, same interleaving
+            dec_nat, dec_py = ab_best(
+                (pin_codec(True), lambda: rpc_mod._decode_body(body)),
+                (pin_codec(False), lambda: rpc_mod._decode_body(body)),
+            )
+            ratio = round(dec_nat / dec_py, 3) if dec_py else None
+            emit(f"rpc_frame_decode_{shape}_native_ops_s", dec_nat, "ops/s",
+                 vs_python=ratio, native_codec=have_native,
+                 dispatch_default=default)
+            emit(f"rpc_frame_decode_{shape}_python_ops_s", dec_py, "ops/s")
+    finally:
+        rpc_mod.GlobalConfig.rpc_native_codec, rpc_mod._C_MIN_BUFS = saved
+        rpc_mod._reset_codec_for_tests()
+
+    # ---- sync submit RTT: direct lane vs loop path on one connection
+    loop_box = {}
+    ready = threading.Event()
+    stop = threading.Event()
+
+    def loop_main():
+        async def amain():
+            server = rpc_mod.RpcServer(_RpcEcho())
+            addr = await server.start()
+            client = await rpc_mod.RpcClient(addr).connect()
+            await client.call("echo", "warm")
+            loop_box["loop"] = asyncio.get_running_loop()
+            loop_box["client"] = client
+            ready.set()
+            while not stop.is_set():
+                await asyncio.sleep(0.01)
+            await client.close()
+            await server.stop()
+
+        asyncio.run(amain())
+
+    t = threading.Thread(target=loop_main, daemon=True)
+    t.start()
+    ready.wait(30)
+    client, loop = loop_box["client"], loop_box["loop"]
+
+    class _RttHandler(rpc_mod.DirectCall):
+        __slots__ = ("evt",)
+
+        def __init__(self):
+            super().__init__()
+            self.evt = threading.Event()
+
+        def on_reply(self, payload):
+            self.evt.set()
+
+        def on_error(self, exc):
+            self.evt.set()
+
+    def direct_rtt():
+        h = _RttHandler()
+        assert client.submit_direct("echo", b"ping", h, timeout=30)
+        h.evt.wait(30)
+
+    def loop_rtt():
+        asyncio.run_coroutine_threadsafe(
+            client.call("echo", b"ping", timeout=30), loop
+        ).result(30)
+
+    for _ in range(200):  # warm both paths
+        direct_rtt()
+        loop_rtt()
+    noop = lambda: None  # noqa: E731 — no per-slice setup for RTT sides
+    direct_ops, loop_ops = ab_best(
+        (noop, direct_rtt), (noop, loop_rtt), trials=3, slices=6,
+        per_slice=150,
+    )
+    stop.set()
+    t.join(10)
+    emit("rpc_sync_submit_direct_rtt_us", 1e6 / direct_ops, "us",
+         speedup_vs_loop=round(direct_ops / loop_ops, 3))
+    emit("rpc_sync_submit_loop_rtt_us", 1e6 / loop_ops, "us")
+
+
+class _RpcEcho:
+    def handle_echo(self, payload, conn):
+        return payload
+
+
 def run_control_plane_suite():
     import os
 
@@ -2146,6 +2334,8 @@ def main():
         # control-plane stage (measured: 1:1 sync ~1,900/s core-first vs
         # ~1,300/s model-first on the 1-core box).  The scaling suite
         # runs in a subprocess either way.
+        if only in ("all", "rpc"):
+            run("rpc", run_rpc_suite)
         if only in ("all", "core"):
             run("core", run_control_plane_suite)
         if only in ("all", "limits"):

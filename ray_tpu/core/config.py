@@ -39,6 +39,26 @@ _KNOBS: Dict[str, tuple] = {
         "Deterministic backoff synchronizes every client's reconnect "
         "attempt after a control-plane restart — a thundering herd",
     ),
+    "rpc_native_codec": (
+        bool, True,
+        "Use the C frame codec (librtpu_native.so rtpu_frame_*) for v2 "
+        "wire frames when the native library loads; the pure-Python codec "
+        "is the always-available, byte-identical fallback",
+    ),
+    "rpc_direct_submit": (
+        bool, True,
+        "User-thread direct submit: eligible sync-path actor pushes "
+        "serialize and send() on the submitting thread under the "
+        "connection's write lock, skipping the call_soon_threadsafe "
+        "self-pipe wake and the per-call submission task on the loop",
+    ),
+    "rpc_timeout_wheel_ms": (
+        int, 50,
+        "Bucket granularity of the shared RPC timeout wheel (one coarse "
+        "timer services every in-flight call deadline on a loop; a "
+        "deadline fires at most one bucket late).  0 restores per-call "
+        "asyncio.wait_for timers",
+    ),
     "rpc_service_lanes": (
         int, 0,
         "Event-loop lanes per RPC service (0 = auto: min(4, cpus) for the "

@@ -57,6 +57,7 @@ from .owner_table import OwnerTable
 from .rpc import (
     UNBOUNDED,
     ClientPool,
+    DirectCall,
     ForwardToPrimary,
     RetryableRpcClient,
     RpcConnectionError,
@@ -693,6 +694,55 @@ class _ActorState:
         # would overtake an earlier submission still parked in the queue.
         self.submit_lock = asyncio.Lock()
         self.waiters = 0
+        # Direct-submit coordination (CoreWorker._direct_submit_actor_task):
+        # every seq assignment — loop path or user thread — happens under
+        # seq_mutex; loop_submits counts loop-path submissions that have
+        # not yet been assigned a seq, and the direct path only runs while
+        # it is zero, so the two planes can never invert program order.
+        self.seq_mutex = threading.Lock()
+        self.loop_submits = 0
+        # Direct pushes outstanding (accepted, no reply yet).  The direct
+        # lane only engages while this is zero: a true sync caller waits
+        # out each call so it is always zero at submit time, while an
+        # async burst trips it after the first call and falls back to the
+        # loop path — which batches frames.  Without this gate a burst
+        # degrades into one raw send() syscall per call.
+        self.direct_inflight = 0
+
+
+class _DirectPushHandler(DirectCall):
+    """Completion sink for a user-thread direct actor push
+    (CoreWorker._direct_submit_actor_task)."""
+
+    __slots__ = ("worker", "spec", "state", "incarnation", "seq")
+
+    def __init__(self, worker: "CoreWorker", spec, state: _ActorState):
+        super().__init__()
+        self.worker = worker
+        self.spec = spec
+        self.state = state
+        self.incarnation = 0
+        self.seq = 0
+
+    def on_reply(self, payload):
+        # Fires on the worker's protocol loop — the owner→worker client's
+        # read loop lives there — so the loop-affine reply plumbing runs
+        # inline, exactly as it does after an awaited call().
+        with self.state.seq_mutex:
+            self.state.direct_inflight -= 1
+        self.worker._handle_task_reply(self.spec, payload)
+
+    def on_error(self, exc: BaseException):
+        # May fire on the read loop OR, in teardown races, the submitting
+        # thread; recovery touches loop-affine state, so always post.
+        # Exactly one of on_reply/on_error fires per submit (the pending
+        # table pops the handler before dispatch), so the inflight count
+        # cannot double-decrement.
+        with self.state.seq_mutex:
+            self.state.direct_inflight -= 1
+        self.worker._post(
+            lambda: self.worker._recover_direct_push(self, exc)
+        )
 
 
 class _LeasePool:
@@ -3051,8 +3101,9 @@ class CoreWorker:
     def _actor_state(self, actor_id: ActorID) -> _ActorState:
         st = self.actors.get(actor_id)
         if st is None:
-            st = _ActorState(actor_id)
-            self.actors[actor_id] = st
+            # setdefault: submit paths now call this from user threads too,
+            # so losing an insertion race must return the winner's state.
+            st = self.actors.setdefault(actor_id, _ActorState(actor_id))
         return st
 
     async def _subscribe_actor(self, state: _ActorState):
@@ -3064,12 +3115,16 @@ class CoreWorker:
 
     def _apply_actor_info(self, info: dict):
         state = self._actor_state(info["actor_id"])
-        state.state = info["state"]
-        state.address = info["address"]
-        if info.get("incarnation", 0) != state.incarnation:
-            # New incarnation ⇒ the executor's per-caller sequence restarts.
-            state.next_seq = 0
-        state.incarnation = info.get("incarnation", 0)
+        # seq_mutex: user-thread direct submits snapshot
+        # (state, incarnation, next_seq) atomically against this update.
+        with state.seq_mutex:
+            state.state = info["state"]
+            state.address = info["address"]
+            if info.get("incarnation", 0) != state.incarnation:
+                # New incarnation ⇒ the executor's per-caller sequence
+                # restarts.
+                state.next_seq = 0
+            state.incarnation = info.get("incarnation", 0)
         state.death_cause = info.get("death_cause") or ""
         state.max_task_retries = info.get("max_task_retries", 0)
         state.changed.set()
@@ -3124,6 +3179,32 @@ class CoreWorker:
             obj = self._new_owned(oid)
             obj.local_refs += 1
 
+        # Direct submit: the sync fast lane pickles and sends the push on
+        # THIS thread (no loop wake, no submission task) when the actor is
+        # alive, nothing is queued ahead, and the args pin no refs (the
+        # loop-affine _hold_args step must not be skipped otherwise).
+        if (
+            GlobalConfig.rpc_direct_submit
+            and not streaming
+            and not held
+            and self._direct_submit_actor_task(spec)
+        ):
+            refs = []
+            for oid in return_ids:
+                ref = ObjectRef.__new__(ObjectRef)
+                ref.id = oid
+                ref.owner_address = self.address
+                ref._worker = self
+                refs.append(ref)
+            return refs
+
+        # Loop path: count this submission until its seq is assigned so a
+        # later direct submit cannot overtake it (program order).
+        state = self._actor_state(actor_id)
+        with state.seq_mutex:
+            state.loop_submits += 1
+        spec._loop_seq_pending = True  # type: ignore[attr-defined]
+
         def setup():
             self._hold_args(held)
             self.task_events.record(
@@ -3155,6 +3236,14 @@ class CoreWorker:
             refs.append(ref)
         return refs
 
+    def _loop_submit_done(self, state: _ActorState, spec) -> None:
+        """A loop-path submission reached seq assignment (or died trying):
+        stop blocking the direct fast lane on its account."""
+        if getattr(spec, "_loop_seq_pending", False):
+            spec._loop_seq_pending = False
+            with state.seq_mutex:
+                state.loop_submits -= 1
+
     async def _submit_actor_task(self, spec: TaskSpec, attempt: int = 0):
         state = self._actor_state(spec.actor_id)
         if state.state == "ALIVE" and state.waiters == 0 and state.subscribed:
@@ -3162,14 +3251,24 @@ class CoreWorker:
             # the sequence number synchronously (no lock round trip) and
             # push; a burst of pushes coalesces into one multiplexed frame
             # at the transport (call(batch=True)).  Submission tasks start
-            # in FIFO order on the loop, so order is preserved.
-            incarnation = state.incarnation
-            seq = state.next_seq
-            state.next_seq += 1
+            # in FIFO order on the loop, so order is preserved.  seq_mutex
+            # orders the assignment against user-thread direct submits.
+            with state.seq_mutex:
+                incarnation = state.incarnation
+                seq = state.next_seq
+                state.next_seq += 1
+                if getattr(spec, "_loop_seq_pending", False):
+                    spec._loop_seq_pending = False
+                    state.loop_submits -= 1
             await self._push_actor_task(spec, state, incarnation, seq, attempt)
             return
-        ok = await self._submit_actor_task_slow(spec, state)
+        try:
+            ok = await self._submit_actor_task_slow(spec, state)
+        except BaseException:
+            self._loop_submit_done(state, spec)
+            raise
         if ok is None:
+            self._loop_submit_done(state, spec)
             return
         incarnation, seq = ok
         await self._push_actor_task(spec, state, incarnation, seq, attempt)
@@ -3210,18 +3309,121 @@ class CoreWorker:
                         spec, ActorDiedError(spec.actor_id.hex(), state.death_cause)
                     )
                     return None
-                seq = state.next_seq
-                state.next_seq += 1
-                return state.incarnation, seq
+                with state.seq_mutex:
+                    seq = state.next_seq
+                    state.next_seq += 1
+                    incarnation = state.incarnation
+                    if getattr(spec, "_loop_seq_pending", False):
+                        spec._loop_seq_pending = False
+                        state.loop_submits -= 1
+                return incarnation, seq
         finally:
             state.waiters -= 1
+
+    def _direct_submit_actor_task(self, spec: TaskSpec) -> bool:
+        """Submit one actor push from the CALLING thread (sync fast lane).
+
+        Eligibility (all checked, the decisive ones under ``seq_mutex``):
+        the actor is ALIVE and subscribed, its worker client is already
+        connected, no slow-path waiter is parked, and no loop-path
+        submission is still awaiting a seq (``loop_submits == 0`` —
+        program order), and no earlier direct push is still unanswered
+        (``direct_inflight == 0`` — an async burst falls back to the
+        batched loop path after its first call instead of degrading into
+        one send() syscall per call).  Returns ``False`` → caller takes
+        the loop path.
+        Once the seq is consumed the push MUST converge on it (the
+        executor's ordering gate admits seqs in order), so post-accept
+        failures re-push the same seq via _recover_direct_push."""
+        state = self.actors.get(spec.actor_id)
+        if state is None or state.state != "ALIVE" or not state.subscribed:
+            return False
+        addr = state.address
+        if addr is None:
+            return False
+        raw = getattr(self.worker_clients.peek(addr), "_client", None)
+        if raw is None or not raw.connected:
+            return False
+        # Burst suppression, connection level: any outstanding reply or
+        # buffered frame means loop-path traffic is in flight on this
+        # connection — a direct send now would fragment its batch
+        # containers for no latency win (nobody is blocked waiting).
+        # Racy reads (GIL-atomic) — this only picks the lane, never
+        # correctness.
+        if raw._pending or raw._wsegs:
+            return False
+        handler = _DirectPushHandler(self, spec, state)
+        with state.seq_mutex:
+            if (
+                state.state != "ALIVE"
+                or not state.subscribed
+                or state.address != addr
+                or state.waiters != 0
+                or state.loop_submits != 0
+                or state.direct_inflight != 0
+            ):
+                return False
+            handler.incarnation = state.incarnation
+            handler.seq = state.next_seq
+            if not raw.submit_direct(
+                "actor_push_task",
+                {
+                    "spec": spec,
+                    "caller": self.address,
+                    "seq": handler.seq,
+                    "incarnation": handler.incarnation,
+                    "attempt": 0,
+                },
+                handler,
+                timeout=GlobalConfig.task_push_keepalive_s,
+            ):
+                return False
+            # Accepted: the handler owns completion now; consume the seq.
+            state.next_seq += 1
+            state.direct_inflight += 1
+        # Safe from user threads (flat tuple append under the GIL).
+        self.task_events.record(
+            spec.task_id.hex(),
+            spec.name,
+            "PENDING_SUBMISSION",
+            job_id_hex=spec.job_id.hex(),
+            actor_id_hex=spec.actor_id.hex(),
+        )
+        return True
+
+    def _recover_direct_push(self, h: _DirectPushHandler, exc: BaseException):
+        """Loop-side recovery for a failed direct push (posted by
+        _DirectPushHandler.on_error)."""
+        if isinstance(exc, RpcRemoteError):
+            self._fail_task_returns(h.spec, exc)
+            return
+        # Timeout or connection loss AFTER the seq was consumed: re-enter
+        # the loop path's keepalive machinery with the SAME
+        # (incarnation, seq) — resends dedup executor-side by
+        # (task_id, attempt), and abandoning the seq would wedge the
+        # actor's ordering gate.
+        t = asyncio.get_running_loop().create_task(
+            self._push_actor_task(h.spec, h.state, h.incarnation, h.seq, 0)
+        )
+        self._inflight_submits.add(t)
+        t.add_done_callback(self._inflight_submits.discard)
 
     async def _push_actor_task(
         self, spec: TaskSpec, state: _ActorState, incarnation: int, seq: int,
         attempt: int,
     ):
-        client = self.worker_clients.get(state.address)
+        addr = state.address
+        client = self.worker_clients.get(addr) if addr is not None else None
         try:
+            if client is None:
+                # Death already applied (address cleared) before we got
+                # here — a direct push's on_error can arrive after
+                # _apply_actor_info ran.  Treat it as the connection loss
+                # it is: the branch below re-enters the normal submission
+                # pipeline (new incarnation, new seq).
+                raise RpcConnectionError(
+                    f"actor {spec.actor_id.hex()} connection gone"
+                )
             # Keepalive re-push (see _LeasePool._push): bounded waits +
             # dedup-safe resends instead of an unbounded reply wait.
             while True:
@@ -3248,7 +3450,8 @@ class CoreWorker:
                 self._fail_task_returns(spec, e)
                 return
             # Connection died: actor crashed or restarting.
-            await self.worker_clients.close(state.address)
+            if addr is not None:
+                await self.worker_clients.close(addr)
             if attempt < state.max_task_retries:
                 await asyncio.sleep(0.2)
                 if spec.streaming:

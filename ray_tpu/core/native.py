@@ -106,6 +106,10 @@ def _declare(lib):
         "rtpu_chan_read_end": (ctypes.c_int, [p]),
         "rtpu_chan_set_closed": (None, [p]),
         "rtpu_chan_is_closed": (ctypes.c_int, [p]),
+        "rtpu_frame_pack": (u64, [p, cp, u64, ctypes.POINTER(u64), u32]),
+        "rtpu_frame_unpack": (i64, [cp, u64, u64, ctypes.POINTER(u64), u32]),
+        "rtpu_frame_pack_batch_head": (None, [p, u64, u32]),
+        "rtpu_frame_unpack_batch": (i64, [cp, u64, ctypes.POINTER(u64), u32]),
         "rtpu_sched_create": (p, []),
         "rtpu_sched_destroy": (None, [p]),
         "rtpu_sched_update_node": (
@@ -562,3 +566,96 @@ def make_scheduler():
     """NativeScheduler if the library is available, else None."""
     lib = get_lib()
     return NativeScheduler(lib) if lib is not None else None
+
+
+# A 1-element char array is enough to hand ctypes the base address of a
+# writable bytearray (the C side writes past it into caller-sized space);
+# one cached type avoids growing ctypes' per-size array-type cache with
+# every distinct frame length.
+_CHAR1 = ctypes.c_char * 1
+
+
+class FrameCodec:
+    """ctypes wrapper over the C v2-frame codec (src/native/rtpu_frame.cc).
+
+    Byte-identical to the pure-Python codec in ``core.rpc`` — the C side
+    only does the framing arithmetic (meta prefix, buf-len table, offset
+    parse); pickling and out-of-band buffer segments stay in Python.
+    Scratch tables are thread-local: encode/decode run concurrently on the
+    protocol loop, server lanes, and direct-submitting user threads."""
+
+    # Frames with more out-of-band buffers than this (or batches with more
+    # sub-frames) fall back to the Python codec — the tables are scratch,
+    # not a protocol limit.
+    MAX_BUFS = 64
+    MAX_SUBS = 2048
+
+    def __init__(self, lib):
+        self._lib = lib
+        self._tls = threading.local()
+
+    def _scratch(self):
+        scr = getattr(self._tls, "scr", None)
+        if scr is None:
+            scr = self._tls.scr = (
+                (ctypes.c_uint64 * (2 + 2 * self.MAX_BUFS))(),  # unpack table
+                (ctypes.c_uint64 * (2 * self.MAX_SUBS))(),      # batch table
+                (ctypes.c_uint64 * self.MAX_BUFS)(),            # pack buf lens
+            )
+        return scr
+
+    def pack(self, header: bytes, buf_lens) -> bytearray:
+        """The meta segment of a v2 frame: [8B len][tag][hlen][nbufs]
+        [buf-len table][header].  Caller appends the buffers as their own
+        wire segments.  ``len(buf_lens)`` must be <= MAX_BUFS."""
+        nbufs = len(buf_lens)
+        meta = bytearray(8 + 9 + 8 * nbufs + len(header))
+        if nbufs:
+            lens = self._scratch()[2]
+            for i, n in enumerate(buf_lens):
+                lens[i] = n
+        else:
+            lens = None
+        self._lib.rtpu_frame_pack(
+            _CHAR1.from_buffer(meta), header, len(header), lens, nbufs
+        )
+        return meta
+
+    def pack_batch_head(self, payload_bytes: int, count: int) -> bytearray:
+        head = bytearray(13)
+        self._lib.rtpu_frame_pack_batch_head(
+            _CHAR1.from_buffer(head), payload_bytes, count
+        )
+        return head
+
+    def unpack(self, body: bytes, off: int, length: int):
+        """Parse the frame at ``body[off : off+length]``.  Returns
+        ``(nbufs, table)`` — table[0]/[1] = header off/len, then per-buffer
+        off/len pairs, all absolute into ``body``; nbufs < 0 means fall
+        back to the Python parser (-2) or corrupt framing (-1)."""
+        table = self._scratch()[0]
+        n = self._lib.rtpu_frame_unpack(body, off, length, table, self.MAX_BUFS)
+        return n, table
+
+    def unpack_batch(self, body: bytes):
+        """Parse a batch container body.  Returns ``(count, table)`` with
+        per-sub-frame off/len pairs (absolute into ``body``); count < 0
+        means fall back (-2) or corrupt framing (-1)."""
+        table = self._scratch()[1]
+        n = self._lib.rtpu_frame_unpack_batch(body, len(body), table, self.MAX_SUBS)
+        return n, table
+
+
+_frame_codec: Optional[FrameCodec] = None
+
+
+def frame_codec() -> Optional[FrameCodec]:
+    """Process-wide FrameCodec over the native library, or None when the
+    toolchain/library is unavailable (callers use the Python codec)."""
+    global _frame_codec
+    if _frame_codec is None:
+        lib = get_lib()
+        if lib is None:
+            return None
+        _frame_codec = FrameCodec(lib)
+    return _frame_codec

@@ -48,6 +48,7 @@ import socket
 import threading
 import time
 import traceback
+import weakref
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .config import GlobalConfig
@@ -228,6 +229,53 @@ FRAME_STATS = {
     "batched_calls": 0,  # calls multiplexed into batch containers
 }
 
+# Counters update from the loop, server lanes, AND direct-submitting user
+# threads; dict += is read-modify-write under the GIL, so exactness (pinned
+# by tests/test_rpc.py) needs a lock.  Only oob/batch frames pay it — the
+# plain-frame hot path never touches FRAME_STATS.
+_STATS_LOCK = threading.Lock()
+
+# --------------------------------------------------------------- frame codec
+# Two byte-identical codecs: the C fast path (src/native/rtpu_frame.cc via
+# ray_tpu.core.native.FrameCodec — meta pack, buf-len table, and body parse
+# in one call each) and the pure-Python reference, which is the
+# always-available fallback when the toolchain/library is missing or
+# ``rpc_native_codec`` is off.  Parity is pinned by tests/test_frame_codec.py.
+_codec = None
+_codec_resolved = False
+
+# Adaptive dispatch threshold: the C codec costs one ctypes FFI round-trip
+# (~1.5µs), which LOSES to CPython's C-backed bytes ops on small frames
+# (measured ~1.4–1.8x slower for header-only frames) and only wins once the
+# out-of-band buffer table is big enough that the Python codec loops in the
+# interpreter (measured crossover ≈4 buffers; +9–10% at 8).  Frames with
+# fewer oob buffers than this take the Python codec even when the native
+# library is loaded.  Tests pin C-path parity for ALL shapes by setting it
+# to 0; batch containers route to C only at 0 too (per-sub FFI never pays
+# for the small call frames batching selects for).
+_C_MIN_BUFS = 4
+
+
+def _resolve_codec():
+    global _codec, _codec_resolved
+    if not _codec_resolved:
+        _codec_resolved = True
+        if GlobalConfig.rpc_native_codec:
+            try:
+                from . import native as _native
+
+                _codec = _native.frame_codec()
+            except Exception:  # noqa: BLE001 — any native failure ⇒ Python codec
+                _codec = None
+    return _codec
+
+
+def _reset_codec_for_tests():
+    """Force re-resolution (tests flip rpc_native_codec / RAY_TPU_NATIVE_LIB)."""
+    global _codec, _codec_resolved
+    _codec = None
+    _codec_resolved = False
+
 
 def _encode_frame(frame) -> Tuple[list, int]:
     """Encode one frame as ``(segments, nbytes)``.
@@ -239,17 +287,51 @@ def _encode_frame(frame) -> Tuple[list, int]:
     copied into an intermediate frame buffer.  ``nbytes`` is the total
     wire size including the 8-byte length prefix (exact, not estimated —
     the batch flusher budgets with it)."""
+    codec = _codec if _codec_resolved else _resolve_codec()
+    if codec is None:
+        return _encode_frame_py(frame)
     bufs: list = []
     header = pickle.dumps(frame, protocol=5, buffer_callback=bufs.append)
     if not bufs:
-        meta = bytearray(_LEN + 9)
-        body_len = 9 + len(header)
-        meta[0:_LEN] = body_len.to_bytes(_LEN, "little")
-        meta[_LEN] = _MAGIC_FRAME
-        meta[_LEN + 1 : _LEN + 5] = len(header).to_bytes(4, "little")
-        meta += header
-        return [meta], _LEN + body_len
+        if _C_MIN_BUFS > 0:
+            return _encode_plain_py(header)
+        meta = codec.pack(header, ())
+        return [meta], len(meta)
     views = [b.raw().cast("B") for b in bufs]
+    if len(views) < _C_MIN_BUFS or len(views) > codec.MAX_BUFS:
+        return _encode_oob_py(header, views)
+    lens = [v.nbytes for v in views]
+    meta = codec.pack(header, lens)
+    total = sum(lens)
+    with _STATS_LOCK:
+        FRAME_STATS["oob_frames"] += 1
+        FRAME_STATS["oob_bytes"] += total
+    segments = [meta]
+    segments.extend(views)
+    return segments, len(meta) + total
+
+
+def _encode_frame_py(frame) -> Tuple[list, int]:
+    """Pure-Python codec; same contract (and bytes) as ``_encode_frame``."""
+    bufs: list = []
+    header = pickle.dumps(frame, protocol=5, buffer_callback=bufs.append)
+    if not bufs:
+        return _encode_plain_py(header)
+    views = [b.raw().cast("B") for b in bufs]
+    return _encode_oob_py(header, views)
+
+
+def _encode_plain_py(header) -> Tuple[list, int]:
+    meta = bytearray(_LEN + 9)
+    body_len = 9 + len(header)
+    meta[0:_LEN] = body_len.to_bytes(_LEN, "little")
+    meta[_LEN] = _MAGIC_FRAME
+    meta[_LEN + 1 : _LEN + 5] = len(header).to_bytes(4, "little")
+    meta += header
+    return [meta], _LEN + body_len
+
+
+def _encode_oob_py(header, views) -> Tuple[list, int]:
     nbufs = len(views)
     meta = bytearray(_LEN + 9 + 8 * nbufs)
     total = 0
@@ -265,8 +347,9 @@ def _encode_frame(frame) -> Tuple[list, int]:
     meta[_LEN + 1 : _LEN + 5] = len(header).to_bytes(4, "little")
     meta[_LEN + 5 : _LEN + 9] = nbufs.to_bytes(4, "little")
     meta += header
-    FRAME_STATS["oob_frames"] += 1
-    FRAME_STATS["oob_bytes"] += total
+    with _STATS_LOCK:
+        FRAME_STATS["oob_frames"] += 1
+        FRAME_STATS["oob_bytes"] += total
     segments = [meta]
     segments.extend(views)
     return segments, _LEN + body_len
@@ -296,6 +379,55 @@ def _decode_frame_v2(mv: memoryview):
 
 
 def _decode_body(data: bytes):
+    codec = _codec if _codec_resolved else _resolve_codec()
+    # The C parser indexes raw bytes; anything exotic goes the Python way.
+    if codec is None or type(data) is not bytes:
+        return _decode_body_py(data)
+    tag = data[0]
+    if tag == _MAGIC_FRAME:
+        # Adaptive: small buffer tables parse faster in Python (the FFI
+        # round-trip costs more than the loop it saves) — peek nbufs.
+        if int.from_bytes(data[5:9], "little") < _C_MIN_BUFS:
+            return _decode_frame_v2(memoryview(data))
+        return _decode_frame_c(data, 0, len(data), codec)
+    if tag == _MAGIC_BATCH:
+        if _C_MIN_BUFS > 0:
+            # Batches multiplex small call frames; per-sub FFI never pays.
+            return _decode_body_py(data)
+        n, table = codec.unpack_batch(data)
+        if n < 0:
+            if n == -2:  # more sub-frames than the scratch table holds
+                return _decode_body_py(data)
+            raise RpcError("corrupt batch frame")
+        # Copy offsets out BEFORE recursing: _decode_frame_c reuses the
+        # same thread-local scratch table.
+        subs = [(table[2 * i], table[2 * i + 1]) for i in range(n)]
+        frames = [_decode_frame_c(data, off, ln, codec) for off, ln in subs]
+        return (0, "__batch__", frames)
+    if tag == _PICKLE_PROTO:
+        return pickle.loads(data)
+    raise RpcError(f"corrupt frame: unknown body tag {tag:#04x}")
+
+
+def _decode_frame_c(data: bytes, off: int, length: int, codec):
+    n, table = codec.unpack(data, off, length)
+    if n < 0:
+        if n == -2:  # more oob buffers than the scratch table holds
+            return _decode_frame_v2(memoryview(data)[off : off + length])
+        raise RpcError("corrupt v2 frame")
+    mv = memoryview(data)
+    header = mv[table[0] : table[0] + table[1]]
+    buffers = []
+    for i in range(n):
+        o = table[2 + 2 * i]
+        ln = table[3 + 2 * i]
+        buffers.append(mv[o : o + ln])
+    # Same zero-copy property as _decode_frame_v2: oob buffers are
+    # memoryview slices of the read buffer.
+    return pickle.loads(header, buffers=buffers)
+
+
+def _decode_body_py(data):
     tag = data[0]
     if tag == _MAGIC_FRAME:
         return _decode_frame_v2(memoryview(data))
@@ -1057,6 +1189,151 @@ class ServerConnection:
             return None
 
 
+class _WheelEntry:
+    __slots__ = ("cb", "args", "cancelled")
+
+
+class TimeoutWheel:
+    """Coarse shared deadline timer: one asyncio timer services every
+    in-flight RPC deadline on a loop.
+
+    Each ``call()`` used to cost two timer-heap operations
+    (``asyncio.wait_for`` arms a ``call_later`` and cancels it on reply).
+    The wheel replaces them with a dict append and a flag flip: deadlines
+    round up into ``granularity_s`` buckets (default 50 ms via
+    ``rpc_timeout_wheel_ms``) and a single ``call_at`` timer — re-armed to
+    the earliest live bucket — sweeps expired entries.  A deadline
+    registered at delay ``d`` fires in ``(d, d + granularity]``: never
+    early, at most one bucket late.  RPC timeouts are liveness bounds
+    measured in seconds, so 50 ms of slack is free; cancellation is lazy
+    (a flag flip under the lock — no heap surgery), and ``add`` is safe
+    from any thread (direct-submit arms deadlines off-loop)."""
+
+    def __init__(self, loop, granularity_s: float):
+        self._loop = loop
+        self._g = granularity_s
+        self._lock = threading.Lock()
+        self._buckets: Dict[int, list] = {}
+        self._timer = None          # loop-thread only
+        self._armed_idx = None      # under _lock: bucket the timer targets
+        self.live = 0               # under _lock: non-cancelled entries
+
+    def add(self, delay_s: float, cb, *args) -> _WheelEntry:
+        e = _WheelEntry()
+        e.cb = cb
+        e.args = args
+        e.cancelled = False
+        # +1 rounds UP: the entry's bucket boundary is never before its
+        # nominal deadline.
+        idx = int((self._loop.time() + delay_s) / self._g) + 1
+        with self._lock:
+            b = self._buckets.get(idx)
+            if b is None:
+                self._buckets[idx] = [e]
+            else:
+                b.append(e)
+            self.live += 1
+            rearm = self._armed_idx is None or idx < self._armed_idx
+            if rearm:
+                self._armed_idx = idx
+        if rearm:
+            try:
+                running = asyncio.get_running_loop()
+            except RuntimeError:
+                running = None
+            if running is self._loop:
+                self._arm()
+            else:
+                try:
+                    self._loop.call_soon_threadsafe(self._arm)
+                except RuntimeError:
+                    pass  # loop closed; entries die with it
+        return e
+
+    def cancel(self, e: _WheelEntry):
+        """Lazy cancel — the bucket entry stays until its sweep, the
+        callback never fires.  Safe from any thread."""
+        with self._lock:
+            if not e.cancelled:
+                e.cancelled = True
+                self.live -= 1
+
+    def _arm(self):
+        # Loop thread only.  Recomputes the earliest bucket under the lock,
+        # so racing add()s converge: whichever _arm runs last wins with the
+        # true minimum.
+        with self._lock:
+            idx = min(self._buckets, default=None)
+            self._armed_idx = idx
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        if idx is not None:
+            self._timer = self._loop.call_at(idx * self._g, self._service)
+
+    def _service(self):
+        self._timer = None
+        now = self._loop.time()
+        fire = []
+        with self._lock:
+            due = [i for i in self._buckets if i * self._g <= now]
+            for i in due:
+                for e in self._buckets.pop(i):
+                    if not e.cancelled:
+                        e.cancelled = True
+                        self.live -= 1
+                        fire.append(e)
+        for e in fire:
+            try:
+                e.cb(*e.args)
+            except Exception:
+                logger.exception("timeout-wheel callback failed")
+        self._arm()
+
+    def bucket_count(self) -> int:
+        """Total entries still held in buckets (incl. lazily-cancelled)."""
+        with self._lock:
+            return sum(len(b) for b in self._buckets.values())
+
+
+# One wheel per event loop, shared by every RpcClient on it.  WeakKey so a
+# dead loop releases its wheel (tests spin up many loops).
+_WHEELS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_WHEELS_LOCK = threading.Lock()
+
+
+def _loop_wheel(loop) -> TimeoutWheel:
+    w = _WHEELS.get(loop)
+    if w is None:
+        with _WHEELS_LOCK:
+            w = _WHEELS.get(loop)
+            if w is None:
+                w = TimeoutWheel(loop, GlobalConfig.rpc_timeout_wheel_ms / 1000.0)
+                _WHEELS[loop] = w
+    return w
+
+
+class DirectCall:
+    """Completion sink for ``RpcClient.submit_direct``.
+
+    Exactly one of ``on_reply`` / ``on_error`` fires, once.  ``on_reply``
+    runs on the client's read loop; ``on_error`` runs on the read loop or
+    — in narrow teardown races — on the submitting thread.  Implementations
+    must therefore be thread-agnostic and non-blocking (post to a loop if
+    they need loop-affine state)."""
+
+    __slots__ = ("entry",)
+
+    def __init__(self):
+        self.entry = None  # armed TimeoutWheel entry, owned by the client
+
+    def on_reply(self, payload):
+        raise NotImplementedError
+
+    def on_error(self, exc: BaseException):
+        raise NotImplementedError
+
+
 class RpcClient:
     """A connection to one RpcServer.  Safe for concurrent calls from one
     event loop.  Push messages from the server are delivered to
@@ -1069,8 +1346,18 @@ class RpcClient:
         self._on_disconnect = on_disconnect
         self._reader = None
         self._writer = None
-        self._pending: Dict[int, asyncio.Future] = {}
-        self._next_id = 1
+        # Values are asyncio.Futures (loop-path calls, odd msg ids) or
+        # DirectCall sinks (direct submits, even msg ids) — the read loop
+        # branches on id parity, never isinstance.
+        self._pending: Dict[int, Any] = {}
+        self._next_id = 1         # loop-path ids: odd, loop-thread only
+        self._direct_next_id = 2  # direct-submit ids: even, lock below
+        self._direct_id_lock = threading.Lock()
+        # Every byte written to the socket goes under _send_lock — the loop
+        # flusher and user-thread direct submits serialize here.
+        self._send_lock = threading.Lock()
+        self._sock = None
+        self._wheel: Optional[TimeoutWheel] = None
         self._wsegs: list = []
         self._wbytes = 0
         self._flush_scheduled = False
@@ -1094,6 +1381,13 @@ class RpcClient:
             self._writer.transport.set_write_buffer_limits(high=4 << 20)
         except Exception:  # raylint: waive[RTL003] write-buffer limit is a transport nicety
             pass
+        # get_extra_info hands back a TransportSocket facade whose send()
+        # deprecation-warns; direct submit needs the real non-blocking
+        # socket underneath it.
+        tsock = self._writer.get_extra_info("socket")
+        self._sock = getattr(tsock, "_sock", tsock)
+        if GlobalConfig.rpc_timeout_wheel_ms > 0:
+            self._wheel = _loop_wheel(self._loop)
         self._read_task = self._loop.create_task(self._read_loop())
         # Version announcement: pipelined ahead of the first real call, so
         # negotiation costs zero round-trips.  ALWAYS the v1 body format —
@@ -1159,30 +1453,40 @@ class RpcClient:
             # length — exactly the batch container's sub-entry format, so
             # flushing is pure concatenation with zero re-pickling.
             body_len = 5 + nbytes
-            head = bytearray(_LEN + 5)
-            head[0:_LEN] = body_len.to_bytes(_LEN, "little")
-            head[_LEN] = _MAGIC_BATCH
-            head[_LEN + 1 : _LEN + 5] = len(items).to_bytes(4, "little")
+            codec = _codec if _codec_resolved else _resolve_codec()
+            if codec is not None:
+                head = codec.pack_batch_head(nbytes, len(items))
+            else:
+                head = bytearray(_LEN + 5)
+                head[0:_LEN] = body_len.to_bytes(_LEN, "little")
+                head[_LEN] = _MAGIC_BATCH
+                head[_LEN + 1 : _LEN + 5] = len(items).to_bytes(4, "little")
             self._wsegs.append(head)
             for segs, _n in items:
                 self._wsegs.extend(segs)
             self._wbytes += _LEN + body_len
-            FRAME_STATS["batch_frames"] += 1
-            FRAME_STATS["batched_calls"] += len(items)
+            with _STATS_LOCK:
+                FRAME_STATS["batch_frames"] += 1
+                FRAME_STATS["batched_calls"] += len(items)
         if not self._flush_scheduled:
             self._flush_scheduled = True
             self._loop.call_soon(self._flush_wbuf)
 
     def _flush_wbuf(self):
         self._flush_scheduled = False
-        if not self._wsegs:
-            return
-        segs, self._wsegs = self._wsegs, []
-        self._wbytes = 0
-        try:
-            self._writer.writelines(segs)
-        except Exception:  # raylint: waive[RTL003] torn down mid-flush; read loop surfaces the failure
-            pass
+        # _send_lock orders this flush against user-thread direct submits:
+        # a direct sender either beats the swap (its segments ride this
+        # writelines) or sees the transport buffer before this loop pass
+        # drains it (and queues instead of writing raw).
+        with self._send_lock:
+            if not self._wsegs:
+                return
+            segs, self._wsegs = self._wsegs, []
+            self._wbytes = 0
+            try:
+                self._writer.writelines(segs)
+            except Exception:  # raylint: waive[RTL003] torn down mid-flush; read loop surfaces the failure
+                pass
 
     async def _read_loop(self):
         try:
@@ -1207,13 +1511,32 @@ class RpcClient:
                         except Exception:
                             logger.exception("push handler failed for %s", kind)
                     continue
-                fut = self._pending.pop(-msg_id, None)
-                if fut is not None and not fut.done():
-                    if kind == "R":
-                        fut.set_result(payload)
-                    else:
-                        exc, tb = payload
-                        fut.set_exception(RpcRemoteError("?", exc, tb))
+                mid = -msg_id
+                handler = self._pending.pop(mid, None)
+                if handler is None:
+                    continue
+                if mid & 1:
+                    # Odd id: loop-path call() awaiting an asyncio future.
+                    if not handler.done():
+                        if kind == "R":
+                            handler.set_result(payload)
+                        else:
+                            exc, tb = payload
+                            handler.set_exception(RpcRemoteError("?", exc, tb))
+                else:
+                    # Even id: direct submit — complete the DirectCall sink
+                    # inline (no future, no task wake).
+                    entry = handler.entry
+                    if entry is not None and self._wheel is not None:
+                        self._wheel.cancel(entry)
+                    try:
+                        if kind == "R":
+                            handler.on_reply(payload)
+                        else:
+                            exc, tb = payload
+                            handler.on_error(RpcRemoteError("?", exc, tb))
+                    except Exception:
+                        logger.exception("direct reply handler failed")
         except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
             pass
         except Exception:
@@ -1234,10 +1557,22 @@ class RpcClient:
                     logger.exception("on_disconnect callback failed")
 
     def _fail_all_pending(self, exc):
-        for fut in self._pending.values():
-            if not fut.done():
-                fut.set_exception(exc)
-        self._pending.clear()
+        # Swap first: submit_direct re-checks _closed after registering and
+        # pops its own entry if it lost the race, so ownership of every
+        # entry here is unambiguous.
+        pending, self._pending = self._pending, {}
+        for mid, handler in pending.items():
+            if mid & 1:
+                if not handler.done():
+                    handler.set_exception(exc)
+            else:
+                entry = handler.entry
+                if entry is not None and self._wheel is not None:
+                    self._wheel.cancel(entry)
+                try:
+                    handler.on_error(exc)
+                except Exception:
+                    logger.exception("direct error handler failed")
 
     @property
     def connected(self) -> bool:
@@ -1260,8 +1595,11 @@ class RpcClient:
             if d > 0:
                 await asyncio.sleep(d)
         # Single-threaded loop: id allocation + buffer append are atomic.
+        # Loop-path ids stay odd; direct-submit ids are even (allocated
+        # under their own lock) — parity tells the read loop which
+        # completion style a reply belongs to without a type check.
         msg_id = self._next_id
-        self._next_id += 1
+        self._next_id += 2
         fut = self._loop.create_future()
         self._pending[msg_id] = fut
         if batch:
@@ -1285,6 +1623,18 @@ class RpcClient:
                 # Explicitly-unbounded calls (task pushes, owner gets) skip
                 # the per-call timer; connection loss still fails the future.
                 result = await fut
+            elif self._wheel is not None:
+                # Shared wheel: a dict append + lazy cancel instead of the
+                # two timer-heap ops asyncio.wait_for costs per call.  The
+                # expiry callback sets the SAME RpcTimeoutError the wait_for
+                # path raised, so retry policies above see no difference.
+                entry = self._wheel.add(
+                    timeout, self._expire_call, msg_id, method, timeout
+                )
+                try:
+                    result = await fut
+                finally:
+                    self._wheel.cancel(entry)
             else:
                 result = await asyncio.wait_for(fut, timeout=timeout)
         except asyncio.TimeoutError:
@@ -1295,6 +1645,110 @@ class RpcClient:
         if self._chaos.enabled() and self._chaos.fail_response(method):
             raise RpcConnectionError(f"[chaos] dropped response {method}")
         return result
+
+    # Wheel expiry callbacks (loop thread, via TimeoutWheel._service).
+    def _expire_call(self, msg_id, method, timeout):
+        fut = self._pending.pop(msg_id, None)
+        if fut is not None and not fut.done():
+            fut.set_exception(RpcTimeoutError(
+                f"rpc {method} to {self.address} timed out after {timeout}s"
+            ))
+
+    def _expire_direct(self, msg_id, method, timeout):
+        handler = self._pending.pop(msg_id, None)
+        if handler is not None:
+            try:
+                handler.on_error(RpcTimeoutError(
+                    f"rpc {method} to {self.address} timed out after {timeout}s"
+                ))
+            except Exception:
+                logger.exception("direct timeout handler failed")
+
+    def submit_direct(self, method: str, payload, handler: DirectCall,
+                      timeout: Optional[float] = None) -> bool:
+        """Serialize and send one request from the CALLING thread.
+
+        The sync-path fast lane: no ``call_soon_threadsafe`` self-pipe
+        wake, no submission task, no per-call timer — the user thread
+        pickles the frame and, when the transport is idle, writes it to
+        the socket itself.  Returns ``False`` (with NO side effects) when
+        the connection isn't usable — the caller falls back to the
+        loop path.  Once it returns ``True``, exactly one of
+        ``handler.on_reply`` / ``handler.on_error`` will fire.
+
+        Ownership rules (see docs/performance.md):
+
+        * Every socket write happens under ``_send_lock`` — here and in
+          the loop's ``_flush_wbuf``; the transport never sees interleaved
+          partial frames.
+        * The raw ``sock.send`` is attempted only when the transport's
+          write buffer is empty AND ``_wsegs`` is empty, so it can never
+          overtake queued bytes (the pipelined hello included — ordering
+          with the handshake is preserved).
+        * On a partial send the remainder is queued at the FRONT of
+          ``_wsegs`` (still under the lock) and the loop flusher takes
+          over; ownership of the bytes passes to the loop exactly once.
+        * After the handler is registered, failures are delivered through
+          it (never a ``False`` return): the frame counters have already
+          ticked and the caller must not re-encode."""
+        if self._sock is None or not self.connected:
+            return False
+        with self._direct_id_lock:
+            msg_id = self._direct_next_id
+            self._direct_next_id += 2
+        timeout = timeout if timeout is not None else GlobalConfig.rpc_call_timeout_s
+        if self._wheel is not None and timeout and timeout != UNBOUNDED:
+            handler.entry = self._wheel.add(
+                timeout, self._expire_direct, msg_id, method, timeout
+            )
+        self._pending[msg_id] = handler
+        if self._closed:
+            # Lost the race with _fail_all_pending's swap: our entry may
+            # sit in the new dict nobody will fail.  We still own it —
+            # deliver the error ourselves.
+            if self._pending.pop(msg_id, None) is not None:
+                if handler.entry is not None and self._wheel is not None:
+                    self._wheel.cancel(handler.entry)
+                try:
+                    handler.on_error(
+                        RpcConnectionError(f"connection to {self.address} lost")
+                    )
+                except Exception:
+                    logger.exception("direct error handler failed")
+            return True
+        segs, n = _encode_frame((msg_id, method, payload))
+        flush = False
+        try:
+            with self._send_lock:
+                if (
+                    not self._wsegs
+                    and self._writer.transport.get_write_buffer_size() == 0
+                ):
+                    data = segs[0] if len(segs) == 1 else b"".join(segs)
+                    try:
+                        sent = self._sock.send(data)
+                    except BlockingIOError:
+                        sent = 0
+                    if sent < len(data):
+                        # Hand the tail to the loop flusher — front of the
+                        # queue, so frame bytes stay contiguous.
+                        self._wsegs.insert(0, memoryview(data)[sent:])
+                        self._wbytes += len(data) - sent
+                        flush = True
+                else:
+                    self._wsegs.extend(segs)
+                    self._wbytes += n
+                    flush = True
+        except OSError:
+            # Socket died mid-send: the read loop observes the same death
+            # and fails every pending entry, ours included.
+            pass
+        if flush:
+            try:
+                self._loop.call_soon_threadsafe(self._flush_wbuf)
+            except RuntimeError:
+                pass  # loop closed; read-loop teardown owns the failure
+        return True
 
     async def notify(self, method: str, payload=None):
         if not self.connected:
@@ -1494,6 +1948,11 @@ class ClientPool:
             )
             self._clients[address] = client
         return client
+
+    def peek(self, address: Address):
+        """Read-only lookup — no insertion, so safe from any thread (the
+        direct-submit fast lane probes for an already-connected client)."""
+        return self._clients.get(address)
 
     def invalidate(self, address: Address):
         """Drop the cached client WITHOUT closing it (caller knows the

@@ -1,0 +1,248 @@
+"""Cross-codec parity for the v2 wire format.
+
+The C codec (src/native/rtpu_frame.cc via FrameCodec) and the pure-Python
+codec must emit byte-identical frames and accept each other's output — a
+mixed fleet (some processes with the native lib, some without) shares one
+wire format.  These tests pin that contract for single frames, out-of-band
+frames, and batch containers, plus the forced-fallback path when the
+library is absent."""
+
+import os
+import pickle
+import subprocess
+import sys
+
+import pytest
+
+from ray_tpu.core import native
+from ray_tpu.core import rpc as rpc_mod
+from ray_tpu.core.config import GlobalConfig
+
+CODEC = native.frame_codec()
+
+needs_native = pytest.mark.skipif(
+    CODEC is None, reason="native library unavailable (no toolchain)"
+)
+
+
+def _concat(segs) -> bytes:
+    return b"".join(bytes(s) for s in segs)
+
+
+@pytest.fixture
+def native_codec_active():
+    """Force _encode_frame/_decode_body onto the C codec for EVERY frame
+    shape — _C_MIN_BUFS=0 disables the adaptive small-frame bypass so
+    parity is pinned for the whole C surface (and restore after)."""
+    if CODEC is None:
+        pytest.skip("native library unavailable")
+    rpc_mod._reset_codec_for_tests()
+    saved = (GlobalConfig.rpc_native_codec, rpc_mod._C_MIN_BUFS)
+    GlobalConfig.rpc_native_codec = True
+    rpc_mod._C_MIN_BUFS = 0
+    assert rpc_mod._resolve_codec() is not None
+    yield
+    GlobalConfig.rpc_native_codec, rpc_mod._C_MIN_BUFS = saved
+    rpc_mod._reset_codec_for_tests()
+
+
+FRAMES = [
+    (1, "method", {"a": 1, "b": [1, 2, 3]}),
+    (0, "__hello__", (3, 2)),
+    (-7, "R", {"returns": [("inline", b"x" * 100)]}),
+    (42, "push", None),
+]
+
+
+@needs_native
+def test_single_frame_parity_both_directions(native_codec_active):
+    """C-encoded and Python-encoded single frames are byte-identical, and
+    each decoder accepts the other's output."""
+    for frame in FRAMES:
+        c_segs, c_n = rpc_mod._encode_frame(frame)
+        p_segs, p_n = rpc_mod._encode_frame_py(frame)
+        assert _concat(c_segs) == _concat(p_segs)
+        assert c_n == p_n == len(_concat(c_segs))
+        body = bytes(_concat(c_segs)[rpc_mod._LEN :])
+        # native-encoded -> python-decoded and native-decoded
+        assert rpc_mod._decode_body_py(body) == frame
+        assert rpc_mod._decode_body(body) == frame
+
+
+@needs_native
+def test_oob_frame_parity_and_no_copy(native_codec_active):
+    """>=64 KiB buffer-protocol payloads: identical bytes from both
+    codecs, encode-side segments alias the caller's memory (mutation after
+    encode is visible on the wire), decode-side buffers are views into the
+    receive buffer on both parsers."""
+    src = bytearray(range(256)) * 512  # 128 KiB
+    frame = (5, "put", pickle.PickleBuffer(src))
+    c_segs, c_n = rpc_mod._encode_frame(frame)
+    p_segs, p_n = rpc_mod._encode_frame_py(frame)
+    assert _concat(c_segs) == _concat(p_segs)
+    assert c_n == p_n
+
+    # No encode-side copy: mutate the source AFTER encoding; the oob
+    # segment (a memoryview over src) must see it.
+    views = [s for s in c_segs if isinstance(s, memoryview)]
+    assert len(views) == 1 and views[0].nbytes == len(src)
+    src[0] = 0xEE
+    assert views[0][0] == 0xEE
+
+    body = bytes(_concat(c_segs)[rpc_mod._LEN :])
+    for decode in (rpc_mod._decode_body, rpc_mod._decode_body_py):
+        mid, method, buf = decode(body)
+        assert (mid, method) == (5, "put")
+        mv = memoryview(buf)
+        assert bytes(mv) == bytes(src)
+        # Zero receive-side copy: the decoded buffer is a view into the
+        # read buffer, not an owned allocation.
+        assert mv.obj is body or getattr(mv.obj, "obj", None) is body
+
+
+@needs_native
+def test_batch_container_parity(native_codec_active):
+    """Batch heads from pack_batch_head match the Python construction
+    byte-for-byte; both decoders unpack the container identically."""
+    subs = [(2 * i + 1, "m", {"x": i, "blob": b"z" * (100 * i)}) for i in range(9)]
+    enc = [rpc_mod._encode_frame(s) for s in subs]
+    nbytes = sum(n for _, n in enc)
+
+    c_head = CODEC.pack_batch_head(nbytes, len(subs))
+    body_len = 5 + nbytes
+    p_head = bytearray(rpc_mod._LEN + 5)
+    p_head[0 : rpc_mod._LEN] = body_len.to_bytes(rpc_mod._LEN, "little")
+    p_head[rpc_mod._LEN] = rpc_mod._MAGIC_BATCH
+    p_head[rpc_mod._LEN + 1 :] = len(subs).to_bytes(4, "little")
+    assert bytes(c_head) == bytes(p_head)
+
+    wire = bytes(c_head) + b"".join(_concat(s) for s, _ in enc)
+    body = wire[rpc_mod._LEN :]
+    expect = (0, "__batch__", subs)
+    assert rpc_mod._decode_body(body) == expect
+    assert rpc_mod._decode_body_py(body) == expect
+
+
+@needs_native
+def test_oob_overflow_falls_back_to_python(native_codec_active):
+    """More oob buffers than the C scratch table holds: the encoder falls
+    back to the Python path (still byte-identical) and the decoder's -2
+    return routes to the Python parser."""
+    n = rpc_mod._codec.MAX_BUFS + 3
+    bufs = [pickle.PickleBuffer(bytearray(b"%03d" % i * 50)) for i in range(n)]
+    frame = (9, "many", bufs)
+    c_segs, c_n = rpc_mod._encode_frame(frame)
+    p_segs, p_n = rpc_mod._encode_frame_py(frame)
+    assert _concat(c_segs) == _concat(p_segs) and c_n == p_n
+    body = bytes(_concat(c_segs)[rpc_mod._LEN :])
+    mid, method, out = rpc_mod._decode_body(body)
+    assert (mid, method) == (9, "many")
+    assert [bytes(b) for b in out] == [bytes(memoryview(b)) for b in bufs]
+
+
+def test_forced_fallback_knob_off():
+    """rpc_native_codec=False pins the Python codec even with the library
+    present; frames stay byte-identical."""
+    rpc_mod._reset_codec_for_tests()
+    saved = GlobalConfig.rpc_native_codec
+    GlobalConfig.rpc_native_codec = False
+    try:
+        assert rpc_mod._resolve_codec() is None
+        for frame in FRAMES:
+            segs, n = rpc_mod._encode_frame(frame)
+            p_segs, p_n = rpc_mod._encode_frame_py(frame)
+            assert _concat(segs) == _concat(p_segs) and n == p_n
+            assert rpc_mod._decode_body(bytes(_concat(segs)[rpc_mod._LEN :])) == frame
+    finally:
+        GlobalConfig.rpc_native_codec = saved
+        rpc_mod._reset_codec_for_tests()
+
+
+def test_forced_fallback_missing_library():
+    """RAY_TPU_NATIVE_LIB pointing at a nonexistent path must leave the
+    full stack functional on the Python codec — and its frames must be
+    byte-identical to this process's encoder."""
+    frame = (3, "probe", {"k": b"v" * 2000})
+    expect = _concat(rpc_mod._encode_frame_py(frame)[0]).hex()
+    script = (
+        "import sys\n"
+        "from ray_tpu.core import native, rpc\n"
+        "assert native.get_lib() is None, 'lib loaded from a missing path?'\n"
+        "assert native.frame_codec() is None\n"
+        "assert rpc._resolve_codec() is None\n"
+        "frame = (3, 'probe', {'k': b'v' * 2000})\n"
+        "segs, n = rpc._encode_frame(frame)\n"
+        "wire = b''.join(bytes(s) for s in segs)\n"
+        "assert wire.hex() == sys.argv[1], 'fallback frames diverged'\n"
+        "assert rpc._decode_body(bytes(wire[8:])) == frame\n"
+        "print('FALLBACK_OK')\n"
+    )
+    env = dict(os.environ)
+    env["RAY_TPU_NATIVE_LIB"] = "/nonexistent/librtpu_native.so"
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-c", script, expect],
+        env=env, capture_output=True, text=True, timeout=120,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert out.returncode == 0, out.stderr
+    assert "FALLBACK_OK" in out.stdout
+
+
+@needs_native
+def test_adaptive_threshold_routes_by_buffer_count():
+    """With the native library loaded, the default dispatch still sends
+    small frames (< _C_MIN_BUFS oob buffers) through the Python codec —
+    a ctypes round-trip loses to CPython bytes ops there — and engages C
+    exactly at the threshold, on both encode and decode."""
+    rpc_mod._reset_codec_for_tests()
+    saved = (GlobalConfig.rpc_native_codec, rpc_mod._C_MIN_BUFS)
+    GlobalConfig.rpc_native_codec = True
+    rpc_mod._C_MIN_BUFS = 4
+    codec = rpc_mod._resolve_codec()
+    assert codec is not None
+    pack_calls, unpack_calls = [], []
+    orig_pack, orig_unpack = codec.pack, codec.unpack
+    codec.pack = lambda h, l: (pack_calls.append(len(l)), orig_pack(h, l))[1]
+    codec.unpack = lambda *a: (unpack_calls.append(1), orig_unpack(*a))[1]
+    try:
+        def bufs(n):
+            return [pickle.PickleBuffer(bytearray(b"b" * 64)) for _ in range(n)]
+
+        bodies = {}
+        for n in (0, 3, 4):
+            frame = (1, "m", bufs(n) or {"k": b"x" * 100})
+            segs, _ = rpc_mod._encode_frame(frame)
+            bodies[n] = bytes(_concat(segs)[rpc_mod._LEN :])
+        assert pack_calls == [4]  # only the at-threshold frame hit C
+        for n in (0, 3):
+            rpc_mod._decode_body(bodies[n])
+        assert unpack_calls == []
+        rpc_mod._decode_body(bodies[4])
+        assert unpack_calls == [1]
+    finally:
+        codec.pack, codec.unpack = orig_pack, orig_unpack
+        GlobalConfig.rpc_native_codec, rpc_mod._C_MIN_BUFS = saved
+        rpc_mod._reset_codec_for_tests()
+
+
+@needs_native
+def test_mixed_pairing_live_roundtrip(native_codec_active):
+    """A native-codec client against a Python-codec server (and the
+    reverse) — simulated at the frame layer, where pairing actually
+    happens: every (encoder, decoder) combination round-trips the same
+    calls, including oob and batch shapes."""
+    big = bytearray(os.urandom(96 * 1024))
+    frames = FRAMES + [(11, "put", pickle.PickleBuffer(big))]
+    encoders = [rpc_mod._encode_frame, rpc_mod._encode_frame_py]
+    decoders = [rpc_mod._decode_body, rpc_mod._decode_body_py]
+    for enc in encoders:
+        for dec in decoders:
+            for frame in frames:
+                body = bytes(_concat(enc(frame)[0])[rpc_mod._LEN :])
+                out = dec(body)
+                if frame[1] == "put":
+                    assert out[:2] == frame[:2]
+                    assert bytes(memoryview(out[2])) == bytes(big)
+                else:
+                    assert out == frame

@@ -349,3 +349,68 @@ def test_retry_backoff_decorrelated_jitter_diverges():
             assert base <= delay <= cap
     finally:
         GlobalConfig.rpc_retry_jitter = saved
+
+
+def test_frame_stats_exact_under_concurrent_encoders():
+    """FRAME_STATS exactness regression: oob/batch counters are updated
+    from the protocol loop, server lanes, AND direct-submitting user
+    threads.  ``dict +=`` is a read-modify-write under the GIL, so without
+    the stats lock concurrent encoders tear increments and the counters
+    drift low — this pins byte- and count-exact accounting."""
+    import pickle
+    import threading
+
+    from ray_tpu.core import rpc as rpc_mod
+
+    before = dict(rpc_mod.FRAME_STATS)
+    n_threads, per_thread = 8, 400
+    blob_size = 64 * 1024 + 16  # every frame rides one oob buffer
+
+    def hammer(tid):
+        src = bytearray(blob_size)
+        for i in range(per_thread):
+            rpc_mod._encode_frame((2 * i + 2, "put", pickle.PickleBuffer(src)))
+
+    threads = [
+        threading.Thread(target=hammer, args=(t,)) for t in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    total_frames = n_threads * per_thread
+    assert rpc_mod.FRAME_STATS["oob_frames"] - before["oob_frames"] == total_frames
+    assert (
+        rpc_mod.FRAME_STATS["oob_bytes"] - before["oob_bytes"]
+        == total_frames * blob_size
+    )
+    # Batch counters stayed untouched by single-frame encodes.
+    assert rpc_mod.FRAME_STATS["batch_frames"] == before["batch_frames"]
+    assert rpc_mod.FRAME_STATS["batched_calls"] == before["batched_calls"]
+
+
+def test_frame_stats_batch_containers_exact():
+    """Batched calls tick batch_frames/batched_calls exactly once per
+    container / per multiplexed call."""
+    from ray_tpu.core import rpc as rpc_mod
+
+    async def main():
+        server = RpcServer(EchoHandler())
+        addr = await server.start()
+        client = await RpcClient(addr).connect()
+        before = dict(rpc_mod.FRAME_STATS)
+        results = await asyncio.gather(
+            *[client.call("echo", i, batch=True) for i in range(12)]
+        )
+        assert results == list(range(12))
+        assert (
+            rpc_mod.FRAME_STATS["batched_calls"] - before["batched_calls"] == 12
+        )
+        assert (
+            rpc_mod.FRAME_STATS["batch_frames"] - before["batch_frames"] >= 1
+        )
+        await client.close()
+        await server.stop()
+
+    run(main())

@@ -1,0 +1,200 @@
+"""Timeout-wheel semantics: firing window, leak-freedom under cancel, the
+wheel-backed RPC timeout path, and the HA retry grace it must not break."""
+
+import asyncio
+import time
+
+import pytest
+
+from ray_tpu.core import rpc as rpc_mod
+from ray_tpu.core.config import GlobalConfig
+from ray_tpu.core.rpc import (
+    RetryableRpcClient,
+    RpcClient,
+    RpcServer,
+    RpcTimeoutError,
+    TimeoutWheel,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_fires_within_one_bucket_of_nominal():
+    """A deadline at delay d fires in (d, d + granularity] — never early,
+    at most one bucket late (plus loop scheduling slack)."""
+
+    async def main():
+        loop = asyncio.get_running_loop()
+        g = 0.05
+        wheel = TimeoutWheel(loop, g)
+        fired = {}
+        done = asyncio.Event()
+        delays = [0.08, 0.12, 0.21]
+
+        t0 = loop.time()
+
+        def cb(key):
+            fired[key] = loop.time() - t0
+            if len(fired) == len(delays):
+                done.set()
+
+        for d in delays:
+            wheel.add(d, cb, d)
+        await asyncio.wait_for(done.wait(), timeout=5.0)
+        for d in delays:
+            # Never early; at most one bucket + scheduling slack late.
+            assert fired[d] >= d - 1e-4, (d, fired[d])
+            assert fired[d] <= d + g + 0.05, (d, fired[d])
+        assert wheel.live == 0
+
+    run(main())
+
+
+def test_cancelled_entries_do_not_leak():
+    """Cancel is lazy (no bucket surgery) but the live count drops
+    immediately and the sweep drains the dead entries — no growth across
+    register/cancel churn, and no cancelled callback ever fires."""
+
+    async def main():
+        loop = asyncio.get_running_loop()
+        g = 0.05
+        wheel = TimeoutWheel(loop, g)
+        fired = []
+        entries = [wheel.add(0.1, fired.append, i) for i in range(500)]
+        assert wheel.live == 500
+        for e in entries:
+            wheel.cancel(e)
+        assert wheel.live == 0
+        assert wheel.bucket_count() == 500  # lazy: swept, not excised
+        await asyncio.sleep(0.1 + 2 * g + 0.05)
+        assert fired == []  # cancellation always wins
+        assert wheel.bucket_count() == 0  # the sweep reclaimed everything
+        # Double-cancel is idempotent.
+        wheel.cancel(entries[0])
+        assert wheel.live == 0
+
+    run(main())
+
+
+def test_add_from_foreign_thread():
+    """Direct submits arm deadlines off-loop: add() from a non-loop thread
+    must re-arm the loop timer and fire on the loop."""
+    import threading
+
+    async def main():
+        loop = asyncio.get_running_loop()
+        wheel = TimeoutWheel(loop, 0.05)
+        fired = asyncio.Event()
+
+        def arm():
+            wheel.add(0.08, loop.call_soon_threadsafe, fired.set)
+
+        threading.Thread(target=arm).start()
+        await asyncio.wait_for(fired.wait(), timeout=5.0)
+        assert wheel.live == 0
+
+    run(main())
+
+
+class SlowHandler:
+    async def handle_stall(self, payload, conn):
+        await asyncio.sleep(30)
+        return "too late"
+
+    def handle_echo(self, payload, conn):
+        return payload
+
+
+def test_rpc_timeout_via_wheel_same_semantics():
+    """With the wheel active, a stalled call raises the same
+    RpcTimeoutError (same message shape) the wait_for path raised, the
+    pending entry is reclaimed, and the connection stays usable."""
+
+    async def main():
+        server = RpcServer(SlowHandler())
+        addr = await server.start()
+        client = await RpcClient(addr).connect()
+        assert client._wheel is not None  # default granularity 50ms > 0
+        t0 = time.monotonic()
+        with pytest.raises(RpcTimeoutError) as ei:
+            await client.call("stall", timeout=0.3)
+        dt = time.monotonic() - t0
+        assert "timed out after 0.3s" in str(ei.value)
+        assert 0.3 <= dt < 1.0  # one bucket late at most, not a hang
+        assert not client._pending  # expired entry reclaimed
+        # The connection survived the timeout — later calls still work.
+        assert await client.call("echo", "alive", timeout=5) == "alive"
+        # Replies cancel their wheel entries: nothing left ticking.
+        assert client._wheel.live == 0
+        await client.close()
+        await server.stop()
+
+    run(main())
+
+
+def test_wheel_disabled_restores_wait_for_path():
+    """rpc_timeout_wheel_ms=0 pins the legacy per-call wait_for timers."""
+    saved = GlobalConfig.rpc_timeout_wheel_ms
+    GlobalConfig.rpc_timeout_wheel_ms = 0
+    try:
+
+        async def main():
+            server = RpcServer(SlowHandler())
+            addr = await server.start()
+            client = await RpcClient(addr).connect()
+            assert client._wheel is None
+            with pytest.raises(RpcTimeoutError):
+                await client.call("stall", timeout=0.2)
+            assert await client.call("echo", 1, timeout=5) == 1
+            await client.close()
+            await server.stop()
+
+        run(main())
+    finally:
+        GlobalConfig.rpc_timeout_wheel_ms = saved
+
+
+def test_ha_retry_grace_spans_leaderless_window():
+    """PR-16 semantics preserved: a resolver-attached (HA) client keeps
+    retrying on connect failures past its attempt budget until the
+    election-sized grace window elapses — wheel or no wheel, because
+    wheel expiries surface as RpcTimeoutError (not swallowed as transport
+    loss) and connect failures still drive the time-based loop."""
+    saved = (GlobalConfig.cp_lease_ttl_s, GlobalConfig.cp_lease_poll_s,
+             GlobalConfig.rpc_retry_base_delay_s)
+    GlobalConfig.cp_lease_ttl_s = 0.2
+    GlobalConfig.cp_lease_poll_s = 0.05
+    GlobalConfig.rpc_retry_base_delay_s = 0.05
+    try:
+
+        async def main():
+            # Resolver that finds a live leader only after a leaderless
+            # window longer than the attempt budget alone would survive.
+            server = RpcServer(SlowHandler())
+            good_addr = await server.start()
+            t0 = time.monotonic()
+            window_s = 1.0
+
+            def resolver():
+                if time.monotonic() - t0 < window_s:
+                    return "127.0.0.1:1"  # nothing listens here
+                return good_addr
+
+            client = RetryableRpcClient(
+                "127.0.0.1:1", address_resolver=resolver
+            )
+            # retries=1 would exhaust instantly without the grace window;
+            # ha_grace (>= 5s here) must carry it across the whole outage.
+            result = await client.call("echo", "found-you", retries=1,
+                                       timeout=5)
+            assert result == "found-you"
+            assert time.monotonic() - t0 >= window_s  # really waited it out
+            await client.close()
+            await server.stop()
+
+        run(main())
+    finally:
+        (GlobalConfig.cp_lease_ttl_s, GlobalConfig.cp_lease_poll_s,
+         GlobalConfig.rpc_retry_base_delay_s) = saved
