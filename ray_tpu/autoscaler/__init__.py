@@ -11,7 +11,8 @@ slice-atomically.
 """
 
 from .config import AutoscalingConfig, NodeTypeConfig  # noqa: F401
-from .autoscaler import Autoscaler  # noqa: F401
+from .autoscaler import Autoscaler, wait_for_nodes  # noqa: F401
+from .elastic import LaunchBackoff, NodeDrainer  # noqa: F401
 from .command_runner import (  # noqa: F401
     CommandRunner,
     LocalCommandRunner,

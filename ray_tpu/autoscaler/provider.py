@@ -13,6 +13,7 @@ provider state against the control plane's node table:
 
 from __future__ import annotations
 
+import threading
 import uuid
 from typing import Dict, List, Optional
 
@@ -44,16 +45,44 @@ class NodeProvider:
 
 class FakeMultiNodeProvider(NodeProvider):
     """Launches real local node-agent processes joined to an existing
-    cluster (the reference's fake-multinode analog)."""
+    cluster (the reference's fake-multinode analog).
+
+    Fault hooks (driven by ``devtools/chaos.py`` injectors, all through
+    the real reconcile loop):
+
+    - ``fault_create_errors``: the next N ``create_node`` calls raise —
+      the backoff-convergence scenario.
+    - ``fault_create_delay_s``: ``create_node`` returns a provider id
+      immediately but the node's processes start only after the delay —
+      slow provisioning, during which the decision must not
+      double-launch.
+    - ``kill_node``: kill a node's processes while KEEPING the provider
+      record — a crashed VM the cloud API still reports as running; the
+      autoscaler's reclaim pass must converge it.
+    """
 
     def __init__(self, cp_address: str, session_id: str):
         self._cp_address = cp_address
         self._session_id = session_id
         self._nodes: Dict[str, tuple] = {}  # provider_id -> (type_name, Node)
+        self._lock = threading.Lock()
+        self.fault_create_errors = 0
+        self.fault_create_delay_s = 0.0
+        self.create_calls = 0
+        self.terminate_calls = 0
 
     def create_node(self, node_type: NodeTypeConfig) -> str:
         from ..core.node import Node
 
+        self.create_calls += 1
+        with self._lock:
+            if self.fault_create_errors > 0:
+                self.fault_create_errors -= 1
+                raise RuntimeError(
+                    "chaos: provider refused create_node "
+                    f"({self.fault_create_errors} more failures queued)"
+                )
+            delay = self.fault_create_delay_s
         provider_id = f"fake-{uuid.uuid4().hex[:8]}"
         labels = dict(node_type.labels)
         labels[NODE_TYPE_LABEL] = node_type.name
@@ -67,12 +96,43 @@ class FakeMultiNodeProvider(NodeProvider):
             session_id=self._session_id,
             num_cpus=resources.get("CPU", 1),
         )
-        node.start()
         self._nodes[provider_id] = (node_type.name, node)
+        if delay > 0:
+            # Slow provisioning: the id exists (non_terminated_nodes
+            # reports it — a real cloud shows the VM as PROVISIONING)
+            # but the agent joins late.
+            timer = threading.Timer(delay, self._deferred_start,
+                                    args=(provider_id, node))
+            timer.daemon = True
+            timer.name = f"rtpu-fake-provision-{provider_id}"
+            timer.start()
+        else:
+            node.start()
         return provider_id
 
+    def _deferred_start(self, provider_id: str, node) -> None:
+        with self._lock:
+            if provider_id not in self._nodes:
+                return  # terminated while provisioning
+        try:
+            node.start()
+        except Exception:  # noqa: BLE001 — raced terminate kills the start
+            from ..util import flight_recorder
+
+            flight_recorder.count_suppressed("fake_provider_deferred_start")
+
     def terminate_node(self, provider_id: str) -> None:
-        entry = self._nodes.pop(provider_id, None)
+        self.terminate_calls += 1
+        with self._lock:
+            entry = self._nodes.pop(provider_id, None)
+        if entry is not None:
+            _, node = entry
+            node.pg.kill_all()
+
+    def kill_node(self, provider_id: str) -> None:
+        """Chaos: crash the node's processes but keep the provider record
+        (the cloud API has not noticed the VM die)."""
+        entry = self._nodes.get(provider_id)
         if entry is not None:
             _, node = entry
             node.pg.kill_all()

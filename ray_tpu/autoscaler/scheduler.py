@@ -27,6 +27,17 @@ class ScalingDecision:
     to_launch: Dict[str, int] = field(default_factory=dict)  # type -> count
     to_terminate: List[str] = field(default_factory=list)  # provider ids
     infeasible: List[dict] = field(default_factory=list)  # unmet demands
+    # Demand summary: how many unmet demands fed this round's packing and
+    # their aggregate shape (the cli/dashboard pending-demand panel).
+    pending_demand: int = 0
+    pending_resources: Dict[str, float] = field(default_factory=dict)
+    # Filled by the Autoscaler after acting: per-type consecutive launch
+    # failures and the remaining backoff gate (0 = clear to launch).
+    launch_failures: Dict[str, int] = field(default_factory=dict)
+    backoff_remaining_s: Dict[str, float] = field(default_factory=dict)
+    # Provider ids the drain state machine currently holds (informational;
+    # never re-listed in to_terminate).
+    draining: List[str] = field(default_factory=list)
 
 
 @dataclass
@@ -78,6 +89,13 @@ def _collect_demands(load_state: dict) -> List[_Demand]:
     demands.extend(
         _Demand(dict(d)) for d in load_state.get("unplaceable_demands", [])
     )
+    # Over-quota task leases queued by admission: no PENDING table holds
+    # them, so the control plane exports a recency window (the JobArbiter
+    # demand the tentpole wires in — queued work provisions nodes instead
+    # of waiting forever).
+    demands.extend(
+        _Demand(dict(d)) for d in load_state.get("queued_task_demands", [])
+    )
     for pg in load_state.get("pending_pgs", []):
         if isinstance(pg, dict):
             strategy, bundles = pg.get("strategy", "PACK"), pg["bundles"]
@@ -103,10 +121,21 @@ def compute_scaling_decision(
 ) -> ScalingDecision:
     decision = ScalingDecision()
     demands = _collect_demands(load_state)
+    decision.pending_demand = len(demands)
+    for d in demands:
+        for k, v in d.resources.items():
+            decision.pending_resources[k] = (
+                decision.pending_resources.get(k, 0.0) + v
+            )
 
     sim_nodes: List[_SimNode] = []
     for node in load_state["nodes"].values():
         if not node["alive"]:
+            continue
+        if node.get("draining"):
+            # A draining node is leaving: nothing may pack onto it, and it
+            # must not be re-selected for idle termination — the drain
+            # state machine already owns its retirement.
             continue
         labels = node.get("labels", {})
         sim_nodes.append(
@@ -116,6 +145,34 @@ def compute_scaling_decision(
                 provider_id=labels.get(PROVIDER_ID_LABEL),
                 type_name=labels.get(NODE_TYPE_LABEL, ""),
                 idle_s=node.get("idle_s", 0.0),
+            )
+        )
+
+    # Provider records whose node has not REGISTERED yet (still
+    # provisioning — e.g. a slow cloud boot) count as planned capacity,
+    # or every round between create_node and the agent's first heartbeat
+    # would launch another copy for the same demand.  A record the
+    # control plane KNOWS but reports dead is excluded: that node is not
+    # coming back on its own (the reclaim grace owns its record), and
+    # suppressing a relaunch would strand the demand.
+    known_pids = {
+        node.get("labels", {}).get(PROVIDER_ID_LABEL)
+        for node in load_state["nodes"].values()
+    }
+    for pid, tname in provider_nodes.items():
+        if pid in known_pids:
+            continue
+        t = config.node_types.get(tname)
+        if t is None:
+            continue
+        sim_nodes.append(
+            _SimNode(
+                avail=dict(t.resources),
+                total=dict(t.resources),
+                provider_id=pid,
+                type_name=tname,
+                idle_s=0.0,
+                planned=True,
             )
         )
 

@@ -155,6 +155,18 @@ _KNOBS: Dict[str, tuple] = {
         "expiry the eviction proceeds anyway (the restart path falls "
         "back to the last driver-side checkpoint)",
     ),
+    "drain_timeout_s": (
+        float, 60.0,
+        "Deadline for a draining node to empty (residents evicted via "
+        "prepare_evict, leases finished); on expiry the autoscaler "
+        "terminates anyway — the restart machinery recovers whatever "
+        "was still resident",
+    ),
+    "drain_poll_period_s": (
+        float, 0.5,
+        "How often the autoscaler polls drain_status for nodes it is "
+        "retiring",
+    ),
     "scheduler_top_k_fraction": (float, 0.2, "Top-k random choice fraction"),
     "lease_idle_timeout_s": (float, 0.3, "Return idle leased worker after"),
     "task_push_keepalive_s": (

@@ -74,6 +74,12 @@ class NodeEntry:
         self.snapshot = snapshot
         self.last_heartbeat = time.monotonic()
         self.alive = True
+        # Drain state machine (autoscaler scale-down): a draining node is
+        # unschedulable but still heartbeats; the autoscaler terminates it
+        # once drain_status reports it empty.
+        self.draining = False
+        self.drain_cause = ""
+        self.drain_started = 0.0
 
 
 class ActorEntry:
@@ -206,7 +212,11 @@ class ControlPlane:
         # evaluate once per beat instead of polling blind.
         self.obs_beats = 0
         self._requested_resources: List[dict] = []
-        self._recent_unplaceable: List[tuple] = []  # (monotonic ts, resources)
+        self._recent_unplaceable: List[tuple] = []  # (ts, key, resources)
+        # Over-quota task-lease demand: unlike queued actors/PGs it lives in
+        # no PENDING table (the submitter backs off and retries), so it is
+        # remembered here briefly for the autoscaler's load state.
+        self._recent_queued_tasks: List[tuple] = []  # (ts, key, resources)
         self.store = store if store is not None else make_store_client(store_path)
         export_path = None
         if store_path:
@@ -488,8 +498,17 @@ class ControlPlane:
     def handle_register_node(self, payload, conn):
         node_id = payload["node_id"]
         entry = NodeEntry(node_id, payload["agent_address"], payload["snapshot"])
+        prev = self.nodes.get(node_id)
+        if prev is not None and prev.draining:
+            # An agent restart must not re-open a node the autoscaler is
+            # retiring: the drain decision outlives the registration.
+            entry.draining = True
+            entry.drain_cause = prev.drain_cause
+            entry.drain_started = prev.drain_started
         self.nodes[node_id] = entry
         self.scheduler.update_node(node_id, payload["snapshot"])
+        if entry.draining:
+            self.scheduler.set_draining(node_id, True)
         logger.info(
             "node %s registered (%s) resources=%s",
             node_id.hex()[:8],
@@ -1604,9 +1623,14 @@ class ControlPlane:
             job_hex, ResourceSet(payload["resources"])
         ):
             # Over-quota task lease: queue (submitter backs off and
-            # retries), surfaced as a queued-by-admission count.
+            # retries), surfaced as a queued-by-admission count and as
+            # autoscaler demand (a quota raise or freed capacity elsewhere
+            # may admit it — the cluster should be ABLE to run it).
             self.arbiter.note_queued_event(job_hex)
             self._record_sched_event("admission_queued", job=job_hex)
+            self._note_queued_task(
+                payload["resources"], owner=payload.get("owner_id")
+            )
             return {"node_id": None}
         try:
             node_id = self.scheduler.pick_node(
@@ -1615,17 +1639,175 @@ class ControlPlane:
                 preferred=payload.get("preferred"),
             )
         except InfeasibleError as e:
-            self._note_unplaceable(payload["resources"])
+            self._note_unplaceable(
+                payload["resources"], owner=payload.get("owner_id")
+            )
             return {"infeasible": True, "error": str(e)}
         if node_id is None:
-            self._note_unplaceable(payload["resources"])
+            self._note_unplaceable(
+                payload["resources"], owner=payload.get("owner_id")
+            )
             return {"node_id": None}
+        # Satisfied demand must stop driving scale-up: a granted lease
+        # retires its own window entries, or the autoscaler would keep
+        # seeing a phantom pending task for up to the window length
+        # (and launch a replacement the moment the hosting node drains).
+        self._clear_demand(payload["resources"], payload.get("owner_id"))
         return {
             "node_id": node_id,
             "agent_address": self.nodes[node_id].agent_address,
         }
 
     # ------------------------------------------------------------- autoscaler
+    #
+    # Drain state machine (scale-down): mark unschedulable -> evict
+    # residents through the prepare_evict checkpoint protocol -> the
+    # autoscaler polls drain_status until the node is empty -> provider
+    # terminate -> drain_complete retires the entry.  Drain flags are
+    # in-memory only: after a control-plane failover the autoscaler's
+    # next status poll sees draining=False and simply re-issues the mark
+    # (drain_node is idempotent).
+
+    def _resolve_node_id(self, raw) -> Optional[NodeID]:
+        if isinstance(raw, NodeID):
+            return raw
+        try:
+            return NodeID.from_hex(raw)
+        except Exception:  # noqa: BLE001 — malformed client input
+            return None
+
+    async def handle_drain_node(self, payload, conn):
+        """Mark a node unschedulable and evict its residents (autoscaler
+        scale-down; reference: ray ``DrainNode`` GCS RPC).  Idempotent;
+        ``cancel`` reverses a drain that has not terminated yet."""
+        node_id = self._resolve_node_id(payload.get("node_id"))
+        entry = self.nodes.get(node_id) if node_id is not None else None
+        if entry is None:
+            return {"ok": False, "error": "unknown node"}
+        if payload.get("cancel"):
+            if entry.draining:
+                entry.draining = False
+                entry.drain_cause = ""
+                self.scheduler.set_draining(node_id, False)
+                self.events.record(
+                    NODE_LIFECYCLE, node_id.hex(), "DRAIN_CANCELLED"
+                )
+                self._kick_pending()
+            return {"ok": True, "draining": False}
+        cause = payload.get("cause") or "autoscaler scale-down"
+        already = entry.draining
+        if not already:
+            entry.draining = True
+            entry.drain_cause = cause
+            entry.drain_started = time.monotonic()
+            self.scheduler.set_draining(node_id, True)
+            self.events.record(
+                NODE_LIFECYCLE, node_id.hex(), "DRAINING", cause=cause
+            )
+            logger.info("draining node %s: %s", node_id.hex()[:8], cause)
+        # Evict resident placement groups through the checkpoint-then-
+        # evict protocol.  No preemption-budget spend: drain is cluster
+        # policy, not one tenant demanding another's chips.
+        evicted = []
+        for pg in list(self.placement_groups.values()):
+            if (
+                pg.state == "CREATED"
+                and pg.bundle_nodes
+                and node_id in pg.bundle_nodes
+            ):
+                await self._preempt_pg(pg, f"node drain: {cause}")
+                evicted.append(pg.pg_id.hex())
+        migrated = 0
+        for actor_id, a in list(self.actors.items()):
+            if (
+                a.node_id == node_id
+                and a.state == ALIVE
+                and not a.spec.placement_group_id
+            ):
+                # Same guard as preemption: the kill must not consume
+                # max_restarts — the actor re-places on another node.
+                self._evicting_actors.add(actor_id)
+                a.incarnation += 1
+                a.state = RESTARTING
+                await self._kill_actor_worker(a)
+                a.address = None
+                self._persist_actor(a)
+                self._publish_actor(a)
+                if actor_id not in self._pending_actors:
+                    self._pending_actors.append(actor_id)
+                migrated += 1
+        if evicted or migrated:
+            self._record_sched_event(
+                "drain_evict", node=node_id.hex()[:8],
+                pgs=len(evicted), actors=migrated,
+            )
+        self._kick_pending()
+        return {
+            "ok": True,
+            "draining": True,
+            "already_draining": already,
+            "evicted_pgs": evicted,
+            "migrated_actors": migrated,
+        }
+
+    def handle_drain_status(self, payload, conn):
+        """Is this draining node empty yet?  The autoscaler polls this
+        until ``drained`` before calling the provider's terminate."""
+        node_id = self._resolve_node_id(payload.get("node_id"))
+        entry = self.nodes.get(node_id) if node_id is not None else None
+        if entry is None:
+            # Gone entirely — nothing left to wait for.
+            return {"known": False, "draining": False, "drained": True}
+        resident_pgs = sum(
+            1
+            for pg in self.placement_groups.values()
+            if pg.state == "CREATED"
+            and pg.bundle_nodes
+            and node_id in pg.bundle_nodes
+        )
+        resident_actors = sum(
+            1
+            for a in self.actors.values()
+            if a.node_id == node_id and a.state == ALIVE
+        )
+        snap = entry.snapshot or {}
+        busy = (
+            bool(snap.get("pending_demands"))
+            or snap.get("available", {}) != snap.get("total", {})
+        )
+        drained = not entry.alive or (
+            resident_pgs == 0 and resident_actors == 0 and not busy
+        )
+        return {
+            "known": True,
+            "alive": entry.alive,
+            "draining": entry.draining,
+            "drained": drained,
+            "resident_pgs": resident_pgs,
+            "resident_actors": resident_actors,
+            "busy": busy,
+            "cause": entry.drain_cause,
+            "age_s": (
+                time.monotonic() - entry.drain_started
+                if entry.draining else 0.0
+            ),
+        }
+
+    async def handle_drain_complete(self, payload, conn):
+        """Provider terminate happened: retire the node entry now instead
+        of waiting out the health-check timeout."""
+        node_id = self._resolve_node_id(payload.get("node_id"))
+        entry = self.nodes.get(node_id) if node_id is not None else None
+        if entry is None:
+            return {"ok": True, "known": False}
+        if entry.alive:
+            self.events.record(
+                NODE_LIFECYCLE, node_id.hex(), "DRAINED",
+                cause=entry.drain_cause,
+            )
+            await self._on_node_dead(node_id)
+        return {"ok": True, "known": True}
+
     def handle_get_load_state(self, payload, conn):
         """Cluster load snapshot for the autoscaler (reference:
         ``GcsAutoscalerStateManager`` state consumed by
@@ -1649,6 +1831,7 @@ class ControlPlane:
             "nodes": {
                 nid.hex(): {
                     "alive": e.alive,
+                    "draining": e.draining,
                     "total": e.snapshot.get("total", {}),
                     "available": e.snapshot.get("available", {}),
                     "labels": e.snapshot.get("labels", {}),
@@ -1662,18 +1845,60 @@ class ControlPlane:
             "requested_resources": list(self._requested_resources),
             "unplaceable_demands": [
                 dict(r)
-                for ts, r in self._recent_unplaceable
+                for ts, _k, r in self._recent_unplaceable
                 if time.monotonic() - ts < 5.0
             ],
+            # Over-quota task leases queued by admission (JobArbiter): no
+            # PENDING table holds them, so they ride a short recency
+            # window like unplaceable demand.
+            "queued_task_demands": [
+                dict(r)
+                for ts, _k, r in self._recent_queued_tasks
+                if time.monotonic() - ts < 5.0
+            ],
+            "queued_by_admission": {
+                job: info.get("queued_now", 0)
+                for job, info in self.arbiter.snapshot().items()
+                if info.get("queued_now")
+            },
         }
 
-    def _note_unplaceable(self, resources: dict, window_s: float = 5.0):
+    @staticmethod
+    def _demand_key(resources: dict, owner) -> tuple:
+        return (owner, tuple(sorted(resources.items())))
+
+    def _note_queued_task(self, resources: dict, owner=None,
+                          window_s: float = 5.0):
+        # Keyed by requester identity: a lease pool retrying the same
+        # over-quota request every backoff must read as ONE pending task,
+        # not one per retry — or the autoscaler overshoots.
         now = time.monotonic()
-        self._recent_unplaceable = [
-            (ts, r) for ts, r in self._recent_unplaceable
-            if now - ts < window_s
+        key = self._demand_key(resources, owner)
+        self._recent_queued_tasks = [
+            (ts, k, r) for ts, k, r in self._recent_queued_tasks
+            if now - ts < window_s and k != key
         ]
-        self._recent_unplaceable.append((now, dict(resources)))
+        self._recent_queued_tasks.append((now, key, dict(resources)))
+
+    def _note_unplaceable(self, resources: dict, owner=None,
+                          window_s: float = 5.0):
+        now = time.monotonic()
+        key = self._demand_key(resources, owner)
+        self._recent_unplaceable = [
+            (ts, k, r) for ts, k, r in self._recent_unplaceable
+            if now - ts < window_s and k != key
+        ]
+        self._recent_unplaceable.append((now, key, dict(resources)))
+
+    def _clear_demand(self, resources: dict, owner):
+        """Retire a requester's window entries once its lease is granted."""
+        key = self._demand_key(resources, owner)
+        self._recent_queued_tasks = [
+            e for e in self._recent_queued_tasks if e[1] != key
+        ]
+        self._recent_unplaceable = [
+            e for e in self._recent_unplaceable if e[1] != key
+        ]
 
     def handle_request_resources(self, payload, conn):
         """Explicit autoscaling demand (``ray.autoscaler.sdk.
@@ -1821,9 +2046,14 @@ class ControlPlane:
 
     def handle_get_state(self, payload, conn):
         """State-API snapshot (reference: ray.util.state / StateAggregator)."""
+        autoscaler = self._kv.get("autoscaler", {}).get("status")
         return {
             "nodes": {
-                nid.hex(): {"alive": e.alive, "snapshot": e.snapshot}
+                nid.hex(): {
+                    "alive": e.alive,
+                    "draining": e.draining,
+                    "snapshot": e.snapshot,
+                }
                 for nid, e in self.nodes.items()
             },
             "actors": [e.public_info() for e in self.actors.values()],
@@ -1833,6 +2063,10 @@ class ControlPlane:
             "jobs": {jid.hex(): dict(j) for jid, j in self.jobs.items()},
             "scheduling": self.arbiter.snapshot(),
             "cp": self._cp_ha_info(),
+            # Published by the autoscaler each reconcile round (KV
+            # namespace "autoscaler"): last decision, per-type counts,
+            # draining nodes, pending-demand summary, launch backoff.
+            "autoscaler": autoscaler if isinstance(autoscaler, dict) else {},
         }
 
 

@@ -946,6 +946,10 @@ class NodeAgent:
                     "placement_group_id": payload.get("placement_group_id"),
                     "bundle_index": payload.get("bundle_index", -1),
                     "job_id": payload.get("job_id"),
+                    # Stable requester identity: the control plane dedupes
+                    # its autoscaler demand windows by it, so one lease
+                    # pool retrying does not read as N pending tasks.
+                    "owner_id": payload.get("owner_id"),
                 },
             )
         except Exception as e:  # noqa: BLE001

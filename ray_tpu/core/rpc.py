@@ -1774,18 +1774,24 @@ class RpcClient:
                 logger.debug("client writer close failed: %s", e)
 
 
-def next_backoff_delay(prev: float) -> float:
+def next_backoff_delay(prev: float, base: Optional[float] = None,
+                       cap: Optional[float] = None) -> float:
     """Next retry sleep after a failed attempt that waited ``prev``.
 
     With ``rpc_retry_jitter`` (default): decorrelated jitter —
     ``min(cap, uniform(base, prev * 3))`` — so two clients that failed at
     the same instant (every client in the cluster, after a control-plane
     restart) diverge instead of reconnecting in lockstep.  Without it:
-    the classic deterministic doubling, ``min(cap, prev * 2)``."""
-    cap = GlobalConfig.rpc_retry_max_delay_s
+    the classic deterministic doubling, ``min(cap, prev * 2)``.
+
+    ``base``/``cap`` default to the rpc retry knobs; other backoff users
+    (the autoscaler's per-type launch gate) pass their own bounds."""
+    if cap is None:
+        cap = GlobalConfig.rpc_retry_max_delay_s
     if not GlobalConfig.rpc_retry_jitter:
         return min(prev * 2, cap)
-    base = GlobalConfig.rpc_retry_base_delay_s
+    if base is None:
+        base = GlobalConfig.rpc_retry_base_delay_s
     return min(cap, random.uniform(base, max(base, prev * 3)))
 
 

@@ -78,6 +78,11 @@ class ClusterScheduler:
 
     def __init__(self, use_native: bool = True):
         self.nodes: Dict[NodeID, NodeResources] = {}
+        # Draining nodes stay in the view (so demand that only THEY could
+        # satisfy queues as infeasible-now rather than hard-failing) but are
+        # excluded from every pick path — a heartbeat can never re-open a
+        # node the autoscaler is retiring.
+        self._draining: set = set()
         self._spread_rr = 0
         self._native = None
         if use_native:
@@ -103,8 +108,18 @@ class ClusterScheduler:
 
     def remove_node(self, node_id: NodeID):
         self.nodes.pop(node_id, None)
+        self._draining.discard(node_id)
         if self._native is not None:
             self._native.remove_node(node_id.binary())
+
+    def set_draining(self, node_id: NodeID, draining: bool = True):
+        if draining:
+            self._draining.add(node_id)
+        else:
+            self._draining.discard(node_id)
+
+    def is_draining(self, node_id: NodeID) -> bool:
+        return node_id in self._draining
 
     # ------------------------------------------------------------------ tasks
     def pick_node(
@@ -118,13 +133,18 @@ class ClusterScheduler:
         if isinstance(strategy, NodeAffinityStrategy):
             target = NodeID.from_hex(strategy.node_id_hex)
             nr = self.nodes.get(target)
-            if nr is not None and nr.can_fit(request):
+            if (
+                nr is not None
+                and target not in self._draining
+                and nr.can_fit(request)
+            ):
                 return target
             if not strategy.soft:
                 return None
             strategy = None  # soft: fall through to hybrid
         if (
             self._native is not None
+            and not self._draining
             and (strategy is None or isinstance(strategy, DefaultStrategy))
         ):
             status, picked = self._native.pick_node(
@@ -151,8 +171,17 @@ class ClusterScheduler:
                 for nid, nr in self.nodes.items()
                 if all(nr.labels.get(k) == v for k, v in strategy.hard.items())
             }
-        feasible_now = _feasible(candidates, request, available=True)
+        schedulable = {
+            nid: nr
+            for nid, nr in candidates.items()
+            if nid not in self._draining
+        }
+        feasible_now = _feasible(schedulable, request, available=True)
         if not feasible_now:
+            # Feasibility ("could this EVER fit") is judged against all
+            # candidates including draining ones: demand whose only home is
+            # a retiring node queues until the drain resolves instead of
+            # hard-failing with InfeasibleError.
             if not _feasible(candidates, request, available=False):
                 if not candidates:
                     return None
@@ -202,6 +231,8 @@ class ClusterScheduler:
         after evicting these victims?' before committing to any eviction."""
         scratch: Dict[NodeID, NodeResources] = {}
         for nid, nr in self.nodes.items():
+            if nid in self._draining:
+                continue
             copy = NodeResources(nr.total.to_dict(), dict(nr.labels))
             copy.available = ResourceSet(nr.available.to_dict())
             if extra_available and nid in extra_available:

@@ -111,6 +111,13 @@ def start_dashboard(
                 # Control-plane HA: role, lease epoch, journal stats and
                 # per-standby replication lag (see docs/ha.md).
                 "cp": state.get("cp", {}),
+                # Elastic capacity: the autoscaler's per-round status blob
+                # (last decision, pending demand, per-type counts/backoff,
+                # in-flight drains — see docs/elastic.md).
+                "autoscaler": state.get("autoscaler", {}),
+                "nodes_draining": sum(
+                    1 for n in alive if n.get("draining")
+                ),
             }
         )
 

@@ -28,6 +28,11 @@ Injectors:
   - ``KilledLeader`` — SIGKILLs the control-plane leader of an HA head;
     recovery is the warm standby taking the lease and clients
     re-anchoring (docs/ha.md), ``revert()`` respawns the standby.
+  - ``ProviderCreateErrors`` / ``SlowProvisioning`` / ``NodeChurn`` —
+    cloud-provider faults on a ``FakeMultiNodeProvider`` (create calls
+    refused, VMs stuck in PROVISIONING, VMs crashing behind the API's
+    back); recovery is the autoscaler's backoff, double-launch
+    protection, and zombie-reclaim pass (docs/elastic.md).
 
 ``CollectiveFabricMember`` is the workload half of the collective
 scenario: a simulated fabric (timed memcpy at per-algorithm bandwidths)
@@ -450,3 +455,68 @@ class QuotaHog(ChaosInjector):
             except Exception as e:  # noqa: BLE001 — keep removing the rest
                 logger.debug("QuotaHog revert skipped: %s", e)
         self.pgs = []
+
+
+# --------------------------------------------------------- provider chaos
+class ProviderCreateErrors(ChaosInjector):
+    """The next ``count`` ``create_node`` calls on a
+    ``FakeMultiNodeProvider`` raise — the cloud API saying no (stockout,
+    quota, rate limit).  Driven through the REAL reconcile loop, the
+    autoscaler must converge to a backoff cadence per node type instead
+    of a hot retry loop; recovery is the queued failures running out (or
+    ``revert()`` clearing them early)."""
+
+    def __init__(self, provider, count: int = 3):
+        self.provider = provider
+        self.count = count
+
+    def apply(self) -> "ProviderCreateErrors":
+        with self.provider._lock:
+            self.provider.fault_create_errors += self.count
+        return self
+
+    def revert(self) -> None:
+        with self.provider._lock:
+            self.provider.fault_create_errors = 0
+
+
+class SlowProvisioning(ChaosInjector):
+    """Every ``create_node`` returns its provider id immediately but the
+    node's processes start only after ``delay_s`` — a VM stuck in
+    PROVISIONING.  The scaling decision must keep counting the pending
+    node (it is in ``non_terminated_nodes``) and NOT double-launch while
+    it boots."""
+
+    def __init__(self, provider, delay_s: float = 3.0):
+        self.provider = provider
+        self.delay_s = delay_s
+
+    def apply(self) -> "SlowProvisioning":
+        with self.provider._lock:
+            self.provider.fault_create_delay_s = self.delay_s
+        return self
+
+    def revert(self) -> None:
+        with self.provider._lock:
+            self.provider.fault_create_delay_s = 0.0
+
+
+class NodeChurn(ChaosInjector):
+    """Crash a launched node's processes while the provider record stays
+    (the cloud still reports the VM running) — one-shot, like
+    ``KilledStageActor``.  Recovery is two-sided: the control plane's
+    health check declares the node dead (restarting its actors
+    elsewhere), and the autoscaler's reclaim pass terminates the zombie
+    provider record after ``reclaim_grace_s`` so a replacement can
+    launch."""
+
+    def __init__(self, provider, provider_id: str):
+        self.provider = provider
+        self.provider_id = provider_id
+
+    def apply(self) -> "NodeChurn":
+        self.provider.kill_node(self.provider_id)
+        return self
+
+    def revert(self) -> None:
+        pass  # recovery is the system's job

@@ -273,6 +273,34 @@ class Anakin(Algorithm):
             self._eval(params, jax.random.PRNGKey(seed), num_envs)
         )
 
+    def resize(self, num_devices: int) -> Dict[str, Any]:
+        """Elastic world-size change: re-form the pmap gang over
+        ``num_devices`` devices without losing learning progress.
+
+        Single-replica params come off-device (``get_state``), the whole
+        compiled loop is rebuilt for the new device set (``setup``), and
+        the params re-replicate bit-identically (``set_state`` — the
+        optimizer state re-initializes, the same policy as a
+        restore-from-checkpoint crossover).  Step counters survive the
+        rebuild; per-device batch shape is unchanged, so the GLOBAL batch
+        scales with the device count — callers accounting for lr/batch
+        coupling read ``num_devices`` out of the returned dict."""
+        from ray_tpu.util import flight_recorder
+
+        old = len(self.devices)
+        if num_devices == old:
+            return {"num_devices": old, "previous": old}
+        state = self.get_state()
+        steps, updates = self.total_env_steps, self.total_updates
+        self.config.num_devices = num_devices
+        self.setup(self.config)
+        self.set_state(state)
+        self.total_env_steps, self.total_updates = steps, updates
+        flight_recorder.record_elastic_resize(
+            "grow" if num_devices > old else "shrink"
+        )
+        return {"num_devices": len(self.devices), "previous": old}
+
     def get_state(self) -> Dict[str, Any]:
         import jax
 
@@ -317,6 +345,9 @@ class AnakinWorker:
 
     def set_state(self, state: Dict[str, Any]) -> None:
         self.algo.set_state(state)
+
+    def resize(self, num_devices: int) -> Dict[str, Any]:
+        return self.algo.resize(num_devices)
 
     def prepare_evict(self) -> bytes:
         """Checkpoint-then-evict hook: pickle the learner state so the

@@ -168,7 +168,11 @@ def cmd_status(args) -> int:
         for sb in cp.get("standbys") or []:
             print(f"  standby {sb.get('holder', '?')} "
                   f"lag={sb.get('lag_records', '?')} records")
-    print(f"nodes: {len(alive)} alive / {len(nodes)} total")
+    draining = [n for n in alive if n.get("draining")]
+    line = f"nodes: {len(alive)} alive / {len(nodes)} total"
+    if draining:
+        line += f" ({len(draining)} draining)"
+    print(line)
     total, avail = {}, {}
     for info in alive:
         for k, v in info["snapshot"]["total"].items():
@@ -206,6 +210,36 @@ def cmd_status(args) -> int:
             if row.get("quarantined_until", 0.0) > 0.0:
                 line += " [preemption-quarantined]"
             print(line)
+    autoscaler = state.get("autoscaler") or {}
+    if autoscaler:
+        # The panel the autoscaler publishes to cluster KV each round:
+        # last decision, pending demand, per-type counts/backoff, drains.
+        last = autoscaler.get("last_decision") or {}
+        launch = ",".join(
+            f"{t}+{n}" for t, n in (last.get("to_launch") or {}).items()
+        ) or "-"
+        print("autoscaler:")
+        print(f"  last decision: launch={launch} "
+              f"terminate={len(last.get('to_terminate') or [])} "
+              f"infeasible={last.get('infeasible', 0)}")
+        demand = autoscaler.get("pending_demand") or {}
+        if demand.get("count"):
+            shape = ",".join(
+                f"{k}={v:g}"
+                for k, v in sorted((demand.get("resources") or {}).items())
+            )
+            print(f"  pending demand: {demand['count']} bundles ({shape})")
+        for tname, row in sorted(
+            (autoscaler.get("node_types") or {}).items()
+        ):
+            line = f"  {tname}: {row.get('count', 0)} node(s)"
+            if row.get("launch_failures"):
+                line += (f" [{row['launch_failures']} launch failure(s), "
+                         f"retry in {row.get('backoff_remaining_s', 0):g}s]")
+            print(line)
+        for d in autoscaler.get("draining") or []:
+            print(f"  draining {d.get('provider_id')} "
+                  f"({d.get('cause', '?')}, {d.get('age_s', 0):g}s)")
     return 0
 
 

@@ -26,6 +26,7 @@ from .session import (  # noqa: F401
     get_context,
     get_dataset_shard,
     report,
+    should_stop,
 )
 from .trainer import (  # noqa: F401
     DataParallelTrainer,

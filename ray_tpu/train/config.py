@@ -22,6 +22,15 @@ class ScalingConfig:
     chips_per_worker: int = 0
     accelerator_version: str = ""
     placement_strategy: str = "SPREAD"
+    # Elastic grow offers: while an under-sized gang trains, the controller
+    # probes free capacity every resize_check_period_s; after
+    # resize_confirm_probes consecutive probes showing room for a larger
+    # gang it requests a cooperative stop (session.should_stop), re-forms
+    # at the new size, and resumes from the latest checkpoint.  Shrink
+    # rides the existing failure path (a dead worker tears the gang down
+    # and _create_group_elastic re-probes).  0 disables grow offers.
+    resize_check_period_s: float = 2.0
+    resize_confirm_probes: int = 2
 
     def worker_resources(self) -> Dict[str, float]:
         if self.resources_per_worker:
@@ -141,3 +150,6 @@ class Result:
     path: str = ""
     error: Optional[BaseException] = None
     metrics_history: Optional[list] = None
+    # Elastic world-size changes over the run: [{"from", "to",
+    # "direction", "reason"}] in order.  Empty/None for fixed gangs.
+    resize_events: Optional[list] = None

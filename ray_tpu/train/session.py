@@ -30,6 +30,7 @@ class TrainContext:
     dataset_shards: Optional[dict] = None
     # filled by the worker actor:
     _report_fn: Any = None
+    _should_stop_fn: Any = None
 
 
 def _set_session(ctx: TrainContext):
@@ -57,6 +58,18 @@ def report(metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):
 
 def get_checkpoint() -> Optional[Checkpoint]:
     return get_context().latest_checkpoint
+
+
+def should_stop() -> bool:
+    """True once the controller asked this worker to stop cooperatively —
+    the elastic-resize offer.  A loop that honors it (checkpoint via
+    ``report``, then return) lets the trainer re-form the gang at a new
+    world size and resume from that checkpoint; a loop that ignores it
+    simply runs to completion."""
+    ctx = get_context()
+    if ctx._should_stop_fn is None:
+        return False
+    return bool(ctx._should_stop_fn())
 
 
 def get_dataset_shard(name: str = "train"):

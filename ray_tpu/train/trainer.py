@@ -88,9 +88,36 @@ class DataParallelTrainer:
         attempts = 0
         metrics_history: List[Dict[str, Any]] = []
         last_error: Optional[BaseException] = None
+        resize_events: List[Dict[str, Any]] = []
+        prev_world: Optional[int] = None
+        # Why the next gang differs in size from the previous one (set
+        # before each `continue`/retry; consumed when the event is logged).
+        resize_reason = ""
 
         while attempts <= max(0, failure_cfg.max_failures):
             group = self._create_group_elastic()
+            if prev_world is not None and group.num_workers != prev_world:
+                from ray_tpu.util import flight_recorder
+
+                direction = (
+                    "grow" if group.num_workers > prev_world else "shrink"
+                )
+                resize_events.append(
+                    {
+                        "from": prev_world,
+                        "to": group.num_workers,
+                        "direction": direction,
+                        "reason": resize_reason or "worker failure",
+                    }
+                )
+                flight_recorder.record_elastic_resize(direction)
+                logger.info(
+                    "elastic resize: world %d -> %d (%s)",
+                    prev_world, group.num_workers,
+                    resize_reason or "worker failure",
+                )
+            prev_world = group.num_workers
+            resize_reason = ""
             try:
                 self.backend.on_start(group)
                 if self.collective_config is not None:
@@ -118,12 +145,22 @@ class DataParallelTrainer:
                     payload, self.train_loop_config, ckpt_mgr.latest(),
                     ckpt_mgr.run_dir, shards_per_worker,
                 )
-                result = self._poll_until_done(group, run_refs, ckpt_mgr,
-                                               metrics_history)
+                result, grow_to = self._poll_until_done(
+                    group, run_refs, ckpt_mgr, metrics_history
+                )
                 self.backend.on_shutdown(group)
                 group.shutdown()
+                if grow_to is not None:
+                    # Cooperative stop for a grow offer: the workers
+                    # checkpointed and returned cleanly — re-form larger
+                    # without consuming a failure attempt.
+                    resize_reason = (
+                        f"capacity for {grow_to} workers became available"
+                    )
+                    continue
                 result.path = ckpt_mgr.run_dir
                 result.metrics_history = metrics_history
+                result.resize_events = resize_events
                 return result
             except Exception as e:  # noqa: BLE001 - worker/group failure
                 last_error = e
@@ -144,6 +181,7 @@ class DataParallelTrainer:
             path=ckpt_mgr.run_dir,
             error=last_error,
             metrics_history=metrics_history,
+            resize_events=resize_events,
         )
 
     def _create_group_elastic(self) -> WorkerGroup:
@@ -187,9 +225,32 @@ class DataParallelTrainer:
             )
         return WorkerGroup(n, res, cfg.placement_strategy)
 
+    def _grow_target(self, current: int) -> Optional[int]:
+        """Largest gang size (≤ num_workers) the cluster could fit right
+        now on top of the running one, or None if no growth is possible."""
+        cfg = self.scaling_config
+        if cfg.min_workers is None or current >= cfg.num_workers:
+            return None
+        res = cfg.worker_resources()
+        avail = ray_tpu.available_resources()
+        extra = cfg.num_workers - current
+        while extra > 0 and any(
+            avail.get(k, 0.0) < v * extra for k, v in res.items()
+        ):
+            extra -= 1
+        return current + extra if extra > 0 else None
+
     def _poll_until_done(self, group, run_refs, ckpt_mgr, metrics_history):
+        """Poll the gang to completion.  Returns ``(result, grow_to)`` —
+        ``grow_to`` is the new world size when the gang was cooperatively
+        stopped for an elastic grow, else None."""
         pending = list(run_refs)
         latest_metrics: Dict[str, Any] = {}
+        cfg = self.scaling_config
+        probe_period = cfg.resize_check_period_s
+        last_probe = time.monotonic()
+        positive_probes = 0
+        grow_to: Optional[int] = None
 
         def drain():
             nonlocal latest_metrics
@@ -209,8 +270,32 @@ class DataParallelTrainer:
             )
             for r in ready:
                 ray_tpu.get(r, timeout=10)  # surface worker exceptions
+            # ---- elastic grow offer: capacity for a larger gang appeared
+            if (
+                grow_to is None
+                and probe_period > 0
+                and time.monotonic() - last_probe >= probe_period
+            ):
+                last_probe = time.monotonic()
+                target = self._grow_target(group.num_workers)
+                positive_probes = positive_probes + 1 if target else 0
+                if target and positive_probes >= max(
+                    1, cfg.resize_confirm_probes
+                ):
+                    # Confirmed twice (a draining node's resources flash
+                    # free before it leaves): ask every worker to
+                    # checkpoint and return; the fit loop re-forms larger.
+                    grow_to = target
+                    logger.info(
+                        "elastic grow offer: %d -> %d workers; requesting "
+                        "cooperative stop", group.num_workers, target,
+                    )
+                    group.request_stop()
         drain()
-        return Result(metrics=latest_metrics, checkpoint=ckpt_mgr.latest())
+        return (
+            Result(metrics=latest_metrics, checkpoint=ckpt_mgr.latest()),
+            grow_to,
+        )
 
 
 class TorchTrainer(DataParallelTrainer):

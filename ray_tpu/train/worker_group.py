@@ -36,6 +36,7 @@ class TrainWorker:
         self._done = False
         self._error: Optional[str] = None
         self._latest_checkpoint: Optional[Checkpoint] = None
+        self._stop_requested = False
 
     # ------------------------------------------------------------ rendezvous
     def get_coordinator_address(self, port: int = 0) -> str:
@@ -116,6 +117,7 @@ class TrainWorker:
             latest_checkpoint=latest_checkpoint,
             dataset_shards=dataset_shards,
             _report_fn=report_fn,
+            _should_stop_fn=lambda: self._stop_requested,
         )
         _set_session(ctx)
         try:
@@ -128,6 +130,13 @@ class TrainWorker:
             _clear_session()
             with self._lock:
                 self._done = True
+
+    def request_stop(self) -> bool:
+        """Elastic resize: ask the user loop (via ``session.should_stop``)
+        to checkpoint and return at the next step boundary.  Runs on a
+        spare call slot while run() blocks."""
+        self._stop_requested = True
+        return True
 
     def poll(self) -> Dict[str, Any]:
         with self._lock:
@@ -172,6 +181,13 @@ class WorkerGroup:
 
     def poll(self):
         return ray_tpu.get([w.poll.remote() for w in self.workers], timeout=60)
+
+    def request_stop(self):
+        """Broadcast the cooperative-stop flag to every worker (the
+        elastic-resize offer)."""
+        ray_tpu.get(
+            [w.request_stop.remote() for w in self.workers], timeout=60
+        )
 
     def shutdown(self):
         for w in self.workers:

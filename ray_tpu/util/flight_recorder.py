@@ -43,6 +43,11 @@ from . import metrics as _metrics
 # Metric names live in ONE registry module (raylint RTL004); the common
 # ones are re-exported here for the recorder's callers and tests.
 from .metric_registry import (  # noqa: F401 — re-exports
+    AUTOSCALER_DRAIN_DURATION_HIST,
+    AUTOSCALER_DRAINS_TOTAL,
+    AUTOSCALER_LAUNCHES_TOTAL,
+    AUTOSCALER_PENDING_DEMAND,
+    AUTOSCALER_TERMINATIONS_TOTAL,
     BACKPRESSURE_BLOCKED_TOTAL,
     BACKPRESSURE_WAIT_HIST,
     COLLECTIVE_ALGO_OPS_TOTAL,
@@ -135,6 +140,7 @@ from .metric_registry import (  # noqa: F401 — re-exports
     TASK_PHASE_HIST,
     TASKS_CANCELLED_TOTAL,
     TRACE_SPANS_DROPPED_TOTAL,
+    TRAIN_ELASTIC_RESIZES_TOTAL,
 )
 
 # Sub-millisecond to minutes: runtime phases span five orders of magnitude.
@@ -804,6 +810,42 @@ def record_sched_event(kind: str, **tags) -> None:
     elif kind == "admission_queued":
         counter(SCHED_ADMISSION_QUEUED_TOTAL, 1.0,
                 {"job": str(tags.get("job", ""))})
+
+
+# ------------------------------------------------------- elastic capacity
+def record_autoscaler_launch(node_type: str, outcome: str) -> None:
+    """One launch attempt in an autoscaler round.  ``outcome``: ``ok``,
+    ``error`` (provider raised), ``backoff`` (gated by the per-type
+    launch backoff, no provider call made)."""
+    counter(AUTOSCALER_LAUNCHES_TOTAL, 1.0,
+            {"type": node_type, "outcome": outcome})
+
+
+def record_autoscaler_termination(outcome: str) -> None:
+    """One provider terminate.  ``outcome``: ``drained`` (clean drain),
+    ``timeout`` (drain deadline expired, terminated anyway), ``direct``
+    (drain disabled), ``reclaimed`` (provider record for a node the
+    control plane declared dead), ``error``."""
+    counter(AUTOSCALER_TERMINATIONS_TOTAL, 1.0, {"outcome": outcome})
+
+
+def record_autoscaler_drain(outcome: str,
+                            duration_s: Optional[float] = None) -> None:
+    """Drain state-machine transitions (``started`` / ``drained`` /
+    ``timeout`` / ``cancelled``); resolved drains also record the
+    mark-to-terminate wall time."""
+    counter(AUTOSCALER_DRAINS_TOTAL, 1.0, {"outcome": outcome})
+    if duration_s is not None:
+        histogram(AUTOSCALER_DRAIN_DURATION_HIST, duration_s)
+
+
+def record_autoscaler_pending_demand(count: int) -> None:
+    gauge(AUTOSCALER_PENDING_DEMAND, float(count))
+
+
+def record_elastic_resize(direction: str) -> None:
+    """One elastic-trainer world-size crossover (``grow`` / ``shrink``)."""
+    counter(TRAIN_ELASTIC_RESIZES_TOTAL, 1.0, {"direction": direction})
 
 
 # ------------------------------------------ continuous-batching LLM serving

@@ -163,6 +163,14 @@ CP_FAILOVERS_TOTAL = "ray_tpu_cp_failovers_total"
 CP_JOURNAL_RECORDS_TOTAL = "ray_tpu_cp_journal_records_total"
 CP_JOURNAL_LAG_RECORDS = "ray_tpu_cp_journal_lag_records"
 
+# ------------------------------------------------ elastic capacity (PR 20)
+AUTOSCALER_LAUNCHES_TOTAL = "ray_tpu_autoscaler_launches_total"
+AUTOSCALER_TERMINATIONS_TOTAL = "ray_tpu_autoscaler_terminations_total"
+AUTOSCALER_DRAINS_TOTAL = "ray_tpu_autoscaler_drains_total"
+AUTOSCALER_PENDING_DEMAND = "ray_tpu_autoscaler_pending_demand"
+AUTOSCALER_DRAIN_DURATION_HIST = "ray_tpu_autoscaler_drain_duration_s"
+TRAIN_ELASTIC_RESIZES_TOTAL = "ray_tpu_train_elastic_resizes_total"
+
 # ------------------------------------------------- runtime self-diagnosis
 EXCEPTION_SUPPRESSED_TOTAL = "ray_tpu_exception_suppressed_total"
 DEBUG_LOCK_CYCLES_TOTAL = "ray_tpu_debug_lock_cycles_total"
@@ -382,6 +390,21 @@ METRICS: Dict[str, str] = {
                               "this leader",
     CP_JOURNAL_LAG_RECORDS: "worst standby replication lag in journal "
                             "records (gauge; leader-side view)",
+    AUTOSCALER_LAUNCHES_TOTAL: "autoscaler node launches, by node type and "
+                               "outcome (ok, error, backoff)",
+    AUTOSCALER_TERMINATIONS_TOTAL: "autoscaler node terminations, by "
+                                   "outcome (drained, timeout, direct, "
+                                   "reclaimed, error)",
+    AUTOSCALER_DRAINS_TOTAL: "drain state machines started/resolved, by "
+                             "outcome (started, drained, timeout, "
+                             "cancelled)",
+    AUTOSCALER_PENDING_DEMAND: "unmet resource demands feeding the "
+                               "scaling decision this round (gauge)",
+    AUTOSCALER_DRAIN_DURATION_HIST: "mark-unschedulable to provider-"
+                                    "terminate wall time per drained node "
+                                    "(histogram)",
+    TRAIN_ELASTIC_RESIZES_TOTAL: "elastic-trainer world-size crossovers, "
+                                 "by direction (grow, shrink)",
 }
 
 

@@ -301,3 +301,303 @@ class TestAutoscalerE2E:
             if provider is not None:
                 provider.shutdown()
             ray_tpu.shutdown()
+
+
+class TestSchedulerElasticEdges:
+    """PR-20 edge cases: draining exclusion, provisioning capacity,
+    queued task demand, demand summary, launch batch trim."""
+
+    def _node(self, pid="p0", avail=4.0, total=4.0, idle=0.0, alive=True,
+              draining=False):
+        return {
+            "alive": alive,
+            "total": {"CPU": total},
+            "available": {"CPU": avail},
+            "labels": {NODE_TYPE_LABEL: "cpu4", PROVIDER_ID_LABEL: pid},
+            "pending_demands": [],
+            "idle_s": idle,
+            "draining": draining,
+        }
+
+    def test_draining_node_excluded_from_packing(self):
+        # An empty draining node must not absorb demand — it is leaving.
+        d = compute_scaling_decision(
+            _state(nodes={"n0": self._node(draining=True)},
+                   pending_actors=[{"CPU": 2.0}]),
+            _cfg(),
+            {"p0": "cpu4"},
+        )
+        assert d.to_launch == {"cpu4": 1}
+
+    def test_draining_node_not_reselected_for_idle_terminate(self):
+        # The drain machine owns retirement; the idle scan must not list
+        # the node again (no repeated drain_node / terminate).
+        d = compute_scaling_decision(
+            _state(nodes={"n0": self._node(idle=100.0, draining=True)}),
+            _cfg(idle_timeout_s=10.0),
+            {"p0": "cpu4"},
+        )
+        assert d.to_terminate == []
+
+    def test_queued_task_demands_feed_packing(self):
+        # Over-quota task leases (admission queue) provision capacity.
+        state = _state()
+        state["queued_task_demands"] = [{"CPU": 2.0}, {"CPU": 2.0}]
+        d = compute_scaling_decision(state, _cfg(), {})
+        assert d.to_launch == {"cpu4": 1}
+        assert d.pending_demand == 2
+
+    def test_pending_demand_summary(self):
+        d = compute_scaling_decision(
+            _state(pending_actors=[{"CPU": 2.0}, {"CPU": 1.0}]), _cfg(), {}
+        )
+        assert d.pending_demand == 2
+        assert d.pending_resources == {"CPU": 3.0}
+
+    def test_provisioning_record_counts_as_capacity(self):
+        # A provider record whose node has not registered yet (slow boot)
+        # absorbs demand — the double-launch protection.
+        d = compute_scaling_decision(
+            _state(pending_actors=[{"CPU": 2.0}]), _cfg(), {"p0": "cpu4"}
+        )
+        assert d.to_launch == {}
+
+    def test_dead_registered_node_does_not_absorb_demand(self):
+        # A record the control plane KNOWS is dead is not capacity: the
+        # demand relaunches now; reclaim owns the stale record.
+        d = compute_scaling_decision(
+            _state(nodes={"n0": self._node(alive=False)},
+                   pending_actors=[{"CPU": 2.0}]),
+            _cfg(),
+            {"p0": "cpu4"},
+        )
+        assert d.to_launch == {"cpu4": 1}
+
+    def test_strict_spread_exclusive_on_planned_nodes(self):
+        # Spread bundles are conservatively exclusive in the simulation:
+        # they never share a planned node with anything placed this
+        # round (in either direction), so plain + 2 spread bundles plan
+        # three nodes.  Over-provisioning here is safe — the idle scan
+        # reclaims an extra node; a violated STRICT_SPREAD would not be.
+        d = compute_scaling_decision(
+            _state(
+                pending_actors=[{"CPU": 1.0}],
+                pending_pgs=[
+                    {"strategy": "STRICT_SPREAD",
+                     "bundles": [{"CPU": 1.0}, {"CPU": 1.0}]}
+                ],
+            ),
+            _cfg(),
+            {},
+        )
+        assert d.to_launch == {"cpu4": 3}
+        assert not d.infeasible
+
+    def test_max_launch_batch_trims(self):
+        cfg = _cfg(max_launch_batch=2)
+        d = compute_scaling_decision(
+            _state(pending_actors=[{"CPU": 4.0}] * 5), cfg, {}
+        )
+        assert sum(d.to_launch.values()) == 2
+
+    def test_global_max_workers_clamp(self):
+        cfg = _cfg(max_workers=1)
+        d = compute_scaling_decision(
+            _state(pending_actors=[{"CPU": 4.0}] * 3), cfg, {}
+        )
+        assert sum(d.to_launch.values()) == 1
+        assert len(d.infeasible) == 2
+
+
+class TestLaunchBackoff:
+    def test_gate_closes_on_failure_and_resets_on_success(self):
+        from ray_tpu.autoscaler.elastic import LaunchBackoff
+
+        b = LaunchBackoff(base_s=1.0, cap_s=30.0)
+        assert b.ready(now=0.0)
+        delay = b.record_failure(now=0.0)
+        assert 1.0 <= delay <= 30.0
+        assert b.consecutive_failures == 1
+        assert not b.ready(now=0.0)
+        assert b.remaining_s(now=0.0) == pytest.approx(delay)
+        assert b.ready(now=delay + 0.001)
+        b.record_success()
+        assert b.consecutive_failures == 0
+        assert b.ready(now=0.0)
+        assert b.remaining_s(now=0.0) == 0.0
+
+    def test_delays_jittered_and_capped(self):
+        from ray_tpu.autoscaler.elastic import LaunchBackoff
+
+        b = LaunchBackoff(base_s=0.5, cap_s=4.0)
+        delays = [b.record_failure(now=float(i)) for i in range(20)]
+        assert all(0.5 <= d <= 4.0 for d in delays)
+        assert b.consecutive_failures == 20
+        # Decorrelated jitter: not a constant schedule.
+        assert len({round(d, 6) for d in delays}) > 1
+
+
+class _StubCp:
+    """Scripted drain_status replies; records every control-plane call."""
+
+    def __init__(self, statuses=()):
+        self.statuses = list(statuses)
+        self.log = []
+
+    def __call__(self, method, payload=None, timeout=30.0):
+        self.log.append((method, dict(payload or {})))
+        if method == "drain_status":
+            if self.statuses:
+                return self.statuses.pop(0)
+            return {"known": True, "alive": True, "draining": True,
+                    "drained": False}
+        return {"ok": True}
+
+    def calls(self, method):
+        return [p for m, p in self.log if m == method]
+
+
+class _StubProvider:
+    def __init__(self, fail_next=0):
+        self.fail_next = fail_next
+        self.create_calls = 0
+        self.terminated = []
+        self._nodes = {}
+
+    def create_node(self, node_type):
+        self.create_calls += 1
+        if self.fail_next > 0:
+            self.fail_next -= 1
+            raise RuntimeError("stockout")
+        pid = f"stub-{self.create_calls}"
+        self._nodes[pid] = node_type.name
+        return pid
+
+    def terminate_node(self, pid):
+        self.terminated.append(pid)
+        self._nodes.pop(pid, None)
+
+    def non_terminated_nodes(self):
+        return dict(self._nodes)
+
+
+class TestNodeDrainer:
+    def _drainer(self, cp, timeout_s=60.0):
+        from ray_tpu.autoscaler.elastic import NodeDrainer
+
+        return NodeDrainer(cp, _StubProvider(), timeout_s=timeout_s)
+
+    def test_drained_node_terminated_and_retired(self):
+        cp = _StubCp(statuses=[
+            {"known": True, "alive": True, "draining": True,
+             "drained": True},
+        ])
+        d = self._drainer(cp)
+        d.request("p1", "aa" * 16, cause="test")
+        assert d.is_draining("p1")
+        assert len(cp.calls("drain_node")) == 1  # marked at request time
+        finished = d.poll()
+        assert finished == ["p1"]
+        assert not d.is_draining("p1")
+        assert d.stats["drained"] == 1
+        assert d._provider.terminated == ["p1"]
+        assert len(cp.calls("drain_complete")) == 1  # prompt retirement
+
+    def test_lost_mark_reissued_after_failover(self):
+        # drain_status says alive-and-not-draining: the control plane
+        # lost the (leader-memory) flag — the poll re-marks idempotently.
+        cp = _StubCp(statuses=[
+            {"known": True, "alive": True, "draining": False,
+             "drained": False},
+        ])
+        d = self._drainer(cp)
+        d.request("p1", "bb" * 16, cause="test")
+        d.poll()
+        assert len(cp.calls("drain_node")) == 2
+        assert d.is_draining("p1")  # still in flight
+
+    def test_timeout_terminates_anyway(self):
+        cp = _StubCp()  # forever draining, never drained
+        d = self._drainer(cp, timeout_s=0.0)
+        d.request("p1", "cc" * 16, cause="test")
+        assert d.poll() == ["p1"]
+        assert d.stats["timeout"] == 1
+        assert d._provider.terminated == ["p1"]
+
+    def test_unregistered_node_skips_mark(self):
+        # Crashed during provisioning: no control-plane id to mark; the
+        # timeout path terminates the provider record.
+        cp = _StubCp()
+        d = self._drainer(cp, timeout_s=0.0)
+        d.request("p1", None, cause="never registered")
+        assert cp.calls("drain_node") == []
+        assert d.poll() == ["p1"]
+        assert cp.calls("drain_complete") == []
+        assert d.stats["timeout"] == 1
+
+    def test_cancel_reopens_node(self):
+        cp = _StubCp()
+        d = self._drainer(cp)
+        d.request("p1", "dd" * 16, cause="test")
+        d.cancel("p1")
+        assert not d.is_draining("p1")
+        assert d.stats["cancelled"] == 1
+        cancels = [p for p in cp.calls("drain_node") if p.get("cancel")]
+        assert len(cancels) == 1
+        assert d._provider.terminated == []
+
+
+class TestAutoscalerBackoffGating:
+    """The reconcile loop against a failing provider — no cluster needed:
+    load state and status publishing are stubbed, the launch path is
+    real."""
+
+    def _scaler(self, provider, monkeypatch, **cfg_kw):
+        defaults = dict(
+            node_types={
+                "worker4": NodeTypeConfig("worker4", {"CPU": 4.0},
+                                          max_workers=2)
+            },
+            launch_backoff_base_s=0.2,
+            launch_backoff_cap_s=0.4,
+        )
+        defaults.update(cfg_kw)
+        scaler = Autoscaler(
+            AutoscalingConfig(**defaults), provider, "stub:0"
+        )
+        monkeypatch.setattr(
+            scaler, "_get_load_state",
+            lambda: _state(pending_actors=[{"CPU": 4.0}]),
+        )
+        monkeypatch.setattr(scaler, "_publish_status", lambda d: None)
+        return scaler
+
+    def test_failures_gate_launches_then_recover(self, monkeypatch):
+        provider = _StubProvider(fail_next=2)
+        scaler = self._scaler(provider, monkeypatch)
+
+        d1 = scaler.update()
+        assert provider.create_calls == 1
+        assert d1.launch_failures == {"worker4": 1}
+        assert d1.backoff_remaining_s.get("worker4", 0.0) > 0.0
+
+        # Immediate re-runs must NOT hit the provider: the gate is closed.
+        for _ in range(5):
+            scaler.update()
+        assert provider.create_calls == 1
+
+        time.sleep(0.45)  # past the 0.4s cap
+        d3 = scaler.update()
+        assert provider.create_calls == 2
+        assert d3.launch_failures == {"worker4": 2}
+
+        time.sleep(0.45)
+        d4 = scaler.update()  # third create succeeds
+        assert provider.create_calls == 3
+        assert d4.launch_failures == {}
+        assert d4.backoff_remaining_s == {}
+
+        # The new record is planned capacity: no further launches while
+        # the (stub) node "boots".
+        scaler.update()
+        assert provider.create_calls == 3
